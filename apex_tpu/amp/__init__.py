@@ -1,0 +1,41 @@
+"""Mixed precision engine (ref: apex/amp).
+
+Opt levels O0-O5 as explicit precision policies, a functional dynamic
+LossScaler, and function-level cast decorators. See `frontend.py` for the
+design mapping from the reference's monkey-patching architecture.
+"""
+
+from apex_tpu.amp.frontend import (
+    OPT_LEVELS,
+    AmpState,
+    Properties,
+    initialize,
+    load_state_dict,
+    make_scaler,
+    state_dict,
+)
+from apex_tpu.amp.functional import (
+    bfloat16_function,
+    compute_cast,
+    float_function,
+    half_function,
+    promote_function,
+)
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+
+__all__ = [
+    "OPT_LEVELS",
+    "AmpState",
+    "Properties",
+    "initialize",
+    "state_dict",
+    "load_state_dict",
+    "make_scaler",
+    "LossScaler",
+    "ScalerState",
+    "half_function",
+    "bfloat16_function",
+    "float_function",
+    "promote_function",
+    "compute_cast",
+]

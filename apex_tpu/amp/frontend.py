@@ -1,0 +1,206 @@
+"""amp opt levels and initialize — precision policies, not monkey-patches.
+
+The reference configures mixed precision through opt levels O0-O5
+(ref: apex/amp/frontend.py:119-255) implemented by patching torch
+namespaces and optimizer methods. The TPU-native design keeps the same
+user-facing opt-level semantics as explicit *policies*:
+
+  O0  fp32 everywhere, no scaling                  (frontend.py:119-135)
+  O1  mixed: whitelist ops in fp16, dynamic scale  (frontend.py:137-160)
+  O2  cast model fp16, fp32 master, dynamic scale  (frontend.py:162-186)
+  O3  pure fp16                                    (frontend.py:188-206)
+  O4  mixed bf16, no loss scaling                  (frontend.py:208-226, fork-only)
+  O5  cast model bf16, fp32 master                 (frontend.py:228-247, fork-only)
+
+O4/O5 are the natural TPU modes. "Patching functions" becomes a compute
+dtype applied at module boundaries (`Policy.compute_dtype` consumed by
+apex_tpu layers and the `half_function`-style decorators in
+`apex_tpu.amp.functional`); "casting the model" becomes casting the
+param pytree with batchnorm params optionally kept fp32.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Any, Callable, Dict, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+
+# parameters that stay fp32 when keep_batchnorm_fp32 is set; matched
+# against the '/'-joined pytree path (flax naming: BatchNorm_0, bn, ...)
+_BN_PATTERN = re.compile(r"(batch_?norm|(^|[/_])bn\d*([/_]|$)|group_?norm)", re.I)
+
+
+@dataclasses.dataclass(frozen=True)
+class Properties:
+    """Validated option struct (ref: apex/amp/frontend.py:8-114)."""
+
+    opt_level: str
+    cast_model_type: Optional[Any]      # dtype params are cast to (O2/O3/O5)
+    compute_dtype: Optional[Any]        # dtype whitelist ops run in (O1/O4)
+    keep_batchnorm_fp32: bool
+    master_weights: bool
+    loss_scale: Any                     # "dynamic" | float | None
+
+    def __post_init__(self):
+        if self.cast_model_type is not None and self.compute_dtype is not None:
+            raise ValueError(
+                "cast_model_type and compute_dtype are mutually exclusive "
+                "(patch-style vs cast-style opt levels)"
+            )
+
+
+def _props(opt_level, cast=None, compute=None, keep_bn=False, master=False,
+           loss_scale=None) -> Properties:
+    return Properties(
+        opt_level=opt_level, cast_model_type=cast, compute_dtype=compute,
+        keep_batchnorm_fp32=keep_bn, master_weights=master,
+        loss_scale=loss_scale,
+    )
+
+
+OPT_LEVELS: Dict[str, Properties] = {
+    "O0": _props("O0"),
+    "O1": _props("O1", compute=jnp.float16, loss_scale="dynamic"),
+    "O2": _props("O2", cast=jnp.float16, keep_bn=True, master=True,
+                 loss_scale="dynamic"),
+    "O3": _props("O3", cast=jnp.float16),
+    "O4": _props("O4", compute=jnp.bfloat16),
+    "O5": _props("O5", cast=jnp.bfloat16, keep_bn=True, master=True),
+}
+
+
+class AmpState(NamedTuple):
+    """Carried amp state: one ScalerState per loss
+    (ref: apex/amp/_initialize.py:229-233 creates num_losses scalers)."""
+
+    properties: Properties            # static
+    scalers: Tuple[ScalerState, ...]
+
+
+# registered static so AmpState is a pytree with only scaler leaves
+jax.tree_util.register_static(Properties)
+
+
+def _cast_params(params: Any, dtype, keep_batchnorm_fp32: bool) -> Any:
+    """Cast a param pytree, optionally keeping norm params fp32
+    (ref: apex/amp/_initialize.py:178-184 convert_network)."""
+
+    def cast(path, leaf):
+        if not isinstance(leaf, (jax.Array, jnp.ndarray)) or not jnp.issubdtype(
+            leaf.dtype, jnp.floating
+        ):
+            return leaf
+        if keep_batchnorm_fp32:
+            name = "/".join(str(getattr(k, "key", getattr(k, "name", k))) for k in path)
+            if _BN_PATTERN.search(name):
+                return leaf.astype(jnp.float32)
+        return leaf.astype(dtype)
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def initialize(
+    params: Any,
+    optimizers=None,
+    opt_level: str = "O1",
+    num_losses: int = 1,
+    cast_model_type=None,
+    keep_batchnorm_fp32=None,
+    master_weights=None,
+    loss_scale=None,
+    min_loss_scale=None,
+    max_loss_scale=2.0 ** 24,
+):
+    """Configure mixed precision (ref: apex/amp/frontend.py:259-431).
+
+    Returns ``(cast_params, amp_state)`` — and, if ``optimizers`` is
+    given (a FlatFusedOptimizer or list), their states initialized from
+    the *fp32 master view* appended: ``(params, opt_states, amp_state)``.
+
+    Unlike the reference there is nothing to patch: the returned params
+    are the cast pytree, and `amp_state.scalers` carry the loss scales
+    through the training loop functionally.
+    """
+    if opt_level not in OPT_LEVELS:
+        raise ValueError(f"Unexpected opt_level {opt_level!r}; expected O0..O5")
+    base = OPT_LEVELS[opt_level]
+    props = Properties(
+        opt_level=opt_level,
+        cast_model_type=cast_model_type if cast_model_type is not None else base.cast_model_type,
+        compute_dtype=base.compute_dtype,
+        keep_batchnorm_fp32=(
+            keep_batchnorm_fp32 if keep_batchnorm_fp32 is not None else base.keep_batchnorm_fp32
+        ),
+        master_weights=master_weights if master_weights is not None else base.master_weights,
+        loss_scale=loss_scale if loss_scale is not None else base.loss_scale,
+    )
+
+    cast_params = params
+    if props.cast_model_type is not None:
+        cast_params = _cast_params(
+            params, props.cast_model_type, props.keep_batchnorm_fp32
+        )
+
+    scaler = make_scaler(props, min_loss_scale=min_loss_scale,
+                         max_loss_scale=max_loss_scale)
+    amp_state = AmpState(
+        properties=props,
+        scalers=tuple(scaler.init() for _ in range(num_losses)),
+    )
+
+    if optimizers is None:
+        return cast_params, amp_state
+    single = not isinstance(optimizers, (list, tuple))
+    opts = [optimizers] if single else list(optimizers)
+    # master weights are created from the ORIGINAL fp32 params, exactly as
+    # the reference stashes fp32 masters before the model cast
+    # (apex/amp/_process_optimizer.py:28-90)
+    opt_states = [o.init(params) for o in opts]
+    return cast_params, (opt_states[0] if single else opt_states), amp_state
+
+
+def make_scaler(props: Properties, min_loss_scale=None,
+                max_loss_scale=2.0 ** 24) -> LossScaler:
+    """Build the LossScaler implied by a Properties object."""
+    if props.loss_scale is None:
+        return LossScaler(loss_scale=1.0)
+    return LossScaler(
+        loss_scale=props.loss_scale,
+        min_loss_scale=min_loss_scale,
+        max_loss_scale=max_loss_scale,
+    )
+
+
+# -- scaler state (de)serialization (ref: apex/amp/frontend.py:434-473) ----
+
+
+def state_dict(amp_state: AmpState) -> Dict[str, Any]:
+    return {
+        f"loss_scaler{i}": {
+            "loss_scale": float(s.loss_scale),
+            "unskipped": int(s.unskipped),
+        }
+        for i, s in enumerate(amp_state.scalers)
+    }
+
+
+def load_state_dict(amp_state: AmpState, d: Dict[str, Any]) -> AmpState:
+    scalers = []
+    for i, s in enumerate(amp_state.scalers):
+        key = f"loss_scaler{i}"
+        if key in d:
+            scalers.append(
+                ScalerState(
+                    loss_scale=jnp.asarray(d[key]["loss_scale"], jnp.float32),
+                    unskipped=jnp.asarray(d[key]["unskipped"], jnp.int32),
+                    found_inf=jnp.zeros((), jnp.float32),
+                )
+            )
+        else:
+            scalers.append(s)
+    return AmpState(properties=amp_state.properties, scalers=tuple(scalers))

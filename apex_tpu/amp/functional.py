@@ -1,0 +1,88 @@
+"""Function-level precision control.
+
+The reference patches torch namespaces with cast wrappers driven by
+FP16/BF16 whitelists and FP32 blacklists (ref: apex/amp/amp.py:75-198,
+apex/amp/wrap.py:10-286, apex/amp/lists/functional_overrides.py:18-92).
+JAX functions cannot (and should not) be monkey-patched; the equivalent
+control points are explicit decorators applied where a function is
+*defined or used*, with the same names as the reference's registration
+API (ref: apex/amp/amp.py:29-44).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+
+def _cast_floats(tree: Any, dtype) -> Any:
+    def cast(x):
+        if isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
+            jnp.asarray(x).dtype, jnp.floating
+        ):
+            return jnp.asarray(x).astype(dtype)
+        return x
+
+    return jax.tree.map(cast, tree)
+
+
+def _cast_function(fn: Callable, dtype) -> Callable:
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        args = _cast_floats(args, dtype)
+        kwargs = _cast_floats(kwargs, dtype)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def half_function(fn: Callable) -> Callable:
+    """Run ``fn`` with float16 inputs (ref: apex/amp/amp.py:29-31)."""
+    return _cast_function(fn, jnp.float16)
+
+
+def bfloat16_function(fn: Callable) -> Callable:
+    """Run ``fn`` with bfloat16 inputs (ref fork: apex/amp/amp.py:33-35)."""
+    return _cast_function(fn, jnp.bfloat16)
+
+
+def float_function(fn: Callable) -> Callable:
+    """Run ``fn`` with float32 inputs (ref: apex/amp/amp.py:37-39)."""
+    return _cast_function(fn, jnp.float32)
+
+
+def promote_function(fn: Callable) -> Callable:
+    """Run ``fn`` with all float args promoted to the widest float dtype
+    among them (ref: apex/amp/wrap.py promote/sequence_promote)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        leaves = [
+            l
+            for l in jax.tree.leaves((args, kwargs))
+            if isinstance(l, (jax.Array, jnp.ndarray))
+            and jnp.issubdtype(jnp.asarray(l).dtype, jnp.floating)
+        ]
+        if not leaves:
+            return fn(*args, **kwargs)
+        widest = jnp.result_type(*[l.dtype for l in leaves])
+        return fn(*_cast_floats(args, widest), **_cast_floats(kwargs, widest))
+
+    return wrapper
+
+
+def compute_cast(fn: Callable, compute_dtype) -> Callable:
+    """Cast inputs to ``compute_dtype`` and outputs back to fp32 — the
+    O1/O4 'patched forward' behavior at one boundary
+    (ref: apex/amp/_initialize.py:196-203 patches model.forward)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        out = fn(*_cast_floats(args, compute_dtype),
+                 **_cast_floats(kwargs, compute_dtype))
+        return _cast_floats(out, jnp.float32)
+
+    return wrapper

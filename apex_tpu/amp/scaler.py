@@ -1,0 +1,121 @@
+"""Functional loss scaler.
+
+TPU re-design of the reference's LossScaler (ref: apex/amp/scaler.py:42-226):
+static or dynamic scaling with the exact dynamic schedule — init 2^16,
+x2 every 2000 unskipped steps, /2 on overflow, clamped — but expressed as
+a carried ``ScalerState`` updated with ``jnp.where``/``lax.cond`` inside
+jit, instead of a Python-side object that patches ``optimizer.step``
+(ref: apex/amp/handle.py:127-154). A skipped step is the caller gating
+the optimizer update on ``found_inf`` (see FlatFusedOptimizer.step's
+``skip_if_nonfinite``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class ScalerState(NamedTuple):
+    """Carried loss-scale state (a valid pytree; jit/scan friendly)."""
+
+    loss_scale: jax.Array     # f32
+    unskipped: jax.Array      # i32 consecutive unskipped steps
+    found_inf: jax.Array      # f32 {0,1} from the last update
+
+
+class LossScaler:
+    """Static or dynamic loss scaler.
+
+    ``loss_scale="dynamic"`` reproduces the reference's schedule
+    (apex/amp/scaler.py:14-18,206-226): start at 2^16, halve on overflow
+    (floored at ``min_loss_scale``), double after ``scale_window``
+    consecutive good steps (capped at ``max_loss_scale``, default 2^24).
+    """
+
+    def __init__(
+        self,
+        loss_scale="dynamic",
+        init_scale: float = 2.0 ** 16,
+        scale_factor: float = 2.0,
+        scale_window: int = 2000,
+        min_loss_scale: Optional[float] = None,
+        max_loss_scale: float = 2.0 ** 24,
+    ):
+        self.dynamic = loss_scale == "dynamic"
+        self._static_scale = 1.0 if self.dynamic else float(loss_scale)
+        self.init_scale = init_scale if self.dynamic else self._static_scale
+        self.scale_factor = scale_factor
+        self.scale_window = scale_window
+        self.min_loss_scale = min_loss_scale
+        self.max_loss_scale = max_loss_scale
+
+    def init(self) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.asarray(self.init_scale, jnp.float32),
+            unskipped=jnp.zeros((), jnp.int32),
+            found_inf=jnp.zeros((), jnp.float32),
+        )
+
+    # -- hot-loop ops ------------------------------------------------------
+
+    def scale_loss(self, loss: jax.Array, state: ScalerState) -> jax.Array:
+        """yield loss.float() * scale (ref: apex/amp/handle.py:113)."""
+        return loss.astype(jnp.float32) * state.loss_scale
+
+    def unscale(self, grads: Any, state: ScalerState) -> Tuple[Any, jax.Array]:
+        """Unscale a grad pytree and report found_inf.
+
+        The fused-buffer path (ref multi_tensor_scale unscaling,
+        apex/amp/scaler.py:123-126) lives in the fused optimizers'
+        ``grad_scale`` argument; this tree version serves unfused loops.
+        """
+        inv = 1.0 / state.loss_scale
+        unscaled = jax.tree.map(lambda g: (g.astype(jnp.float32) * inv).astype(g.dtype), grads)
+        leaves = jax.tree.leaves(unscaled)
+        finite = jnp.bool_(True)
+        for l in leaves:
+            finite = jnp.logical_and(finite, jnp.all(jnp.isfinite(l)))
+        return unscaled, jnp.where(finite, 0.0, 1.0).astype(jnp.float32)
+
+    def update(self, state: ScalerState, found_inf: jax.Array) -> ScalerState:
+        """Advance scale state after a step attempt
+        (ref: apex/amp/scaler.py:206-226)."""
+        found_inf = jnp.asarray(found_inf, jnp.float32)
+        if not self.dynamic:
+            return ScalerState(
+                loss_scale=state.loss_scale,
+                unskipped=state.unskipped + jnp.where(found_inf > 0, 0, 1).astype(jnp.int32),
+                found_inf=found_inf,
+            )
+        overflow = found_inf > 0
+        backed_off = state.loss_scale / self.scale_factor
+        if self.min_loss_scale is not None:
+            backed_off = jnp.maximum(backed_off, self.min_loss_scale)
+        unskipped = jnp.where(overflow, 0, state.unskipped + 1)
+        grow = unskipped >= self.scale_window
+        grown = jnp.minimum(state.loss_scale * self.scale_factor, self.max_loss_scale)
+        new_scale = jnp.where(overflow, backed_off, jnp.where(grow, grown, state.loss_scale))
+        unskipped = jnp.where(grow & ~overflow, 0, unskipped)
+        return ScalerState(
+            loss_scale=new_scale.astype(jnp.float32),
+            unskipped=unskipped.astype(jnp.int32),
+            found_inf=found_inf,
+        )
+
+    # -- (de)serialization: ref apex/amp/frontend.py:434-473 ---------------
+
+    def state_dict(self, state: ScalerState) -> Dict[str, Any]:
+        return {
+            "loss_scale": float(state.loss_scale),
+            "unskipped": int(state.unskipped),
+        }
+
+    def load_state_dict(self, d: Dict[str, Any]) -> ScalerState:
+        return ScalerState(
+            loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
+            unskipped=jnp.asarray(d["unskipped"], jnp.int32),
+            found_inf=jnp.zeros((), jnp.float32),
+        )

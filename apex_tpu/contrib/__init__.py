@@ -1,0 +1,1 @@
+"""Placeholder — populated as the build progresses."""

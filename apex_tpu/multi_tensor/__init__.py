@@ -1,0 +1,48 @@
+"""Fused update engine — TPU re-design of apex's multi_tensor_apply.
+
+Public surface (ref: apex/multi_tensor_apply/__init__.py + csrc/amp_C):
+
+- `FlatSpace` — static layout packing a pytree into one aligned flat
+  buffer (replaces device-side tensor-pointer tables).
+- `fused_elementwise` — the generic one-kernel-over-all-tensors engine.
+- op table: `multi_tensor_scale`, `multi_tensor_axpby`,
+  `multi_tensor_l2norm`, `per_tensor_l2norm`, `fused_adam_update`,
+  `fused_adagrad_update`, `fused_sgd_update`, `fused_lamb_update`,
+  `fused_novograd_update`, `fused_lars_update`.
+"""
+
+from apex_tpu.multi_tensor.flat_buffer import DEFAULT_ALIGN, FlatSpace, pack_like
+from apex_tpu.multi_tensor.engine import (
+    fused_elementwise,
+    fused_sumsq_partials,
+)
+from apex_tpu.multi_tensor.ops import (
+    fused_adagrad_update,
+    fused_adam_update,
+    fused_lamb_update,
+    fused_lars_update,
+    fused_novograd_update,
+    fused_sgd_update,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+    per_tensor_l2norm,
+)
+
+__all__ = [
+    "DEFAULT_ALIGN",
+    "FlatSpace",
+    "pack_like",
+    "fused_elementwise",
+    "fused_sumsq_partials",
+    "multi_tensor_scale",
+    "multi_tensor_axpby",
+    "multi_tensor_l2norm",
+    "per_tensor_l2norm",
+    "fused_adam_update",
+    "fused_adagrad_update",
+    "fused_sgd_update",
+    "fused_lamb_update",
+    "fused_novograd_update",
+    "fused_lars_update",
+]

@@ -1,0 +1,253 @@
+"""Generic fused element-wise engine over flat buffers.
+
+TPU re-design of the reference's multi-tensor-apply machinery
+(ref: csrc/multi_tensor_apply.cuh:44-147 launcher, csrc/amp_C_frontend.cpp
+op table). One Pallas kernel sweeps lane-aligned tiles of a flat buffer;
+the per-op functor is a Python callable traced into the kernel, so every
+fused optimizer/scaler op is a few lines. Per-tensor scalars (LAMB trust
+ratios, LARS coefficients, per-tensor norms) ride in via scalar prefetch
+plus a static tile->leaf map, replacing the reference's device-side
+pointer/chunk tables.
+
+The `found_inf` output replaces the reference's ``noop_flag`` convention
+(ref: csrc/multi_tensor_scale_kernel.cu:47-70): kernels *report* non-finite
+values; skip-step gating happens functionally in the loss scaler
+(`apex_tpu.amp.scaler`) via `lax.cond`/`jnp.where`, never by patching.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu._backend import interpret_flag, resolve_impl
+
+LANES = 128
+# 512 rows x 128 lanes = 65536 elements per tile, matching the reference's
+# large multi-tensor chunk size (ref: apex/multi_tensor_apply/__init__.py:4).
+DEFAULT_TILE_ROWS = 512
+# Per-tensor ops use the alignment-sized tile so a tile never straddles
+# a leaf (see FlatSpace.tile_leaf_ids).
+PER_TENSOR_TILE_ROWS = 16
+
+
+def _pad_to(buf: jax.Array, n: int) -> jax.Array:
+    if buf.shape[0] == n:
+        return buf
+    return jnp.pad(buf, (0, n - buf.shape[0]))
+
+
+def fused_elementwise(
+    fn: Callable,
+    inputs: Sequence[jax.Array],
+    *,
+    scalars: Sequence = (),
+    num_outputs: int = 1,
+    out_dtypes: Optional[Sequence] = None,
+    check_finite: Sequence[int] = (),
+    tile_ids: Optional[np.ndarray] = None,
+    per_tensor: Sequence[jax.Array] = (),
+    impl: Optional[str] = None,
+    tile_rows: Optional[int] = None,
+):
+    """Run ``fn`` element-wise over 1-D buffers in one fused kernel.
+
+    fn(ins, scalars, tensor_scalars) -> list of output arrays, where
+    ``ins`` are same-shape blocks, ``scalars`` are 0-d values and
+    ``tensor_scalars`` are values broadcastable against the blocks
+    (per-tensor values resolved through ``tile_ids``).
+
+    Returns ``(outputs, found_inf)`` where ``found_inf`` is a float32
+    scalar in {0, 1} covering the ``check_finite`` input indices.
+    """
+    impl = resolve_impl(impl)
+    n = inputs[0].shape[0]
+    for b in inputs:
+        assert b.ndim == 1 and b.shape[0] == n, "flat buffers must be same-length 1-D"
+    if out_dtypes is None:
+        out_dtypes = [inputs[0].dtype] * num_outputs
+
+    if tile_rows is None:
+        tile_rows = PER_TENSOR_TILE_ROWS if tile_ids is not None else DEFAULT_TILE_ROWS
+    tile = tile_rows * LANES
+
+    scalars = [jnp.asarray(s, jnp.float32) for s in scalars]
+
+    if impl == "xla":
+        return _fused_elementwise_xla(
+            fn, inputs, scalars, num_outputs, out_dtypes, check_finite,
+            tile_ids, per_tensor, tile,
+        )
+
+    padded_n = ((n + tile - 1) // tile) * tile
+    bufs = [_pad_to(b, padded_n) for b in inputs]
+    num_tiles = padded_n // tile
+    if tile_ids is not None:
+        tile_ids = np.asarray(tile_ids, np.int32)
+        if tile_ids.shape[0] * tile != padded_n:
+            # pad map for the trailing partial tile (maps to last leaf)
+            extra = padded_n // tile - tile_ids.shape[0]
+            tile_ids = np.concatenate([tile_ids, np.full(extra, tile_ids[-1] if len(tile_ids) else 0, np.int32)])
+
+    n_in = len(bufs)
+    n_pt = len(per_tensor)
+    has_ids = tile_ids is not None
+
+    def kernel(*refs):
+        # prefetch refs: scalars_ref, [ids_ref], per_tensor refs...
+        k = 0
+        scalar_ref = refs[k]; k += 1
+        ids_ref = None
+        if has_ids:
+            ids_ref = refs[k]; k += 1
+        pt_refs = refs[k : k + n_pt]; k += n_pt
+        in_refs = refs[k : k + n_in]; k += n_in
+        out_refs = refs[k : k + num_outputs]; k += num_outputs
+        found_ref = refs[k]
+
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _():
+            found_ref[0, 0] = jnp.float32(0.0)
+
+        svals = [scalar_ref[j] for j in range(len(scalars))]
+        if has_ids:
+            tid = ids_ref[i]
+            tvals = [r[tid] for r in pt_refs]
+        else:
+            tvals = [r[0] for r in pt_refs]
+
+        ins = [r[...] for r in in_refs]
+        if check_finite:
+            ok = jnp.bool_(True)
+            for idx in check_finite:
+                ok = jnp.logical_and(ok, jnp.all(jnp.isfinite(ins[idx])))
+            found_ref[0, 0] = jnp.maximum(
+                found_ref[0, 0], jnp.where(ok, 0.0, 1.0).astype(jnp.float32)
+            )
+        outs = fn(ins, svals, tvals)
+        for r, o in zip(out_refs, outs):
+            r[...] = o.astype(r.dtype)
+
+    # index maps receive (grid idx, *prefetch refs) under PrefetchScalarGridSpec
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1 + (1 if has_ids else 0) + n_pt,
+        grid=(num_tiles,),
+        in_specs=[
+            pl.BlockSpec(
+                (tile_rows, LANES), lambda i, *_: (i, 0), memory_space=pltpu.VMEM
+            )
+            for _ in range(n_in)
+        ],
+        out_specs=(
+            [
+                pl.BlockSpec(
+                    (tile_rows, LANES), lambda i, *_: (i, 0), memory_space=pltpu.VMEM
+                )
+                for _ in range(num_outputs)
+            ]
+            + [pl.BlockSpec((1, 1), lambda i, *_: (0, 0), memory_space=pltpu.SMEM)]
+        ),
+    )
+
+    scalar_arg = (
+        jnp.stack(scalars) if scalars else jnp.zeros((1,), jnp.float32)
+    )
+    prefetch = [scalar_arg]
+    if has_ids:
+        prefetch.append(jnp.asarray(tile_ids))
+    prefetch.extend(jnp.asarray(p, jnp.float32) for p in per_tensor)
+
+    out_shapes = [
+        jax.ShapeDtypeStruct((padded_n // LANES, LANES), dt) for dt in out_dtypes
+    ] + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+
+    results = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shapes,
+        interpret=interpret_flag(impl),
+    )(*prefetch, *[b.reshape(padded_n // LANES, LANES) for b in bufs])
+
+    outs = [r.reshape(padded_n)[:n] for r in results[:num_outputs]]
+    found = results[num_outputs][0, 0]
+    return outs, found
+
+
+def _fused_elementwise_xla(
+    fn, inputs, scalars, num_outputs, out_dtypes, check_finite,
+    tile_ids, per_tensor, tile,
+):
+    """Pure-XLA reference path (CPU tests, simulated meshes)."""
+    n = inputs[0].shape[0]
+    if tile_ids is not None:
+        padded_n = tile_ids.shape[0] * tile
+        bufs = [_pad_to(b, padded_n).reshape(-1, tile) for b in inputs]
+        ids = jnp.asarray(tile_ids)
+        tvals = [jnp.asarray(p, jnp.float32)[ids][:, None] for p in per_tensor]
+    else:
+        bufs = list(inputs)
+        tvals = [jnp.asarray(p, jnp.float32) for p in per_tensor]
+    found = jnp.float32(0.0)
+    for idx in check_finite:
+        found = jnp.maximum(
+            found, jnp.where(jnp.all(jnp.isfinite(bufs[idx])), 0.0, 1.0)
+        )
+    outs = fn(bufs, scalars, tvals)
+    outs = [
+        o.reshape(-1)[:n].astype(dt) if tile_ids is not None else o.astype(dt)
+        for o, dt in zip(outs, out_dtypes)
+    ]
+    return outs, found
+
+
+# ---------------------------------------------------------------------------
+# Fused L2-norm (per-buffer and per-tensor partials)
+# ---------------------------------------------------------------------------
+
+
+def fused_sumsq_partials(
+    buf: jax.Array,
+    *,
+    impl: Optional[str] = None,
+    tile_rows: int = PER_TENSOR_TILE_ROWS,
+) -> jax.Array:
+    """Per-tile partial sums of squares over a flat buffer.
+
+    TPU analog of the two-phase reduction in
+    ref: csrc/multi_tensor_l2norm_kernel.cu (per-chunk partials + cleanup):
+    the kernel emits one fp32 partial per tile; the tiny finishing
+    reduction (global sum or per-tensor segment-sum) runs in XLA.
+    """
+    impl = resolve_impl(impl)
+    tile = tile_rows * LANES
+    n = buf.shape[0]
+    padded_n = ((n + tile - 1) // tile) * tile
+    num_tiles = padded_n // tile
+    if impl == "xla":
+        x = _pad_to(buf, padded_n).astype(jnp.float32).reshape(num_tiles, tile)
+        return jnp.sum(x * x, axis=1)
+
+    def kernel(in_ref, out_ref):
+        i = pl.program_id(0)
+        x = in_ref[...].astype(jnp.float32)
+        out_ref[0, 0] = jnp.sum(x * x)
+        del i
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(num_tiles,),
+        in_specs=[
+            pl.BlockSpec((tile_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
+        out_shape=jax.ShapeDtypeStruct((num_tiles, 1), jnp.float32),
+        interpret=interpret_flag(impl),
+    )(_pad_to(buf, padded_n).reshape(padded_n // LANES, LANES))
+    return out[:, 0]

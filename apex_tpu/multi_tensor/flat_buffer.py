@@ -1,0 +1,141 @@
+"""Flat parameter-space machinery.
+
+The reference packs lists of tensor pointers into kernel-arg structs and
+iterates chunks on-device (ref: csrc/multi_tensor_apply.cuh:16-147,
+apex/multi_tensor_apply/multi_tensor_apply.py:3-30). On TPU the equivalent
+is a *flat parameter space*: a pytree of arrays is packed into one 1-D
+buffer (each leaf padded to a fixed alignment), fused Pallas kernels run
+over the whole buffer in lane-aligned tiles, and per-tensor semantics
+(LAMB trust ratios, per-tensor L2 norms) come from a static tile->leaf map
+instead of device-side pointer tables.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Default per-leaf alignment in elements. 2048 = (16 sublanes x 128 lanes),
+# the minimum bf16 tile, so any tile size that divides the alignment never
+# straddles a leaf boundary for fp32 or bf16 buffers.
+DEFAULT_ALIGN = 2048
+
+
+def _round_up(x: int, m: int) -> int:
+    return ((x + m - 1) // m) * m
+
+
+@jax.tree_util.register_static
+@dataclasses.dataclass(frozen=True)
+class FlatSpace:
+    """Static layout of a pytree flattened into one aligned 1-D buffer."""
+
+    treedef: Any
+    shapes: tuple[tuple[int, ...], ...]
+    dtypes: tuple[Any, ...]
+    offsets: tuple[int, ...]
+    sizes: tuple[int, ...]
+    padded_sizes: tuple[int, ...]
+    total: int
+    align: int
+
+    @classmethod
+    def create(cls, tree: Any, align: int = DEFAULT_ALIGN) -> "FlatSpace":
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        shapes, dtypes, offsets, sizes, padded = [], [], [], [], []
+        off = 0
+        for leaf in leaves:
+            size = int(np.prod(leaf.shape)) if leaf.shape else 1
+            psize = _round_up(max(size, 1), align)
+            shapes.append(tuple(leaf.shape))
+            dtypes.append(jnp.dtype(leaf.dtype))
+            offsets.append(off)
+            sizes.append(size)
+            padded.append(psize)
+            off += psize
+        return cls(
+            treedef=treedef,
+            shapes=tuple(shapes),
+            dtypes=tuple(dtypes),
+            offsets=tuple(offsets),
+            sizes=tuple(sizes),
+            padded_sizes=tuple(padded),
+            total=off,
+            align=align,
+        )
+
+    # -- packing -----------------------------------------------------------
+
+    @property
+    def num_leaves(self) -> int:
+        return len(self.shapes)
+
+    def pack(self, tree: Any, dtype: Optional[Any] = None) -> jax.Array:
+        """Flatten ``tree`` into one 1-D buffer, optionally casting leaves.
+
+        Padding elements are zero — harmless for every fused op in this
+        package (updates of zero state stay zero; norms add zero).
+        """
+        leaves = self.treedef.flatten_up_to(tree)
+        dt = jnp.dtype(dtype) if dtype is not None else None
+        parts = []
+        for leaf, size, psize in zip(leaves, self.sizes, self.padded_sizes):
+            flat = jnp.ravel(leaf)
+            if dt is not None:
+                flat = flat.astype(dt)
+            if psize != size:
+                flat = jnp.pad(flat, (0, psize - size))
+            parts.append(flat)
+        return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+    def unpack(self, buf: jax.Array, dtype: str = "original") -> Any:
+        """Inverse of :meth:`pack`.
+
+        ``dtype='original'`` casts each leaf back to its recorded dtype;
+        ``dtype='buffer'`` keeps the buffer dtype (e.g. fp32 master values).
+        """
+        leaves = []
+        for shape, ldt, off, size in zip(
+            self.shapes, self.dtypes, self.offsets, self.sizes
+        ):
+            leaf = jax.lax.slice(buf, (off,), (off + size,)).reshape(shape)
+            if dtype == "original":
+                leaf = leaf.astype(ldt)
+            leaves.append(leaf)
+        return self.treedef.unflatten(leaves)
+
+    def zeros(self, dtype=jnp.float32) -> jax.Array:
+        return jnp.zeros((self.total,), dtype=dtype)
+
+    # -- per-tensor maps ---------------------------------------------------
+
+    def tile_leaf_ids(self, tile_elems: int) -> np.ndarray:
+        """Static int32 map from tile index -> leaf index.
+
+        Requires the alignment to be a multiple of ``tile_elems`` so no
+        tile straddles two leaves (the TPU analog of the reference's
+        block->(tensor, chunk) table, csrc/multi_tensor_apply.cuh:98-116).
+        """
+        if self.align % tile_elems:
+            raise ValueError(
+                f"tile_elems={tile_elems} must divide align={self.align} "
+                "for per-tensor fused ops"
+            )
+        ids = np.empty((self.total // tile_elems,), dtype=np.int32)
+        for i, (off, psize) in enumerate(zip(self.offsets, self.padded_sizes)):
+            ids[off // tile_elems : (off + psize) // tile_elems] = i
+        return ids
+
+    def elementwise_leaf_values(self, per_leaf: jax.Array) -> jax.Array:
+        """Broadcast a (num_leaves,) array to a (total,) buffer (XLA path)."""
+        reps = np.asarray(self.padded_sizes)
+        return jnp.repeat(per_leaf, reps, total_repeat_length=self.total)
+
+
+def pack_like(space: FlatSpace, trees: Sequence[Any], dtype=jnp.float32):
+    """Pack several congruent pytrees with one layout."""
+    return [space.pack(t, dtype=dtype) for t in trees]

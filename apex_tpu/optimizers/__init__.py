@@ -1,0 +1,31 @@
+"""Fused optimizers (ref: apex/optimizers/__init__.py).
+
+`FusedAdam`, `FusedLAMB`, `FusedSGD`, `FusedNovoGrad`, `FusedAdagrad`,
+`FusedLARS` — functional flat-space optimizers with fp32 master weights
+and in-kernel found_inf. `as_optax` adapts any of them to an
+`optax.GradientTransformation` for drop-in use in optax training loops.
+"""
+
+from apex_tpu.optimizers.fused import (
+    FlatFusedOptimizer,
+    FlatOptState,
+    FusedAdagrad,
+    FusedAdam,
+    FusedLAMB,
+    FusedLARS,
+    FusedNovoGrad,
+    FusedSGD,
+)
+from apex_tpu.optimizers.optax_adapter import as_optax
+
+__all__ = [
+    "FlatFusedOptimizer",
+    "FlatOptState",
+    "FusedAdam",
+    "FusedLAMB",
+    "FusedSGD",
+    "FusedNovoGrad",
+    "FusedAdagrad",
+    "FusedLARS",
+    "as_optax",
+]

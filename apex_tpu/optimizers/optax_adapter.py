@@ -1,0 +1,45 @@
+"""optax interop for the flat-space fused optimizers.
+
+The reference's optimizers are drop-in ``torch.optim.Optimizer``
+subclasses; the TPU-native equivalent of "drop-in" is an
+``optax.GradientTransformation``. The adapter keeps the fp32 master
+buffer in the optax state and emits updates = new_params - params so
+``optax.apply_updates`` reproduces the fused result exactly in fp32
+(params in lower precision get the master-rounded value).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import optax
+
+from apex_tpu.optimizers.fused import FlatFusedOptimizer, FlatOptState
+
+
+class FusedOptaxState(NamedTuple):
+    inner: FlatOptState
+
+
+def as_optax(opt: FlatFusedOptimizer) -> optax.GradientTransformation:
+    """Wrap a fused optimizer as an optax GradientTransformation.
+
+    Note: requires ``params`` to be passed to ``update`` (as optax
+    recommends for weight-decay transforms).
+    """
+
+    def init_fn(params):
+        return FusedOptaxState(inner=opt.init(params))
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("as_optax(...) requires update(..., params=params)")
+        new_params, new_inner = opt.step(state.inner, updates)
+        deltas = jax.tree.map(
+            lambda n, p: (n.astype(jax.numpy.float32) - p.astype(jax.numpy.float32)).astype(p.dtype),
+            new_params, params,
+        )
+        return deltas, FusedOptaxState(inner=new_inner)
+
+    return optax.GradientTransformation(init_fn, update_fn)

@@ -1,0 +1,54 @@
+"""Test harness config.
+
+Mirrors the reference's "multi-process on one node, no cluster needed"
+strategy (ref: apex/transformer/testing/distributed_test_base.py:30-103)
+the TPU way: a simulated 8-device CPU mesh via
+``--xla_force_host_platform_device_count`` (SURVEY.md §4 "TPU translation").
+Must run before jax initializes its backend, hence module-level in conftest.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+# The axon TPU-tunnel plugin (injected via sitecustomize at interpreter
+# start) hooks jax backend lookup and blocks CPU-only runs on tunnel
+# availability. Tests are CPU-only by design — unregister it.
+sys.path = [p for p in sys.path if ".axon_site" not in p]
+os.environ.pop("PYTHONPATH", None)
+
+import jax  # noqa: E402
+import jax._src.xla_bridge as _xb  # noqa: E402
+
+_xb._backend_factories.pop("axon", None)
+_hook = _xb._get_backend_uncached
+if getattr(_hook, "__name__", "") == "_axon_get_backend_uncached":
+    for _cell in _hook.__closure__ or ():
+        if callable(_cell.cell_contents):
+            _xb._get_backend_uncached = _cell.cell_contents
+jax.config.update("jax_platforms", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+jax.config.update("jax_threefry_partitionable", True)
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture
+def rng():
+    return np.random.RandomState(42)
+
+
+@pytest.fixture(params=["xla", "interpret"])
+def impl(request):
+    """Every fused op runs both the XLA reference path and the Pallas
+    kernel (interpreter mode on CPU), mirroring the reference's
+    kernel-vs-reference test style (ref: tests/L0/run_amp/test_multi_tensor_scale.py)."""
+    return request.param

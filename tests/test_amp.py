@@ -1,0 +1,230 @@
+"""amp engine tests.
+
+Mirrors ref tests/L0/run_amp (test_basic_casts.py, test_promotion.py,
+test_checkpointing.py) behaviorally: policy casting, dynamic-scale
+schedule, skip-step integration, state (de)serialization.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import LossScaler
+from apex_tpu.optimizers import FusedSGD
+
+
+def make_params():
+    return {
+        "dense": {"kernel": jnp.ones((8, 8), jnp.float32), "bias": jnp.zeros((8,), jnp.float32)},
+        "BatchNorm_0": {"scale": jnp.ones((8,), jnp.float32), "bias": jnp.zeros((8,), jnp.float32)},
+    }
+
+
+class TestOptLevels:
+    def test_O0_identity(self):
+        p, state = amp.initialize(make_params(), opt_level="O0")
+        assert state.properties.cast_model_type is None
+        assert p["dense"]["kernel"].dtype == jnp.float32
+
+    def test_O2_casts_model_keeps_bn(self):
+        p, state = amp.initialize(make_params(), opt_level="O2")
+        assert p["dense"]["kernel"].dtype == jnp.float16
+        assert p["BatchNorm_0"]["scale"].dtype == jnp.float32
+        assert state.properties.loss_scale == "dynamic"
+
+    def test_O3_pure_half(self):
+        p, _ = amp.initialize(make_params(), opt_level="O3")
+        assert p["dense"]["kernel"].dtype == jnp.float16
+        assert p["BatchNorm_0"]["scale"].dtype == jnp.float16
+
+    def test_O5_bf16_master(self):
+        p, state = amp.initialize(make_params(), opt_level="O5")
+        assert p["dense"]["kernel"].dtype == jnp.bfloat16
+        assert p["BatchNorm_0"]["scale"].dtype == jnp.float32
+        assert state.properties.master_weights
+        assert state.properties.loss_scale is None
+
+    def test_O1_O4_compute_dtype(self):
+        _, s1 = amp.initialize(make_params(), opt_level="O1")
+        _, s4 = amp.initialize(make_params(), opt_level="O4")
+        assert s1.properties.compute_dtype == jnp.float16
+        assert s4.properties.compute_dtype == jnp.bfloat16
+        assert s4.properties.loss_scale is None
+
+    def test_override(self):
+        p, state = amp.initialize(
+            make_params(), opt_level="O2", keep_batchnorm_fp32=False,
+            loss_scale=128.0,
+        )
+        assert p["BatchNorm_0"]["scale"].dtype == jnp.float16
+        assert state.properties.loss_scale == 128.0
+
+    def test_with_optimizer_master_from_fp32(self):
+        opt = FusedSGD(lr=0.1, momentum=0.9)
+        params = make_params()
+        cast_params, opt_state, state = amp.initialize(
+            params, opt, opt_level="O2"
+        )
+        # master weights are fp32 copies of original params
+        assert opt_state.master.dtype == jnp.float32
+        master = opt.master_params(opt_state)
+        np.testing.assert_array_equal(
+            np.asarray(master["dense"]["kernel"]),
+            np.asarray(params["dense"]["kernel"]),
+        )
+
+
+class TestLossScaler:
+    def test_static(self):
+        s = LossScaler(loss_scale=128.0)
+        st = s.init()
+        assert float(st.loss_scale) == 128.0
+        scaled = s.scale_loss(jnp.asarray(2.0), st)
+        assert float(scaled) == 256.0
+        st = s.update(st, jnp.asarray(1.0))
+        assert float(st.loss_scale) == 128.0  # static never changes
+
+    def test_dynamic_backoff_and_growth(self):
+        s = LossScaler(loss_scale="dynamic", scale_window=4)
+        st = s.init()
+        assert float(st.loss_scale) == 2.0 ** 16
+        st = s.update(st, jnp.asarray(1.0))  # overflow
+        assert float(st.loss_scale) == 2.0 ** 15
+        assert int(st.unskipped) == 0
+        for _ in range(3):
+            st = s.update(st, jnp.asarray(0.0))
+        assert float(st.loss_scale) == 2.0 ** 15
+        st = s.update(st, jnp.asarray(0.0))  # 4th good step -> grow
+        assert float(st.loss_scale) == 2.0 ** 16
+        assert int(st.unskipped) == 0
+
+    def test_dynamic_max_clamp(self):
+        s = LossScaler(loss_scale="dynamic", scale_window=1, max_loss_scale=2.0 ** 17)
+        st = s.init()
+        for _ in range(5):
+            st = s.update(st, jnp.asarray(0.0))
+        assert float(st.loss_scale) == 2.0 ** 17
+
+    def test_dynamic_min_clamp(self):
+        s = LossScaler(loss_scale="dynamic", min_loss_scale=2.0 ** 15)
+        st = s.init()
+        for _ in range(5):
+            st = s.update(st, jnp.asarray(1.0))
+        assert float(st.loss_scale) == 2.0 ** 15
+
+    def test_unscale_reports_inf(self):
+        s = LossScaler()
+        st = s.init()
+        grads = {"a": jnp.ones((16,)) * st.loss_scale, "b": jnp.ones((4, 4))}
+        un, found = s.unscale(grads, st)
+        np.testing.assert_allclose(np.asarray(un["a"]), np.ones(16), rtol=1e-6)
+        assert float(found) == 0.0
+        grads["a"] = grads["a"].at[3].set(jnp.nan)
+        _, found = s.unscale(grads, st)
+        assert float(found) == 1.0
+
+    def test_update_inside_jit(self):
+        s = LossScaler(scale_window=2)
+
+        @jax.jit
+        def step(st, found):
+            return s.update(st, found)
+
+        st = s.init()
+        st = step(st, jnp.asarray(0.0))
+        st = step(st, jnp.asarray(0.0))
+        assert float(st.loss_scale) == 2.0 ** 17
+
+    def test_state_dict_roundtrip(self):
+        s = LossScaler()
+        st = s.update(s.init(), jnp.asarray(1.0))
+        d = s.state_dict(st)
+        st2 = s.load_state_dict(d)
+        assert float(st2.loss_scale) == float(st.loss_scale)
+        assert int(st2.unskipped) == int(st.unskipped)
+
+    def test_amp_state_dict_roundtrip(self):
+        params, state = amp.initialize(make_params(), opt_level="O2", num_losses=3)
+        d = amp.state_dict(state)
+        assert set(d) == {"loss_scaler0", "loss_scaler1", "loss_scaler2"}
+        state2 = amp.load_state_dict(state, d)
+        assert len(state2.scalers) == 3
+
+
+class TestSkipStepIntegration:
+    def test_overflow_skips_update(self):
+        """End-to-end O2-style loop: overflow grads leave params+count
+        untouched and halve the scale (ref: apex/amp/handle.py:127-154)."""
+        params = {"w": jnp.ones((32,), jnp.float32)}
+        opt = FusedSGD(lr=0.1, momentum=0.0, impl="xla")
+        scaler = LossScaler()
+        ost = opt.init(params)
+        sst = scaler.init()
+
+        good = {"w": jnp.ones((32,), jnp.float32) * float(sst.loss_scale)}
+        bad = {"w": good["w"].at[0].set(jnp.inf)}
+
+        # good step
+        p1, ost = opt.step(ost, good, grad_scale=sst.loss_scale,
+                           skip_if_nonfinite=True)
+        sst = scaler.update(sst, ost.found_inf)
+        np.testing.assert_allclose(np.asarray(p1["w"]), 0.9 * np.ones(32), rtol=1e-6)
+        assert int(ost.count) == 1
+
+        # overflow step
+        p2, ost = opt.step(ost, bad, grad_scale=sst.loss_scale,
+                           skip_if_nonfinite=True)
+        sst = scaler.update(sst, ost.found_inf)
+        np.testing.assert_allclose(np.asarray(p2["w"]), np.asarray(p1["w"]))
+        assert int(ost.count) == 1
+        assert float(sst.loss_scale) == 2.0 ** 15
+
+
+class TestFunctionCasts:
+    def test_half_and_float_function(self):
+        @amp.half_function
+        def f(x):
+            return x
+
+        assert f(jnp.ones((4,), jnp.float32)).dtype == jnp.float16
+
+        @amp.float_function
+        def g(x):
+            return x
+
+        assert g(jnp.ones((4,), jnp.float16)).dtype == jnp.float32
+
+    def test_bfloat16_function(self):
+        @amp.bfloat16_function
+        def f(x):
+            return x
+
+        assert f(jnp.ones((4,), jnp.float32)).dtype == jnp.bfloat16
+
+    def test_promote_function(self):
+        @amp.promote_function
+        def add(x, y):
+            return x + y
+
+        out = add(jnp.ones((4,), jnp.float16), jnp.ones((4,), jnp.float32))
+        assert out.dtype == jnp.float32
+
+    def test_compute_cast_roundtrip(self):
+        def f(x):
+            assert x.dtype == jnp.bfloat16
+            return x * 2
+
+        g = amp.compute_cast(f, jnp.bfloat16)
+        out = g(jnp.ones((4,), jnp.float32))
+        assert out.dtype == jnp.float32
+
+    def test_int_args_untouched(self):
+        @amp.half_function
+        def f(x, n):
+            return x, n
+
+        x, n = f(jnp.ones((4,), jnp.float32), jnp.arange(4))
+        assert x.dtype == jnp.float16
+        assert n.dtype == jnp.int32

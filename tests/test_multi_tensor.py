@@ -1,0 +1,357 @@
+"""Fused update engine tests — kernel-vs-reference parity.
+
+Mirrors ref tests/L0/run_amp/test_multi_tensor_scale.py,
+test_multi_tensor_axpby.py, test_multi_tensor_l2norm.py and
+tests/L0/run_optimizers fused-vs-reference equivalence.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.multi_tensor import (
+    FlatSpace,
+    fused_adagrad_update,
+    fused_adam_update,
+    fused_lamb_update,
+    fused_lars_update,
+    fused_novograd_update,
+    fused_sgd_update,
+    multi_tensor_axpby,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+    per_tensor_l2norm,
+)
+
+
+def make_tree(rng, scale=1.0):
+    return {
+        "w1": jnp.asarray(rng.randn(33, 65) * scale, jnp.float32),
+        "b1": jnp.asarray(rng.randn(65) * scale, jnp.float32),
+        "w2": jnp.asarray(rng.randn(129, 257) * scale, jnp.float32),
+        "scalar": jnp.asarray(rng.randn() * scale, jnp.float32),
+    }
+
+
+class TestFlatSpace:
+    def test_roundtrip(self, rng):
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        buf = space.pack(tree)
+        assert buf.ndim == 1 and buf.shape[0] == space.total
+        assert space.total % space.align == 0
+        out = space.unpack(buf)
+        jax.tree.map(np.testing.assert_array_equal, tree, out)
+
+    def test_cast_roundtrip(self, rng):
+        tree = jax.tree.map(lambda x: x.astype(jnp.bfloat16), make_tree(rng))
+        space = FlatSpace.create(tree)
+        buf = space.pack(tree, dtype=jnp.float32)
+        assert buf.dtype == jnp.float32
+        out = space.unpack(buf)
+        assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(out))
+
+    def test_tile_ids(self, rng):
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        ids = space.tile_leaf_ids(2048)
+        assert ids.shape[0] == space.total // 2048
+        # each leaf owns padded_size/2048 consecutive tiles
+        counts = np.bincount(ids, minlength=space.num_leaves)
+        np.testing.assert_array_equal(
+            counts, np.asarray(space.padded_sizes) // 2048
+        )
+
+    def test_padding_is_zero(self, rng):
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        buf = np.asarray(space.pack(tree))
+        for off, size, psize in zip(space.offsets, space.sizes, space.padded_sizes):
+            assert np.all(buf[off + size : off + psize] == 0)
+
+
+class TestScaleAxpbyL2norm:
+    def test_scale(self, rng, impl):
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        buf = space.pack(tree)
+        out, found = multi_tensor_scale(buf, 4.0, impl=impl)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(buf) * 4.0, rtol=1e-6)
+        assert float(found) == 0.0
+
+    def test_scale_found_inf(self, rng, impl):
+        buf = jnp.asarray(rng.randn(4096), jnp.float32).at[17].set(jnp.inf)
+        _, found = multi_tensor_scale(buf, 1.0, impl=impl)
+        assert float(found) == 1.0
+        buf = jnp.asarray(rng.randn(4096), jnp.float32).at[100].set(jnp.nan)
+        _, found = multi_tensor_scale(buf, 0.5, impl=impl)
+        assert float(found) == 1.0
+
+    def test_scale_overflow_detected_post_scale(self, impl):
+        # scaling can overflow even finite inputs — reference flags the output
+        buf = jnp.full((2048,), 3e38, jnp.float32)
+        _, found = multi_tensor_scale(buf, 10.0, impl=impl)
+        assert float(found) == 1.0
+
+    def test_axpby(self, rng, impl):
+        x = jnp.asarray(rng.randn(5000), jnp.float32)
+        y = jnp.asarray(rng.randn(5000), jnp.float32)
+        out, found = multi_tensor_axpby(x, y, 2.0, -3.0, impl=impl)
+        np.testing.assert_allclose(
+            np.asarray(out), 2.0 * np.asarray(x) - 3.0 * np.asarray(y), rtol=1e-6
+        )
+        assert float(found) == 0.0
+
+    @pytest.mark.parametrize("arg_to_check,bad_x,expect", [
+        (-1, True, 1.0), (-1, False, 1.0), (0, True, 1.0),
+        (0, False, 0.0), (1, False, 1.0), (1, True, 0.0),
+    ])
+    def test_axpby_arg_to_check(self, rng, impl, arg_to_check, bad_x, expect):
+        x = jnp.asarray(rng.randn(3000), jnp.float32)
+        y = jnp.asarray(rng.randn(3000), jnp.float32)
+        if bad_x:
+            x = x.at[5].set(jnp.nan)
+        else:
+            y = y.at[5].set(jnp.nan)
+        _, found = multi_tensor_axpby(x, y, 1.0, 1.0, arg_to_check=arg_to_check, impl=impl)
+        assert float(found) == expect
+
+    def test_l2norm_global(self, rng, impl):
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        buf = space.pack(tree)
+        norm, _ = multi_tensor_l2norm(buf, impl=impl)
+        np.testing.assert_allclose(
+            float(norm), float(np.linalg.norm(np.asarray(buf))), rtol=1e-5
+        )
+
+    def test_l2norm_per_tensor(self, rng, impl):
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        buf = space.pack(tree)
+        norm, pt = multi_tensor_l2norm(buf, space, per_tensor=True, impl=impl)
+        leaves = jax.tree.leaves(tree)
+        expected = np.array([np.linalg.norm(np.asarray(l)) for l in leaves])
+        np.testing.assert_allclose(np.asarray(pt), expected, rtol=1e-5)
+        np.testing.assert_allclose(
+            float(norm), float(np.linalg.norm(np.asarray(buf))), rtol=1e-5
+        )
+
+
+def _np_adam(p, m, v, g, lr, b1, b2, eps, step, wd, adam_w):
+    if not adam_w:
+        g = g + wd * p
+    m = b1 * m + (1 - b1) * g
+    v = b2 * v + (1 - b2) * g * g
+    mhat = m / (1 - b1**step)
+    vhat = v / (1 - b2**step)
+    upd = mhat / (np.sqrt(vhat) + eps)
+    if adam_w:
+        upd = upd + wd * p
+    return p - lr * upd, m, v
+
+
+class TestFusedOptimizerOps:
+    @pytest.mark.parametrize("adam_w", [True, False])
+    def test_adam(self, rng, impl, adam_w):
+        n = 6000
+        p, g = rng.randn(n).astype(np.float32), rng.randn(n).astype(np.float32)
+        m, v = rng.randn(n).astype(np.float32), np.abs(rng.randn(n)).astype(np.float32)
+        for step in (1, 2, 3):
+            p2, m2, v2, found = fused_adam_update(
+                jnp.asarray(p), jnp.asarray(m), jnp.asarray(v), jnp.asarray(g),
+                lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8, step=step,
+                weight_decay=0.01, adam_w_mode=adam_w, impl=impl,
+            )
+            pe, me, ve = _np_adam(p, m, v, g, 1e-3, 0.9, 0.999, 1e-8, step, 0.01, adam_w)
+            np.testing.assert_allclose(np.asarray(p2), pe, rtol=2e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(m2), me, rtol=2e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(v2), ve, rtol=2e-5, atol=1e-6)
+            assert float(found) == 0.0
+            p, m, v = np.asarray(p2), np.asarray(m2), np.asarray(v2)
+
+    def test_adam_grad_scale(self, rng, impl):
+        n = 3000
+        p, m, v = (rng.randn(n).astype(np.float32) for _ in range(3))
+        v = np.abs(v)
+        g = rng.randn(n).astype(np.float32)
+        p2a, *_ = fused_adam_update(
+            jnp.asarray(p), jnp.asarray(m), jnp.asarray(v), jnp.asarray(g * 128.0),
+            lr=1e-3, step=1, grad_scale=128.0, impl=impl,
+        )
+        p2b, *_ = fused_adam_update(
+            jnp.asarray(p), jnp.asarray(m), jnp.asarray(v), jnp.asarray(g),
+            lr=1e-3, step=1, impl=impl,
+        )
+        np.testing.assert_allclose(np.asarray(p2a), np.asarray(p2b), rtol=1e-5, atol=1e-7)
+
+    def test_adam_found_inf(self, rng, impl):
+        n = 3000
+        p, m, v, g = (rng.randn(n).astype(np.float32) for _ in range(4))
+        g[7] = np.inf
+        _, _, _, found = fused_adam_update(
+            jnp.asarray(p), jnp.asarray(m), jnp.asarray(v), jnp.asarray(g),
+            lr=1e-3, step=1, impl=impl,
+        )
+        assert float(found) == 1.0
+
+    @pytest.mark.parametrize("nesterov", [False, True])
+    def test_sgd(self, rng, impl, nesterov):
+        n = 4000
+        p = rng.randn(n).astype(np.float32)
+        g = rng.randn(n).astype(np.float32)
+        mom = np.zeros(n, np.float32)
+        lr, mu, wd = 0.1, 0.9, 1e-4
+        pj, mj = jnp.asarray(p), jnp.asarray(mom)
+        for step in range(3):
+            pj, mj, found = fused_sgd_update(
+                pj, mj, jnp.asarray(g), lr=lr, momentum=mu, weight_decay=wd,
+                nesterov=nesterov, first_run=(step == 0), impl=impl,
+            )
+            ge = g + wd * p
+            mom = ge if step == 0 else mu * mom + ge
+            upd = ge + mu * mom if nesterov else mom
+            p = p - lr * upd
+            np.testing.assert_allclose(np.asarray(pj), p, rtol=1e-5, atol=1e-6)
+            np.testing.assert_allclose(np.asarray(mj), mom, rtol=1e-5, atol=1e-6)
+            assert float(found) == 0.0
+
+    def test_sgd_no_momentum(self, rng, impl):
+        n = 2048
+        p = rng.randn(n).astype(np.float32)
+        g = rng.randn(n).astype(np.float32)
+        p2, m2, _ = fused_sgd_update(
+            jnp.asarray(p), jnp.zeros(n, jnp.float32), jnp.asarray(g),
+            lr=0.5, momentum=0.0, impl=impl,
+        )
+        np.testing.assert_allclose(np.asarray(p2), p - 0.5 * g, rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(m2), np.zeros(n), atol=0)
+
+    def test_adagrad(self, rng, impl):
+        n = 3000
+        p = rng.randn(n).astype(np.float32)
+        g = rng.randn(n).astype(np.float32)
+        h = np.abs(rng.randn(n)).astype(np.float32)
+        p2, h2, found = fused_adagrad_update(
+            jnp.asarray(p), jnp.asarray(h), jnp.asarray(g),
+            lr=0.01, eps=1e-10, weight_decay=1e-4, impl=impl,
+        )
+        ge = g + 1e-4 * p
+        he = h + ge * ge
+        pe = p - 0.01 * ge / (np.sqrt(he) + 1e-10)
+        np.testing.assert_allclose(np.asarray(h2), he, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(p2), pe, rtol=1e-5, atol=1e-6)
+        assert float(found) == 0.0
+
+    def test_lamb_matches_manual(self, rng, impl):
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        p = space.pack(tree)
+        g = space.pack(jax.tree.map(lambda x: x * 0.1, tree))
+        m = jnp.zeros_like(p)
+        v = jnp.zeros_like(p)
+        lr, b1, b2, eps, wd, step = 0.01, 0.9, 0.999, 1e-6, 0.01, 1
+        p2, m2, v2, found = fused_lamb_update(
+            p, m, v, g, space, lr=lr, beta1=b1, beta2=b2, eps=eps, step=step,
+            weight_decay=wd, max_grad_norm=0.0, impl=impl,
+        )
+        # manual per-tensor reference
+        pn, gn = np.asarray(p), np.asarray(g)
+        me = (1 - b1) * gn
+        ve = (1 - b2) * gn * gn
+        upd = (me / (1 - b1**step)) / (np.sqrt(ve / (1 - b2**step)) + eps) + wd * pn
+        pe = np.array(pn)
+        for off, psize in zip(space.offsets, space.padded_sizes):
+            sl = slice(off, off + psize)
+            wn = np.linalg.norm(pn[sl])
+            un = np.linalg.norm(upd[sl])
+            ratio = wn / un if (wn > 0 and un > 0) else 1.0
+            pe[sl] = pn[sl] - lr * ratio * upd[sl]
+        np.testing.assert_allclose(np.asarray(m2), me, rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(v2), ve, rtol=1e-5, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(p2), pe, rtol=1e-4, atol=1e-6)
+        assert float(found) == 0.0
+
+    def test_lamb_grad_clipping(self, rng, impl):
+        tree = make_tree(rng, scale=100.0)
+        space = FlatSpace.create(tree)
+        p = space.pack(make_tree(rng))
+        g = space.pack(tree)  # huge grads
+        m = jnp.zeros_like(p)
+        v = jnp.zeros_like(p)
+        p_clip, *_ = fused_lamb_update(
+            p, m, v, g, space, lr=0.01, step=1, max_grad_norm=1.0, impl=impl,
+        )
+        gnorm = float(jnp.linalg.norm(g))
+        p_manual, *_ = fused_lamb_update(
+            p, m, v, g / gnorm, space, lr=0.01, step=1, max_grad_norm=0.0, impl=impl,
+        )
+        np.testing.assert_allclose(
+            np.asarray(p_clip), np.asarray(p_manual), rtol=1e-4, atol=1e-6
+        )
+
+    def test_novograd(self, rng, impl):
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        p = space.pack(tree)
+        g = space.pack(jax.tree.map(lambda x: x * 0.1, tree))
+        m = jnp.zeros_like(p)
+        v = jnp.zeros((space.num_leaves,), jnp.float32)
+        p2, m2, v2, found = fused_novograd_update(
+            p, m, v, g, space, lr=0.01, beta1=0.95, beta2=0.98, step=1,
+            weight_decay=0.001, impl=impl,
+        )
+        gn = np.asarray(g)
+        pn = np.asarray(p)
+        # step 1: v = ||g||^2 per tensor
+        expected_v = []
+        pe, me = np.array(pn), np.zeros_like(pn)
+        for off, psize in zip(space.offsets, space.padded_sizes):
+            sl = slice(off, off + psize)
+            gnorm = np.linalg.norm(gn[sl])
+            expected_v.append(gnorm**2)
+            denom = gnorm + 1e-8
+            gg = gn[sl] / denom + 0.001 * pn[sl]
+            me[sl] = 0.05 * gg
+            pe[sl] = pn[sl] - 0.01 * me[sl]
+        np.testing.assert_allclose(np.asarray(v2), expected_v, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(m2), me, rtol=1e-4, atol=1e-7)
+        np.testing.assert_allclose(np.asarray(p2), pe, rtol=1e-4, atol=1e-7)
+        assert float(found) == 0.0
+
+    def test_lars(self, rng, impl):
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        p = space.pack(tree)
+        g = space.pack(jax.tree.map(lambda x: x * 0.01, tree))
+        mom = jnp.zeros_like(p)
+        p2, mom2, found = fused_lars_update(
+            p, mom, g, space, lr=0.1, momentum=0.9, weight_decay=1e-4,
+            trust_coefficient=0.02, first_run=True, impl=impl,
+        )
+        pn, gn = np.asarray(p), np.asarray(g)
+        pe = np.array(pn)
+        for off, psize in zip(space.offsets, space.padded_sizes):
+            sl = slice(off, off + psize)
+            wn, gnorm = np.linalg.norm(pn[sl]), np.linalg.norm(gn[sl])
+            ratio = 0.02 * wn / (gnorm + 1e-4 * wn + 1e-8)
+            ratio = min(ratio, 1.0) if (wn > 0 and gnorm > 0) else 1.0
+            ge = (gn[sl] + 1e-4 * pn[sl]) * ratio
+            pe[sl] = pn[sl] - 0.1 * ge
+        np.testing.assert_allclose(np.asarray(p2), pe, rtol=1e-4, atol=1e-7)
+        assert float(found) == 0.0
+
+
+class TestJitAndDonation:
+    def test_adam_jits(self, rng):
+        n = 4096
+        p, m, v, g = (jnp.asarray(rng.randn(n), jnp.float32) for _ in range(4))
+
+        @jax.jit
+        def step(p, m, v, g):
+            return fused_adam_update(p, m, v, g, lr=1e-3, step=1, impl="xla")
+
+        p2, m2, v2, found = step(p, m, v, g)
+        assert p2.shape == (n,)
+        assert float(found) == 0.0
