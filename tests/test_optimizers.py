@@ -155,16 +155,17 @@ class TestMasterWeights:
 
 class TestNovoGradLARS:
     def test_novograd_converges(self, rng):
-        params = {"w": jnp.asarray(rng.randn(512), jnp.float32)}
-        target = jnp.zeros(512)
-        opt = FusedNovoGrad(lr=0.05, impl="xla")
+        # NovoGrad normalizes grads per-tensor, so the effective per-element
+        # step is ~lr/sqrt(n); size lr accordingly
+        params = {"w": jnp.asarray(rng.randn(64), jnp.float32)}
+        opt = FusedNovoGrad(lr=0.5, impl="xla")
         state = opt.init(params)
 
         def loss_fn(p):
             return jnp.sum(p["w"] ** 2)
 
         l0 = float(loss_fn(params))
-        for _ in range(50):
+        for _ in range(100):
             grads = jax.grad(loss_fn)(params)
             params, state = opt.step(state, grads)
         assert float(loss_fn(params)) < 0.2 * l0
