@@ -1,1 +1,4 @@
-"""Placeholder — populated as the build progresses."""
+"""Megatron-style model-parallel transformer library (ref: apex/transformer)."""
+
+from apex_tpu.transformer import parallel_state
+from apex_tpu.transformer import tensor_parallel

@@ -1,0 +1,212 @@
+"""Model-parallel topology state — one device mesh instead of process groups.
+
+TPU re-design of the reference's process-group registry
+(ref: apex/transformer/parallel_state.py:81-311). The reference builds
+NCCL/UCC groups for DP / TP / PP / model / embedding from a (tp, pp)
+grid over ranks; here the same grid is a single `jax.sharding.Mesh`
+with named axes — collectives are addressed by axis name inside
+`shard_map`/`pjit`, so there is nothing to create per group: every
+"group" of the reference corresponds to one mesh axis (or a tuple of
+axes):
+
+    DP group        -> axis "data"
+    TP group        -> axis "tensor"   (innermost: rides ICI neighbors)
+    PP group        -> axis "pipe"
+    model group     -> axes ("pipe", "tensor")
+    embedding group -> first/last pp stages (a slice of "pipe")
+    sequence-parallel "group" -> same axis as TP (Megatron SP shares it)
+    expert-parallel  -> axis "expert" (optional; carved out of "data")
+
+Virtual-pipeline rank bookkeeping for interleaved schedules keeps the
+reference's global-state shape (ref: parallel_state.py:163-176,560-575)
+since it is host-side schedule state, not device state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+DATA_AXIS = "data"
+TENSOR_AXIS = "tensor"
+PIPELINE_AXIS = "pipe"
+EXPERT_AXIS = "expert"
+
+# module-level state mirroring the reference's group globals
+# (ref: parallel_state.py:33-79)
+_MESH: Optional[Mesh] = None
+_VIRTUAL_PP_RANK: Optional[int] = None
+_VIRTUAL_PP_WORLD_SIZE: Optional[int] = None
+_PIPELINE_SPLIT_RANK: Optional[int] = None
+
+
+def initialize_model_parallel(
+    tensor_model_parallel_size: int = 1,
+    pipeline_model_parallel_size: int = 1,
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_split_rank: Optional[int] = None,
+    expert_model_parallel_size: int = 1,
+    *,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build the global device mesh (ref: parallel_state.py:81-311).
+
+    Axis order is (data, expert, pipe, tensor) outer->inner so TP —
+    the latency-critical axis — maps to physically adjacent devices
+    (the reference achieves the same by making TP ranks consecutive,
+    parallel_state.py:196-221).
+    """
+    global _MESH, _VIRTUAL_PP_RANK, _VIRTUAL_PP_WORLD_SIZE, _PIPELINE_SPLIT_RANK
+    devs = list(devices if devices is not None else jax.devices())
+    world = len(devs)
+    tp, pp, ep = (
+        tensor_model_parallel_size,
+        pipeline_model_parallel_size,
+        expert_model_parallel_size,
+    )
+    if world % (tp * pp * ep):
+        raise RuntimeError(
+            f"world size {world} not divisible by tp({tp}) x pp({pp}) x ep({ep})"
+        )
+    dp = world // (tp * pp * ep)
+    if virtual_pipeline_model_parallel_size is not None:
+        if pp <= 2 and virtual_pipeline_model_parallel_size > 1:
+            # interleaving requires >2 stages (ref: parallel_state.py:155-160)
+            raise RuntimeError(
+                "pipeline-model-parallel size should be greater than 2 with "
+                "interleaved schedule"
+            )
+        _VIRTUAL_PP_RANK = 0
+        _VIRTUAL_PP_WORLD_SIZE = virtual_pipeline_model_parallel_size
+    _PIPELINE_SPLIT_RANK = pipeline_model_parallel_split_rank
+
+    arr = np.asarray(devs).reshape(dp, ep, pp, tp)
+    _MESH = Mesh(arr, (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS, TENSOR_AXIS))
+    return _MESH
+
+
+def model_parallel_is_initialized() -> bool:
+    return _MESH is not None
+
+
+def get_mesh() -> Mesh:
+    if _MESH is None:
+        raise RuntimeError(
+            "model parallel mesh is not initialized "
+            "(call initialize_model_parallel first)"
+        )
+    return _MESH
+
+
+def destroy_model_parallel() -> None:
+    """ref: parallel_state.py:640-669."""
+    global _MESH, _VIRTUAL_PP_RANK, _VIRTUAL_PP_WORLD_SIZE, _PIPELINE_SPLIT_RANK
+    _MESH = None
+    _VIRTUAL_PP_RANK = None
+    _VIRTUAL_PP_WORLD_SIZE = None
+    _PIPELINE_SPLIT_RANK = None
+
+
+# -- world sizes (host-side, from mesh shape) ------------------------------
+
+
+def _axis_size(name: str) -> int:
+    return get_mesh().shape[name]
+
+
+def get_tensor_model_parallel_world_size() -> int:
+    return _axis_size(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_world_size() -> int:
+    return _axis_size(PIPELINE_AXIS)
+
+
+def get_data_parallel_world_size() -> int:
+    return _axis_size(DATA_AXIS)
+
+
+def get_expert_model_parallel_world_size() -> int:
+    return _axis_size(EXPERT_AXIS)
+
+
+def get_world_size() -> int:
+    m = get_mesh()
+    return int(np.prod([m.shape[a] for a in m.axis_names]))
+
+
+# -- ranks (device-side, inside shard_map) ---------------------------------
+
+
+def get_tensor_model_parallel_rank():
+    """Axis position of the executing device; valid inside shard_map
+    over the mesh (the SPMD analog of ref parallel_state.py:389-396)."""
+    return jax.lax.axis_index(TENSOR_AXIS)
+
+
+def get_pipeline_model_parallel_rank():
+    return jax.lax.axis_index(PIPELINE_AXIS)
+
+
+def get_data_parallel_rank():
+    return jax.lax.axis_index(DATA_AXIS)
+
+
+def get_expert_model_parallel_rank():
+    return jax.lax.axis_index(EXPERT_AXIS)
+
+
+# -- pipeline-stage predicates (host-side, by stage id) --------------------
+
+
+def is_pipeline_first_stage(stage: int, ignore_virtual: bool = False) -> bool:
+    """ref: parallel_state.py:508-527. ``stage`` is the pp index; in the
+    SPMD schedule the caller iterates stages explicitly."""
+    if not ignore_virtual and _VIRTUAL_PP_WORLD_SIZE is not None:
+        if _VIRTUAL_PP_RANK != 0:
+            return False
+    return stage == 0
+
+
+def is_pipeline_last_stage(stage: int, ignore_virtual: bool = False) -> bool:
+    if not ignore_virtual and _VIRTUAL_PP_WORLD_SIZE is not None:
+        if _VIRTUAL_PP_RANK != (_VIRTUAL_PP_WORLD_SIZE - 1):
+            return False
+    return stage == get_pipeline_model_parallel_world_size() - 1
+
+
+def get_pipeline_model_parallel_next_rank(stage: int) -> int:
+    """ref: parallel_state.py:609-616 (modular neighbors on the pp axis)."""
+    return (stage + 1) % get_pipeline_model_parallel_world_size()
+
+
+def get_pipeline_model_parallel_prev_rank(stage: int) -> int:
+    return (stage - 1) % get_pipeline_model_parallel_world_size()
+
+
+# -- virtual pipeline (interleaving) state ---------------------------------
+
+
+def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
+    return _VIRTUAL_PP_RANK
+
+
+def set_virtual_pipeline_model_parallel_rank(rank: int) -> None:
+    global _VIRTUAL_PP_RANK
+    _VIRTUAL_PP_RANK = rank
+
+
+def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
+    return _VIRTUAL_PP_WORLD_SIZE
+
+
+def get_pipeline_model_parallel_split_rank() -> Optional[int]:
+    return _PIPELINE_SPLIT_RANK
+
+
+def set_pipeline_model_parallel_split_rank(rank: int) -> None:
+    global _PIPELINE_SPLIT_RANK
+    _PIPELINE_SPLIT_RANK = rank

@@ -1,0 +1,192 @@
+"""The TP/SP collective mapping ops with Megatron-exact VJPs.
+
+TPU re-design of ref apex/transformer/tensor_parallel/mappings.py. Each
+op is an autograd Function there; here each is a `jax.custom_vjp` built
+on `jax.lax` collectives, used inside `shard_map` over the mesh's
+tensor axis. The forward/backward pairs are the Megatron canon:
+
+  copy            id         / all-reduce        (ref mappings.py:133)
+  reduce          all-reduce / id                (ref mappings.py:151)
+  scatter (last)  split      / all-gather        (ref mappings.py:169)
+  gather  (last)  all-gather / split             (ref mappings.py:187)
+  scatter_to_sequence_parallel  split(first) / all-gather(first)   (:205)
+  gather_from_sequence_parallel all-gather(first) / reduce-scatter (:223)
+  reduce_scatter_to_sequence_parallel rs(first) / all-gather       (:245)
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+
+# -- raw collectives (ref mappings.py:23-130) ------------------------------
+
+
+def _rank(axis_name):
+    return lax.axis_index(axis_name)
+
+
+def _size(axis_name):
+    return lax.axis_size(axis_name)
+
+
+def _split_along_dim(x, dim, axis_name):
+    """Take this rank's chunk along ``dim`` (ref mappings.py:36-68)."""
+    size = _size(axis_name)
+    chunk = x.shape[dim] // size
+    return lax.dynamic_slice_in_dim(x, _rank(axis_name) * chunk, chunk, axis=dim)
+
+
+def _gather_along_dim(x, dim, axis_name):
+    """Concatenate chunks from all ranks along ``dim``
+    (ref mappings.py:71-112 _gather_along_last_dim/_first_dim)."""
+    return lax.all_gather(x, axis_name, axis=dim, tiled=True)
+
+
+def _reduce(x, axis_name):
+    return lax.psum(x, axis_name)
+
+
+def _reduce_scatter_along_first_dim(x, axis_name):
+    """ref mappings.py:114-130 (_reduce_scatter_base)."""
+    return lax.psum_scatter(x, axis_name, scatter_dimension=0, tiled=True)
+
+
+# -- the 7 mapping ops as custom-VJP functions -----------------------------
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def copy_to_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Identity forward; all-reduce gradient (ref mappings.py:133-148).
+    Entry point of a column-parallel block: the input is replicated in
+    the forward pass, and each rank contributes a partial grad."""
+    return x
+
+
+def _copy_fwd(x, axis_name):
+    return x, None
+
+
+def _copy_bwd(axis_name, _, g):
+    return (_reduce(g, axis_name),)
+
+
+copy_to_tensor_model_parallel_region.defvjp(_copy_fwd, _copy_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_from_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """All-reduce forward; identity gradient (ref mappings.py:151-166).
+    Exit point of a row-parallel matmul."""
+    return _reduce(x, axis_name)
+
+
+def _reduce_fwd(x, axis_name):
+    return _reduce(x, axis_name), None
+
+
+def _reduce_bwd(axis_name, _, g):
+    return (g,)
+
+
+reduce_from_tensor_model_parallel_region.defvjp(_reduce_fwd, _reduce_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Split along the last dim fwd; all-gather bwd (ref mappings.py:169-184)."""
+    return _split_along_dim(x, -1, axis_name)
+
+
+def _scatter_fwd(x, axis_name):
+    return _split_along_dim(x, -1, axis_name), None
+
+
+def _scatter_bwd(axis_name, _, g):
+    return (_gather_along_dim(g, g.ndim - 1, axis_name),)
+
+
+scatter_to_tensor_model_parallel_region.defvjp(_scatter_fwd, _scatter_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def gather_from_tensor_model_parallel_region(x, axis_name=TENSOR_AXIS):
+    """All-gather along the last dim fwd; split bwd (ref mappings.py:187-202)."""
+    return _gather_along_dim(x, x.ndim - 1, axis_name)
+
+
+def _gather_fwd(x, axis_name):
+    return _gather_along_dim(x, x.ndim - 1, axis_name), None
+
+
+def _gather_bwd(axis_name, _, g):
+    return (_split_along_dim(g, -1, axis_name),)
+
+
+gather_from_tensor_model_parallel_region.defvjp(_gather_fwd, _gather_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def scatter_to_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Split along the first (sequence) dim fwd; all-gather bwd
+    (ref mappings.py:205-220). Used at the embedding->SP boundary."""
+    return _split_along_dim(x, 0, axis_name)
+
+
+def _scatter_seq_fwd(x, axis_name):
+    return _split_along_dim(x, 0, axis_name), None
+
+
+def _scatter_seq_bwd(axis_name, _, g):
+    return (_gather_along_dim(g, 0, axis_name),)
+
+
+scatter_to_sequence_parallel_region.defvjp(_scatter_seq_fwd, _scatter_seq_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def gather_from_sequence_parallel_region(
+    x, axis_name=TENSOR_AXIS, tensor_parallel_output_grad=True
+):
+    """All-gather along the sequence dim fwd; backward is a
+    reduce-scatter when the consumer is tensor-parallel (each rank
+    holds a *partial* grad of the full sequence), else a plain split
+    (ref mappings.py:223-242)."""
+    return _gather_along_dim(x, 0, axis_name)
+
+
+def _gather_seq_fwd(x, axis_name, tensor_parallel_output_grad):
+    return _gather_along_dim(x, 0, axis_name), None
+
+
+def _gather_seq_bwd(axis_name, tensor_parallel_output_grad, _, g):
+    if tensor_parallel_output_grad:
+        return (_reduce_scatter_along_first_dim(g, axis_name),)
+    return (_split_along_dim(g, 0, axis_name),)
+
+
+gather_from_sequence_parallel_region.defvjp(_gather_seq_fwd, _gather_seq_bwd)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def reduce_scatter_to_sequence_parallel_region(x, axis_name=TENSOR_AXIS):
+    """Reduce-scatter along the sequence dim fwd; all-gather bwd
+    (ref mappings.py:245-260). Exit of a row-parallel matmul under SP."""
+    return _reduce_scatter_along_first_dim(x, axis_name)
+
+
+def _rs_seq_fwd(x, axis_name):
+    return _reduce_scatter_along_first_dim(x, axis_name), None
+
+
+def _rs_seq_bwd(axis_name, _, g):
+    return (_gather_along_dim(g, 0, axis_name),)
+
+
+reduce_scatter_to_sequence_parallel_region.defvjp(_rs_seq_fwd, _rs_seq_bwd)

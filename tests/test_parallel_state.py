@@ -1,0 +1,119 @@
+"""Mesh topology tests (mirrors ref tests/L0/run_transformer/test_parallel_state.py)."""
+
+import jax
+import numpy as np
+import pytest
+
+from apex_tpu.transformer import parallel_state as ps
+
+
+@pytest.fixture(autouse=True)
+def clean_state():
+    ps.destroy_model_parallel()
+    yield
+    ps.destroy_model_parallel()
+
+
+class TestInitializeModelParallel:
+    @pytest.mark.parametrize("tp,pp", [(1, 1), (2, 1), (1, 2), (2, 2), (4, 2), (8, 1)])
+    def test_shapes(self, tp, pp):
+        mesh = ps.initialize_model_parallel(tp, pp)
+        world = len(jax.devices())
+        assert ps.get_tensor_model_parallel_world_size() == tp
+        assert ps.get_pipeline_model_parallel_world_size() == pp
+        assert ps.get_data_parallel_world_size() == world // (tp * pp)
+        assert ps.get_world_size() == world
+        assert mesh.axis_names == ("data", "expert", "pipe", "tensor")
+
+    def test_indivisible_raises(self):
+        with pytest.raises(RuntimeError):
+            ps.initialize_model_parallel(3, 1)
+
+    def test_not_initialized_raises(self):
+        with pytest.raises(RuntimeError):
+            ps.get_mesh()
+        assert not ps.model_parallel_is_initialized()
+
+    def test_destroy(self):
+        ps.initialize_model_parallel(2, 2)
+        assert ps.model_parallel_is_initialized()
+        ps.destroy_model_parallel()
+        assert not ps.model_parallel_is_initialized()
+
+    def test_tp_is_innermost(self):
+        """TP ranks must be adjacent devices (ref parallel_state.py:196-221
+        makes TP ranks consecutive)."""
+        mesh = ps.initialize_model_parallel(4, 2)
+        devs = np.asarray(mesh.devices)
+        # along tensor axis, device ids are consecutive
+        ids = np.vectorize(lambda d: d.id)(devs)
+        row = ids[0, 0, 0, :]
+        np.testing.assert_array_equal(row, np.arange(row[0], row[0] + 4))
+
+    def test_virtual_pp(self):
+        ps.initialize_model_parallel(
+            1, 4, virtual_pipeline_model_parallel_size=2
+        )
+        assert ps.get_virtual_pipeline_model_parallel_world_size() == 2
+        assert ps.get_virtual_pipeline_model_parallel_rank() == 0
+        ps.set_virtual_pipeline_model_parallel_rank(1)
+        assert ps.get_virtual_pipeline_model_parallel_rank() == 1
+
+    def test_virtual_pp_requires_deep_pipeline(self):
+        with pytest.raises(RuntimeError):
+            ps.initialize_model_parallel(
+                1, 2, virtual_pipeline_model_parallel_size=2
+            )
+
+    def test_expert_parallel(self):
+        ps.initialize_model_parallel(2, 1, expert_model_parallel_size=2)
+        assert ps.get_expert_model_parallel_world_size() == 2
+        assert ps.get_data_parallel_world_size() == 2
+
+
+class TestPipelinePredicates:
+    def test_first_last_stage(self):
+        ps.initialize_model_parallel(1, 4)
+        assert ps.is_pipeline_first_stage(0)
+        assert not ps.is_pipeline_first_stage(1)
+        assert ps.is_pipeline_last_stage(3)
+        assert not ps.is_pipeline_last_stage(0)
+
+    def test_virtual_stage_predicates(self):
+        ps.initialize_model_parallel(1, 4, virtual_pipeline_model_parallel_size=2)
+        ps.set_virtual_pipeline_model_parallel_rank(0)
+        assert ps.is_pipeline_first_stage(0)
+        assert not ps.is_pipeline_last_stage(3)  # vpp rank 0 != last chunk
+        ps.set_virtual_pipeline_model_parallel_rank(1)
+        assert not ps.is_pipeline_first_stage(0)
+        assert ps.is_pipeline_last_stage(3)
+        assert ps.is_pipeline_first_stage(0, ignore_virtual=True)
+
+    def test_next_prev(self):
+        ps.initialize_model_parallel(1, 4)
+        assert ps.get_pipeline_model_parallel_next_rank(0) == 1
+        assert ps.get_pipeline_model_parallel_next_rank(3) == 0
+        assert ps.get_pipeline_model_parallel_prev_rank(0) == 3
+
+
+class TestRankQueriesInShardMap:
+    def test_axis_index(self):
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        mesh = ps.initialize_model_parallel(4, 1)
+
+        def f():
+            return (
+                ps.get_tensor_model_parallel_rank()[None],
+                ps.get_data_parallel_rank()[None],
+            )
+
+        tp_ranks, dp_ranks = jax.jit(
+            shard_map(
+                f, mesh=mesh, in_specs=(),
+                out_specs=(P("tensor"), P("data")),
+            )
+        )()
+        np.testing.assert_array_equal(np.asarray(tp_ranks), np.arange(4))
+        np.testing.assert_array_equal(np.asarray(dp_ranks), np.arange(2))
