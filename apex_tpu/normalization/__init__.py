@@ -1,1 +1,89 @@
-"""Placeholder — populated as the build progresses."""
+"""Fused normalization modules (ref: apex/normalization/__init__.py).
+
+Flax modules over the Pallas kernels in `apex_tpu.ops.layer_norm`:
+
+- `FusedLayerNorm` / `FusedRMSNorm` — fp32-param norms
+  (ref: apex/normalization/fused_layer_norm.py:204-356)
+- `MixedFusedLayerNorm` / `MixedFusedRMSNorm` — bf16/fp16 input with
+  fp32 params, fp32 compute, input-dtype output
+  (ref: fused_layer_norm.py mixed-dtype variants :358-433)
+
+Functional forms `fused_layer_norm` / `fused_rms_norm` are re-exported
+(ref: fused_layer_norm affine functional entry points).
+"""
+
+from typing import Optional, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from apex_tpu.ops.layer_norm import fused_layer_norm, fused_rms_norm
+
+
+def _shape_tuple(normalized_shape):
+    if isinstance(normalized_shape, int):
+        return (normalized_shape,)
+    return tuple(normalized_shape)
+
+
+class FusedLayerNorm(nn.Module):
+    """Drop-in LayerNorm over the trailing ``normalized_shape`` dims
+    (ref: apex.normalization.FusedLayerNorm)."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    use_bias: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+    impl: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _shape_tuple(self.normalized_shape)
+        if self.elementwise_affine:
+            w = self.param(
+                "scale", nn.initializers.ones, shape, self.param_dtype
+            )
+            b = (
+                self.param("bias", nn.initializers.zeros, shape, self.param_dtype)
+                if self.use_bias
+                else None
+            )
+        else:
+            w = b = None
+        return fused_layer_norm(x, w, b, eps=self.eps, impl=self.impl)
+
+
+class FusedRMSNorm(nn.Module):
+    """Drop-in RMSNorm (ref: apex.normalization.FusedRMSNorm)."""
+
+    normalized_shape: Union[int, Sequence[int]]
+    eps: float = 1e-5
+    elementwise_affine: bool = True
+    param_dtype: jnp.dtype = jnp.float32
+    impl: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x):
+        shape = _shape_tuple(self.normalized_shape)
+        w = (
+            self.param("scale", nn.initializers.ones, shape, self.param_dtype)
+            if self.elementwise_affine
+            else None
+        )
+        return fused_rms_norm(x, w, eps=self.eps, impl=self.impl)
+
+
+# mixed-dtype aliases: params are fp32 regardless of input dtype; compute
+# fp32; output follows input — exactly what the base kernels already do.
+MixedFusedLayerNorm = FusedLayerNorm
+MixedFusedRMSNorm = FusedRMSNorm
+
+__all__ = [
+    "FusedLayerNorm",
+    "FusedRMSNorm",
+    "MixedFusedLayerNorm",
+    "MixedFusedRMSNorm",
+    "fused_layer_norm",
+    "fused_rms_norm",
+]

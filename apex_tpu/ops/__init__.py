@@ -1,1 +1,16 @@
-"""Placeholder — populated as the build progresses."""
+"""Pallas/XLA fused ops (TPU equivalents of the reference's csrc/ kernels)."""
+
+from apex_tpu.ops.layer_norm import fused_layer_norm, fused_rms_norm
+from apex_tpu.ops.softmax import (
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+from apex_tpu.ops.rope import (
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_2d,
+    fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_thd,
+)
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss

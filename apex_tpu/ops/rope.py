@@ -1,0 +1,119 @@
+"""Fused rotary positional embedding — the reference's 4 RoPE variants.
+
+TPU re-design of ref apex/transformer/functional/fused_rope.py:19-291 and
+csrc/megatron/fused_rotary_positional_embedding{.h,_cuda.cu}. RoPE is a
+bandwidth-bound elementwise op; on TPU the optimal implementation is XLA
+fusion into the surrounding matmuls (a standalone Pallas kernel would
+*add* an HBM round-trip the CUDA version needs but XLA elides). The
+custom VJP mirrors the reference's backward — apply the rotation with
+negated sin — so no cos/sin recomputation or residual stash of t.
+
+Layouts follow the reference:
+  sbhd   t: (seq, batch, heads, dim)
+  cached precomputed cos/sin: (seq, 1, 1, dim)
+  thd    packed varlen t: (tokens, heads, dim) + cu_seqlens
+  2d     image t: (batch, h, w, heads, dim), separate freqs for h and w
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def _rotate_half(t):
+    # (ref fused_rope.py rotate_half convention: split-in-half, not interleave)
+    d = t.shape[-1] // 2
+    t1, t2 = t[..., :d], t[..., d:]
+    return jnp.concatenate([-t2, t1], axis=-1)
+
+
+def _apply(t, cos, sin):
+    """Rotate the leading rot_dim channels of t; pass the rest through."""
+    rot_dim = cos.shape[-1]
+    t_rot, t_pass = t[..., :rot_dim], t[..., rot_dim:]
+    out = t_rot.astype(jnp.float32) * cos + _rotate_half(t_rot).astype(jnp.float32) * sin
+    out = out.astype(t.dtype)
+    if t_pass.shape[-1]:
+        out = jnp.concatenate([out, t_pass], axis=-1)
+    return out
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=())
+def _rope_cached(t, cos, sin):
+    return _apply(t, cos, sin)
+
+
+def _rope_cached_fwd(t, cos, sin):
+    return _apply(t, cos, sin), (cos, sin)
+
+
+def _rope_cached_bwd(res, g):
+    cos, sin = res
+    # backward rotation = forward with -sin (ref fused_rope.py backward)
+    return _apply(g, cos, -sin), None, None
+
+
+_rope_cached.defvjp(_rope_cached_fwd, _rope_cached_bwd)
+
+
+def fused_apply_rotary_pos_emb(
+    t: jax.Array, freqs: jax.Array, transpose_output_memory: bool = False
+) -> jax.Array:
+    """sbhd variant (ref fused_rope.py:19-88): t (s, b, h, d),
+    freqs (s, 1, 1, d_rot) of angles; cos/sin computed here."""
+    del transpose_output_memory  # layout is XLA's concern on TPU
+    cos = jnp.cos(freqs).astype(jnp.float32)
+    sin = jnp.sin(freqs).astype(jnp.float32)
+    return _rope_cached(t, cos, sin)
+
+
+def fused_apply_rotary_pos_emb_cached(
+    t: jax.Array, cos_: jax.Array, sin_: jax.Array,
+    transpose_output_memory: bool = False,
+) -> jax.Array:
+    """cached-cos/sin variant (ref fused_rope.py:91-160)."""
+    del transpose_output_memory
+    return _rope_cached(t, cos_.astype(jnp.float32), sin_.astype(jnp.float32))
+
+
+def fused_apply_rotary_pos_emb_thd(
+    t: jax.Array, cu_seqlens: jax.Array, freqs: jax.Array
+) -> jax.Array:
+    """Packed-varlen (THD) variant (ref fused_rope.py:163-225):
+    t (tokens, h, d); cu_seqlens (nseq+1,) cumulative boundaries; each
+    sequence's positions restart at 0. Positions are computed with a
+    searchsorted over the static token index — O(tokens * log nseq) on
+    the VPU, no host sync."""
+    tokens = t.shape[0]
+    idx = jnp.arange(tokens)
+    seq_id = jnp.searchsorted(cu_seqlens, idx, side="right") - 1
+    pos = idx - cu_seqlens[seq_id]
+    angles = freqs.reshape(freqs.shape[0], -1)[pos]      # (tokens, d_rot)
+    cos = jnp.cos(angles)[:, None, :]
+    sin = jnp.sin(angles)[:, None, :]
+    return _rope_cached(t, cos.astype(jnp.float32), sin.astype(jnp.float32))
+
+
+def fused_apply_rotary_pos_emb_2d(
+    t: jax.Array, img_h: int, img_w: int,
+    cos_h: jax.Array, sin_h: jax.Array,
+    cos_w: jax.Array, sin_w: jax.Array,
+) -> jax.Array:
+    """2D image variant (ref fused_rope.py:228-291): t (b, h*w, heads, d);
+    first half of d rotated by row position, second half by column."""
+    b, hw, heads, d = t.shape
+    assert hw == img_h * img_w
+    half = d // 2
+    th = t[..., :half].reshape(b, img_h, img_w, heads, half)
+    tw = t[..., half:].reshape(b, img_h, img_w, heads, half)
+    ch = cos_h.reshape(1, img_h, 1, 1, half).astype(jnp.float32)
+    sh = sin_h.reshape(1, img_h, 1, 1, half).astype(jnp.float32)
+    cw = cos_w.reshape(1, 1, img_w, 1, half).astype(jnp.float32)
+    sw = sin_w.reshape(1, 1, img_w, 1, half).astype(jnp.float32)
+    oh = _rope_cached(th, ch, sh)
+    ow = _rope_cached(tw, cw, sw)
+    return jnp.concatenate([oh, ow], axis=-1).reshape(b, hw, heads, d)

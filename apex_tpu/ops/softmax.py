@@ -1,0 +1,262 @@
+"""Fused scaled/masked softmax — Pallas kernels with custom VJP.
+
+TPU re-design of the reference's four megatron softmax extensions
+(ref: csrc/megatron/scaled_softmax_cuda.cu,
+scaled_masked_softmax_cuda.cu, scaled_upper_triang_masked_softmax_cuda.cu,
+generic_scaled_masked_softmax_cuda.cu; Python wrappers
+apex/transformer/functional/fused_softmax.py:21-160).
+
+All variants compute softmax(scale * x [+ mask]) over the last dim in
+fp32 and emit the input dtype. The backward uses the saved softmax
+output: dx = scale * y * (g - sum(g*y)) — the same recomputation-free
+scheme as the reference kernels' backward.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu._backend import interpret_flag, resolve_impl
+
+MASK_FILL = -10000.0  # reference fill for masked logits
+
+
+def _row_tile(rows: int, cols: int, budget=2 * 1024 * 1024) -> int:
+    tile = max(8, min(256, budget // max(cols * 4, 1)))
+    while rows % tile:
+        tile //= 2
+        if tile < 1:
+            return 1
+    return max(tile, 1)
+
+
+# -- forward kernels -------------------------------------------------------
+
+
+def _softmax_rows(x, scale, extra=None):
+    """fp32 softmax of scale*x + extra over the last dim."""
+    s = x.astype(jnp.float32) * scale
+    if extra is not None:
+        s = s + extra
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    return e / jnp.sum(e, axis=-1, keepdims=True)
+
+
+def _scaled_kernel(x_ref, o_ref, *, scale):
+    o_ref[...] = _softmax_rows(x_ref[...], scale).astype(o_ref.dtype)
+
+
+def _causal_kernel(x_ref, o_ref, *, scale, tile):
+    j = pl.program_id(1)
+    x = x_ref[...]  # (1, tile, sk)
+    sk = x.shape[-1]
+    row = j * tile + jax.lax.broadcasted_iota(jnp.int32, (1, tile, sk), 1)
+    col = jax.lax.broadcasted_iota(jnp.int32, (1, tile, sk), 2)
+    neg = jnp.where(col > row, jnp.float32(-1e30), 0.0)
+    o_ref[...] = _softmax_rows(x, scale, neg).astype(o_ref.dtype)
+
+
+def _masked_kernel(x_ref, m_ref, o_ref, *, scale):
+    mask = m_ref[...]
+    extra = jnp.where(mask, jnp.float32(MASK_FILL), 0.0)
+    o_ref[...] = _softmax_rows(x_ref[...], scale, extra).astype(o_ref.dtype)
+
+
+# -- backward (shared): dx = scale * y * (g - sum(g*y)) --------------------
+
+
+def _bwd_kernel(y_ref, g_ref, dx_ref, *, scale):
+    y = y_ref[...].astype(jnp.float32)
+    g = g_ref[...].astype(jnp.float32)
+    dot = jnp.sum(y * g, axis=-1, keepdims=True)
+    dx_ref[...] = (scale * y * (g - dot)).astype(dx_ref.dtype)
+
+
+def _bwd_pallas(y, g, scale, impl):
+    shape = y.shape
+    y2 = y.reshape(-1, shape[-1])
+    g2 = g.reshape(-1, shape[-1])
+    rows, cols = y2.shape
+    tile = _row_tile(rows, cols)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel, scale=scale),
+        grid=(rows // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, cols), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, cols), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, cols), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), y.dtype),
+        interpret=interpret_flag(impl),
+    )(y2, g2)
+    return dx.reshape(shape)
+
+
+def _bwd_any(y, g, scale, impl):
+    if impl == "xla":
+        yf = y.astype(jnp.float32)
+        gf = g.astype(jnp.float32)
+        dot = jnp.sum(yf * gf, axis=-1, keepdims=True)
+        return (scale * yf * (gf - dot)).astype(y.dtype)
+    return _bwd_pallas(y, g, scale, impl)
+
+
+# -- scaled softmax --------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scaled_softmax(x, scale: float = 1.0, impl: Optional[str] = None):
+    """softmax(scale*x) over the last dim, any leading dims
+    (ref: csrc/megatron/scaled_softmax_cuda.cu ScaledSoftmax)."""
+    impl = resolve_impl(impl)
+    if impl == "xla":
+        return _softmax_rows(x, scale).astype(x.dtype)
+    shape = x.shape
+    x2 = x.reshape(-1, shape[-1])
+    rows, cols = x2.shape
+    tile = _row_tile(rows, cols)
+    y = pl.pallas_call(
+        functools.partial(_scaled_kernel, scale=scale),
+        grid=(rows // tile,),
+        in_specs=[pl.BlockSpec((tile, cols), lambda i: (i, 0), memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec((tile, cols), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), x.dtype),
+        interpret=interpret_flag(impl),
+    )(x2)
+    return y.reshape(shape)
+
+
+def _scaled_fwd(x, scale, impl):
+    y = scaled_softmax(x, scale, impl)
+    return y, y
+
+
+def _scaled_bwd(scale, impl, y, g):
+    return (_bwd_any(y, g, scale, resolve_impl(impl)),)
+
+
+scaled_softmax.defvjp(_scaled_fwd, _scaled_bwd)
+
+
+# -- causal (upper-triangular masked) softmax ------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def scaled_upper_triang_masked_softmax(x, scale: float = 1.0,
+                                       impl: Optional[str] = None):
+    """Causal softmax over (attn_batches, sq, sk)
+    (ref: csrc/megatron/scaled_upper_triang_masked_softmax.h — zeroes
+    the strictly-upper triangle before normalizing)."""
+    impl = resolve_impl(impl)
+    assert x.ndim == 3, "expected (attn_batches, sq, sk)"
+    a, sq, sk = x.shape
+    if impl == "xla":
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, sq, sk), 1)
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, sq, sk), 2)
+        neg = jnp.where(col > row, jnp.float32(-1e30), 0.0)
+        return _softmax_rows(x, scale, neg).astype(x.dtype)
+    tile = _row_tile(sq, sk)
+    y = pl.pallas_call(
+        functools.partial(_causal_kernel, scale=scale, tile=tile),
+        grid=(a, sq // tile),
+        in_specs=[
+            pl.BlockSpec((1, tile, sk), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM)
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tile, sk), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((a, sq, sk), x.dtype),
+        interpret=interpret_flag(impl),
+    )(x)
+    return y
+
+
+def _causal_fwd(x, scale, impl):
+    y = scaled_upper_triang_masked_softmax(x, scale, impl)
+    return y, y
+
+
+def _causal_bwd(scale, impl, y, g):
+    # masked positions have y == 0, so the shared backward stays exact
+    return (_bwd_any(y, g, scale, resolve_impl(impl)),)
+
+
+scaled_upper_triang_masked_softmax.defvjp(_causal_fwd, _causal_bwd)
+
+
+# -- masked softmax (4D mask, broadcast over heads) ------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def scaled_masked_softmax(x, mask, scale: float = 1.0,
+                          impl: Optional[str] = None):
+    """softmax(scale*x + mask_fill) for x (b, h, sq, sk) and boolean
+    mask (b or 1, 1, sq, sk) where True masks out
+    (ref: csrc/megatron/scaled_masked_softmax_cuda.cu; the generic
+    variant covers arbitrary broadcastable masks the same way)."""
+    impl = resolve_impl(impl)
+    assert x.ndim == 4 and mask.ndim == 4
+    b, h, sq, sk = x.shape
+    if impl == "xla":
+        extra = jnp.where(mask, jnp.float32(MASK_FILL), 0.0)
+        return _softmax_rows(x, scale, extra).astype(x.dtype)
+    mb = mask.shape[0]
+    x3 = x.reshape(b * h, sq, sk)
+    m3 = jnp.broadcast_to(mask, (mb, 1, sq, sk)).reshape(mb, sq, sk)
+    tile = _row_tile(sq, sk)
+
+    def mask_index(i, j):
+        return (jax.lax.rem(i // h, mb), j, 0)
+
+    y = pl.pallas_call(
+        functools.partial(_masked_kernel, scale=scale),
+        grid=(b * h, sq // tile),
+        in_specs=[
+            pl.BlockSpec((1, tile, sk), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, tile, sk), mask_index, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, tile, sk), lambda i, j: (i, j, 0), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, sk), x.dtype),
+        interpret=interpret_flag(impl),
+    )(x3, m3)
+    return y.reshape(b, h, sq, sk)
+
+
+def _masked_fwd(x, mask, scale, impl):
+    y = scaled_masked_softmax(x, mask, scale, impl)
+    return y, y
+
+
+def _masked_bwd(scale, impl, y, g):
+    return (_bwd_any(y, g, scale, resolve_impl(impl)), None)
+
+
+scaled_masked_softmax.defvjp(_masked_fwd, _masked_bwd)
+
+
+def generic_scaled_masked_softmax(x, mask, scale: float = 1.0,
+                                  impl: Optional[str] = None):
+    """Arbitrary-broadcast masked softmax
+    (ref: csrc/megatron/generic_scaled_masked_softmax_cuda.cu). Masks
+    with the standard (b|1, 1, sq, sk) layout take the fused kernel;
+    anything else runs the XLA path, which fuses into one kernel anyway.
+    """
+    if (
+        x.ndim == 4
+        and mask.ndim == 4
+        and mask.shape[1] == 1
+        and mask.shape[2:] == x.shape[2:]
+        and mask.shape[0] in (1, x.shape[0])
+    ):
+        return scaled_masked_softmax(x, mask, scale, impl)
+    extra = jnp.where(mask, jnp.float32(MASK_FILL), 0.0)
+    return _softmax_rows(x, scale, extra).astype(x.dtype)

@@ -1,0 +1,157 @@
+"""Fused softmax cross-entropy with label smoothing.
+
+TPU re-design of the reference's xentropy extension
+(ref: apex/contrib/xentropy/softmax_xentropy.py:4,
+apex/contrib/csrc/xentropy/xentropy_kernel.cu). Same memory trick:
+the forward saves only the per-row logsumexp (not the softmax), and the
+backward recomputes probabilities from (logits, lse) — one fused kernel
+each way.
+
+loss_i = lse_i - (1-eps) * x_i[y_i] - eps * mean_j(x_ij)
+dx_ij  = g_i * (exp(x_ij - lse_i) - (1-eps)*[j==y_i] - eps/K)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu._backend import interpret_flag, resolve_impl
+
+
+def _row_tile(rows: int, cols: int, budget=2 * 1024 * 1024) -> int:
+    tile = max(8, min(128, budget // max(cols * 4, 1)))
+    while rows % tile:
+        tile //= 2
+        if tile < 1:
+            return 1
+    return max(tile, 1)
+
+
+def _fwd_kernel(x_ref, y_ref, loss_ref, lse_ref, *, smoothing):
+    x = x_ref[...].astype(jnp.float32)          # (T, K)
+    y = y_ref[...]                              # (T, 1) int32
+    m = jnp.max(x, axis=-1, keepdims=True)
+    lse = m + jnp.log(jnp.sum(jnp.exp(x - m), axis=-1, keepdims=True))
+    k = x.shape[-1]
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    x_t = jnp.sum(jnp.where(col == y, x, 0.0), axis=-1, keepdims=True)
+    loss = lse - (1.0 - smoothing) * x_t
+    if smoothing > 0.0:
+        loss = loss - smoothing * jnp.mean(x, axis=-1, keepdims=True)
+    loss_ref[...] = loss
+    lse_ref[...] = lse
+
+
+def _bwd_kernel(x_ref, y_ref, lse_ref, g_ref, dx_ref, *, smoothing):
+    x = x_ref[...].astype(jnp.float32)
+    y = y_ref[...]
+    lse = lse_ref[...]
+    g = g_ref[...]
+    k = x.shape[-1]
+    p = jnp.exp(x - lse)
+    col = jax.lax.broadcasted_iota(jnp.int32, x.shape, 1)
+    onehot = jnp.where(col == y, 1.0, 0.0)
+    dx = g * (p - (1.0 - smoothing) * onehot - smoothing / k)
+    dx_ref[...] = dx.astype(dx_ref.dtype)
+
+
+def _fwd_impl(logits2, labels2, smoothing, impl):
+    rows, cols = logits2.shape
+    if impl == "xla":
+        x = logits2.astype(jnp.float32)
+        lse = jax.scipy.special.logsumexp(x, axis=-1, keepdims=True)
+        x_t = jnp.take_along_axis(x, labels2, axis=-1)
+        loss = lse - (1.0 - smoothing) * x_t
+        if smoothing > 0.0:
+            loss = loss - smoothing * jnp.mean(x, axis=-1, keepdims=True)
+        return loss, lse
+    tile = _row_tile(rows, cols)
+    loss, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, smoothing=smoothing),
+        grid=(rows // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, cols), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+            jax.ShapeDtypeStruct((rows, 1), jnp.float32),
+        ],
+        interpret=interpret_flag(impl),
+    )(logits2, labels2)
+    return loss, lse
+
+
+def _bwd_impl(logits2, labels2, lse, g2, smoothing, impl):
+    rows, cols = logits2.shape
+    if impl == "xla":
+        x = logits2.astype(jnp.float32)
+        p = jnp.exp(x - lse)
+        onehot = jax.nn.one_hot(labels2[:, 0], cols, dtype=jnp.float32)
+        dx = g2 * (p - (1.0 - smoothing) * onehot - smoothing / cols)
+        return dx.astype(logits2.dtype)
+    tile = _row_tile(rows, cols)
+    dx = pl.pallas_call(
+        functools.partial(_bwd_kernel, smoothing=smoothing),
+        grid=(rows // tile,),
+        in_specs=[
+            pl.BlockSpec((tile, cols), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((tile, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((tile, cols), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((rows, cols), logits2.dtype),
+        interpret=interpret_flag(impl),
+    )(logits2, labels2, lse, g2)
+    return dx
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def softmax_cross_entropy_loss(logits, labels, smoothing: float = 0.0,
+                               impl: Optional[str] = None):
+    """Per-example fused CE (ref: apex.contrib.xentropy
+    SoftmaxCrossEntropyLoss). logits (..., K); labels (...,) int;
+    returns fp32 losses shaped like labels."""
+    impl = resolve_impl(impl)
+    shape = labels.shape
+    loss, _ = _fwd_impl(
+        logits.reshape(-1, logits.shape[-1]),
+        labels.reshape(-1, 1).astype(jnp.int32),
+        smoothing, impl,
+    )
+    return loss.reshape(shape)
+
+
+def _ce_fwd(logits, labels, smoothing, impl):
+    impl_r = resolve_impl(impl)
+    l2 = logits.reshape(-1, logits.shape[-1])
+    y2 = labels.reshape(-1, 1).astype(jnp.int32)
+    loss, lse = _fwd_impl(l2, y2, smoothing, impl_r)
+    return loss.reshape(labels.shape), (logits, labels, lse)
+
+
+def _ce_bwd(smoothing, impl, res, g):
+    logits, labels, lse = res
+    impl_r = resolve_impl(impl)
+    dx = _bwd_impl(
+        logits.reshape(-1, logits.shape[-1]),
+        labels.reshape(-1, 1).astype(jnp.int32),
+        lse,
+        g.reshape(-1, 1).astype(jnp.float32),
+        smoothing, impl_r,
+    )
+    return dx.reshape(logits.shape), None
+
+
+softmax_cross_entropy_loss.defvjp(_ce_fwd, _ce_bwd)

@@ -1,0 +1,402 @@
+"""Fused layer tests — kernel-vs-reference parity incl. gradients.
+
+Mirrors ref tests/L0/run_fused_layer_norm/test_fused_layer_norm.py,
+tests/L0/run_transformer/test_fused_softmax.py, test_fused_rope.py,
+apex/contrib/test/xentropy, fused_dense, mlp.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.fused_dense import FusedDense, FusedDenseGeluDense
+from apex_tpu.mlp import MLP
+from apex_tpu.normalization import FusedLayerNorm, FusedRMSNorm
+from apex_tpu.ops import (
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_2d,
+    fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_thd,
+    fused_layer_norm,
+    fused_rms_norm,
+    generic_scaled_masked_softmax,
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+    softmax_cross_entropy_loss,
+)
+
+
+# ---------------------------------------------------------------------------
+# layer norm / rms norm
+# ---------------------------------------------------------------------------
+
+
+def ref_layer_norm(x, w, b, eps):
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    y = (x - mu) / np.sqrt(var + eps)
+    if w is not None:
+        y = y * w
+    if b is not None:
+        y = y + b
+    return y
+
+
+class TestFusedLayerNorm:
+    @pytest.mark.parametrize("affine,bias", [(True, True), (True, False), (False, False)])
+    def test_forward(self, rng, impl, affine, bias):
+        x = rng.randn(12, 256).astype(np.float32)
+        w = rng.randn(256).astype(np.float32) if affine else None
+        b = rng.randn(256).astype(np.float32) if bias else None
+        y = fused_layer_norm(
+            jnp.asarray(x),
+            jnp.asarray(w) if affine else None,
+            jnp.asarray(b) if bias else None,
+            eps=1e-5, impl=impl,
+        )
+        np.testing.assert_allclose(
+            np.asarray(y), ref_layer_norm(x, w, b, 1e-5), rtol=2e-5, atol=1e-5
+        )
+
+    def test_grad_matches_xla_autodiff(self, rng, impl):
+        x = jnp.asarray(rng.randn(8, 128), jnp.float32)
+        w = jnp.asarray(rng.randn(128), jnp.float32)
+        b = jnp.asarray(rng.randn(128), jnp.float32)
+
+        def fused_loss(x, w, b):
+            return jnp.sum(fused_layer_norm(x, w, b, impl=impl) ** 2)
+
+        def ref_loss(x, w, b):
+            mu = x.mean(-1, keepdims=True)
+            var = jnp.mean((x - mu) ** 2, -1, keepdims=True)
+            return jnp.sum(((x - mu) * jax.lax.rsqrt(var + 1e-5) * w + b) ** 2)
+
+        g1 = jax.grad(fused_loss, argnums=(0, 1, 2))(x, w, b)
+        g2 = jax.grad(ref_loss, argnums=(0, 1, 2))(x, w, b)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-4)
+
+    def test_mixed_dtype_bf16_in_fp32_params(self, rng, impl):
+        x = jnp.asarray(rng.randn(16, 128), jnp.bfloat16)
+        w = jnp.ones((128,), jnp.float32)
+        b = jnp.zeros((128,), jnp.float32)
+        y = fused_layer_norm(x, w, b, impl=impl)
+        assert y.dtype == jnp.bfloat16
+
+    def test_multidim_normalized_shape(self, rng, impl):
+        x = rng.randn(6, 4, 32).astype(np.float32)
+        w = rng.randn(4, 32).astype(np.float32)
+        y = fused_layer_norm(jnp.asarray(x), jnp.asarray(w), None, impl=impl)
+        flat = x.reshape(6, -1)
+        expected = ref_layer_norm(flat, w.reshape(-1), None, 1e-5).reshape(6, 4, 32)
+        np.testing.assert_allclose(np.asarray(y), expected, rtol=2e-5, atol=1e-5)
+
+    def test_module(self, rng):
+        mod = FusedLayerNorm(normalized_shape=64, impl="xla")
+        x = jnp.asarray(rng.randn(4, 64), jnp.float32)
+        params = mod.init(jax.random.PRNGKey(0), x)
+        y = mod.apply(params, x)
+        assert y.shape == (4, 64)
+        assert params["params"]["scale"].shape == (64,)
+
+
+class TestFusedRMSNorm:
+    def test_forward(self, rng, impl):
+        x = rng.randn(10, 192).astype(np.float32)
+        w = rng.randn(192).astype(np.float32)
+        y = fused_rms_norm(jnp.asarray(x), jnp.asarray(w), eps=1e-6, impl=impl)
+        rms = np.sqrt((x ** 2).mean(-1, keepdims=True) + 1e-6)
+        np.testing.assert_allclose(np.asarray(y), x / rms * w, rtol=2e-5, atol=1e-5)
+
+    def test_grad(self, rng, impl):
+        x = jnp.asarray(rng.randn(8, 128), jnp.float32)
+        w = jnp.asarray(rng.randn(128), jnp.float32)
+
+        def fused_loss(x, w):
+            return jnp.sum(fused_rms_norm(x, w, eps=1e-6, impl=impl) ** 2)
+
+        def ref_loss(x, w):
+            rms = jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6)
+            return jnp.sum((x * rms * w) ** 2)
+
+        g1 = jax.grad(fused_loss, argnums=(0, 1))(x, w)
+        g2 = jax.grad(ref_loss, argnums=(0, 1))(x, w)
+        for a, e in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(e), rtol=1e-4, atol=1e-4)
+
+    def test_module(self, rng):
+        mod = FusedRMSNorm(normalized_shape=64, impl="xla")
+        x = jnp.asarray(rng.randn(4, 64), jnp.float32)
+        params = mod.init(jax.random.PRNGKey(0), x)
+        assert mod.apply(params, x).shape == (4, 64)
+
+
+# ---------------------------------------------------------------------------
+# fused softmax family
+# ---------------------------------------------------------------------------
+
+
+def np_softmax(x, axis=-1):
+    m = x.max(axis=axis, keepdims=True)
+    e = np.exp(x - m)
+    return e / e.sum(axis=axis, keepdims=True)
+
+
+class TestFusedSoftmax:
+    def test_scaled(self, rng, impl):
+        x = rng.randn(2, 4, 16, 128).astype(np.float32)
+        y = scaled_softmax(jnp.asarray(x), 0.5, impl)
+        np.testing.assert_allclose(np.asarray(y), np_softmax(0.5 * x), rtol=1e-5, atol=1e-6)
+
+    def test_causal(self, rng, impl):
+        x = rng.randn(6, 32, 32).astype(np.float32)
+        y = scaled_upper_triang_masked_softmax(jnp.asarray(x), 2.0, impl)
+        mask = np.triu(np.ones((32, 32), bool), k=1)
+        ref = np_softmax(np.where(mask, -1e30, 2.0 * x))
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-5, atol=1e-6)
+        # strictly-upper entries are exactly zero
+        assert float(np.abs(np.asarray(y)[:, 0, 1:]).max()) == 0.0
+
+    def test_masked(self, rng, impl):
+        x = rng.randn(2, 4, 16, 64).astype(np.float32)
+        mask = rng.rand(2, 1, 16, 64) > 0.7
+        y = scaled_masked_softmax(jnp.asarray(x), jnp.asarray(mask), 0.8, impl)
+        ref = np_softmax(0.8 * x + np.where(mask, -10000.0, 0.0))
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-6)
+
+    def test_masked_broadcast_batch1(self, rng, impl):
+        x = rng.randn(4, 2, 8, 64).astype(np.float32)
+        mask = rng.rand(1, 1, 8, 64) > 0.5
+        y = scaled_masked_softmax(jnp.asarray(x), jnp.asarray(mask), 1.0, impl)
+        ref = np_softmax(x + np.where(mask, -10000.0, 0.0))
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-6)
+
+    def test_generic_fallback(self, rng):
+        x = rng.randn(2, 3, 8, 32).astype(np.float32)
+        mask = rng.rand(2, 3, 8, 32) > 0.5  # full-head mask -> generic path
+        y = generic_scaled_masked_softmax(jnp.asarray(x), jnp.asarray(mask), 1.0)
+        ref = np_softmax(x + np.where(mask, -10000.0, 0.0))
+        np.testing.assert_allclose(np.asarray(y), ref, rtol=1e-4, atol=1e-6)
+
+    def test_causal_grad(self, rng, impl):
+        x = jnp.asarray(rng.randn(2, 16, 16), jnp.float32)
+        t = jnp.asarray(rng.randn(2, 16, 16), jnp.float32)
+
+        def fused_loss(x):
+            return jnp.sum(scaled_upper_triang_masked_softmax(x, 1.3, impl) * t)
+
+        def ref_loss(x):
+            row = jax.lax.broadcasted_iota(jnp.int32, (1, 16, 16), 1)
+            col = jax.lax.broadcasted_iota(jnp.int32, (1, 16, 16), 2)
+            s = jnp.where(col > row, -1e30, 1.3 * x)
+            return jnp.sum(jax.nn.softmax(s, axis=-1) * t)
+
+        g1 = jax.grad(fused_loss)(x)
+        g2 = jax.grad(ref_loss)(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-5)
+
+    def test_scaled_grad(self, rng, impl):
+        x = jnp.asarray(rng.randn(4, 8, 64), jnp.float32)
+        t = jnp.asarray(rng.randn(4, 8, 64), jnp.float32)
+
+        def fused_loss(x):
+            return jnp.sum(scaled_softmax(x, 0.7, impl) * t)
+
+        def ref_loss(x):
+            return jnp.sum(jax.nn.softmax(0.7 * x, axis=-1) * t)
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(fused_loss)(x)),
+            np.asarray(jax.grad(ref_loss)(x)),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+# ---------------------------------------------------------------------------
+# RoPE
+# ---------------------------------------------------------------------------
+
+
+def np_rope(t, freqs):
+    rot = freqs.shape[-1]
+    cos, sin = np.cos(freqs), np.sin(freqs)
+    tr, tp = t[..., :rot], t[..., rot:]
+    half = rot // 2
+    rh = np.concatenate([-tr[..., half:], tr[..., :half]], -1)
+    return np.concatenate([tr * cos + rh * sin, tp], -1)
+
+
+class TestFusedRope:
+    def test_sbhd(self, rng):
+        s, b, h, d = 16, 2, 4, 32
+        t = rng.randn(s, b, h, d).astype(np.float32)
+        freqs = rng.randn(s, 1, 1, 24).astype(np.float32)
+        y = fused_apply_rotary_pos_emb(jnp.asarray(t), jnp.asarray(freqs))
+        np.testing.assert_allclose(np.asarray(y), np_rope(t, freqs), rtol=1e-5, atol=1e-5)
+
+    def test_cached(self, rng):
+        s, b, h, d = 8, 2, 2, 16
+        t = rng.randn(s, b, h, d).astype(np.float32)
+        freqs = rng.randn(s, 1, 1, d).astype(np.float32)
+        y1 = fused_apply_rotary_pos_emb(jnp.asarray(t), jnp.asarray(freqs))
+        y2 = fused_apply_rotary_pos_emb_cached(
+            jnp.asarray(t), jnp.cos(jnp.asarray(freqs)), jnp.sin(jnp.asarray(freqs))
+        )
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
+
+    def test_thd_restarts_positions(self, rng):
+        # two sequences of length 6 and 10 packed; positions restart
+        d = 8
+        freqs = rng.randn(16, 1, 1, d).astype(np.float32)
+        t = rng.randn(16, 2, d).astype(np.float32)
+        cu = jnp.asarray([0, 6, 16], jnp.int32)
+        y = fused_apply_rotary_pos_emb_thd(jnp.asarray(t), cu, jnp.asarray(freqs))
+        # sequence 0: positions 0..5 ; sequence 1: positions 0..9
+        t_sbhd0 = t[:6][:, None]          # (6, 1, 2, d)
+        t_sbhd1 = t[6:][:, None]
+        e0 = np_rope(t_sbhd0, freqs[:6])
+        e1 = np_rope(t_sbhd1, freqs[:10])
+        np.testing.assert_allclose(np.asarray(y[:6]), e0[:, 0], rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(y[6:]), e1[:, 0], rtol=1e-5, atol=1e-5)
+
+    def test_2d(self, rng):
+        b, H, W, h, d = 2, 4, 4, 2, 16
+        t = rng.randn(b, H * W, h, d).astype(np.float32)
+        half = d // 2
+        fh = rng.randn(H, half).astype(np.float32)
+        fw = rng.randn(W, half).astype(np.float32)
+        y = fused_apply_rotary_pos_emb_2d(
+            jnp.asarray(t), H, W,
+            jnp.cos(jnp.asarray(fh)), jnp.sin(jnp.asarray(fh)),
+            jnp.cos(jnp.asarray(fw)), jnp.sin(jnp.asarray(fw)),
+        )
+        tt = t.reshape(b, H, W, h, d)
+        eh = np_rope(tt[..., :half], fh[None, :, None, None, :])
+        ew = np_rope(tt[..., half:], fw[None, None, :, None, :])
+        expected = np.concatenate([eh, ew], -1).reshape(b, H * W, h, d)
+        np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-5, atol=1e-5)
+
+    def test_grad_is_inverse_rotation(self, rng):
+        # standard RoPE: freqs are the same angle duplicated across the
+        # two halves (Megatron cat((freqs, freqs), -1)) — the reference
+        # kernel's backward-via-neg-sin identity assumes this layout
+        s, b, h, d = 8, 1, 2, 16
+        t = jnp.asarray(rng.randn(s, b, h, d), jnp.float32)
+        half = rng.randn(s, 1, 1, d // 2).astype(np.float32)
+        freqs = jnp.asarray(np.concatenate([half, half], -1), jnp.float32)
+
+        def fused_loss(t):
+            return jnp.sum(fused_apply_rotary_pos_emb(t, freqs) ** 2)
+
+        def ref_loss(t):
+            cos, sin = jnp.cos(freqs), jnp.sin(freqs)
+            half = d // 2
+            rh = jnp.concatenate([-t[..., half:], t[..., :half]], -1)
+            return jnp.sum((t * cos + rh * sin) ** 2)
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(fused_loss)(t)),
+            np.asarray(jax.grad(ref_loss)(t)),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+# ---------------------------------------------------------------------------
+# xentropy
+# ---------------------------------------------------------------------------
+
+
+class TestXentropy:
+    @pytest.mark.parametrize("smoothing", [0.0, 0.1])
+    def test_forward(self, rng, impl, smoothing):
+        logits = rng.randn(16, 512).astype(np.float32)
+        labels = rng.randint(0, 512, (16,))
+        loss = softmax_cross_entropy_loss(
+            jnp.asarray(logits), jnp.asarray(labels), smoothing, impl
+        )
+        lse = np.log(np.exp(logits - logits.max(-1, keepdims=True)).sum(-1)) + logits.max(-1)
+        nll = lse - logits[np.arange(16), labels]
+        expected = (1 - smoothing) * nll + smoothing * (lse - logits.mean(-1))
+        np.testing.assert_allclose(np.asarray(loss), expected, rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("smoothing", [0.0, 0.2])
+    def test_grad(self, rng, impl, smoothing):
+        logits = jnp.asarray(rng.randn(8, 256), jnp.float32)
+        labels = jnp.asarray(rng.randint(0, 256, (8,)), jnp.int32)
+
+        def fused_loss(l):
+            return jnp.sum(softmax_cross_entropy_loss(l, labels, smoothing, impl))
+
+        def ref_loss(l):
+            lse = jax.scipy.special.logsumexp(l, axis=-1)
+            nll = lse - jnp.take_along_axis(l, labels[:, None], 1)[:, 0]
+            smooth = lse - jnp.mean(l, -1)
+            return jnp.sum((1 - smoothing) * nll + smoothing * smooth)
+
+        np.testing.assert_allclose(
+            np.asarray(jax.grad(fused_loss)(logits)),
+            np.asarray(jax.grad(ref_loss)(logits)),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_multidim(self, rng, impl):
+        logits = jnp.asarray(rng.randn(2, 8, 128), jnp.float32)
+        labels = jnp.asarray(rng.randint(0, 128, (2, 8)), jnp.int32)
+        loss = softmax_cross_entropy_loss(logits, labels, 0.0, impl)
+        assert loss.shape == (2, 8)
+
+
+# ---------------------------------------------------------------------------
+# fused dense / MLP
+# ---------------------------------------------------------------------------
+
+
+class TestFusedDenseMLP:
+    def test_fused_dense(self, rng):
+        mod = FusedDense(features=32)
+        x = jnp.asarray(rng.randn(4, 16), jnp.float32)
+        params = mod.init(jax.random.PRNGKey(0), x)
+        y = mod.apply(params, x)
+        w = params["params"]["kernel"]
+        b = params["params"]["bias"]
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(x) @ np.asarray(w).T + np.asarray(b),
+            rtol=1e-5, atol=1e-5,
+        )
+
+    def test_gelu_dense(self, rng):
+        mod = FusedDenseGeluDense(intermediate_features=64, out_features=16)
+        x = jnp.asarray(rng.randn(4, 16), jnp.float32)
+        params = mod.init(jax.random.PRNGKey(0), x)
+        assert mod.apply(params, x).shape == (4, 16)
+
+    def test_mlp_matches_manual(self, rng):
+        mod = MLP(mlp_sizes=(16, 32, 8), activation="relu")
+        x = jnp.asarray(rng.randn(4, 16), jnp.float32)
+        params = mod.init(jax.random.PRNGKey(0), x)
+        y = mod.apply(params, x)
+        p = params["params"]
+        h = np.maximum(
+            np.asarray(x) @ np.asarray(p["kernel_0"]).T + np.asarray(p["bias_0"]), 0
+        )
+        expected = h @ np.asarray(p["kernel_1"]).T + np.asarray(p["bias_1"])
+        np.testing.assert_allclose(np.asarray(y), expected, rtol=1e-5, atol=1e-5)
+
+    def test_mlp_sigmoid_nobias(self, rng):
+        mod = MLP(mlp_sizes=(8, 16, 4), activation="sigmoid", use_bias=False)
+        x = jnp.asarray(rng.randn(2, 8), jnp.float32)
+        params = mod.init(jax.random.PRNGKey(0), x)
+        assert mod.apply(params, x).shape == (2, 4)
+
+    def test_mlp_grads_flow(self, rng):
+        mod = MLP(mlp_sizes=(8, 16, 4))
+        x = jnp.asarray(rng.randn(2, 8), jnp.float32)
+        params = mod.init(jax.random.PRNGKey(0), x)
+
+        def loss(p):
+            return jnp.sum(mod.apply(p, x) ** 2)
+
+        g = jax.grad(loss)(params)
+        assert float(jnp.abs(g["params"]["kernel_0"]).sum()) > 0
