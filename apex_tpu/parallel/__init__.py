@@ -1,0 +1,23 @@
+"""Data-parallel runtime (ref: apex/parallel/__init__.py:9-17).
+
+`DistributedDataParallel` (psum-mean grad sync policy), `Reducer`,
+`SyncBatchNorm` + `convert_syncbn_model` + BN process groups, and `LARC`.
+"""
+
+from apex_tpu.parallel.distributed import DistributedDataParallel, Reducer
+from apex_tpu.parallel.larc import LARC, larc_transform
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    convert_syncbn_model,
+    create_syncbn_group_assignment,
+)
+
+__all__ = [
+    "DistributedDataParallel",
+    "Reducer",
+    "SyncBatchNorm",
+    "convert_syncbn_model",
+    "create_syncbn_group_assignment",
+    "LARC",
+    "larc_transform",
+]

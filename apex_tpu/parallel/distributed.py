@@ -1,0 +1,107 @@
+"""Data-parallel gradient synchronization.
+
+TPU re-design of the reference's DistributedDataParallel
+(ref: apex/parallel/distributed.py). The reference's machinery —
+per-grad-accumulator hooks, arrival-order bucket construction, side
+streams, flatten/unflatten (distributed.py:254-557) — exists to overlap
+NCCL all-reduce with backward compute. Under XLA, the *scheduler* does
+that: gradients are averaged with one `psum`/`pmean` over the mesh's
+data axis inside the jitted step, and XLA overlaps the collectives with
+the backward automatically. What remains of DDP's surface is its
+*policy* knobs, kept here with reference semantics:
+
+  gradient_average          -> mean instead of sum     (distributed.py:166)
+  gradient_predivide_factor -> divide by f before, by world/f after
+                               (distributed.py:170-175,451-457)
+  allreduce_always_fp32     -> cast grads fp32 for the reduction
+                               (distributed.py:162,446-449)
+
+`Reducer` mirrors the manual helper (distributed.py:89-126).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
+
+class DistributedDataParallel:
+    """Gradient-averaging policy over the data axis.
+
+    Use inside a shard_map/pjit training step::
+
+        ddp = DistributedDataParallel(gradient_average=True)
+        grads = jax.grad(loss_fn)(params, batch_shard)
+        grads = ddp.allreduce_grads(grads)
+
+    (ref: apex.parallel.DistributedDataParallel(module, message_size=...,
+    delay_allreduce=...) — bucketing/stream knobs have no TPU analog and
+    are intentionally absent; XLA owns comm/compute overlap.)
+    """
+
+    def __init__(
+        self,
+        axis_name: str = DATA_AXIS,
+        gradient_average: bool = True,
+        gradient_predivide_factor: float = 1.0,
+        allreduce_always_fp32: bool = False,
+        axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
+    ):
+        self.axis_name = axis_name
+        self.gradient_average = gradient_average
+        self.gradient_predivide_factor = gradient_predivide_factor
+        self.allreduce_always_fp32 = allreduce_always_fp32
+        self.axis_index_groups = axis_index_groups
+
+    def allreduce_grads(self, grads: Any) -> Any:
+        """All-reduce a grad pytree over the data axis
+        (ref allreduce_fallback/comm_ready_buckets semantics,
+        distributed.py:426-557)."""
+        predivide = self.gradient_predivide_factor
+
+        def reduce_one(g):
+            dtype = g.dtype
+            if self.allreduce_always_fp32:
+                g = g.astype(jnp.float32)
+            if predivide != 1.0:
+                g = g / predivide
+            g = lax.psum(g, self.axis_name,
+                         axis_index_groups=self.axis_index_groups)
+            if self.gradient_average:
+                world = lax.axis_size(self.axis_name)
+                post = world / predivide if predivide != 1.0 else world
+                g = g / post
+            elif predivide != 1.0:
+                g = g * predivide
+            return g.astype(dtype)
+
+        return jax.tree.map(reduce_one, grads)
+
+    # parity alias matching the reference's module-method name
+    __call__ = allreduce_grads
+
+
+class Reducer:
+    """Manual all-reduce helper (ref: apex.parallel.Reducer,
+    distributed.py:89-126): call ``.reduce(tree)`` whenever you choose —
+    no implicit hooks."""
+
+    def __init__(self, axis_name: str = DATA_AXIS,
+                 axis_index_groups=None):
+        self.axis_name = axis_name
+        self.axis_index_groups = axis_index_groups
+
+    def reduce(self, tree: Any, average: bool = True) -> Any:
+        def f(x):
+            y = lax.psum(x, self.axis_name,
+                         axis_index_groups=self.axis_index_groups)
+            if average:
+                y = y / lax.axis_size(self.axis_name)
+            return y.astype(x.dtype)
+
+        return jax.tree.map(f, tree)

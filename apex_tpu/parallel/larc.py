@@ -1,0 +1,93 @@
+"""LARC — layer-wise adaptive rate clipping/scaling.
+
+TPU re-design of ref apex/parallel/LARC.py:5-107: an optimizer *wrapper*
+that replaces each tensor's lr with
+``trust_coefficient * ||p|| / (||g|| + wd*||p|| + eps)``, either clipped
+at the base lr (clip mode) or used directly (scale mode). Provided two
+ways:
+
+- `larc_transform(...)` — an optax GradientTransformation to chain
+  before any optimizer (grads are rescaled so the downstream lr step
+  realizes the adaptive lr).
+- `LARC` — wrapper class around a FlatFusedOptimizer mirroring the
+  reference's wrap-the-optimizer API.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from apex_tpu.optimizers.fused import FlatFusedOptimizer, FlatOptState, _resolve_lr
+
+
+def _adaptive_ratio(p, g, lr, trust_coefficient, clip, eps, weight_decay):
+    pn = jnp.linalg.norm(p.astype(jnp.float32))
+    gn = jnp.linalg.norm(g.astype(jnp.float32))
+    adaptive = trust_coefficient * pn / (gn + weight_decay * pn + eps)
+    adaptive = jnp.where((pn > 0) & (gn > 0), adaptive, lr)
+    if clip:
+        # clip mode: lr <- min(adaptive/lr, 1) (ref LARC.py:91-99)
+        return jnp.minimum(adaptive / lr, 1.0)
+    return adaptive / lr
+
+
+def larc_transform(
+    learning_rate: float,
+    trust_coefficient: float = 0.02,
+    clip: bool = True,
+    eps: float = 1e-8,
+    weight_decay: float = 0.0,
+) -> optax.GradientTransformation:
+    """Chainable LARC: rescales each leaf's grad by the adaptive-lr /
+    base-lr ratio so the following optimizer's step realizes LARC."""
+
+    def init_fn(params):
+        del params
+        return optax.EmptyState()
+
+    def update_fn(updates, state, params=None):
+        if params is None:
+            raise ValueError("larc_transform requires params")
+        lr = jnp.asarray(learning_rate, jnp.float32)
+
+        def scale(g, p):
+            r = _adaptive_ratio(p, g, lr, trust_coefficient, clip, eps,
+                                weight_decay)
+            return (g.astype(jnp.float32) * r).astype(g.dtype)
+
+        return jax.tree.map(scale, updates, params), state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class LARC:
+    """Wrap a FlatFusedOptimizer with LARC lr adaptation
+    (ref: apex.parallel.LARC(optimizer, trust_coefficient, clip, eps))."""
+
+    def __init__(self, optimizer: FlatFusedOptimizer,
+                 trust_coefficient: float = 0.02, clip: bool = True,
+                 eps: float = 1e-8):
+        self.optimizer = optimizer
+        self.trust_coefficient = trust_coefficient
+        self.clip = clip
+        self.eps = eps
+
+    def init(self, params) -> FlatOptState:
+        return self.optimizer.init(params)
+
+    def step(self, state: FlatOptState, grads, **kwargs):
+        lr = _resolve_lr(kwargs.pop("lr", None) or self.optimizer.lr, state.count)
+        wd = getattr(self.optimizer, "weight_decay", 0.0)
+        params = self.optimizer.master_params(state)
+
+        def scale(g, p):
+            r = _adaptive_ratio(p, g, lr, self.trust_coefficient, self.clip,
+                                self.eps, wd)
+            return (g.astype(jnp.float32) * r).astype(g.dtype)
+
+        grads = jax.tree.map(scale, grads, params)
+        return self.optimizer.step(state, grads, lr=lr, **kwargs)

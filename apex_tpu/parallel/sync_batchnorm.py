@@ -1,0 +1,177 @@
+"""Synchronized BatchNorm over mesh axes.
+
+TPU re-design of the reference's optimized SyncBatchNorm
+(ref: apex/parallel/optimized_sync_batchnorm.py,
+optimized_sync_batchnorm_kernel.py:10-119, csrc/welford.cu). The CUDA
+path computes local Welford stats, all-gathers (mean, var, count) and
+merges; on TPU the numerically-equal single-pass form is a `psum` of
+(sum, sumsq, count) over the sync axes — the merge tree disappears into
+the collective. Backward needs no custom kernel: the stats' psum is in
+the graph, so AD produces exactly the reference's reduce-then-allreduce
+backward (sum_dy, sum_dy_xmu over the group).
+
+BN process groups of size N (ref: apex/parallel/__init__.py:21-95
+create_syncbn_process_group) map to ``axis_index_groups`` on the data
+axis via `create_syncbn_group_assignment`.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
+
+def create_syncbn_group_assignment(world_size: int, group_size: int):
+    """Partition dp ranks into BN groups of ``group_size``
+    (ref: create_syncbn_process_group, apex/parallel/__init__.py:60-95).
+    Returns axis_index_groups for lax.psum."""
+    if world_size % group_size:
+        raise ValueError("world_size must be divisible by group_size")
+    return [
+        list(range(i, i + group_size))
+        for i in range(0, world_size, group_size)
+    ]
+
+
+class SyncBatchNorm(nn.Module):
+    """BatchNorm2d/1d synchronized across the data axis
+    (ref: apex.parallel.SyncBatchNorm). Channel-last layout (TPU-native;
+    the reference's NHWC 'channel_last' variant is the default here).
+
+    Use inside shard_map/pjit with the data axis mapped; pass
+    ``axis_name=None`` to run unsynchronized (single-device fallback,
+    ref optimized_sync_batchnorm.py:70-75).
+    """
+
+    num_features: int
+    eps: float = 1e-5
+    momentum: float = 0.1
+    affine: bool = True
+    track_running_stats: bool = True
+    axis_name: Optional[str] = DATA_AXIS
+    axis_index_groups: Optional[Sequence[Sequence[int]]] = None
+    fuse_relu: bool = False
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, use_running_stats: bool = False):
+        """x: (..., C) with C == num_features. ``use_running_stats``
+        selects inference normalization (ref falls back to F.batch_norm
+        for eval, optimized_sync_batchnorm.py:76-85)."""
+        c = self.num_features
+        assert x.shape[-1] == c, "SyncBatchNorm expects channels-last"
+        dtype = x.dtype
+
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((c,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((c,), jnp.float32)
+        )
+
+        if use_running_stats or not self.track_running_stats:
+            if use_running_stats:
+                mean = ra_mean.value
+                var = ra_var.value
+            else:
+                mean, var = self._batch_stats(x)
+        else:
+            mean, var = self._batch_stats(x)
+            # running-stat update uses unbiased variance like the reference
+            # (optimized_sync_batchnorm_kernel.py:53-56)
+            n = self._total_count(x)
+            unbiased = var * (n / jnp.maximum(n - 1.0, 1.0))
+            if not self.is_initializing():
+                m = self.momentum
+                ra_mean.value = (1 - m) * ra_mean.value + m * mean
+                ra_var.value = (1 - m) * ra_var.value + m * unbiased
+
+        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.eps)
+        if self.affine:
+            w = self.param("scale", nn.initializers.ones, (c,), self.param_dtype)
+            b = self.param("bias", nn.initializers.zeros, (c,), self.param_dtype)
+            y = y * w + b
+        if self.fuse_relu:
+            # (ref optimized_sync_batchnorm.py fuse_relu option)
+            y = jax.nn.relu(y)
+        return y.astype(dtype)
+
+    def _in_collective(self) -> bool:
+        if self.axis_name is None or self.is_initializing():
+            return False
+        try:
+            lax.axis_size(self.axis_name)
+            return True
+        except NameError:
+            return False
+
+    def _total_count(self, x):
+        local = 1.0
+        for d in x.shape[:-1]:
+            local *= d
+        if self._in_collective():
+            # all groups have equal size; count scales by group size
+            g = (
+                len(self.axis_index_groups[0])
+                if self.axis_index_groups
+                else lax.axis_size(self.axis_name)
+            )
+            local = local * g
+        return jnp.float32(local)
+
+    def _batch_stats(self, x):
+        xf = x.astype(jnp.float32)
+        axes = tuple(range(x.ndim - 1))
+        s = jnp.sum(xf, axis=axes)
+        ss = jnp.sum(xf * xf, axis=axes)
+        if self._in_collective():
+            s, ss = lax.psum(
+                (s, ss), self.axis_name,
+                axis_index_groups=self.axis_index_groups,
+            )
+        n = self._total_count(x)
+        mean = s / n
+        var = ss / n - mean * mean
+        return mean, var
+
+
+def convert_syncbn_model(module: nn.Module,
+                         axis_name: str = DATA_AXIS,
+                         axis_index_groups=None) -> nn.Module:
+    """Recursively swap flax BatchNorm for SyncBatchNorm
+    (ref: apex.parallel.convert_syncbn_model, __init__.py:21-58).
+
+    Flax modules are frozen dataclasses, so the swap is a structural
+    clone: any `nn.BatchNorm` attribute or submodule is replaced by an
+    equivalent `SyncBatchNorm`. Works for modules that declare BN
+    layers as dataclass fields; @nn.compact-defined BNs should use
+    SyncBatchNorm directly.
+    """
+    if isinstance(module, nn.BatchNorm):
+        return SyncBatchNorm(
+            num_features=module.num_features
+            if hasattr(module, "num_features") else -1,
+            eps=module.epsilon,
+            momentum=1.0 - module.momentum,
+            axis_name=axis_name,
+            axis_index_groups=axis_index_groups,
+        )
+    changes = {}
+    for name, value in vars(module).items():
+        if isinstance(value, nn.BatchNorm):
+            changes[name] = convert_syncbn_model(
+                value, axis_name, axis_index_groups
+            )
+        elif isinstance(value, nn.Module):
+            converted = convert_syncbn_model(value, axis_name, axis_index_groups)
+            if converted is not value:
+                changes[name] = converted
+    if changes:
+        return module.clone(**changes)
+    return module
