@@ -1,5 +1,20 @@
-"""Tensor-parallel library (ref: apex/transformer/tensor_parallel)."""
+"""Tensor-parallel library (ref: apex/transformer/tensor_parallel/__init__.py)."""
 
+from apex_tpu.transformer.tensor_parallel.cross_entropy import (
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.data import broadcast_data, shard_batch
+from apex_tpu.transformer.tensor_parallel.layers import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    column_bias_spec,
+    column_kernel_spec,
+    linear_with_grad_accumulation_and_async_allreduce,
+    row_bias_spec,
+    row_kernel_spec,
+    vocab_embedding_spec,
+)
 from apex_tpu.transformer.tensor_parallel.mappings import (
     copy_to_tensor_model_parallel_region,
     gather_from_sequence_parallel_region,
@@ -8,4 +23,19 @@ from apex_tpu.transformer.tensor_parallel.mappings import (
     reduce_scatter_to_sequence_parallel_region,
     scatter_to_sequence_parallel_region,
     scatter_to_tensor_model_parallel_region,
+)
+from apex_tpu.transformer.tensor_parallel.memory import MemoryBuffer, RingMemBuffer
+from apex_tpu.transformer.tensor_parallel.random import (
+    RngStatesTracker,
+    checkpoint,
+    checkpoint_wrapper,
+    data_parallel_rng_key,
+    model_parallel_rng_key,
+    model_parallel_seed_keys,
+)
+from apex_tpu.transformer.tensor_parallel.utils import (
+    VocabUtility,
+    divide,
+    ensure_divisibility,
+    split_tensor_along_last_dim,
 )

@@ -1,0 +1,71 @@
+"""Vocab-parallel cross entropy.
+
+TPU re-design of ref apex/transformer/tensor_parallel/cross_entropy.py:23-101
+(_VocabParallelCrossEntropy): softmax CE over a vocab-sharded logits
+tensor without ever gathering the vocab dim — psum-max, local target
+masking, psum of exp-sums. With label smoothing (the fork carries it:
+cross_entropy.py:68-87).
+
+The backward falls out of AD over the psums (each rank's dlogits is its
+local softmax minus the locally-held one-hot), identical math to the
+reference's saved-softmax backward.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
+
+
+def vocab_parallel_cross_entropy(
+    vocab_parallel_logits: jax.Array,
+    target: jax.Array,
+    label_smoothing: float = 0.0,
+    axis_name: str = TENSOR_AXIS,
+) -> jax.Array:
+    """Per-token CE losses for logits sharded over the last (vocab) dim.
+
+    vocab_parallel_logits: (..., vocab/tp) local shard, inside shard_map.
+    target: (...) global token ids.
+    """
+    logits = vocab_parallel_logits.astype(jnp.float32)
+    # numerically stable global max (ref cross_entropy.py:30-36); the
+    # shift is gradient-transparent (softmax shift invariance), so stop
+    # gradients at the pmax like the reference detaches its max
+    local_max = jnp.max(lax.stop_gradient(logits), axis=-1)
+    global_max = lax.pmax(local_max, axis_name)
+    logits = logits - lax.stop_gradient(global_max)[..., None]
+
+    tp = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    per = logits.shape[-1]
+    start, end = VocabUtility.vocab_range_from_per_partition_vocab_size(
+        per, rank, tp
+    )
+    # local target logit, masked outside this shard (ref :38-57)
+    in_range = (target >= start) & (target < end)
+    local_target = jnp.where(in_range, target - start, 0)
+    picked = jnp.take_along_axis(
+        logits, local_target[..., None], axis=-1
+    )[..., 0]
+    picked = jnp.where(in_range, picked, 0.0)
+    target_logit = lax.psum(picked, axis_name)
+
+    sum_exp = lax.psum(jnp.sum(jnp.exp(logits), axis=-1), axis_name)
+    lse = jnp.log(sum_exp)
+    loss = lse - target_logit
+
+    if label_smoothing > 0.0:
+        # ref cross_entropy.py:68-87: smoothed loss mixes mean log prob
+        vocab_size = per * tp
+        smoothing = label_smoothing * vocab_size / (vocab_size - 1)
+        mean_logit = lax.psum(jnp.sum(logits, axis=-1), axis_name) / vocab_size
+        mean_log_prob = mean_logit - lse
+        loss = (1.0 - smoothing) * loss - smoothing * mean_log_prob
+    return loss

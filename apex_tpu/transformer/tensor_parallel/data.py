@@ -1,0 +1,47 @@
+"""Batch placement utilities.
+
+TPU re-design of ref apex/transformer/tensor_parallel/data.py:80
+(broadcast_data): the reference broadcasts keyed batches from TP-rank-0
+over NCCL because each process loads data independently. In the SPMD
+single-controller model the equivalent is *placement*: shard the global
+batch over the data axis and replicate it over tensor/pipe axes with a
+NamedSharding — no broadcast collective exists at runtime because every
+TP rank addresses the same replicated buffer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS, get_mesh
+
+
+def broadcast_data(keys: Sequence[str], data: Dict[str, Any], dtype=None,
+                   mesh: Mesh = None) -> Dict[str, jax.Array]:
+    """Place ``data[key]`` batch-sharded over the data axis, replicated
+    over model-parallel axes (ref data.py:80-131: same result — every
+    TP rank sees the batch — achieved by sharding, not comms)."""
+    mesh = mesh or get_mesh()
+    out = {}
+    for k in keys:
+        arr = jnp.asarray(data[k], dtype=dtype)
+        spec = P(DATA_AXIS, *([None] * (arr.ndim - 1)))
+        out[k] = jax.device_put(arr, NamedSharding(mesh, spec))
+    return out
+
+
+def shard_batch(batch: Any, mesh: Mesh = None, batch_axis: str = DATA_AXIS):
+    """Shard an arbitrary batch pytree over the data axis."""
+    mesh = mesh or get_mesh()
+
+    def place(x):
+        x = jnp.asarray(x)
+        spec = P(batch_axis, *([None] * (x.ndim - 1)))
+        return jax.device_put(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(place, batch)

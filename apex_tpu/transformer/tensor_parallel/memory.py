@@ -1,0 +1,63 @@
+"""Preallocated-buffer shims.
+
+The reference preallocates device memory and hands out chunk views to
+avoid allocator churn for checkpointed activations
+(ref: apex/transformer/tensor_parallel/memory.py:37-133 MemoryBuffer,
+:135-162 RingMemBuffer). XLA owns allocation and buffer reuse on TPU —
+donation/aliasing replace manual pools — so these classes exist for API
+parity and as documentation anchors; `allocate` returns zeroed arrays
+and XLA's buffer assignment does the recycling the CUDA pool did.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax.numpy as jnp
+
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+
+class MemoryBuffer:
+    """ref memory.py:37-133."""
+
+    def __init__(self, numel: int, dtype=jnp.float32):
+        self.numel = numel
+        self.dtype = jnp.dtype(dtype)
+        self.data = jnp.zeros((numel,), dtype=dtype)
+        self._start = 0
+
+    def reset(self) -> None:
+        self._start = 0
+
+    def is_in_use(self) -> bool:
+        return self._start > 0
+
+    def add(self, shape: Tuple[int, ...]):
+        size = 1
+        for d in shape:
+            size *= d
+        if self._start + size > self.numel:
+            raise RuntimeError("MemoryBuffer out of space")
+        view = self.data[self._start : self._start + size].reshape(shape)
+        self._start += size
+        return view
+
+    def get_data(self):
+        return self.data
+
+
+class RingMemBuffer:
+    """ref memory.py:135-162: N rotating buffers."""
+
+    def __init__(self, name: str, num_buffers: int, numel: int, dtype=jnp.float32):
+        self.name = name
+        self.num_buffers = num_buffers
+        self.buffers = [MemoryBuffer(numel, dtype) for _ in range(num_buffers)]
+        self._index = -1
+
+    def get_next_buffer(self) -> MemoryBuffer:
+        self._index = (self._index + 1) % self.num_buffers
+        buf = self.buffers[self._index]
+        buf.reset()
+        return buf

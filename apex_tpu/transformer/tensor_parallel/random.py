@@ -1,0 +1,114 @@
+"""Model-parallel RNG discipline + activation checkpointing.
+
+TPU re-design of ref apex/transformer/tensor_parallel/random.py. The
+reference tracks named CUDA RNG *states* and forks into them so dropout
+differs across TP ranks where it must (model-parallel regions) and
+agrees where it must (data-parallel regions)
+(CudaRNGStatesTracker random.py:124-199, model_parallel_cuda_manual_seed
+:204-235). JAX keys are explicit values, so the same guarantees are a
+key-derivation convention:
+
+  data-parallel stream : the raw key (same on all TP ranks)
+  model-parallel stream: fold_in(key, 2718 + tp_rank)   (ref :226-231's
+                         tensor_model_parallel_seed = seed + 2718 + rank)
+
+`RngStatesTracker` reproduces the named-stream + fork bookkeeping for
+API parity; `checkpoint` wraps `jax.checkpoint`, which already replays
+RNG exactly in the rematerialized forward — the reference needed manual
+state save/restore (:253-283) because CUDA RNG is ambient mutable state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+
+_MODEL_PARALLEL_RNG_OFFSET = 2718  # ref random.py:219
+
+_DATA_PARALLEL_RNG_TRACKER_NAME = "data-parallel-rng"    # ref random.py:119
+_MODEL_PARALLEL_RNG_TRACKER_NAME = "model-parallel-rng"  # ref random.py:121
+
+
+def data_parallel_rng_key(key: jax.Array) -> jax.Array:
+    """Stream equal across TP ranks (dropout before TP regions)."""
+    return key
+
+
+def model_parallel_rng_key(key: jax.Array,
+                           axis_name: str = TENSOR_AXIS) -> jax.Array:
+    """Stream distinct per TP rank — inside shard_map
+    (ref tensor_model_parallel_seed, random.py:226-231)."""
+    return jax.random.fold_in(
+        jax.random.fold_in(key, _MODEL_PARALLEL_RNG_OFFSET),
+        lax.axis_index(axis_name),
+    )
+
+
+def model_parallel_seed_keys(seed: int, axis_name: str = TENSOR_AXIS):
+    """Build both streams from an int seed, inside shard_map
+    (ref model_parallel_cuda_manual_seed, random.py:204-235)."""
+    base = jax.random.PRNGKey(seed)
+    return {
+        _DATA_PARALLEL_RNG_TRACKER_NAME: base,
+        _MODEL_PARALLEL_RNG_TRACKER_NAME: model_parallel_rng_key(base, axis_name),
+    }
+
+
+class RngStatesTracker:
+    """Named RNG streams with fork semantics, functionally
+    (ref CudaRNGStatesTracker random.py:124-199). Each ``fork`` returns
+    a fresh subkey and advances the stream — the functional equivalent
+    of entering the forked CUDA generator state."""
+
+    def __init__(self):
+        self._states: Dict[str, jax.Array] = {}
+
+    def reset(self) -> None:
+        self._states = {}
+
+    def get_states(self) -> Dict[str, jax.Array]:
+        return dict(self._states)
+
+    def set_states(self, states: Dict[str, jax.Array]) -> None:
+        self._states = dict(states)
+
+    def add(self, name: str, seed_or_key) -> None:
+        if name in self._states:
+            raise ValueError(f"rng state {name} already exists")
+        key = (
+            jax.random.PRNGKey(seed_or_key)
+            if isinstance(seed_or_key, int)
+            else seed_or_key
+        )
+        self._states[name] = key
+
+    def fork(self, name: str = _MODEL_PARALLEL_RNG_TRACKER_NAME) -> jax.Array:
+        if name not in self._states:
+            raise ValueError(f"rng state {name} is not added")
+        key, sub = jax.random.split(self._states[name])
+        self._states[name] = key
+        return sub
+
+
+# -- activation checkpointing (ref random.py:237-308 CheckpointFunction) ---
+
+
+def checkpoint(fn: Callable, *args,
+               policy: Optional[Callable] = None, **kwargs):
+    """Checkpointed call: recompute ``fn`` in the backward instead of
+    saving activations. `jax.checkpoint` replays traced RNG exactly, so
+    the reference's fork/save/restore dance is implicit. ``policy``
+    takes any `jax.checkpoint_policies` member (e.g.
+    ``dots_with_no_batch_dims_saveable``) — the analog of the
+    reference's partial/selective checkpointing options."""
+    return jax.checkpoint(fn, policy=policy)(*args, **kwargs)
+
+
+def checkpoint_wrapper(fn: Callable, policy: Optional[Callable] = None):
+    """Decorator form, for wrapping transformer blocks."""
+    return jax.checkpoint(fn, policy=policy)

@@ -1,0 +1,48 @@
+"""TP utilities (ref: apex/transformer/tensor_parallel/utils.py:22-80,
+apex/transformer/utils.py divide/ensure_divisibility)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def ensure_divisibility(numerator: int, denominator: int) -> None:
+    if numerator % denominator:
+        raise ValueError(f"{numerator} is not divisible by {denominator}")
+
+
+def divide(numerator: int, denominator: int) -> int:
+    ensure_divisibility(numerator, denominator)
+    return numerator // denominator
+
+
+def split_tensor_along_last_dim(tensor: jax.Array, num_partitions: int):
+    """ref utils.py:22-43 (contiguity flag is meaningless under XLA)."""
+    last = divide(tensor.shape[-1], num_partitions)
+    return tuple(
+        jax.lax.slice_in_dim(tensor, i * last, (i + 1) * last, axis=tensor.ndim - 1)
+        for i in range(num_partitions)
+    )
+
+
+class VocabUtility:
+    """Vocab range math for row-sharded embeddings
+    (ref utils.py:46-80)."""
+
+    @staticmethod
+    def vocab_range_from_per_partition_vocab_size(
+        per_partition_vocab_size: int, rank, world_size: int
+    ):
+        f = rank * per_partition_vocab_size
+        return f, f + per_partition_vocab_size
+
+    @staticmethod
+    def vocab_range_from_global_vocab_size(global_vocab_size: int, rank,
+                                           world_size: int):
+        per = divide(global_vocab_size, world_size)
+        return VocabUtility.vocab_range_from_per_partition_vocab_size(
+            per, rank, world_size
+        )
