@@ -1,0 +1,3 @@
+"""Model-parallel amp (ref: apex/transformer/amp)."""
+
+from apex_tpu.transformer.amp.grad_scaler import GradScaler, allreduce_found_inf

@@ -1,0 +1,40 @@
+"""Model-parallel-aware grad scaler.
+
+TPU re-design of ref apex/transformer/amp/grad_scaler.py:21-61: the
+reference subclasses torch GradScaler to all-reduce found_inf across
+the model-parallel group so every TP/PP rank skips the same step. Here
+that is one psum of the found_inf scalar over the model axes inside the
+jitted step.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS, TENSOR_AXIS
+
+
+def allreduce_found_inf(found_inf,
+                        axis_names: Sequence[str] = (TENSOR_AXIS, PIPELINE_AXIS)):
+    """OR-reduce found_inf over the model-parallel axes
+    (ref grad_scaler.py:36-61 _unscale_grads_/update hooks)."""
+    for ax in axis_names:
+        found_inf = lax.psum(found_inf, ax)
+    return jnp.minimum(found_inf, 1.0)
+
+
+class GradScaler(LossScaler):
+    """LossScaler whose update first syncs found_inf across model axes
+    (ref: apex.transformer.amp.grad_scaler.GradScaler)."""
+
+    def __init__(self, *args, axis_names=(TENSOR_AXIS, PIPELINE_AXIS),
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self.axis_names = tuple(axis_names)
+
+    def update(self, state: ScalerState, found_inf) -> ScalerState:
+        return super().update(state, allreduce_found_inf(found_inf, self.axis_names))

@@ -1,0 +1,12 @@
+"""Transformer functional ops (ref: apex/transformer/functional)."""
+
+from apex_tpu.ops.rope import (
+    fused_apply_rotary_pos_emb,
+    fused_apply_rotary_pos_emb_2d,
+    fused_apply_rotary_pos_emb_cached,
+    fused_apply_rotary_pos_emb_thd,
+)
+from apex_tpu.transformer.functional.fused_softmax import (
+    AttnMaskType,
+    FusedScaleMaskSoftmax,
+)

@@ -1,0 +1,88 @@
+"""FusedScaleMaskSoftmax — kernel-selection wrapper.
+
+TPU re-design of ref apex/transformer/functional/fused_softmax.py:164-273:
+the module that picks the right fused softmax (causal vs masked vs
+plain) by mask type / dtype / shape and falls back to the unfused path
+outside kernel limits. The CUDA kernels' shape limits (sk <= 4096 etc.,
+fused_softmax.py:194-213 is_kernel_available) don't bind on TPU; the
+availability check kept here is only "rows fit VMEM", everything else
+routes to the same Pallas kernels.
+"""
+
+from __future__ import annotations
+
+import enum
+from typing import Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.ops.softmax import (
+    scaled_masked_softmax,
+    scaled_softmax,
+    scaled_upper_triang_masked_softmax,
+)
+
+
+class AttnMaskType(enum.Enum):
+    """ref apex/transformer/enums.py AttnMaskType."""
+
+    padding = 1
+    causal = 2
+
+
+class FusedScaleMaskSoftmax:
+    """fused softmax dispatcher (ref fused_softmax.py FusedScaleMaskSoftmax).
+
+    input: (b, np, sq, sk) attention scores.
+    mask: boolean, True = masked (padding mask), or None for causal.
+    """
+
+    def __init__(
+        self,
+        input_in_fp16: bool = False,
+        input_in_bf16: bool = False,
+        attn_mask_type: AttnMaskType = AttnMaskType.padding,
+        scaled_masked_softmax_fusion: bool = True,
+        mask_func=None,
+        softmax_in_fp32: bool = True,
+        scale: Optional[float] = None,
+        impl: Optional[str] = None,
+    ):
+        self.input_in_float16 = input_in_fp16 or input_in_bf16
+        self.attn_mask_type = attn_mask_type
+        self.scaled_masked_softmax_fusion = scaled_masked_softmax_fusion
+        self.mask_func = mask_func
+        self.softmax_in_fp32 = softmax_in_fp32
+        self.scale = scale
+        self.impl = impl
+        if scale is not None and not softmax_in_fp32:
+            raise ValueError("softmax should be in fp32 when scaled")
+
+    def is_kernel_available(self, mask, b, np_, sq, sk) -> bool:
+        """ref fused_softmax.py:194-213 — TPU kernels have no fixed sk
+        ceiling; require only lane-friendly row width."""
+        return self.scaled_masked_softmax_fusion and sk >= 1
+
+    def __call__(self, inp, mask=None):
+        assert inp.ndim == 4
+        b, np_, sq, sk = inp.shape
+        scale = self.scale if self.scale is not None else 1.0
+        if self.is_kernel_available(mask, b, np_, sq, sk):
+            if self.attn_mask_type == AttnMaskType.causal:
+                out = scaled_upper_triang_masked_softmax(
+                    inp.reshape(-1, sq, sk), scale, self.impl
+                )
+                return out.reshape(b, np_, sq, sk)
+            if mask is not None:
+                return scaled_masked_softmax(inp, mask, scale, self.impl)
+            return scaled_softmax(inp, scale, self.impl)
+        # unfused path (ref forward_torch_softmax :252-270)
+        x = inp.astype(jnp.float32) if self.softmax_in_fp32 else inp
+        x = x * scale
+        if self.mask_func is not None and mask is not None:
+            x = self.mask_func(x, mask)
+        elif mask is not None:
+            x = jnp.where(mask, -10000.0, x)
+        out = jnp.exp(x - jnp.max(x, -1, keepdims=True))
+        out = out / jnp.sum(out, -1, keepdims=True)
+        return out.astype(inp.dtype)

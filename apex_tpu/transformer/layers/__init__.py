@@ -1,0 +1,3 @@
+"""Transformer layer modules (ref: apex/transformer/layers)."""
+
+from apex_tpu.transformer.layers.layer_norm import FusedLayerNorm, MixedFusedLayerNorm

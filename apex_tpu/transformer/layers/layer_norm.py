@@ -1,0 +1,19 @@
+"""Sequence-parallel-aware layer norm (ref: apex/transformer/layers/layer_norm.py:26-99).
+
+The reference subclasses FusedLayerNorm only to tag params with
+``sequence_parallel`` so DDP all-reduces their grads separately (SP
+shards activations, so norm-param grads are partial per rank). In the
+SPMD design that bookkeeping is structural: norm params are replicated
+in the mesh specs and shard_map's transpose already psums their grads
+over the tensor axis. The subclass is kept for API parity and carries
+the ``sequence_parallel_enabled`` flag as metadata.
+"""
+
+from apex_tpu.normalization import FusedLayerNorm as _FusedLayerNorm
+
+
+class FusedLayerNorm(_FusedLayerNorm):
+    sequence_parallel_enabled: bool = False
+
+
+MixedFusedLayerNorm = FusedLayerNorm

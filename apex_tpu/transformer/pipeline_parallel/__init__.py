@@ -1,0 +1,38 @@
+"""Pipeline-parallel library (ref: apex/transformer/pipeline_parallel)."""
+
+from apex_tpu.transformer.pipeline_parallel.microbatches import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    build_num_microbatches_calculator,
+)
+from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
+    recv_backward,
+    recv_forward,
+    send_backward,
+    send_backward_recv_backward,
+    send_backward_recv_forward,
+    send_forward,
+    send_forward_recv_backward,
+    send_forward_recv_forward,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    last_stage_value,
+    spmd_pipeline,
+)
+from apex_tpu.transformer.pipeline_parallel.utils import (
+    Timers,
+    average_losses_across_data_parallel_group,
+    calc_params_l2_norm,
+    get_current_global_batch_size,
+    get_kth_microbatch,
+    get_ltor_masks_and_position_ids,
+    get_micro_batch_size,
+    get_num_microbatches,
+    get_timers,
+    setup_microbatch_calculator,
+    update_num_microbatches,
+)

@@ -1,0 +1,67 @@
+"""Stage-to-stage activation transfer.
+
+TPU re-design of ref apex/transformer/pipeline_parallel/p2p_communication.py.
+The reference pairs isend/irecv between pipeline neighbors with shape
+negotiation and optional scatter-gather (p2p_communication.py:48-330).
+On TPU there are no point-to-point process calls: a stage transfer is a
+`lax.ppermute` ring shift over the pipe axis inside the jitted step —
+XLA lowers it to a neighbor-to-neighbor ICI CollectivePermute, the
+hardware-native equivalent of batch_isend_irecv, with shapes static at
+trace time (no negotiation handshake needed).
+
+These helpers keep the reference's vocabulary: send_forward == shift
++1 along the ring, send_backward == shift -1; the *_recv fused forms
+are the same single collective (a ppermute both sends and receives).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+
+
+def _ring(axis_name: str, reverse: bool = False):
+    size = lax.axis_size(axis_name)
+    if reverse:
+        return [(i, (i - 1) % size) for i in range(size)]
+    return [(i, (i + 1) % size) for i in range(size)]
+
+
+def send_forward_recv_forward(x, axis_name: str = PIPELINE_AXIS):
+    """Shift activations one stage forward (ref p2p_communication.py
+    send_forward_recv_forward): stage s's x arrives at stage s+1; stage
+    0 receives stage S-1's (callers mask the wraparound)."""
+    return lax.ppermute(x, axis_name, _ring(axis_name))
+
+
+def send_backward_recv_backward(g, axis_name: str = PIPELINE_AXIS):
+    """Shift gradients one stage backward (ref send_backward_recv_backward)."""
+    return lax.ppermute(g, axis_name, _ring(axis_name, reverse=True))
+
+
+# parity aliases: in SPMD a send IS the fused send/recv collective
+send_forward = send_forward_recv_forward
+send_backward = send_backward_recv_backward
+recv_forward = send_forward_recv_forward
+recv_backward = send_backward_recv_backward
+
+
+def send_forward_recv_backward(x, g, axis_name: str = PIPELINE_AXIS):
+    """Fused 1F1B steady-state exchange (ref
+    send_forward_recv_backward): one collective carrying activations
+    forward and grads backward simultaneously."""
+    return (
+        lax.ppermute(x, axis_name, _ring(axis_name)),
+        lax.ppermute(g, axis_name, _ring(axis_name, reverse=True)),
+    )
+
+
+def send_backward_recv_forward(g, x, axis_name: str = PIPELINE_AXIS):
+    return (
+        lax.ppermute(g, axis_name, _ring(axis_name, reverse=True)),
+        lax.ppermute(x, axis_name, _ring(axis_name)),
+    )

@@ -1,0 +1,270 @@
+"""Pipeline schedules — SPMD scan pipelines instead of imperative 1F1B.
+
+TPU re-design of ref apex/transformer/pipeline_parallel/schedules/*:
+  fwd_bwd_no_pipelining.py:31            -> forward_backward_no_pipelining
+  fwd_bwd_pipelining_without_interleaving.py:228 -> ..._without_interleaving
+  fwd_bwd_pipelining_with_interleaving.py:26     -> ..._with_interleaving
+  schedules/__init__.py:22-35            -> get_forward_backward_func
+
+The reference drives warmup/steady(1F1B)/cooldown per rank with
+isend/irecv. In SPMD there is ONE program: the pipeline is a
+`lax.scan` over M + S - 1 ticks; at tick t, stage s computes microbatch
+t-s and a single `ppermute` rotates activations. `jax.grad` of that
+scan IS the backward pipeline (the transpose of ppermute is the reverse
+shift; the reverse scan replays cooldown->steady->warmup), so the
+forward and backward bubbles match the reference's schedule without any
+per-rank imperative control flow. Memory matches 1F1B when `remat`
+wraps the stage function (activations per in-flight microbatch, not
+per layer).
+
+The interleaved variant runs the ring `vpp` times (model chunks), the
+same dataflow as interleaved 1F1B (each microbatch crosses every device
+vpp times).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import PIPELINE_AXIS
+
+Params = Any
+Batch = Any
+
+
+# ---------------------------------------------------------------------------
+# core SPMD pipeline primitive
+# ---------------------------------------------------------------------------
+
+
+def spmd_pipeline(
+    stage_fn: Callable[[Params, jax.Array], jax.Array],
+    stage_params: Params,
+    x_microbatches: jax.Array,
+    *,
+    axis_name: str = PIPELINE_AXIS,
+    remat: bool = True,
+) -> jax.Array:
+    """Run microbatches through the pipeline ring once.
+
+    stage_fn(stage_params, x) -> y        (local stage transform)
+    x_microbatches: (M, mb, ...) inputs for stage 0 (replicated on all
+    pp ranks — SPMD; other ranks' copies feed the bubble ticks).
+
+    Returns (M, mb, ...) outputs of the LAST stage, replicated-shape on
+    every rank but only meaningful on the last (callers typically psum a
+    masked loss; see `last_stage_value`).
+    """
+    m = x_microbatches.shape[0]
+    s_size = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    ticks = m + s_size - 1
+    perm = [(i, (i + 1) % s_size) for i in range(s_size)]
+
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    def tick(carry, t):
+        buf, outputs = carry
+        mb_idx = t - rank
+        # stage 0 picks up a fresh microbatch; others take the rotated buf
+        fresh = lax.dynamic_index_in_dim(
+            x_microbatches, jnp.clip(t, 0, m - 1), 0, keepdims=False
+        )
+        x = jnp.where(rank == 0, fresh, buf)
+        y = fn(stage_params, x)
+        active = jnp.logical_and(mb_idx >= 0, mb_idx < m)
+        # last stage records its finished microbatch
+        write_idx = jnp.clip(mb_idx, 0, m - 1)
+        cur = lax.dynamic_index_in_dim(outputs, write_idx, 0, keepdims=False)
+        rec = jnp.where(jnp.logical_and(active, rank == s_size - 1), y, cur)
+        outputs = lax.dynamic_update_index_in_dim(outputs, rec, write_idx, 0)
+        # one collective rotates activations to the next stage
+        buf = lax.ppermute(y, axis_name, perm)
+        return (buf, outputs), None
+
+    y0 = jax.eval_shape(fn, stage_params, x_microbatches[0])
+    buf0 = jnp.zeros(y0.shape, y0.dtype)
+    outputs0 = jnp.zeros((m,) + y0.shape, y0.dtype)
+    (_, outputs), _ = lax.scan(
+        tick, (buf0, outputs0), jnp.arange(ticks)
+    )
+    return outputs
+
+
+def last_stage_value(value, axis_name: str = PIPELINE_AXIS):
+    """Broadcast a value computed on the last stage to every pp rank
+    (replaces the reference's implicit 'loss lives on the last rank')."""
+    s_size = lax.axis_size(axis_name)
+    rank = lax.axis_index(axis_name)
+    mask = (rank == s_size - 1).astype(value.dtype)
+    return lax.psum(value * mask, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# schedule functions (reference API shape)
+# ---------------------------------------------------------------------------
+
+
+def _split_microbatches(batch: Batch, num_microbatches: int) -> Batch:
+    """Reshape leading batch dim to (M, mb, ...)."""
+
+    def split(x):
+        return x.reshape((num_microbatches, x.shape[0] // num_microbatches)
+                         + x.shape[1:])
+
+    return jax.tree.map(split, batch)
+
+
+def forward_backward_no_pipelining(
+    forward_step_func: Callable[[Params, Batch], jax.Array],
+    batch: Batch,
+    params: Params,
+    *,
+    num_microbatches: int = 1,
+    forward_only: bool = False,
+    grad_scale=None,
+):
+    """Microbatched grad accumulation without pipelining
+    (ref fwd_bwd_no_pipelining.py:31): scan microbatches, average the
+    loss, sum the grads. The reference's no-sync context for all but
+    the last microbatch is moot — grads accumulate functionally and any
+    DDP reduction happens once, after."""
+    mb = _split_microbatches(batch, num_microbatches)
+
+    def one(params, microbatch):
+        loss = forward_step_func(params, microbatch)
+        if grad_scale is not None:
+            loss = loss * grad_scale
+        return loss
+
+    if forward_only:
+        def body(carry, microbatch):
+            return carry + one(params, microbatch), None
+
+        total, _ = lax.scan(body, jnp.zeros((), jnp.float32), mb)
+        return total / num_microbatches, None
+
+    grad_fn = jax.value_and_grad(one)
+
+    def body(carry, microbatch):
+        loss_acc, grad_acc = carry
+        loss, grads = grad_fn(params, microbatch)
+        return (loss_acc + loss,
+                jax.tree.map(jnp.add, grad_acc, grads)), None
+
+    zero_grads = jax.tree.map(jnp.zeros_like, params)
+    (loss, grads), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), zero_grads), mb
+    )
+    inv = 1.0 / num_microbatches
+    return loss * inv, jax.tree.map(lambda g: g * inv, grads)
+
+
+def forward_backward_pipelining_without_interleaving(
+    stage_fn: Callable[[Params, jax.Array], jax.Array],
+    loss_fn: Callable[[jax.Array, Batch], jax.Array],
+    pre_fn: Optional[Callable[[Params, Batch], jax.Array]],
+    params: Params,
+    batch: Batch,
+    *,
+    num_microbatches: int,
+    axis_name: str = PIPELINE_AXIS,
+    forward_only: bool = False,
+    remat: bool = True,
+):
+    """Pipelined forward+backward over the pipe axis
+    (ref fwd_bwd_pipelining_without_interleaving.py:228).
+
+    pre_fn(params, microbatch) -> x0     (embedding; every rank computes)
+    stage_fn(params, x) -> y             (this rank's stage body)
+    loss_fn is applied to the last stage's outputs; its mean over
+    microbatches is returned on every rank (psum-masked broadcast).
+    Backward is jax.grad through the scan — the reverse pipeline.
+    """
+    mb = _split_microbatches(batch, num_microbatches)
+
+    # The differentiated loss is RAW per-rank (meaningful on the last
+    # stage only, constant elsewhere): in SPMD AD every rank seeds its
+    # own copy, the ppermute transposes route the last stage's cotangent
+    # to every stage, and the dead ranks' losses contribute zero grad.
+    # Broadcasting the value through a psum BEFORE grad would multiply
+    # every cotangent by the pipe size.
+    def total_loss(params):
+        if pre_fn is not None:
+            x_mb = jax.vmap(lambda b: pre_fn(params, b))(mb)
+        else:
+            x_mb = mb
+        outs = spmd_pipeline(
+            stage_fn, params, x_mb, axis_name=axis_name, remat=remat
+        )
+        losses = jax.vmap(lambda y, b: loss_fn(y, b))(outs, mb)
+        return jnp.mean(losses)
+
+    if forward_only:
+        return last_stage_value(total_loss(params), axis_name), None
+    loss, grads = jax.value_and_grad(total_loss)(params)
+    return last_stage_value(loss, axis_name), grads
+
+
+def forward_backward_pipelining_with_interleaving(
+    stage_fn: Callable[[Params, jax.Array, int], jax.Array],
+    loss_fn: Callable[[jax.Array, Batch], jax.Array],
+    pre_fn: Optional[Callable[[Params, Batch], jax.Array]],
+    params: Params,
+    batch: Batch,
+    *,
+    num_microbatches: int,
+    num_model_chunks: int,
+    axis_name: str = PIPELINE_AXIS,
+    forward_only: bool = False,
+    remat: bool = True,
+):
+    """Interleaved (virtual pipeline) schedule
+    (ref fwd_bwd_pipelining_with_interleaving.py:26): each rank hosts
+    ``num_model_chunks`` model chunks; a microbatch crosses the ring
+    once per chunk. ``stage_fn(params, x, chunk_id)`` selects the local
+    chunk (chunk params indexed by leading axis, mirroring the
+    reference's model-chunk list from build_model common.py:30-151)."""
+    mb = _split_microbatches(batch, num_microbatches)
+    s_axis = axis_name
+
+    def total_loss(params):
+        if pre_fn is not None:
+            x_mb = jax.vmap(lambda b: pre_fn(params, b))(mb)
+        else:
+            x_mb = mb
+        for chunk in range(num_model_chunks):
+            x_mb = spmd_pipeline(
+                functools.partial(stage_fn, chunk_id=chunk),
+                params, x_mb, axis_name=s_axis, remat=remat,
+            )
+            if chunk != num_model_chunks - 1:
+                # outputs live on the last stage; rotate them to stage 0
+                # for the next chunk's ring traversal
+                size = lax.axis_size(s_axis)
+                perm = [(i, (i + 1) % size) for i in range(size)]
+                x_mb = lax.ppermute(x_mb, s_axis, perm)
+        losses = jax.vmap(lambda y, b: loss_fn(y, b))(x_mb, mb)
+        return jnp.mean(losses)   # raw per-rank loss; see note above
+
+    if forward_only:
+        return last_stage_value(total_loss(params), s_axis), None
+    loss, grads = jax.value_and_grad(total_loss)(params)
+    return last_stage_value(loss, s_axis), grads
+
+
+def get_forward_backward_func(
+    virtual_pipeline_model_parallel_size: Optional[int] = None,
+    pipeline_model_parallel_size: int = 1,
+):
+    """Schedule dispatch (ref schedules/__init__.py:22-35)."""
+    if pipeline_model_parallel_size > 1:
+        if virtual_pipeline_model_parallel_size is not None:
+            return forward_backward_pipelining_with_interleaving
+        return forward_backward_pipelining_without_interleaving
+    return forward_backward_no_pipelining

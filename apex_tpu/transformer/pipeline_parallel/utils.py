@@ -1,0 +1,199 @@
+"""Pipeline-parallel utilities.
+
+TPU re-design of ref apex/transformer/pipeline_parallel/utils.py:
+global microbatch calculator (:58-103), batch slicing (:122),
+DP loss averaging (:242), TP-aware global param norm (:213-239),
+ltor masks (:303), and `_Timers` (pipeline_parallel/_timers.py:6-83).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import DATA_AXIS, TENSOR_AXIS
+from apex_tpu.transformer.pipeline_parallel.microbatches import (
+    build_num_microbatches_calculator,
+)
+
+_GLOBAL_NUM_MICROBATCHES_CALCULATOR = None
+_GLOBAL_TIMERS = None
+
+
+def setup_microbatch_calculator(
+    global_batch_size: int,
+    micro_batch_size: int,
+    data_parallel_size: int,
+    rampup_batch_size: Optional[Sequence[int]] = None,
+) -> None:
+    """ref utils.py:58-103 (rank arg dropped: SPMD single controller)."""
+    global _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+    _GLOBAL_NUM_MICROBATCHES_CALCULATOR = build_num_microbatches_calculator(
+        global_batch_size, micro_batch_size, data_parallel_size,
+        rampup_batch_size,
+    )
+
+
+def _ensure_calculator():
+    if _GLOBAL_NUM_MICROBATCHES_CALCULATOR is None:
+        raise RuntimeError("call setup_microbatch_calculator first")
+    return _GLOBAL_NUM_MICROBATCHES_CALCULATOR
+
+
+def get_num_microbatches() -> int:
+    return _ensure_calculator().get()
+
+
+def get_current_global_batch_size() -> int:
+    return _ensure_calculator().get_current_global_batch_size()
+
+
+def get_micro_batch_size() -> int:
+    return _ensure_calculator().micro_batch_size
+
+
+def update_num_microbatches(consumed_samples: int,
+                            consistency_check: bool = True) -> None:
+    _ensure_calculator().update(consumed_samples, consistency_check)
+
+
+def get_kth_microbatch(batch: Any, k: int, micro_batch_size: int) -> Any:
+    """Slice the k-th microbatch from a batch pytree (ref utils.py:122)."""
+    return jax.tree.map(
+        lambda x: lax.dynamic_slice_in_dim(
+            x, k * micro_batch_size, micro_batch_size, 0
+        ),
+        batch,
+    )
+
+
+def average_losses_across_data_parallel_group(losses: Sequence[jax.Array],
+                                              axis_name: str = DATA_AXIS):
+    """ref utils.py:242-252."""
+    stacked = jnp.stack([jnp.mean(l.astype(jnp.float32)) for l in losses])
+    return lax.pmean(stacked, axis_name)
+
+
+def calc_params_l2_norm(params: Any, axis_name: str = TENSOR_AXIS,
+                        params_sharded: bool = True) -> jax.Array:
+    """Global parameter L2 norm, TP-aware (ref utils.py:213-239: the
+    reference must dedupe TP-replicated params; here the caller states
+    whether the pytree leaves are shards (sum over axis) or replicated)."""
+    sumsq = sum(
+        jnp.sum(jnp.square(l.astype(jnp.float32)))
+        for l in jax.tree.leaves(params)
+    )
+    if params_sharded:
+        sumsq = lax.psum(sumsq, axis_name)
+    return jnp.sqrt(sumsq)
+
+
+def get_ltor_masks_and_position_ids(
+    data: jax.Array,
+    eod_token: Optional[int] = None,
+    reset_position_ids: bool = False,
+    reset_attention_mask: bool = False,
+    eod_mask_loss: bool = False,
+):
+    """Left-to-right (causal) masks + position ids (ref utils.py:303-357).
+
+    Returns (attention_mask [b,1,s,s] bool where True = MASKED, matching
+    the reference's `< 0.5` convention after its tril, loss_mask [b,s],
+    position_ids [b,s]). EOD-based sub-document resets are supported
+    with static shapes via cumulative segment counting.
+    """
+    b, s = data.shape
+    causal = jnp.triu(jnp.ones((s, s), jnp.bool_), k=1)  # True above diag
+    attention_mask = jnp.broadcast_to(causal, (b, 1, s, s))
+
+    loss_mask = jnp.ones((b, s), jnp.float32)
+    if eod_mask_loss and eod_token is not None:
+        loss_mask = jnp.where(data == eod_token, 0.0, loss_mask)
+
+    position_ids = jnp.broadcast_to(jnp.arange(s), (b, s))
+    if eod_token is not None and (reset_position_ids or reset_attention_mask):
+        # segment id = number of EODs strictly before each token
+        is_eod = (data == eod_token).astype(jnp.int32)
+        seg = jnp.cumsum(is_eod, axis=1) - is_eod  # EOD belongs to its segment
+        if reset_position_ids:
+            # position within segment: global pos minus segment start
+            seg_start = jnp.where(
+                seg[:, :, None] == seg[:, None, :],
+                jnp.arange(s)[None, None, :], s,
+            ).min(axis=-1)
+            position_ids = jnp.arange(s)[None, :] - seg_start
+        if reset_attention_mask:
+            same_seg = seg[:, :, None] == seg[:, None, :]
+            attention_mask = attention_mask | ~same_seg[:, None, :, :]
+    return attention_mask, loss_mask, position_ids
+
+
+class _Timer:
+    """Host-side named timer with device sync
+    (ref _timers.py:6-50: cuda.synchronize becomes block_until_ready)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed_ = 0.0
+        self.started_ = False
+        self.start_time = 0.0
+
+    def start(self, barrier_data=None):
+        assert not self.started_
+        if barrier_data is not None:
+            jax.block_until_ready(barrier_data)
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, barrier_data=None):
+        assert self.started_
+        if barrier_data is not None:
+            jax.block_until_ready(barrier_data)
+        self.elapsed_ += time.time() - self.start_time
+        self.started_ = False
+
+    def reset(self):
+        self.elapsed_ = 0.0
+        self.started_ = False
+
+    def elapsed(self, reset=True):
+        started = self.started_
+        if started:
+            self.stop()
+        e = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return e
+
+
+class Timers:
+    """Named timer registry (ref _timers.py:53-83 + get_timers
+    utils.py:146-157)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: Sequence[str], normalizer: float = 1.0) -> str:
+        parts = [
+            f"{n}: {self.timers[n].elapsed(reset=False) * 1000.0 / normalizer:.2f}"
+            for n in names if n in self.timers
+        ]
+        return "time (ms) | " + " | ".join(parts)
+
+
+def get_timers() -> Timers:
+    global _GLOBAL_TIMERS
+    if _GLOBAL_TIMERS is None:
+        _GLOBAL_TIMERS = Timers()
+    return _GLOBAL_TIMERS

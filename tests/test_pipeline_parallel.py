@@ -1,0 +1,305 @@
+"""Pipeline-parallel tests.
+
+Mirrors ref tests/L0/run_transformer/test_pipeline_parallel_fwd_bwd.py,
+test_p2p_comm.py, test_microbatches.py — on the simulated mesh: the
+pipelined loss/grads must equal the single-device sequential model.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.pipeline_parallel import (
+    ConstantNumMicroBatches,
+    RampupBatchsizeNumMicroBatches,
+    forward_backward_no_pipelining,
+    forward_backward_pipelining_with_interleaving,
+    forward_backward_pipelining_without_interleaving,
+    get_forward_backward_func,
+    get_kth_microbatch,
+    get_ltor_masks_and_position_ids,
+    send_forward_recv_forward,
+    spmd_pipeline,
+)
+
+PP = 4
+
+
+@pytest.fixture
+def pp_mesh():
+    m = ps.initialize_model_parallel(1, PP)  # dp=2, pp=4
+    yield m
+    ps.destroy_model_parallel()
+
+
+class TestP2P:
+    def test_ring_shift(self, pp_mesh):
+        def f(x):
+            r = jax.lax.axis_index("pipe").astype(jnp.float32)
+            y = send_forward_recv_forward(x + r)
+            return y[None]
+
+        out = jax.jit(
+            shard_map(
+                f, mesh=pp_mesh, in_specs=(P(),), out_specs=P(None, "pipe"),
+                check_vma=False,
+            )
+        )(jnp.zeros((2,)))
+        # stage s receives from s-1: row s = (s-1) mod PP
+        got = np.asarray(out).reshape(2, PP).T[0] if False else None
+        arr = np.asarray(out)  # (1*? ...) shape (1? ...)
+        # out shape: (1, PP*2)? out_specs P(None, "pipe") concat on dim1
+        vals = arr.reshape(1, PP, 2)[0, :, 0]
+        np.testing.assert_array_equal(vals, [(s - 1) % PP for s in range(PP)])
+
+
+class TestSpmdPipeline:
+    def _stacked_params(self, rng, n_layers, width):
+        # one linear layer per pp stage: stage s applies W_s
+        return jnp.asarray(rng.randn(n_layers, width, width) * 0.3, jnp.float32)
+
+    def test_matches_sequential(self, pp_mesh, rng):
+        width, m, mb = 8, 6, 2
+        ws = self._stacked_params(rng, PP, width)
+        x = jnp.asarray(rng.randn(m, mb, width), jnp.float32)
+
+        def stage_fn(w_local, h):
+            return jnp.tanh(h @ w_local[0])
+
+        out = jax.jit(
+            shard_map(
+                lambda w, x: spmd_pipeline(stage_fn, w, x),
+                mesh=pp_mesh,
+                in_specs=(P("pipe", None, None), P()),
+                out_specs=P(),
+                check_vma=False,
+            )
+        )(ws, x)
+
+        # sequential reference
+        h = np.asarray(x)
+        for s in range(PP):
+            h = np.tanh(h @ np.asarray(ws[s]))
+        # outputs valid on last stage; out_specs P() takes one replica —
+        # with check_vma off this is rank 0's buffer, which only matches
+        # on the last stage. Broadcast via psum-mask inside instead:
+        def run(w, x):
+            from apex_tpu.transformer.pipeline_parallel import last_stage_value
+            y = spmd_pipeline(stage_fn, w, x)
+            return last_stage_value(y)
+
+        out2 = jax.jit(
+            shard_map(
+                run, mesh=pp_mesh,
+                in_specs=(P("pipe", None, None), P()),
+                out_specs=P(), check_vma=False,
+            )
+        )(ws, x)
+        np.testing.assert_allclose(np.asarray(out2), h, rtol=1e-4, atol=1e-5)
+
+    def test_grads_match_sequential(self, pp_mesh, rng):
+        width, m, mb = 8, 4, 2
+        ws = self._stacked_params(rng, PP, width)
+        x = jnp.asarray(rng.randn(m, mb, width), jnp.float32)
+        t = jnp.asarray(rng.randn(m, mb, width), jnp.float32)
+
+        def stage_fn(w_local, h):
+            return jnp.tanh(h @ w_local[0])
+
+        def pipeline_loss(w, x):
+            from apex_tpu.transformer.pipeline_parallel import last_stage_value
+            y = spmd_pipeline(stage_fn, w, x)
+            loss = jnp.sum((y - t) ** 2)
+            return last_stage_value(loss)
+
+        fn = shard_map(
+            pipeline_loss, mesh=pp_mesh,
+            in_specs=(P("pipe", None, None), P()),
+            out_specs=P(), check_vma=False,
+        )
+        g1 = jax.jit(jax.grad(lambda w: fn(w, x)))(ws)
+
+        def seq_loss(ws):
+            h = x
+            for s in range(PP):
+                h = jnp.tanh(h @ ws[s])
+            return jnp.sum((h - t) ** 2)
+
+        g2 = jax.grad(seq_loss)(ws)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-4)
+
+
+class TestSchedules:
+    def test_no_pipelining_grad_accumulation(self, rng):
+        w = jnp.asarray(rng.randn(8, 4), jnp.float32)
+        batch = jnp.asarray(rng.randn(16, 8), jnp.float32)
+
+        def step(params, mb):
+            return jnp.mean((mb @ params) ** 2)
+
+        loss, grads = forward_backward_no_pipelining(
+            step, batch, w, num_microbatches=4
+        )
+        # reference: mean over microbatches == full-batch loss here
+        full_loss = float(step(w, batch))
+        np.testing.assert_allclose(float(loss), full_loss, rtol=1e-5)
+        g_full = jax.grad(step)(w, batch)
+        np.testing.assert_allclose(np.asarray(grads), np.asarray(g_full), rtol=1e-4, atol=1e-5)
+
+    def test_no_pipelining_forward_only(self, rng):
+        w = jnp.asarray(rng.randn(8, 4), jnp.float32)
+        batch = jnp.asarray(rng.randn(8, 8), jnp.float32)
+        loss, grads = forward_backward_no_pipelining(
+            lambda p, b: jnp.mean((b @ p) ** 2), batch, w,
+            num_microbatches=2, forward_only=True,
+        )
+        assert grads is None
+
+    def test_pipelining_without_interleaving(self, pp_mesh, rng):
+        width, m = 8, 8
+        ws = jnp.asarray(rng.randn(PP, width, width) * 0.3, jnp.float32)
+        emb = jnp.asarray(rng.randn(width, width) * 0.3, jnp.float32)
+        batch = jnp.asarray(rng.randn(m * 2, width), jnp.float32)
+        t = 1.5
+
+        def pre_fn(params, mb):
+            return mb @ params["emb"]
+
+        def stage_fn(params, h):
+            return jnp.tanh(h @ params["stages"][0])
+
+        def loss_fn(y, mb):
+            return jnp.mean((y - t) ** 2)
+
+        params = {"emb": emb, "stages": ws}
+
+        fn = shard_map(
+            lambda p, b: forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, pre_fn, p, b, num_microbatches=m
+            ),
+            mesh=pp_mesh,
+            in_specs=({"emb": P(), "stages": P("pipe", None, None)}, P()),
+            out_specs=(P(), {"emb": P(), "stages": P("pipe", None, None)}),
+            check_vma=False,
+        )
+        loss, grads = jax.jit(fn)(params, batch)
+
+        def seq_loss(params):
+            h = batch.reshape(m, 2, width) @ params["emb"]
+            for s in range(PP):
+                h = jnp.tanh(h @ params["stages"][s])
+            return jnp.mean(jax.vmap(lambda y: jnp.mean((y - t) ** 2))(h))
+
+        ref_loss, ref_grads = jax.value_and_grad(seq_loss)(params)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(grads["stages"]), np.asarray(ref_grads["stages"]),
+            rtol=1e-3, atol=1e-4,
+        )
+
+    def test_pipelining_with_interleaving(self, pp_mesh, rng):
+        """2 model chunks x 4 stages = 8 virtual stages; equals an
+        8-layer sequential model."""
+        width, m, vpp = 8, 4, 2
+        # chunk c on stage s holds layer index c*PP + s
+        ws = jnp.asarray(rng.randn(PP, vpp, width, width) * 0.2, jnp.float32)
+        batch = jnp.asarray(rng.randn(m * 2, width), jnp.float32)
+
+        def stage_fn(params, h, chunk_id):
+            return jnp.tanh(h @ params[0, chunk_id])
+
+        def loss_fn(y, mb):
+            return jnp.mean(y ** 2)
+
+        fn = shard_map(
+            lambda p, b: forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_fn, None, p, b,
+                num_microbatches=m, num_model_chunks=vpp,
+            ),
+            mesh=pp_mesh,
+            in_specs=(P("pipe", None, None, None), P()),
+            out_specs=(P(), P("pipe", None, None, None)),
+            check_vma=False,
+        )
+        loss, grads = jax.jit(fn)(ws, batch)
+
+        def seq_loss(ws):
+            h = batch.reshape(m, 2, width)
+            for c in range(vpp):
+                for s in range(PP):
+                    h = jnp.tanh(h @ ws[s, c])
+            return jnp.mean(h ** 2)
+
+        ref_loss, ref_grads = jax.value_and_grad(seq_loss)(ws)
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-4)
+        np.testing.assert_allclose(
+            np.asarray(grads), np.asarray(ref_grads), rtol=1e-3, atol=1e-4
+        )
+
+    def test_get_forward_backward_func(self):
+        assert get_forward_backward_func(None, 1) is forward_backward_no_pipelining
+        assert (
+            get_forward_backward_func(None, 4)
+            is forward_backward_pipelining_without_interleaving
+        )
+        assert (
+            get_forward_backward_func(2, 4)
+            is forward_backward_pipelining_with_interleaving
+        )
+
+
+class TestMicrobatches:
+    def test_constant(self):
+        c = ConstantNumMicroBatches(64, 4, 2)
+        assert c.get() == 8
+        assert c.get_current_global_batch_size() == 64
+
+    def test_constant_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            ConstantNumMicroBatches(65, 4, 2)
+
+    def test_rampup(self):
+        r = RampupBatchsizeNumMicroBatches(
+            start_batch_size=16, batch_size_increment=16, ramup_samples=1000,
+            global_batch_size=64, micro_batch_size=4, data_parallel_size=2,
+        )
+        assert r.get_current_global_batch_size() == 16
+        r.update(500, False)  # 500/(1000/3) -> 1 increment
+        assert r.get_current_global_batch_size() == 32
+        r.update(2000, False)
+        assert r.get_current_global_batch_size() == 64
+        assert r.get() == 8
+
+    def test_kth_microbatch(self, rng):
+        batch = {"x": jnp.asarray(rng.randn(12, 3), jnp.float32)}
+        mb = get_kth_microbatch(batch, 2, 4)
+        np.testing.assert_allclose(
+            np.asarray(mb["x"]), np.asarray(batch["x"][8:12])
+        )
+
+
+class TestLtorMasks:
+    def test_causal_mask(self):
+        data = jnp.asarray([[5, 3, 7, 1]], jnp.int32)
+        mask, loss_mask, pos = get_ltor_masks_and_position_ids(data)
+        assert mask.shape == (1, 1, 4, 4)
+        m = np.asarray(mask[0, 0])
+        assert not m[2, 1] and m[1, 2]  # can attend backward, not forward
+        np.testing.assert_array_equal(np.asarray(pos[0]), [0, 1, 2, 3])
+        np.testing.assert_array_equal(np.asarray(loss_mask[0]), [1, 1, 1, 1])
+
+    def test_eod_resets(self):
+        data = jnp.asarray([[5, 0, 7, 1]], jnp.int32)  # EOD token = 0
+        mask, loss_mask, pos = get_ltor_masks_and_position_ids(
+            data, eod_token=0, reset_position_ids=True,
+            reset_attention_mask=True, eod_mask_loss=True,
+        )
+        np.testing.assert_array_equal(np.asarray(loss_mask[0]), [1, 0, 1, 1])
+        # positions restart after EOD (EOD belongs to first segment)
+        np.testing.assert_array_equal(np.asarray(pos[0]), [0, 1, 0, 1])
+        m = np.asarray(mask[0, 0])
+        assert m[2, 0]  # token 2 (new doc) cannot see token 0

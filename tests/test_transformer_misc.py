@@ -1,0 +1,100 @@
+"""FusedScaleMaskSoftmax dispatcher + model-parallel grad scaler tests."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.transformer import parallel_state as ps
+from apex_tpu.transformer.amp import GradScaler, allreduce_found_inf
+from apex_tpu.transformer.functional import AttnMaskType, FusedScaleMaskSoftmax
+
+
+class TestFusedScaleMaskSoftmax:
+    def test_causal_dispatch(self, rng):
+        sm = FusedScaleMaskSoftmax(
+            attn_mask_type=AttnMaskType.causal, scale=0.5, impl="xla"
+        )
+        x = jnp.asarray(rng.randn(2, 3, 8, 8), jnp.float32)
+        y = sm(x)
+        assert y.shape == x.shape
+        # causal: strictly-upper entries zero
+        assert float(jnp.abs(y[..., 0, 1:]).max()) == 0.0
+        np.testing.assert_allclose(
+            np.asarray(jnp.sum(y, -1)), np.ones((2, 3, 8)), rtol=1e-5
+        )
+
+    def test_padding_dispatch(self, rng):
+        sm = FusedScaleMaskSoftmax(impl="xla")
+        x = jnp.asarray(rng.randn(2, 3, 4, 16), jnp.float32)
+        mask = jnp.asarray(rng.rand(2, 1, 4, 16) > 0.5)
+        y = sm(x, mask)
+        ref = jax.nn.softmax(jnp.where(mask, x - 10000.0, x), axis=-1)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref), rtol=1e-3, atol=1e-5)
+
+    def test_no_mask_dispatch(self, rng):
+        sm = FusedScaleMaskSoftmax(impl="xla")
+        x = jnp.asarray(rng.randn(1, 2, 4, 8), jnp.float32)
+        y = sm(x)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(jax.nn.softmax(x, -1)), rtol=1e-5, atol=1e-6
+        )
+
+    def test_unfused_fallback(self, rng):
+        sm = FusedScaleMaskSoftmax(scaled_masked_softmax_fusion=False)
+        x = jnp.asarray(rng.randn(1, 2, 4, 8), jnp.float32)
+        y = sm(x)
+        np.testing.assert_allclose(
+            np.asarray(y), np.asarray(jax.nn.softmax(x, -1)), rtol=1e-5, atol=1e-6
+        )
+
+    def test_scale_requires_fp32(self):
+        with pytest.raises(ValueError):
+            FusedScaleMaskSoftmax(scale=2.0, softmax_in_fp32=False)
+
+
+class TestModelParallelGradScaler:
+    @pytest.fixture(autouse=True)
+    def mesh(self):
+        m = ps.initialize_model_parallel(2, 2)
+        yield m
+        ps.destroy_model_parallel()
+
+    def test_found_inf_syncs_across_model_axes(self, mesh):
+        """One rank overflowing must make ALL tp/pp ranks skip
+        (ref apex/transformer/amp/grad_scaler.py:21-61)."""
+
+        def f():
+            tp_r = jax.lax.axis_index("tensor")
+            pp_r = jax.lax.axis_index("pipe")
+            local = jnp.where((tp_r == 1) & (pp_r == 0), 1.0, 0.0)
+            return allreduce_found_inf(local)[None]
+
+        out = jax.jit(
+            shard_map(
+                f, mesh=mesh, in_specs=(),
+                out_specs=P(("pipe", "tensor")), check_vma=False,
+            )
+        )()
+        np.testing.assert_array_equal(np.asarray(out), np.ones(4))
+
+    def test_grad_scaler_update_in_mesh(self, mesh):
+        scaler = GradScaler(scale_window=100)
+
+        def f(st_scale):
+            st = scaler.init()._replace(loss_scale=st_scale)
+            tp_r = jax.lax.axis_index("tensor")
+            found = jnp.where(tp_r == 0, 1.0, 0.0)  # only rank 0 saw inf
+            new = scaler.update(st, found)
+            return new.loss_scale[None]
+
+        out = jax.jit(
+            shard_map(
+                f, mesh=mesh, in_specs=(P(),),
+                out_specs=P(("pipe", "tensor")), check_vma=False,
+            )
+        )(jnp.asarray(2.0 ** 16, jnp.float32))
+        # every rank backed off together
+        np.testing.assert_allclose(np.asarray(out), 2.0 ** 15 * np.ones(4))
