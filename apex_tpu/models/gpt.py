@@ -1,0 +1,260 @@
+"""Megatron-style GPT — the flagship model family.
+
+TPU re-design of the reference's standalone GPT test fixture
+(ref: apex/transformer/testing/standalone_gpt.py,
+standalone_transformer_lm.py — embedding + L x [LN, parallel attention,
+LN, parallel MLP] + final LN + tied vocab head, trained with
+vocab-parallel cross entropy). Built entirely from apex_tpu parallel
+layers, so one module serves:
+
+  - single device (plain apply; layers degrade to dense)
+  - tensor parallel (+ sequence parallel) inside shard_map over "tensor"
+  - pipeline parallel via `spmd_pipeline` (layer stack as stage body)
+
+`gpt_param_specs` derives the PartitionSpec tree for the step boundary
+(the analog of the reference's per-layer process-group wiring).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.transformer.functional import AttnMaskType, FusedScaleMaskSoftmax
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import _inside_axis
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    max_seq_len: int = 1024
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    ffn_hidden_size: Optional[int] = None   # default 4*hidden
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    sequence_parallel: bool = False
+    softmax_impl: Optional[str] = None
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    # GPT-2 345M (BASELINE configs[3]: ref run_gpt_minimal_test.py)
+    @staticmethod
+    def gpt2_345m(**kw) -> "GPTConfig":
+        return GPTConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                         max_seq_len=1024, **kw)
+
+
+class ParallelAttention(nn.Module):
+    """Self attention: column-parallel fused QKV, causal fused softmax,
+    row-parallel output projection (ref standalone_transformer_lm.py
+    ParallelAttention)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic=True):
+        cfg = self.config
+        h = cfg.hidden_size
+        inside = _inside_axis(TENSOR_AXIS)
+        tp = lax.axis_size(TENSOR_AXIS) if inside else 1
+        heads_local = cfg.num_heads // tp
+        head_dim = h // cfg.num_heads
+
+        qkv = ColumnParallelLinear(
+            output_size=3 * h, gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="qkv",
+        )(x)
+        # (s, b, 3h/tp) -> (s, b, heads_local, 3, head_dim)
+        s, b = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(s, b, heads_local, 3 * head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        # (b*heads, s, d)
+        def to_bhsd(t):
+            return t.transpose(1, 2, 0, 3).reshape(b * heads_local, s, head_dim)
+
+        q, k, v = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+        scores = jnp.einsum(
+            "bsd,btd->bst", q, k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(head_dim).astype(jnp.float32)
+        probs = FusedScaleMaskSoftmax(
+            attn_mask_type=AttnMaskType.causal, impl=cfg.softmax_impl
+        )(scores.reshape(b, heads_local, s, s).astype(cfg.dtype))
+        if cfg.attention_dropout > 0.0 and not deterministic:
+            probs = nn.Dropout(rate=cfg.attention_dropout)(
+                probs, deterministic=False
+            )
+        ctx = jnp.einsum(
+            "bhst,bhtd->bhsd", probs,
+            v.reshape(b, heads_local, s, head_dim),
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        # (b, hl, s, d) -> (s, b, hl*d)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, heads_local * head_dim)
+        out = RowParallelLinear(
+            output_size=h, input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
+        )(ctx)
+        return out
+
+
+class ParallelMLP(nn.Module):
+    """Column(4h, no gather) -> gelu -> Row(h) (ref ParallelMLP)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        hcol = ColumnParallelLinear(
+            output_size=cfg.ffn, gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="fc1",
+        )(x)
+        hcol = jax.nn.gelu(hcol, approximate=True)
+        return RowParallelLinear(
+            output_size=cfg.hidden_size, input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="fc2",
+        )(hcol)
+
+
+class GPTLayer(nn.Module):
+    """Pre-LN transformer block (ref ParallelTransformerLayer)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x, *, deterministic=True):
+        cfg = self.config
+        a = ParallelAttention(cfg, name="attention")(
+            FusedLayerNorm(cfg.hidden_size, name="input_norm")(x),
+            deterministic=deterministic,
+        )
+        if cfg.hidden_dropout > 0.0 and not deterministic:
+            a = nn.Dropout(rate=cfg.hidden_dropout)(a, deterministic=False)
+        x = x + a
+        m = ParallelMLP(cfg, name="mlp")(
+            FusedLayerNorm(cfg.hidden_size, name="post_norm")(x)
+        )
+        if cfg.hidden_dropout > 0.0 and not deterministic:
+            m = nn.Dropout(rate=cfg.hidden_dropout)(m, deterministic=False)
+        return x + m
+
+
+class GPTModel(nn.Module):
+    """Full GPT LM. Input token ids (b, s); returns vocab-parallel
+    logits in (s, b, vocab[/tp]) layout (Megatron sbh convention)."""
+
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, tokens, *, deterministic=True):
+        cfg = self.config
+        b, s = tokens.shape
+        emb = VocabParallelEmbedding(
+            num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="embedding",
+        )
+        x = emb(tokens)                                   # (b, s, h)
+        pos = self.param(
+            "position_embedding",
+            nn.initializers.normal(stddev=0.02),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype,
+        )
+        x = x + pos[:s][None, :, :].astype(cfg.dtype)
+        x = x.transpose(1, 0, 2)                          # (s, b, h)
+
+        if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
+            from apex_tpu.transformer.tensor_parallel import (
+                scatter_to_sequence_parallel_region,
+            )
+            x = scatter_to_sequence_parallel_region(x)
+
+        for i in range(cfg.num_layers):
+            x = GPTLayer(cfg, name=f"layer_{i}")(x, deterministic=deterministic)
+        x = FusedLayerNorm(cfg.hidden_size, name="final_norm")(x)
+
+        if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
+            from apex_tpu.transformer.tensor_parallel import (
+                gather_from_sequence_parallel_region,
+            )
+            x = gather_from_sequence_parallel_region(
+                x, tensor_parallel_output_grad=True
+            )
+
+        # tied LM head: logits = x @ E^T over the local vocab shard
+        # (ref parallel_lm_logits: copy op so dL/dx is allreduced)
+        if _inside_axis(TENSOR_AXIS):
+            from apex_tpu.transformer.tensor_parallel import (
+                copy_to_tensor_model_parallel_region,
+            )
+            x = copy_to_tensor_model_parallel_region(x)
+        table = emb.variables["params"]["embedding"]
+        logits = jnp.einsum(
+            "sbh,vh->sbv", x.astype(jnp.float32),
+            table.astype(jnp.float32),
+        )
+        return logits
+
+
+def gpt_loss_fn(logits, labels, axis_name: str = TENSOR_AXIS):
+    """Mean CE over tokens; vocab-parallel when inside the mesh.
+
+    logits: (s, b, vocab[/tp]) ; labels: (b, s)
+    """
+    labels_sb = labels.transpose(1, 0)
+    if _inside_axis(axis_name):
+        losses = vocab_parallel_cross_entropy(logits, labels_sb,
+                                              axis_name=axis_name)
+    else:
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, labels_sb[..., None], -1)[..., 0]
+        losses = lse - tgt
+    return jnp.mean(losses)
+
+
+# -- partition specs -------------------------------------------------------
+
+
+def gpt_param_specs(params: Any) -> Any:
+    """PartitionSpec tree for a GPTModel param pytree: column kernels
+    split on the output dim, row kernels on the input dim, the embedding
+    on the vocab dim, everything else replicated."""
+
+    def spec_for(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        joined = "/".join(names)
+        if "embedding" in joined and names[-1] == "embedding":
+            return P(TENSOR_AXIS, None)
+        if ("qkv" in joined or "fc1" in joined) and names[-1] == "kernel":
+            return P(TENSOR_AXIS, None)
+        if ("qkv" in joined or "fc1" in joined) and names[-1] == "bias":
+            return P(TENSOR_AXIS)
+        if ("proj" in joined or "fc2" in joined) and names[-1] == "kernel":
+            return P(None, TENSOR_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -20,6 +20,9 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.mappings import (
+    reduce_from_tensor_model_parallel_region as _reduce_identity_bwd,
+)
 from apex_tpu.transformer.tensor_parallel.utils import VocabUtility
 
 
@@ -55,9 +58,14 @@ def vocab_parallel_cross_entropy(
         logits, local_target[..., None], axis=-1
     )[..., 0]
     picked = jnp.where(in_range, picked, 0.0)
-    target_logit = lax.psum(picked, axis_name)
+    # Megatron backward convention: every rank seeds the (replicated)
+    # loss with cotangent 1 and reductions are identity in reverse —
+    # raw lax.psum's psum-transpose would multiply cotangents by tp
+    target_logit = _reduce_identity_bwd(picked, axis_name)
 
-    sum_exp = lax.psum(jnp.sum(jnp.exp(logits), axis=-1), axis_name)
+    sum_exp = _reduce_identity_bwd(
+        jnp.sum(jnp.exp(logits), axis=-1), axis_name
+    )
     lse = jnp.log(sum_exp)
     loss = lse - target_logit
 
@@ -65,7 +73,10 @@ def vocab_parallel_cross_entropy(
         # ref cross_entropy.py:68-87: smoothed loss mixes mean log prob
         vocab_size = per * tp
         smoothing = label_smoothing * vocab_size / (vocab_size - 1)
-        mean_logit = lax.psum(jnp.sum(logits, axis=-1), axis_name) / vocab_size
+        mean_logit = (
+            _reduce_identity_bwd(jnp.sum(logits, axis=-1), axis_name)
+            / vocab_size
+        )
         mean_log_prob = mean_logit - lse
         loss = (1.0 - smoothing) * loss - smoothing * mean_log_prob
     return loss

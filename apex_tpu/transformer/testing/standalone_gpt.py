@@ -1,0 +1,18 @@
+"""Standalone GPT test fixture (ref: apex/transformer/testing/standalone_gpt.py).
+
+Thin parity wrapper over the real model family in `apex_tpu.models.gpt`."""
+
+from apex_tpu.models.gpt import (
+    GPTConfig,
+    GPTLayer,
+    GPTModel,
+    ParallelAttention,
+    ParallelMLP,
+    gpt_loss_fn,
+    gpt_param_specs,
+)
+
+
+def gpt_model_provider(config: GPTConfig = None, **kw) -> GPTModel:
+    """ref run_gpt_minimal_test.py gpt_model_provider."""
+    return GPTModel(config or GPTConfig(**kw))

@@ -339,23 +339,23 @@ class TestVocabParallelCrossEntropy:
         logits = jnp.asarray(rng.randn(6, vocab), jnp.float32)
         target = jnp.asarray(rng.randint(0, vocab, (6,)), jnp.int32)
 
-        fn = shard_map(
-            lambda l, t: jnp.sum(vocab_parallel_cross_entropy(l, t)),
+        # train-step pattern: grad inside shard_map, sharded in/out
+        step = shard_map(
+            lambda l, t: jax.grad(
+                lambda l_: jnp.sum(vocab_parallel_cross_entropy(l_, t))
+            )(l),
             mesh=mesh,
             in_specs=(P(None, "tensor"), P()),
-            out_specs=P(),
+            out_specs=P(None, "tensor"),
             check_vma=False,
         )
-
-        def sharded_loss(l):
-            return fn(l, target)
 
         def full_loss(l):
             lse = jax.scipy.special.logsumexp(l, axis=-1)
             tgt = jnp.take_along_axis(l, target[:, None], -1)[:, 0]
             return jnp.sum(lse - tgt)
 
-        g1 = jax.jit(jax.grad(sharded_loss))(logits)
+        g1 = jax.jit(step)(logits, target)
         g2 = jax.grad(full_loss)(logits)
         np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-4, atol=1e-5)
 
