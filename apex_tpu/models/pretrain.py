@@ -1,0 +1,243 @@
+"""Full pretrain-step composition: DP x TP x SP x PP in one SPMD program.
+
+The TPU answer to the reference's GPT pretraining path (ref:
+tests/L0/run_transformer/run_gpt_minimal_test.py +
+fwd_bwd_pipelining_without_interleaving.py): one `shard_map` over the
+(data, pipe, tensor) mesh containing microbatched pipeline forward,
+backward, data-parallel grad reduction, and the fused optimizer step —
+XLA schedules all collectives (grad psum over data, TP all-reduces,
+pipeline ppermutes) against compute.
+
+Layout:
+  - embedding / position / final norm / head: replicated over pipe;
+    their grads are psum'd over pipe (only the touching stages
+    contribute — the reference's embedding-group allreduce,
+    ref parallel_state.py:251-276).
+  - transformer layers: stacked (num_layers, ...) pytree, leading dim
+    sharded over pipe; each stage scans its local layers.
+  - TP sharding per gpt_param_specs; batch sharded over data; optimizer
+    state packed from LOCAL shards inside shard_map, so Adam/LAMB state
+    is TP/PP-sharded for free.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax, shard_map
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models.gpt import GPTConfig, GPTLayer, gpt_param_specs
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.optimizers.fused import FlatFusedOptimizer
+from apex_tpu.transformer.parallel_state import (
+    DATA_AXIS,
+    PIPELINE_AXIS,
+    TENSOR_AXIS,
+)
+from apex_tpu.transformer.pipeline_parallel.schedules import (
+    last_stage_value,
+    spmd_pipeline,
+)
+from apex_tpu.transformer.tensor_parallel import (
+    VocabParallelEmbedding,
+    copy_to_tensor_model_parallel_region,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import _inside_axis
+
+
+def init_gpt_pretrain_params(cfg: GPTConfig, key) -> Any:
+    """Initialize the pipeline-layout GPT param tree (full, unsharded)."""
+    k_emb, k_layers, k_norm = jax.random.split(key, 3)
+    dummy_tokens = jnp.zeros((1, cfg.max_seq_len), jnp.int32)
+    emb = VocabParallelEmbedding(
+        num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+        param_dtype=cfg.param_dtype, dtype=cfg.dtype,
+    )
+    emb_params = emb.init(k_emb, dummy_tokens)["params"]
+    pos = (
+        jax.random.normal(
+            jax.random.fold_in(k_emb, 1),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype,
+        )
+        * 0.02
+    )
+    layer = GPTLayer(cfg)
+    dummy_x = jnp.zeros((cfg.max_seq_len, 1, cfg.hidden_size), cfg.dtype)
+    layer_params = jax.vmap(lambda k: layer.init(k, dummy_x)["params"])(
+        jax.random.split(k_layers, cfg.num_layers)
+    )
+    norm_params = FusedLayerNorm(cfg.hidden_size).init(k_norm, dummy_x)["params"]
+    return {
+        "embedding": emb_params,
+        "position_embedding": pos,
+        "layers": layer_params,
+        "final_norm": norm_params,
+    }
+
+
+def gpt_pretrain_param_specs(params: Any) -> Any:
+    """PartitionSpecs for the pipeline-layout tree: TP specs per
+    gpt_param_specs, layers sharded over pipe on the stacked dim."""
+    tp = gpt_param_specs({"params": {
+        "embedding": params["embedding"],
+        "layer_0": params["layers"],
+        "final_norm": params["final_norm"],
+    }})["params"]
+    layers = jax.tree.map(lambda s: P(PIPELINE_AXIS, *s), tp["layer_0"])
+    return {
+        "embedding": tp["embedding"],
+        "position_embedding": P(),
+        "layers": layers,
+        "final_norm": jax.tree.map(lambda _: P(), params["final_norm"]),
+    }
+
+
+def _local_shapes(params: Any, specs: Any, mesh: Mesh) -> Any:
+    """Per-device shard shapes implied by the specs."""
+
+    def one(leaf, spec):
+        shape = list(leaf.shape)
+        for i, ax in enumerate(spec):
+            if ax is None:
+                continue
+            for nm in (ax if isinstance(ax, tuple) else (ax,)):
+                shape[i] //= mesh.shape[nm]
+        return jax.ShapeDtypeStruct(tuple(shape), leaf.dtype)
+
+    return jax.tree.map(one, params, specs,
+                        is_leaf=lambda x: isinstance(x, P))
+
+
+def _opt_state_specs(optimizer: FlatFusedOptimizer, local_params: Any) -> Any:
+    """Specs for the FlatOptState produced inside shard_map: big flat
+    buffers are distinct per device -> sharded jointly over all mesh
+    axes on dim 0; scalars (count, found_inf, flags) are replicated."""
+    state_shape = jax.eval_shape(optimizer.init, local_params)
+    joint = P((DATA_AXIS, PIPELINE_AXIS, TENSOR_AXIS))
+    return jax.tree.map(
+        lambda l: joint if l.ndim >= 1 else P(), state_shape
+    )
+
+
+def make_gpt_pretrain_step(
+    cfg: GPTConfig,
+    mesh: Mesh,
+    optimizer: FlatFusedOptimizer,
+    *,
+    num_microbatches: int = 1,
+    remat: bool = True,
+):
+    """Build the jitted full-parallel train step.
+
+    Returns (init_opt_fn, step_fn, param_specs):
+      init_opt_fn(params_global) -> opt_state (sharded)
+      step_fn(params, opt_state, tokens, labels) -> (params, opt_state, loss)
+    tokens/labels: (global_batch, seq) int32.
+    """
+    layer = GPTLayer(cfg)
+    emb_mod = VocabParallelEmbedding(
+        num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+        param_dtype=cfg.param_dtype, dtype=cfg.dtype,
+    )
+    norm_mod = FusedLayerNorm(cfg.hidden_size)
+    pp = mesh.shape[PIPELINE_AXIS]
+    if cfg.num_layers % pp:
+        raise ValueError("num_layers must be divisible by pipeline size")
+
+    def pre_fn(params, mb_tokens):
+        x = emb_mod.apply({"params": params["embedding"]}, mb_tokens)
+        s = mb_tokens.shape[1]
+        x = x + params["position_embedding"][:s][None].astype(cfg.dtype)
+        x = x.transpose(1, 0, 2)  # (s, mb, h)
+        if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
+            from apex_tpu.transformer.tensor_parallel import (
+                scatter_to_sequence_parallel_region,
+            )
+            x = scatter_to_sequence_parallel_region(x)
+        return x
+
+    def stage_fn(params, x):
+        def body(h, lp):
+            return layer.apply({"params": lp}, h), None
+
+        y, _ = lax.scan(body, x, params["layers"])
+        return y
+
+    def loss_fn_mb(params, y, mb_labels):
+        y = norm_mod.apply({"params": params["final_norm"]}, y)
+        if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
+            from apex_tpu.transformer.tensor_parallel import (
+                gather_from_sequence_parallel_region,
+            )
+            y = gather_from_sequence_parallel_region(
+                y, tensor_parallel_output_grad=True
+            )
+        if _inside_axis(TENSOR_AXIS):
+            y = copy_to_tensor_model_parallel_region(y)
+        table = params["embedding"]["embedding"]
+        logits = jnp.einsum(
+            "sbh,vh->sbv", y.astype(jnp.float32), table.astype(jnp.float32)
+        )
+        labels_sb = mb_labels.transpose(1, 0)
+        if _inside_axis(TENSOR_AXIS):
+            losses = vocab_parallel_cross_entropy(logits, labels_sb)
+        else:
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(logits, labels_sb[..., None], -1)[..., 0]
+            losses = lse - tgt
+        return jnp.mean(losses)
+
+    def local_loss(params, tokens, labels):
+        m = num_microbatches
+        mb_tok = tokens.reshape(m, tokens.shape[0] // m, -1)
+        mb_lab = labels.reshape(m, labels.shape[0] // m, -1)
+        x_mb = jax.vmap(lambda t: pre_fn(params, t))(mb_tok)
+        outs = spmd_pipeline(
+            stage_fn, params, x_mb, axis_name=PIPELINE_AXIS, remat=remat
+        )
+        losses = jax.vmap(lambda y, l: loss_fn_mb(params, y, l))(outs, mb_lab)
+        return jnp.mean(losses)
+
+    def step(params, opt_state, tokens, labels):
+        loss, grads = jax.value_and_grad(local_loss)(params, tokens, labels)
+        for name in ("embedding", "position_embedding", "final_norm"):
+            grads[name] = jax.tree.map(
+                lambda g: lax.psum(g, PIPELINE_AXIS), grads[name]
+            )
+        grads = jax.tree.map(lambda g: lax.pmean(g, DATA_AXIS), grads)
+        params, opt_state = optimizer.step(opt_state, grads)
+        # reported loss: average over data shards, broadcast from the
+        # last pipeline stage (ref average_losses_across_data_parallel_group)
+        loss = lax.pmean(loss, DATA_AXIS)
+        return params, opt_state, last_stage_value(loss, PIPELINE_AXIS)
+
+    def params_specs(params):
+        return gpt_pretrain_param_specs(params)
+
+    def build(params):
+        specs = params_specs(params)
+        local_params = _local_shapes(params, specs, mesh)
+        opt_specs = _opt_state_specs(optimizer, local_params)
+        init_opt = jax.jit(
+            shard_map(
+                optimizer.init, mesh=mesh, in_specs=(specs,),
+                out_specs=opt_specs, check_vma=False,
+            )
+        )
+        step_fn = jax.jit(
+            shard_map(
+                step, mesh=mesh,
+                in_specs=(specs, opt_specs, P(DATA_AXIS), P(DATA_AXIS)),
+                out_specs=(specs, opt_specs, P()),
+                check_vma=False,
+            )
+        )
+        return init_opt, step_fn, specs
+
+    return build

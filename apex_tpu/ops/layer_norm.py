@@ -225,8 +225,8 @@ def _norm_bwd(eps, rms, impl, res, g):
         dx, dw, db = _bwd_xla(x2, w, mean, rstd, g, rms, affine)
     else:
         dx, dw, db = _bwd_pallas(x2, w, mean, rstd, g, rms, affine, impl)
-    dwo = dw.astype(w.dtype) if affine else None
-    dbo = db.astype(b.dtype) if b is not None else None
+    dwo = dw.reshape(w.shape).astype(w.dtype) if affine else None
+    dbo = db.reshape(b.shape).astype(b.dtype) if b is not None else None
     return dx, dwo, dbo
 
 

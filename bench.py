@@ -1,0 +1,115 @@
+"""Benchmark: FusedLAMB optimizer step-time vs optax — the north-star
+metric (BASELINE.md: target <= 1.1x optax on the same update).
+
+Builds a BERT-large-shaped parameter set (~390 tensors, ~110M params —
+the reference's FusedLAMB workload class, ref apex/optimizers/
+fused_lamb.py:96-214), times one full LAMB step for (a) optax.lamb over
+the pytree and (b) apex_tpu.FusedLAMB (flat-buffer fused kernels), and
+prints ONE JSON line. vs_baseline = fused_time / optax_time (< 1 beats
+the baseline, 1.1 is the target ceiling).
+"""
+
+import json
+import sys
+import time
+
+
+def bert_large_shapes(hidden=1024, layers=24, vocab=30522, seq=512):
+    shapes = [(vocab, hidden), (seq, hidden), (2, hidden), (hidden,), (hidden,)]
+    for _ in range(layers):
+        shapes += [
+            (hidden, hidden), (hidden,),          # q
+            (hidden, hidden), (hidden,),          # k
+            (hidden, hidden), (hidden,),          # v
+            (hidden, hidden), (hidden,),          # attn out
+            (hidden,), (hidden,),                 # attn LN
+            (4 * hidden, hidden), (4 * hidden,),  # ffn in
+            (hidden, 4 * hidden), (hidden,),      # ffn out
+            (hidden,), (hidden,),                 # ffn LN
+        ]
+    shapes += [(hidden, hidden), (hidden,), (hidden,), (hidden,), (vocab,)]
+    return shapes
+
+
+def time_fn(fn, *args, iters=None, warmup=2):
+    import jax
+
+    if iters is None:
+        iters = 5 if jax.default_backend() == "cpu" else 20
+    out = None
+    for _ in range(warmup):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters, out
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from apex_tpu.optimizers import FusedLAMB
+
+    rng = np.random.RandomState(0)
+    if jax.default_backend() == "cpu":
+        # CPU smoke sizing only; the driver benches on real TPU
+        shapes = bert_large_shapes(hidden=256, layers=4, vocab=8192, seq=128)
+    else:
+        shapes = bert_large_shapes()
+    params = {
+        f"p{i}": jnp.asarray(rng.randn(*s).astype(np.float32) * 0.02)
+        for i, s in enumerate(shapes)
+    }
+    grads = {
+        k: jnp.asarray(rng.randn(*v.shape).astype(np.float32) * 0.001)
+        for k, v in params.items()
+    }
+    n_params = sum(int(np.prod(s)) for s in shapes)
+
+    lr, wd = 1e-3, 0.01
+
+    # optax baseline (its LAMB: scale_by_adam + add_wd + trust ratio)
+    tx = optax.lamb(lr, weight_decay=wd)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def optax_step(params, state, grads):
+        updates, state = tx.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
+    t_optax, _ = time_fn(optax_step, params, opt_state, grads)
+
+    # fused flat-space LAMB
+    fused = FusedLAMB(lr=lr, weight_decay=wd, max_grad_norm=0.0,
+                      use_nvlamb=True)
+    fstate = fused.init(params)
+
+    @jax.jit
+    def fused_step(state, grads):
+        return fused.step(state, grads)
+
+    t_fused, _ = time_fn(fused_step, fstate, grads)
+
+    ratio = t_fused / t_optax
+    print(json.dumps({
+        "metric": "fused_lamb_step_time_vs_optax",
+        "value": round(ratio, 4),
+        "unit": "x (fused/optax, lower is better; target <= 1.1)",
+        "vs_baseline": round(ratio, 4),
+        "detail": {
+            "n_params": n_params,
+            "n_tensors": len(shapes),
+            "t_optax_ms": round(t_optax * 1e3, 3),
+            "t_fused_ms": round(t_fused * 1e3, 3),
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

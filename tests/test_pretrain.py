@@ -1,0 +1,107 @@
+"""Full-parallel pretrain composition tests (DP x TP x SP x PP) +
+driver entry points."""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.models.gpt import GPTConfig
+from apex_tpu.models.pretrain import (
+    init_gpt_pretrain_params,
+    make_gpt_pretrain_step,
+)
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.transformer import parallel_state as ps
+
+
+@pytest.fixture(autouse=True)
+def clean():
+    ps.destroy_model_parallel()
+    yield
+    ps.destroy_model_parallel()
+
+
+class TestPretrainStep:
+    @pytest.mark.parametrize("tp,pp,sp", [(2, 2, True), (2, 2, False),
+                                          (4, 2, True), (1, 4, False)])
+    def test_step_runs_and_loss_decreases(self, rng, tp, pp, sp):
+        mesh = ps.initialize_model_parallel(tp, pp)
+        dp = 8 // (tp * pp)
+        cfg = GPTConfig(
+            vocab_size=128, max_seq_len=32, hidden_size=64,
+            num_layers=max(pp, 2) if pp <= 2 else pp, num_heads=4,
+            dtype=jnp.float32, sequence_parallel=sp,
+        )
+        params = init_gpt_pretrain_params(cfg, jax.random.PRNGKey(0))
+        opt = FusedAdam(lr=2e-3, impl="xla")
+        build = make_gpt_pretrain_step(cfg, mesh, opt, num_microbatches=2)
+        init_opt, step_fn, _ = build(params)
+        opt_state = init_opt(params)
+        toks = jnp.asarray(rng.randint(0, 128, (4 * dp, 33)), jnp.int32)
+        x, y = toks[:, :-1], toks[:, 1:]
+        losses = []
+        for _ in range(5):
+            params, opt_state, loss = step_fn(params, opt_state, x, y)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+
+    def test_matches_single_device(self, rng):
+        """Parallel pretrain loss == dense sequential model loss."""
+        mesh = ps.initialize_model_parallel(2, 2)
+        cfg = GPTConfig(
+            vocab_size=64, max_seq_len=16, hidden_size=32, num_layers=2,
+            num_heads=4, dtype=jnp.float32,
+        )
+        params = init_gpt_pretrain_params(cfg, jax.random.PRNGKey(1))
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        build = make_gpt_pretrain_step(cfg, mesh, opt, num_microbatches=1)
+        init_opt, step_fn, _ = build(params)
+        opt_state = init_opt(params)
+        toks = jnp.asarray(rng.randint(0, 64, (2, 17)), jnp.int32)
+        x, y = toks[:, :-1], toks[:, 1:]
+        _, _, loss = step_fn(params, opt_state, x, y)
+
+        # dense reference: same params applied sequentially
+        from apex_tpu.models.gpt import GPTLayer
+        from apex_tpu.normalization import FusedLayerNorm
+
+        def dense_loss(params):
+            table = params["embedding"]["embedding"]
+            h = table[x] + params["position_embedding"][:16][None]
+            h = h.transpose(1, 0, 2)
+            layer = GPTLayer(cfg)
+            for i in range(cfg.num_layers):
+                lp = jax.tree.map(lambda l: l[i], params["layers"])
+                h = layer.apply({"params": lp}, h)
+            h = FusedLayerNorm(cfg.hidden_size).apply(
+                {"params": params["final_norm"]}, h
+            )
+            logits = jnp.einsum("sbh,vh->sbv", h, table)
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)
+            tgt = jnp.take_along_axis(
+                logits, y.transpose(1, 0)[..., None], -1
+            )[..., 0]
+            return jnp.mean(lse - tgt)
+
+        np.testing.assert_allclose(float(loss), float(dense_loss(params)),
+                                   rtol=2e-4)
+
+
+class TestGraftEntry:
+    def test_entry_compiles(self):
+        sys.path.insert(0, "/root/repo")
+        import __graft_entry__ as g
+
+        fn, args = g.entry()
+        out = jax.jit(fn)(*args)
+        assert out.shape[0] == 256
+
+    def test_dryrun_multichip(self):
+        sys.path.insert(0, "/root/repo")
+        import __graft_entry__ as g
+
+        g.dryrun_multichip(8)
