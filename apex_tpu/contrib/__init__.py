@@ -1,0 +1,10 @@
+"""apex_tpu.contrib — production-grade specials (ref: apex/contrib).
+
+Subpackages mirror the reference's contrib surface, re-designed for TPU:
+
+    contrib.optimizers — ZeRO-style sharded optimizers
+                         (ref: apex/contrib/optimizers/distributed_fused_adam.py,
+                          distributed_fused_lamb.py)
+"""
+
+from apex_tpu.contrib import optimizers  # noqa: F401

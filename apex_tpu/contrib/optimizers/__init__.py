@@ -1,0 +1,13 @@
+"""ZeRO-style sharded optimizers (ref: apex/contrib/optimizers)."""
+
+from apex_tpu.contrib.optimizers.distributed import (
+    DistributedFusedAdam,
+    DistributedFusedLAMB,
+    DistFlatOptState,
+)
+
+__all__ = [
+    "DistributedFusedAdam",
+    "DistributedFusedLAMB",
+    "DistFlatOptState",
+]

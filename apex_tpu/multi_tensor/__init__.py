@@ -19,7 +19,9 @@ from apex_tpu.multi_tensor.engine import (
 from apex_tpu.multi_tensor.ops import (
     fused_adagrad_update,
     fused_adam_update,
+    fused_lamb_compute_update_term,
     fused_lamb_update,
+    lamb_trust_ratio,
     fused_lars_update,
     fused_novograd_update,
     fused_sgd_update,
@@ -41,6 +43,8 @@ __all__ = [
     "per_tensor_l2norm",
     "fused_adam_update",
     "fused_adagrad_update",
+    "fused_lamb_compute_update_term",
+    "lamb_trust_ratio",
     "fused_sgd_update",
     "fused_lamb_update",
     "fused_novograd_update",

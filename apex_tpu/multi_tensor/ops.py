@@ -218,6 +218,53 @@ def fused_sgd_update(
 # ---------------------------------------------------------------------------
 
 
+def fused_lamb_compute_update_term(
+    p, m, v, g, *,
+    beta1, beta2, beta3, eps, weight_decay, bias_correction1,
+    bias_correction2, adam_w_mode, inv_scale, impl=None,
+):
+    """LAMB stage 1: Adam-style update term + moment updates on any flat
+    fp32 buffer (full or ZeRO shard).
+
+    Mirrors the reference's standalone update-term kernel used by both
+    the single-device driver (csrc/multi_tensor_lamb.cu:41-230
+    LAMBStage1Functor) and the sharded optimizer
+    (distributed_lamb_cuda.multi_tensor_lamb_compute_update_term,
+    apex/contrib/optimizers/distributed_fused_lamb.py:105).
+
+    Returns ((update, m', v'), found_inf).
+    """
+    mode = 1.0 if adam_w_mode else 0.0
+
+    def stage1(ins, s, _):
+        p_, m_, v_, g_ = [x.astype(jnp.float32) for x in ins]
+        b1_, b2_, beta3_, eps_, wd, bc1_, bc2_, mode_, inv = s
+        g_ = g_ / inv
+        g_eff = jnp.where(mode_ > 0.5, g_, g_ + wd * p_)
+        m2 = b1_ * m_ + beta3_ * g_eff
+        v2 = b2_ * v_ + (1.0 - b2_) * g_eff * g_eff
+        upd = (m2 / bc1_) / (jnp.sqrt(v2 / bc2_) + eps_)
+        upd = upd + jnp.where(mode_ > 0.5, wd * p_, 0.0)
+        return [upd, m2, v2]
+
+    return fused_elementwise(
+        stage1, [p, m, v, g],
+        scalars=[beta1, beta2, beta3, eps, weight_decay,
+                 bias_correction1, bias_correction2, mode, inv_scale],
+        num_outputs=3, out_dtypes=[jnp.float32, m.dtype, v.dtype],
+        check_finite=(3,), impl=impl,
+    )
+
+
+def lamb_trust_ratio(w_norm, u_norm, *, weight_decay, use_nvlamb):
+    """Per-tensor trust ratio (ref csrc/multi_tensor_lamb.cu:270-283);
+    NVLAMB applies the ratio even for wd==0 groups."""
+    ratio = jnp.where((w_norm > 0.0) & (u_norm > 0.0), w_norm / u_norm, 1.0)
+    if not use_nvlamb and not (weight_decay > 0.0):
+        ratio = jnp.ones_like(ratio)
+    return ratio
+
+
 def fused_lamb_update(
     p, m, v, g, space: FlatSpace, *,
     lr, beta1=0.9, beta2=0.999, eps=1e-6, step=1,
@@ -252,35 +299,19 @@ def fused_lamb_update(
     else:
         clip = jnp.float32(1.0)
     inv_scale = clip * jnp.asarray(grad_scale, jnp.float32)
-    mode = 1.0 if adam_w_mode else 0.0
 
-    def stage1(ins, s, _):
-        p_, m_, v_, g_ = [x.astype(jnp.float32) for x in ins]
-        b1_, b2_, beta3_, eps_, wd, bc1_, bc2_, mode_, inv = s
-        g_ = g_ / inv
-        g_eff = jnp.where(mode_ > 0.5, g_, g_ + wd * p_)
-        m2 = b1_ * m_ + beta3_ * g_eff
-        v2 = b2_ * v_ + (1.0 - b2_) * g_eff * g_eff
-        upd = (m2 / bc1_) / (jnp.sqrt(v2 / bc2_) + eps_)
-        upd = upd + jnp.where(mode_ > 0.5, wd * p_, 0.0)
-        return [upd, m2, v2]
-
-    (u, m2, v2), found = fused_elementwise(
-        stage1, [p, m, v, g],
-        scalars=[b1, b2, beta3, eps, weight_decay, bc1, bc2, mode, inv_scale],
-        num_outputs=3, out_dtypes=[jnp.float32, m.dtype, v.dtype],
-        check_finite=(3,), impl=impl,
+    (u, m2, v2), found = fused_lamb_compute_update_term(
+        p, m, v, g,
+        beta1=b1, beta2=b2, beta3=beta3, eps=eps,
+        weight_decay=weight_decay, bias_correction1=bc1,
+        bias_correction2=bc2, adam_w_mode=adam_w_mode,
+        inv_scale=inv_scale, impl=impl,
     )
 
     w_norm = per_tensor_l2norm(p, space, impl=impl)
     u_norm = per_tensor_l2norm(u, space, impl=impl)
-    # trust ratio (ref csrc/multi_tensor_lamb.cu:270-283); NVLAMB applies
-    # the ratio even for wd==0 groups (use_nvlamb flag in the driver).
-    ratio = jnp.where(
-        (w_norm > 0.0) & (u_norm > 0.0), w_norm / u_norm, 1.0
-    )
-    if not use_nvlamb and not (weight_decay > 0.0):
-        ratio = jnp.ones_like(ratio)
+    ratio = lamb_trust_ratio(w_norm, u_norm, weight_decay=weight_decay,
+                             use_nvlamb=use_nvlamb)
 
     def stage2(ins, s, t):
         p_, u_ = [x.astype(jnp.float32) for x in ins]
