@@ -2,9 +2,13 @@
 
 Subpackages mirror the reference's contrib surface, re-designed for TPU:
 
-    contrib.optimizers — ZeRO-style sharded optimizers
-                         (ref: apex/contrib/optimizers/distributed_fused_adam.py,
-                          distributed_fused_lamb.py)
+    contrib.optimizers     — ZeRO-style sharded optimizers
+                             (ref: apex/contrib/optimizers/distributed_fused_adam.py,
+                              distributed_fused_lamb.py)
+    contrib.multihead_attn — fused MHA modules (ref: apex/contrib/multihead_attn)
+    contrib.fmha           — packed-varlen flash attention (ref: apex/contrib/fmha)
 """
 
 from apex_tpu.contrib import optimizers  # noqa: F401
+from apex_tpu.contrib import multihead_attn  # noqa: F401
+from apex_tpu.contrib import fmha  # noqa: F401

@@ -1,0 +1,57 @@
+"""Packed-varlen flash attention (ref: apex/contrib/fmha).
+
+The reference's FMHA handles packed variable-length batches — all
+sequences concatenated into one (total_tokens, ...) buffer delimited by
+``cu_seqlens`` — with fixed max seqlen {128,256,384,512}, head_dim 64,
+sm80 only (ref: apex/contrib/fmha/fmha.py:33-74).
+
+TPU re-design: segment-id masking inside the seqlen-generic Pallas
+flash kernel (apex_tpu/ops/attention.py). Packed rows become one
+batch-1 sequence whose segment ids are derived from cu_seqlens; no
+max-seqlen or head-dim restriction.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.attention import flash_attention
+
+
+def segment_ids_from_cu_seqlens(cu_seqlens: jax.Array, total: int) -> jax.Array:
+    """cu_seqlens (nseq+1,) int32 -> (total,) segment ids.
+
+    Positions beyond cu_seqlens[-1] get segment id nseq (a padding
+    segment distinct from every real one).
+    """
+    pos = jnp.arange(total, dtype=jnp.int32)
+    return jnp.searchsorted(cu_seqlens, pos, side="right").astype(jnp.int32) - 1
+
+
+def fmha(
+    qkv: jax.Array,
+    cu_seqlens: jax.Array,
+    *,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Attention over a packed batch.
+
+    qkv: (total_tokens, 3, num_heads, head_dim) — the reference's packed
+    layout (ref apex/contrib/fmha/fmha.py:42). Returns
+    (total_tokens, num_heads, head_dim).
+    """
+    total, three, nh, d = qkv.shape
+    assert three == 3, f"expected (total, 3, heads, d); got {qkv.shape}"
+    seg = segment_ids_from_cu_seqlens(cu_seqlens, total)[None]
+    q, k, v = (qkv[:, i].transpose(1, 0, 2)[None] for i in range(3))
+    out = flash_attention(q, k, v, segment_ids=seg, causal=causal,
+                          softmax_scale=softmax_scale, impl=impl)
+    return out[0].transpose(1, 0, 2)
+
+
+__all__ = ["fmha", "segment_ids_from_cu_seqlens"]

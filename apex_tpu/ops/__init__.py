@@ -14,3 +14,4 @@ from apex_tpu.ops.rope import (
     fused_apply_rotary_pos_emb_thd,
 )
 from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+from apex_tpu.ops.attention import flash_attention

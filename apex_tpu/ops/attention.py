@@ -1,0 +1,608 @@
+"""Flash attention — Pallas TPU kernels with custom VJP.
+
+TPU re-design of the reference's two attention kernel families:
+
+  - ``apex/contrib/fmha`` (fixed-seqlen sm80 flash attention over packed
+    varlen batches, ref: apex/contrib/fmha/fmha.py:33-74,
+    apex/contrib/csrc/fmha/) — superseded here by a seqlen-generic
+    flash kernel with segment-id masking for packed varlen.
+  - ``apex/contrib/multihead_attn`` CUDA softmax/GEMM fusions
+    (ref: apex/contrib/csrc/multihead_attn/, 8438 LoC) — the module
+    layer on top lives in apex_tpu/contrib/multihead_attn.
+
+Design (standard TPU flash attention, "How to Scale Your Model" ch. on
+attention): online softmax over KV blocks streamed through VMEM; the
+MXU sees (block_q, d) x (d, block_k) and (block_q, block_k) x
+(block_k, d) matmuls; stats (running max m, normalizer l) live in VMEM
+scratch broadcast across 128 lanes. Backward recomputes P from the
+saved logsumexp (no O(S^2) residuals) with two kernels: dq
+(parallel over Q blocks) and dk/dv (parallel over KV blocks).
+
+Layout: (batch, heads, seq, head_dim) ("bhsd"). fp32 accumulation
+throughout, output in the input dtype.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu._backend import interpret_flag, resolve_impl
+
+NEG_INF = -1e30
+
+
+def _bias_index_map(b_b: int, h_b: int, h: int):
+    """Flat-bias index for grid step bh, honoring size-1 broadcast dims.
+
+    bias is stored (b_b*h_b, sq, sk) with b_b in {1, b}, h_b in {1, h};
+    grid step bh = ib*h + ih reads bias block (ib % b_b)*h_b + ih % h_b.
+    """
+    def bmap(bh):
+        return (bh // h) % b_b * h_b + (bh % h) % h_b
+    return bmap
+
+
+def _pick_block(seq: int, want: int) -> int:
+    """Largest power-of-two block <= want that divides seq."""
+    b = min(want, seq)
+    while seq % b:
+        b //= 2
+    return max(b, 1)
+
+
+def _mask_block(iq, ik, bq, bk, sq, sk, causal, q_seg, k_seg):
+    """fp32 additive mask (bq, bk) for the (iq, ik) block pair."""
+    row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    neg = jnp.zeros((bq, bk), jnp.float32)
+    if causal:
+        # query i attends to keys j <= i + (sk - sq) (supports sk >= sq)
+        neg = jnp.where(col > row + (sk - sq), NEG_INF, neg)
+    if q_seg is not None:
+        neg = jnp.where(q_seg[:, None] != k_seg[None, :], NEG_INF, neg)
+    return neg
+
+
+# --------------------------------------------------------------------------
+# forward kernel
+# --------------------------------------------------------------------------
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
+                o_ref, lse_ref, acc_sc, m_sc, l_sc,
+                *, scale, causal, nk, bq, bk, sq, sk):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc_sc[...] = jnp.zeros_like(acc_sc)
+        m_sc[...] = jnp.full_like(m_sc, NEG_INF)
+        l_sc[...] = jnp.zeros_like(l_sc)
+
+    # causal: whole block above the diagonal contributes nothing
+    run = True
+    if causal:
+        run = (ik * bk) <= (iq * bq + bq - 1 + (sk - sq))
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)          # (bq, d)
+        k = k_ref[0].astype(jnp.float32)          # (bk, d)
+        v = v_ref[0].astype(jnp.float32)
+        s = jax.lax.dot_general(
+            q * scale, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bq, bk)
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        q_seg = qs_ref[0] if qs_ref is not None else None
+        k_seg = ks_ref[0] if ks_ref is not None else None
+        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, q_seg, k_seg)
+
+        m_prev = m_sc[:, :1]                       # (bq, 1)
+        l_prev = l_sc[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                     # (bq, bk)
+        corr = jnp.exp(m_prev - m_new)             # (bq, 1)
+        l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        acc_sc[...] = acc_sc[...] * corr + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
+        l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
+
+    @pl.when(ik == nk - 1)
+    def _fin():
+        l = l_sc[:, :1]
+        m = m_sc[:, :1]
+        # fully-masked rows (e.g. a q segment with no matching kv
+        # segment): every logit carries the NEG_INF additive mask, so m
+        # sits near NEG_INF. Emit 0 there, and set lse=0 so the backward's
+        # p = exp(s - lse) = exp(~NEG_INF) underflows to exactly 0.
+        valid = m > NEG_INF * 0.5
+        safe = jnp.where(l > 0.0, l, 1.0)
+        o_ref[0] = jnp.where(valid, acc_sc[...] / safe, 0.0).astype(o_ref.dtype)
+        lse_ref[0] = jnp.where(valid[:, 0], m[:, 0] + jnp.log(safe[:, 0]),
+                               0.0).astype(jnp.float32)
+
+
+def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal,
+                      bq, bk, interpret):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = _pick_block(sq, bq)
+    bk = _pick_block(sk, bk)
+    nq, nk = sq // bq, sk // bk
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * h, sk, d)
+    vf = v.reshape(b * h, sk, d)
+
+    in_specs = [
+        pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
+    ]
+    args = [qf, kf, vf]
+    if bias is not None:
+        # keep ALL broadcast (size-1) dims: batch/head via the index map,
+        # sq/sk via size-1 blocks that broadcast inside the kernel.
+        b_b, h_b, sq_b, sk_b = bias.shape
+        bias_f = bias.reshape(b_b * h_b, sq_b, sk_b)
+        bmap = _bias_index_map(b_b, h_b, h)
+        in_specs.append(pl.BlockSpec(
+            (1, bq if sq_b > 1 else 1, bk if sk_b > 1 else 1),
+            lambda bh, iq, ik: (bmap(bh),
+                                iq if sq_b > 1 else 0,
+                                ik if sk_b > 1 else 0)))
+        args.append(bias_f)
+    else:
+        in_specs.append(None)
+        args.append(None)
+    if q_seg is not None:
+        # (b, seq) read per grid step via bh // h — no h-fold copy
+        in_specs.append(
+            pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh // h, iq)))
+        in_specs.append(
+            pl.BlockSpec((1, bk), lambda bh, iq, ik: (bh // h, ik)))
+        args += [q_seg, k_seg]
+    else:
+        in_specs += [None, None]
+        args += [None, None]
+
+    live_specs = [s for s in in_specs if s is not None]
+    live_args = [a for a in args if a is not None]
+
+    def kernel(*refs):
+        it = iter(refs[:len(live_specs)])
+        q_ref = next(it)
+        k_ref = next(it)
+        v_ref = next(it)
+        bias_ref = next(it) if bias is not None else None
+        qs_ref = next(it) if q_seg is not None else None
+        ks_ref = next(it) if q_seg is not None else None
+        o_ref, lse_ref, acc_sc, m_sc, l_sc = refs[len(live_specs):]
+        _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
+                    o_ref, lse_ref, acc_sc, m_sc, l_sc,
+                    scale=scale, causal=causal, nk=nk, bq=bq, bk=bk,
+                    sq=sq, sk=sk)
+
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(b * h, nq, nk),
+        in_specs=live_specs,
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+            pltpu.VMEM((bq, 128), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*live_args)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+
+
+# --------------------------------------------------------------------------
+# backward kernels (recompute P from saved lse)
+# --------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                   bias_ref, qs_ref, ks_ref, dq_ref, dq_sc,
+                   *, scale, causal, nk, bq, bk, sq, sk):
+    ik = pl.program_id(2)
+    iq = pl.program_id(1)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_sc[...] = jnp.zeros_like(dq_sc)
+
+    run = True
+    if causal:
+        run = (ik * bk) <= (iq * bq + bq - 1 + (sk - sq))
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = dl_ref[0][:, None]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        q_seg = qs_ref[0] if qs_ref is not None else None
+        k_seg = ks_ref[0] if ks_ref is not None else None
+        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, q_seg, k_seg)
+        p = jnp.exp(s - lse)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dq_sc[...] = dq_sc[...] + jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(ik == nk - 1)
+    def _fin():
+        dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
+                    bias_ref, qs_ref, ks_ref, dk_ref, dv_ref, dk_sc, dv_sc,
+                    *, scale, causal, nq, bq, bk, sq, sk):
+    iq = pl.program_id(2)
+    ik = pl.program_id(1)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_sc[...] = jnp.zeros_like(dk_sc)
+        dv_sc[...] = jnp.zeros_like(dv_sc)
+
+    run = True
+    if causal:
+        run = (ik * bk) <= (iq * bq + bq - 1 + (sk - sq))
+
+    @pl.when(run)
+    def _step():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0][:, None]
+        delta = dl_ref[0][:, None]
+        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if bias_ref is not None:
+            s = s + bias_ref[0].astype(jnp.float32)
+        q_seg = qs_ref[0] if qs_ref is not None else None
+        k_seg = ks_ref[0] if ks_ref is not None else None
+        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, q_seg, k_seg)
+        p = jnp.exp(s - lse)                       # (bq, bk)
+        dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bk, d)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_sc[...] = dk_sc[...] + jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(iq == nq - 1)
+    def _fin():
+        dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
+
+
+def _flash_bwd_pallas(res, g, delta, scale, causal, bq, bk, interpret):
+    q, k, v, bias, q_seg, k_seg, out, lse = res
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    bq = _pick_block(sq, bq)
+    bk = _pick_block(sk, bk)
+    nq, nk = sq // bq, sk // bk
+
+    def flat(t, s):
+        return t.reshape(b * h, s, -1)
+
+    qf, kf, vf = flat(q, sq), flat(k, sk), flat(v, sk)
+    dof = flat(g, sq)
+    lsef = lse.reshape(b * h, sq)
+    dlf = delta.reshape(b * h, sq)
+    if bias is not None:
+        b_b, h_b, sq_b, sk_b = bias.shape
+        bias_f = bias.reshape(b_b * h_b, sq_b, sk_b)
+        bmap = _bias_index_map(b_b, h_b, h)
+
+    def build(order_kv_major):
+        # the two kernels differ only in grid meaning:
+        # dq: grid=(bh, iq, ik); dkv: grid=(bh, ik, iq)
+        if order_kv_major:
+            iq_of = lambda a, b_: b_             # noqa: E731
+            ik_of = lambda a, b_: a              # noqa: E731
+        else:
+            iq_of = lambda a, b_: a              # noqa: E731
+            ik_of = lambda a, b_: b_             # noqa: E731
+        qi = lambda bh, a, b_: (bh, iq_of(a, b_), 0)   # noqa: E731
+        ki = lambda bh, a, b_: (bh, ik_of(a, b_), 0)   # noqa: E731
+        rowi = lambda bh, a, b_: (bh, iq_of(a, b_))    # noqa: E731
+        specs = [
+            pl.BlockSpec((1, bq, d), qi),
+            pl.BlockSpec((1, bk, d), ki),
+            pl.BlockSpec((1, bk, d), ki),
+            pl.BlockSpec((1, bq, d), qi),
+            pl.BlockSpec((1, bq), rowi),
+            pl.BlockSpec((1, bq), rowi),
+        ]
+        arr = [qf, kf, vf, dof, lsef, dlf]
+        if bias is not None:
+            specs.append(pl.BlockSpec(
+                (1, bq if sq_b > 1 else 1, bk if sk_b > 1 else 1),
+                lambda bh, a, b_: (bmap(bh),
+                                   iq_of(a, b_) if sq_b > 1 else 0,
+                                   ik_of(a, b_) if sk_b > 1 else 0)))
+            arr.append(bias_f)
+        if q_seg is not None:
+            specs.append(pl.BlockSpec(
+                (1, bq), lambda bh, a, b_: (bh // h, iq_of(a, b_))))
+            specs.append(pl.BlockSpec(
+                (1, bk), lambda bh, a, b_: (bh // h, ik_of(a, b_))))
+            arr += [q_seg, k_seg]
+        return specs, arr
+
+    # dq pass
+    specs, arr = build(order_kv_major=False)
+
+    def dq_kernel(*refs):
+        n = len(specs)
+        it = iter(refs[:n])
+        base = [next(it) for _ in range(6)]
+        bias_ref = next(it) if bias is not None else None
+        qs_ref = next(it) if q_seg is not None else None
+        ks_ref = next(it) if q_seg is not None else None
+        dq_ref, dq_sc = refs[n:]
+        _bwd_dq_kernel(*base, bias_ref, qs_ref, ks_ref, dq_ref, dq_sc,
+                       scale=scale, causal=causal, nk=nk, bq=bq, bk=bk,
+                       sq=sq, sk=sk)
+
+    dq = pl.pallas_call(
+        dq_kernel,
+        grid=(b * h, nq, nk),
+        in_specs=specs,
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+        out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*arr)
+
+    # dk/dv pass
+    specs, arr = build(order_kv_major=True)
+
+    def dkv_kernel(*refs):
+        n = len(specs)
+        it = iter(refs[:n])
+        base = [next(it) for _ in range(6)]
+        bias_ref = next(it) if bias is not None else None
+        qs_ref = next(it) if q_seg is not None else None
+        ks_ref = next(it) if q_seg is not None else None
+        dk_ref, dv_ref, dk_sc, dv_sc = refs[n:]
+        _bwd_dkv_kernel(*base, bias_ref, qs_ref, ks_ref,
+                        dk_ref, dv_ref, dk_sc, dv_sc,
+                        scale=scale, causal=causal, nq=nq, bq=bq, bk=bk,
+                        sq=sq, sk=sk)
+
+    dk, dv = pl.pallas_call(
+        dkv_kernel,
+        grid=(b * h, nk, nq),
+        in_specs=specs,
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda bh, ik, iq: (bh, ik, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary")),
+        interpret=interpret,
+    )(*arr)
+
+    def unflat(t, s):
+        return t.reshape(b, h, s, d)
+
+    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
+
+
+# --------------------------------------------------------------------------
+# XLA reference path
+# --------------------------------------------------------------------------
+
+
+def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
+                   dropout_rate=0.0, dropout_rng=None):
+    b, h, sq, d = q.shape
+    sk = k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
+                   k.astype(jnp.float32))
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
+    if causal:
+        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+        s = jnp.where(col > row + (sk - sq), NEG_INF, s)
+    if q_seg is not None:
+        seg = q_seg[:, None, :, None] != k_seg[:, None, None, :]
+        s = jnp.where(seg, NEG_INF, s)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    e = jnp.exp(s - m)
+    l = jnp.sum(e, axis=-1, keepdims=True)
+    p = e / jnp.where(l > 0.0, l, 1.0)
+    # fully-masked rows emit 0 (matches the Pallas kernel's guard)
+    p = jnp.where(m > NEG_INF * 0.5, p, 0.0)
+    if dropout_rate > 0.0:
+        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, p.shape)
+        p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
+    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+# --------------------------------------------------------------------------
+# public API
+# --------------------------------------------------------------------------
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
+def _flash(q, k, v, bias, q_seg, k_seg, scale, causal, bq, bk, interpret):
+    out, _ = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal,
+                               bq, bk, interpret)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, bias, q_seg, k_seg, scale, causal, bq, bk,
+                    interpret):
+    out, lse = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal,
+                                 bq, bk, interpret)
+    return out, (q, k, v, bias, q_seg, k_seg, out, lse)
+
+
+def _flash_bwd_rule(scale, causal, bq, bk, interpret, res, g):
+    q, k, v, bias, q_seg, k_seg, out, lse = res
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
+    dq, dk, dv = _flash_bwd_pallas(res, g, delta, scale, causal, bq, bk,
+                                   interpret)
+    dbias = None
+    if bias is not None:
+        # bias grad by recompute, one (batch, head) slice at a time —
+        # O(sq*sk) live memory, scatter-added into the (possibly
+        # broadcast-shaped) bias cotangent.
+        b, h, sq, _ = q.shape
+        sk = k.shape[2]
+        b_b, h_b, sq_b, sk_b = bias.shape
+        bmap = _bias_index_map(b_b, h_b, h)
+
+        def body(bh, acc):
+            ib, ih = bh // h, bh % h
+            s = jax.lax.dot_general(
+                q[ib, ih].astype(jnp.float32) * scale,
+                k[ib, ih].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            s = s + bias[ib % b_b, ih % h_b].astype(jnp.float32)
+            if causal:
+                row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+                col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+                s = jnp.where(col > row + (sk - sq), NEG_INF, s)
+            if q_seg is not None:
+                seg = q_seg[ib][:, None] != k_seg[ib][None, :]
+                s = jnp.where(seg, NEG_INF, s)
+            p = jnp.exp(s - lse[ib, ih][:, None])
+            dp = jax.lax.dot_general(
+                g[ib, ih].astype(jnp.float32),
+                v[ib, ih].astype(jnp.float32),
+                (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[ib, ih][:, None])
+            if sq_b == 1:
+                ds = jnp.sum(ds, axis=0, keepdims=True)
+            if sk_b == 1:
+                ds = jnp.sum(ds, axis=1, keepdims=True)
+            return acc.at[bmap(bh)].add(ds)
+
+        acc = jax.lax.fori_loop(
+            0, b * h, body, jnp.zeros((b_b * h_b, sq_b, sk_b), jnp.float32))
+        dbias = acc.reshape(bias.shape).astype(bias.dtype)
+
+    def int_ct(a):
+        import numpy as np
+        return (None if a is None
+                else np.zeros(a.shape, dtype=jax.dtypes.float0))
+
+    return (dq, dk, dv, dbias, int_ct(q_seg), int_ct(k_seg))
+
+
+_flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    bias: Optional[jax.Array] = None,
+    segment_ids: Optional[jax.Array] = None,
+    kv_segment_ids: Optional[jax.Array] = None,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    dropout_rate: float = 0.0,
+    dropout_rng: Optional[jax.Array] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Memory-efficient attention over (batch, heads, seq, head_dim).
+
+    ``segment_ids`` (batch, seq_q) int32 enables packed-varlen batches —
+    tokens only attend within their own segment (the TPU equivalent of the
+    reference's cu_seqlens packed layout, ref apex/contrib/fmha/fmha.py:33-74).
+    ``bias`` is an additive fp32 logit bias broadcastable to
+    (batch, heads, seq_q, seq_k) — covers the reference's additive-mask
+    multihead_attn variants. Dropout (on attention probabilities) is only
+    supported on the XLA path (``impl="xla"`` is auto-selected then).
+    """
+    impl = resolve_impl(impl)
+    if bias is not None:
+        b, h, sq, sk = (q.shape[0], q.shape[1], q.shape[2], k.shape[2])
+        ok = (bias.ndim == 4
+              and bias.shape[0] in (1, b) and bias.shape[1] in (1, h)
+              and bias.shape[2] in (1, sq) and bias.shape[3] in (1, sk))
+        if not ok:
+            raise ValueError(
+                f"bias must be 4-D with each dim 1 or full "
+                f"({(b, h, sq, sk)}); got shape {bias.shape}")
+    if softmax_scale is None:
+        softmax_scale = q.shape[-1] ** -0.5
+    if segment_ids is not None and kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    elif kv_segment_ids is not None and segment_ids is None:
+        # key-side-only masking (e.g. padded keys in cross attention):
+        # queries are all segment 0 and attend only to segment-0 keys.
+        segment_ids = jnp.zeros(
+            (q.shape[0], q.shape[2]), kv_segment_ids.dtype)
+    if dropout_rate > 0.0:
+        if dropout_rng is None:
+            raise ValueError("dropout_rate > 0 requires dropout_rng")
+        impl = "xla"
+    if impl == "xla":
+        return _attention_xla(q, k, v, bias, segment_ids, kv_segment_ids,
+                              softmax_scale, causal, dropout_rate,
+                              dropout_rng)
+    return _flash(q, k, v, bias, segment_ids, kv_segment_ids,
+                  softmax_scale, causal, block_q, block_k,
+                  interpret_flag(impl))
+
+
+__all__ = ["flash_attention"]

@@ -1,0 +1,263 @@
+"""Flash attention kernel + MHA module tests.
+
+Mirrors the reference's contrib attention tests
+(ref: apex/contrib/test/multihead_attn/test_self_multihead_attn.py,
+test_encdec_multihead_attn.py, apex/contrib/test/fmha/test_fmha.py):
+fused kernel vs pure reference implementation, fwd and bwd.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.contrib.fmha import fmha, segment_ids_from_cu_seqlens
+from apex_tpu.contrib.multihead_attn import (
+    EncdecMultiheadAttn,
+    SelfMultiheadAttn,
+)
+from apex_tpu.ops.attention import flash_attention
+
+
+def naive_attention(q, k, v, bias=None, causal=False, scale=None):
+    scale = scale or q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if bias is not None:
+        s = s + bias
+    if causal:
+        sq, sk = q.shape[2], k.shape[2]
+        row = np.arange(sq)[:, None]
+        col = np.arange(sk)[None, :]
+        s = jnp.where(jnp.asarray(col > row + (sk - sq)), -1e30, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
+
+
+@pytest.fixture
+def qkv(rng):
+    b, h, s, d = 2, 4, 128, 64
+    return [jnp.asarray(rng.randn(b, h, s, d).astype(np.float32)) * 0.3
+            for _ in range(3)]
+
+
+@pytest.mark.parametrize("causal", [False, True])
+def test_flash_matches_naive(qkv, causal, impl):
+    q, k, v = qkv
+    out = flash_attention(q, k, v, causal=causal, impl=impl)
+    ref = naive_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-3, rtol=1e-3)
+
+
+def test_flash_bias(qkv, rng, impl):
+    q, k, v = qkv
+    bias = jnp.asarray(rng.randn(1, q.shape[1], q.shape[2],
+                                 k.shape[2]).astype(np.float32))
+    out = flash_attention(q, k, v, bias=bias, impl=impl)
+    ref = naive_attention(q, k, v, bias=bias)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-3, rtol=1e-3)
+
+
+def test_flash_grads_match_xla(qkv, rng):
+    q, k, v = qkv
+    bias = jnp.asarray(rng.randn(1, 4, 128, 128).astype(np.float32)) * 0.1
+
+    def mk(impl):
+        def f(q, k, v, bias):
+            o = flash_attention(q, k, v, bias=bias, causal=True, impl=impl)
+            return jnp.sum(o * o)
+        return jax.grad(f, argnums=(0, 1, 2, 3))
+
+    gi = mk("interpret")(q, k, v, bias)
+    gx = mk("xla")(q, k, v, bias)
+    for a, b in zip(gi, gx):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   atol=2e-2, rtol=1e-2)
+
+
+def test_flash_segment_ids_isolate(rng, impl):
+    """Packed sequences must not attend across segment boundaries: the
+    packed result equals per-segment attention computed separately."""
+    b, h, s, d = 1, 2, 128, 32
+    q, k, v = [jnp.asarray(rng.randn(b, h, s, d).astype(np.float32)) * 0.5
+               for _ in range(3)]
+    seg = jnp.asarray(np.repeat([0, 1], s // 2)[None], jnp.int32)
+    out = flash_attention(q, k, v, segment_ids=seg, causal=True, impl=impl)
+    half = s // 2
+    ref0 = naive_attention(q[:, :, :half], k[:, :, :half], v[:, :, :half],
+                           causal=True)
+    ref1 = naive_attention(q[:, :, half:], k[:, :, half:], v[:, :, half:],
+                           causal=True)
+    np.testing.assert_allclose(np.asarray(out[:, :, :half]), np.asarray(ref0),
+                               atol=5e-3, rtol=1e-3)
+    np.testing.assert_allclose(np.asarray(out[:, :, half:]), np.asarray(ref1),
+                               atol=5e-3, rtol=1e-3)
+
+
+def test_fmha_packed_varlen(rng, impl):
+    lens = [48, 80, 128]
+    total = sum(lens)
+    nh, d = 4, 32
+    qkv_packed = jnp.asarray(rng.randn(total, 3, nh, d).astype(np.float32)) * 0.4
+    cu = jnp.asarray(np.cumsum([0] + lens), jnp.int32)
+    out = fmha(qkv_packed, cu, impl=impl)
+    assert out.shape == (total, nh, d)
+    # compare each sequence against standalone attention
+    off = 0
+    for ln in lens:
+        chunk = qkv_packed[off:off + ln]
+        q, k, v = (chunk[:, i].transpose(1, 0, 2)[None] for i in range(3))
+        ref = naive_attention(q, k, v)[0].transpose(1, 0, 2)
+        np.testing.assert_allclose(np.asarray(out[off:off + ln]),
+                                   np.asarray(ref), atol=5e-3, rtol=1e-3)
+        off += ln
+
+
+def test_segment_ids_from_cu_seqlens():
+    cu = jnp.asarray([0, 3, 5], jnp.int32)
+    seg = segment_ids_from_cu_seqlens(cu, 7)
+    np.testing.assert_array_equal(np.asarray(seg), [0, 0, 0, 1, 1, 2, 2])
+
+
+@pytest.mark.parametrize("norm_add", [False, True])
+def test_self_multihead_attn(rng, norm_add):
+    s, b, e, h = 64, 2, 128, 4
+    x = jnp.asarray(rng.randn(s, b, e).astype(np.float32)) * 0.5
+    mod = SelfMultiheadAttn(embed_dim=e, num_heads=h, bias=True,
+                            include_norm_add=norm_add, impl="interpret")
+    params = mod.init(jax.random.PRNGKey(0), x)
+    out, _ = mod.apply(params, x)
+    assert out.shape == (s, b, e)
+    ref = SelfMultiheadAttn(embed_dim=e, num_heads=h, bias=True,
+                            include_norm_add=norm_add, impl="default")
+    out_ref, _ = ref.apply(params, x)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                               atol=5e-3, rtol=1e-3)
+
+
+def test_self_multihead_attn_padding_mask(rng):
+    s, b, e, h = 64, 2, 64, 4
+    x = jnp.asarray(rng.randn(s, b, e).astype(np.float32)) * 0.5
+    pad = jnp.asarray(np.arange(s)[None] >= 48).repeat(b, 0)  # (b, s)
+    mod = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="interpret")
+    params = mod.init(jax.random.PRNGKey(0), x)
+    out, _ = mod.apply(params, x, key_padding_mask=pad)
+    ref = SelfMultiheadAttn(embed_dim=e, num_heads=h, impl="default")
+    out_ref, _ = ref.apply(params, x, key_padding_mask=pad)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                               atol=5e-3, rtol=1e-3)
+
+
+def test_encdec_multihead_attn(rng):
+    sq, sk, b, e, h = 32, 64, 2, 64, 4
+    q = jnp.asarray(rng.randn(sq, b, e).astype(np.float32)) * 0.5
+    kv = jnp.asarray(rng.randn(sk, b, e).astype(np.float32)) * 0.5
+    mod = EncdecMultiheadAttn(embed_dim=e, num_heads=h, impl="interpret")
+    params = mod.init(jax.random.PRNGKey(0), q, kv)
+    out, _ = mod.apply(params, q, kv)
+    assert out.shape == (sq, b, e)
+    ref = EncdecMultiheadAttn(embed_dim=e, num_heads=h, impl="default")
+    out_ref, _ = ref.apply(params, q, kv)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                               atol=5e-3, rtol=1e-3)
+
+
+def test_mha_dropout_deterministic_under_key(rng):
+    s, b, e, h = 32, 2, 64, 4
+    x = jnp.asarray(rng.randn(s, b, e).astype(np.float32))
+    mod = SelfMultiheadAttn(embed_dim=e, num_heads=h, dropout=0.5,
+                            impl="default")
+    params = mod.init(jax.random.PRNGKey(0), x, is_training=False)
+    o1, _ = mod.apply(params, x, is_training=True,
+                      rngs={"dropout": jax.random.PRNGKey(7)})
+    o2, _ = mod.apply(params, x, is_training=True,
+                      rngs={"dropout": jax.random.PRNGKey(7)})
+    o3, _ = mod.apply(params, x, is_training=False)
+    np.testing.assert_array_equal(np.asarray(o1), np.asarray(o2))
+    assert np.abs(np.asarray(o1) - np.asarray(o3)).max() > 1e-3
+
+
+def test_fully_masked_q_segment_zero_output_and_grads(rng):
+    """A q segment with no matching kv segment must emit 0 with zero
+    gradients — on both impls (code-review regression)."""
+    b, h, s, d = 1, 2, 128, 32
+    q, k, v = [jnp.asarray(rng.randn(b, h, s, d).astype(np.float32)) * 0.5
+               for _ in range(3)]
+    q_seg = jnp.asarray(np.repeat([0, 7], s // 2)[None], jnp.int32)
+    k_seg = jnp.zeros((b, s), jnp.int32)  # segment 7 queries match nothing
+
+    outs, grads = {}, {}
+    for impl in ("xla", "interpret"):
+        def f(q, k, v):
+            o = flash_attention(q, k, v, segment_ids=q_seg,
+                                kv_segment_ids=k_seg, impl=impl)
+            return jnp.sum(o * o), o
+        (_, o), g = jax.value_and_grad(f, argnums=(0, 1, 2),
+                                       has_aux=True)(q, k, v)
+        outs[impl], grads[impl] = o, g
+
+    for impl in ("xla", "interpret"):
+        np.testing.assert_array_equal(
+            np.asarray(outs[impl][:, :, s // 2:]), 0.0)
+        np.testing.assert_array_equal(
+            np.asarray(grads[impl][0][:, :, s // 2:]), 0.0)
+    for a, b_ in zip(grads["xla"], grads["interpret"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   atol=2e-2, rtol=1e-2)
+
+
+def test_kv_segment_ids_only(rng, impl):
+    """kv_segment_ids without segment_ids masks padded keys
+    (code-review regression: used to be silently ignored)."""
+    b, h, s, d = 2, 2, 128, 32
+    q, k, v = [jnp.asarray(rng.randn(b, h, s, d).astype(np.float32)) * 0.5
+               for _ in range(3)]
+    valid = 96
+    kv_seg = jnp.asarray((np.arange(s) >= valid)[None].repeat(b, 0),
+                         jnp.int32)
+    out = flash_attention(q, k, v, kv_segment_ids=kv_seg, impl=impl)
+    ref = naive_attention(q[:, :, :, :], k[:, :, :valid], v[:, :, :valid])
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=5e-3, rtol=1e-3)
+
+
+def test_flash_bias_grad_broadcast_shapes(rng):
+    """dbias must come back in the bias's own (broadcast) shape and match
+    the XLA path (code-review regression for the chunked recompute)."""
+    b, h, s, d = 2, 2, 64, 32
+    q, k, v = [jnp.asarray(rng.randn(b, h, s, d).astype(np.float32)) * 0.5
+               for _ in range(3)]
+    for shape in [(1, 1, s, s), (1, h, s, s), (b, h, s, s),
+                  (b, 1, 1, s), (1, h, s, 1)]:
+        bias = jnp.asarray(rng.randn(*shape).astype(np.float32)) * 0.1
+
+        def mk(impl):
+            def f(bias):
+                o = flash_attention(q, k, v, bias=bias, causal=True,
+                                    impl=impl)
+                return jnp.sum(o * o)
+            return jax.grad(f)
+        gi = mk("interpret")(bias)
+        gx = mk("xla")(bias)
+        assert gi.shape == shape
+        np.testing.assert_allclose(np.asarray(gi), np.asarray(gx),
+                                   atol=2e-2, rtol=1e-2)
+
+
+def test_mask_additive_fast_impl(rng):
+    """mask_additive builds a (b,1,1,sk) bias; the Pallas path must accept
+    it (code-review regression: size-1 sq/sk dims used to crash)."""
+    s, b, e, h = 64, 2, 64, 4
+    x = jnp.asarray(rng.randn(s, b, e).astype(np.float32)) * 0.5
+    add_mask = jnp.where(jnp.asarray(np.arange(s)[None] >= 48).repeat(b, 0),
+                         -10000.0, 0.0)
+    fast = SelfMultiheadAttn(embed_dim=e, num_heads=h, mask_additive=True,
+                             impl="interpret")
+    params = fast.init(jax.random.PRNGKey(0), x)
+    out, _ = fast.apply(params, x, key_padding_mask=add_mask)
+    ref = SelfMultiheadAttn(embed_dim=e, num_heads=h, mask_additive=True,
+                            impl="default")
+    out_ref, _ = ref.apply(params, x, key_padding_mask=add_mask)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(out_ref),
+                               atol=5e-3, rtol=1e-3)
