@@ -1,6 +1,7 @@
 """Megatron-style model-parallel transformer library (ref: apex/transformer/__init__.py)."""
 
 from apex_tpu.transformer import amp
+from apex_tpu.transformer import context_parallel
 from apex_tpu.transformer import functional
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer import pipeline_parallel

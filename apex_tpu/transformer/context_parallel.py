@@ -1,0 +1,318 @@
+"""Context parallelism — ring attention and Ulysses (all-to-all) attention.
+
+The reference implements Megatron sequence parallelism only and has **no
+ring attention / context parallelism / Ulysses** (SURVEY.md §5
+"Long-context": apex/transformer/tensor_parallel/mappings.py:205-260 is
+the whole story; apex/contrib/fmha is capped at seqlen 512). Long
+sequences are first-class in the TPU build, so this module provides the
+two standard sequence-scaling schemes over the mesh's "context" axis:
+
+  - **Ring attention** (`ring_attention`): Q stays put; (K, V) chunks
+    rotate around the context-axis ring via ``lax.ppermute`` while an
+    online-softmax accumulator merges each visiting chunk — exact
+    attention with per-device score memory O(s_local^2) instead of
+    O(S^2), and comms that ride ICI neighbor links. Causality is
+    enforced from *global* token positions, which also makes zig-zag
+    load balancing (`zigzag_indices`) a pure input permutation.
+  - **Ulysses attention** (`ulysses_attention`): two ``lax.all_to_all``
+    switches seq-sharding <-> head-sharding so each device runs the
+    full-sequence Pallas flash kernel (apex_tpu/ops/attention.py) on
+    its own head slice. Cheaper comms than the ring for moderate S,
+    bounded by num_heads % cp == 0.
+
+Both are called *inside* ``shard_map`` on local shards laid out
+(batch, heads, seq_local, head_dim); ``*_sharded`` convenience wrappers
+apply the shard_map for the common mesh layout. Both are reverse-mode
+differentiable (scan + ppermute/all_to_all transpose rules give the
+textbook re-ringing backward).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+from apex_tpu.ops.attention import flash_attention
+from apex_tpu.transformer.parallel_state import CONTEXT_AXIS, DATA_AXIS
+
+NEG_INF = -1e30
+
+
+# --------------------------------------------------------------------------
+# zig-zag load balancing
+# --------------------------------------------------------------------------
+
+
+def zigzag_indices(seq_len: int, cp_size: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Permutation (and its inverse) that balances causal work over the ring.
+
+    With plain block sharding device 0 holds the earliest tokens and is
+    masked out for most ring steps while the last device does full work.
+    The zig-zag layout gives device i the chunk pair (i, 2*cp-1-i) so
+    every device owns one "early" and one "late" chunk and the causal
+    work is even. Returns (perm, inv): ``x[perm]`` is the balanced
+    order to shard; ``y[inv]`` restores the original order.
+    """
+    if seq_len % (2 * cp_size):
+        raise ValueError(
+            f"zig-zag needs seq_len divisible by 2*cp ({2 * cp_size}); "
+            f"got {seq_len}")
+    piece = seq_len // (2 * cp_size)
+    chunks = np.arange(seq_len).reshape(2 * cp_size, piece)
+    order = []
+    for i in range(cp_size):
+        order.append(chunks[i])
+        order.append(chunks[2 * cp_size - 1 - i])
+    perm = np.concatenate(order)
+    inv = np.argsort(perm)
+    return perm, inv
+
+
+# --------------------------------------------------------------------------
+# ring attention
+# --------------------------------------------------------------------------
+
+
+def _chunk_attn(q, k_c, v_c, qpos, kpos, scale, causal):
+    """One ring step: scores of local Q against a visiting KV chunk,
+    returning (m, l, o_unnorm) partials in fp32 for online merging.
+
+    A fully-masked row (a chunk entirely in this query's causal future)
+    yields m = NEG_INF; the caller's merge then weights it by
+    exp(NEG_INF - m_new) == 0 once any unmasked chunk has been seen, so
+    its garbage l/o never survive — causal self-attention always sees
+    its own diagonal chunk unmasked.
+    """
+    s = jnp.einsum(
+        "bhqd,bhkd->bhqk", q, k_c, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        mask = qpos[:, None] >= kpos[None, :]
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    l = jnp.sum(p, axis=-1)
+    o = jnp.einsum(
+        "bhqk,bhkd->bhqd", p.astype(v_c.dtype), v_c,
+        preferred_element_type=jnp.float32,
+    )
+    return m, l, o
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = CONTEXT_AXIS,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Exact ring attention over the ``axis_name`` device ring.
+
+    Call inside ``shard_map``; ``q``/``k``/``v`` are the local sequence
+    shards, (batch, heads, s_local, head_dim). ``q_positions`` /
+    ``kv_positions`` are the *global* token positions of the local shard
+    (s_local,) — defaults assume contiguous block sharding; pass the
+    zig-zag positions when the inputs were permuted with
+    :func:`zigzag_indices`. KV (and its positions) rotate ring-wise via
+    ``ppermute``; the online-softmax carry merges chunks exactly as the
+    Pallas flash kernel does across KV blocks, so the result matches
+    single-device attention to fp32 accumulation order.
+    """
+    cp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    if q_positions is None:
+        q_positions = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
+    if kv_positions is None:
+        kv_positions = idx * k.shape[2] + jnp.arange(k.shape[2], dtype=jnp.int32)
+
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    q_max = jnp.max(q_positions)
+
+    def compute(k_c, v_c, kpos):
+        """(m, l, o) partials for one chunk; under causal masking a chunk
+        that lies entirely in this device's causal future is skipped via
+        ``lax.cond`` — no score matmul is issued for it, which is what
+        makes zig-zag layout an actual work-balancer and not just a
+        permutation (the per-device predicate is collective-free, so
+        divergent branches across the ring are fine)."""
+        if not causal:
+            return _chunk_attn(q, k_c, v_c, q_positions, kpos, scale, False)
+        return lax.cond(
+            jnp.min(kpos) > q_max,
+            lambda: (jnp.full((b, h, s_local), NEG_INF, jnp.float32),
+                     jnp.zeros((b, h, s_local), jnp.float32),
+                     jnp.zeros((b, h, s_local, d), jnp.float32)),
+            lambda: _chunk_attn(q, k_c, v_c, q_positions, kpos, scale, True),
+        )
+
+    # chunk 0 is the local KV shard — computed before any rotation, so
+    # the ring does exactly cp-1 ppermutes (none wasted).
+    m, l, o = compute(k, v, kv_positions)
+
+    def step(carry, _):
+        o, m, l, k_c, v_c, kpos = carry
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        kpos = lax.ppermute(kpos, axis_name, perm)
+        m_c, l_c, o_c = compute(k_c, v_c, kpos)
+        m_new = jnp.maximum(m, m_c)
+        c_old = jnp.exp(m - m_new)
+        c_new = jnp.exp(m_c - m_new)
+        o = o * c_old[..., None] + o_c * c_new[..., None]
+        l = l * c_old + l_c * c_new
+        return (o, m_new, l, k_c, v_c, kpos), None
+
+    (o, m, l, _, _, _), _ = lax.scan(
+        step, (o, m, l, k, v, kv_positions), None, length=cp - 1)
+    # guard fully-masked rows (l == 0) — only possible with non-causal
+    # external masks; causal self-attention always sees the diagonal.
+    out = o / jnp.maximum(l, 1e-30)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    axis_name: str = CONTEXT_AXIS,
+    batch_axis: Optional[str] = DATA_AXIS,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    zigzag: bool = False,
+) -> jax.Array:
+    """shard_map convenience wrapper: global (b, h, S, d) in/out, sequence
+    sharded over ``axis_name`` (and batch over ``batch_axis`` if given).
+
+    With ``zigzag=True`` the sequence is permuted to the balanced layout
+    before sharding and un-permuted after — causality stays exact because
+    :func:`ring_attention` masks from global positions.
+    """
+    cp = mesh.shape[axis_name]
+    S = q.shape[2]
+    if S % cp:
+        raise ValueError(f"seq len {S} not divisible by cp={cp}")
+
+    pos = np.arange(S, dtype=np.int32)
+    if zigzag:
+        perm, inv = zigzag_indices(S, cp)
+        q, k, v = q[:, :, perm], k[:, :, perm], v[:, :, perm]
+        pos = pos[perm]
+    pos = jnp.asarray(pos)
+
+    spec_x = P(batch_axis, None, axis_name, None)
+    spec_p = P(axis_name)
+
+    @functools.partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec_x, spec_x, spec_x, spec_p),
+        out_specs=spec_x, check_vma=False,
+    )
+    def run(ql, kl, vl, posl):
+        return ring_attention(
+            ql, kl, vl, axis_name=axis_name, causal=causal,
+            softmax_scale=softmax_scale,
+            q_positions=posl, kv_positions=posl,
+        )
+
+    out = run(q, k, v, pos)
+    if zigzag:
+        out = out[:, :, inv]
+    return out
+
+
+# --------------------------------------------------------------------------
+# Ulysses (all-to-all head<->sequence resharding)
+# --------------------------------------------------------------------------
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = CONTEXT_AXIS,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """DeepSpeed-Ulysses-style attention: all_to_all seq->heads, local
+    full-sequence flash attention, all_to_all heads->seq.
+
+    Call inside ``shard_map`` with local shards (b, h, s_local, d);
+    requires ``h % cp == 0``. The inner kernel is the Pallas flash
+    attention (apex_tpu/ops/attention.py), so per-device memory is the
+    flash kernel's, and the MXU sees full-length attention matmuls.
+    """
+    cp = lax.axis_size(axis_name)
+    h = q.shape[1]
+    if h % cp:
+        raise ValueError(f"num heads {h} not divisible by cp={cp}")
+
+    def to_seq(x):  # (b, h, s/cp, d) -> (b, h/cp, S, d)
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    def to_heads(x):  # (b, h/cp, S, d) -> (b, h, s/cp, d)
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    qh, kh, vh = to_seq(q), to_seq(k), to_seq(v)
+    out = flash_attention(
+        qh, kh, vh, causal=causal, softmax_scale=softmax_scale,
+        impl=impl, block_q=block_q, block_k=block_k,
+    )
+    return to_heads(out)
+
+
+def ulysses_attention_sharded(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    mesh,
+    *,
+    axis_name: str = CONTEXT_AXIS,
+    batch_axis: Optional[str] = DATA_AXIS,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """shard_map wrapper for :func:`ulysses_attention` (global arrays in/out)."""
+    spec_x = P(batch_axis, None, axis_name, None)
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(spec_x, spec_x, spec_x),
+        out_specs=spec_x, check_vma=False,
+    )
+    def run(ql, kl, vl):
+        return ulysses_attention(
+            ql, kl, vl, axis_name=axis_name, causal=causal,
+            softmax_scale=softmax_scale, impl=impl,
+        )
+
+    return run(q, k, v)
+
+
+__all__ = [
+    "ring_attention",
+    "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
+    "zigzag_indices",
+]

@@ -34,6 +34,7 @@ DATA_AXIS = "data"
 TENSOR_AXIS = "tensor"
 PIPELINE_AXIS = "pipe"
 EXPERT_AXIS = "expert"
+CONTEXT_AXIS = "context"
 
 # module-level state mirroring the reference's group globals
 # (ref: parallel_state.py:33-79)
@@ -49,6 +50,7 @@ def initialize_model_parallel(
     virtual_pipeline_model_parallel_size: Optional[int] = None,
     pipeline_model_parallel_split_rank: Optional[int] = None,
     expert_model_parallel_size: int = 1,
+    context_parallel_size: int = 1,
     *,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
@@ -62,16 +64,18 @@ def initialize_model_parallel(
     global _MESH, _VIRTUAL_PP_RANK, _VIRTUAL_PP_WORLD_SIZE, _PIPELINE_SPLIT_RANK
     devs = list(devices if devices is not None else jax.devices())
     world = len(devs)
-    tp, pp, ep = (
+    tp, pp, ep, cp = (
         tensor_model_parallel_size,
         pipeline_model_parallel_size,
         expert_model_parallel_size,
+        context_parallel_size,
     )
-    if world % (tp * pp * ep):
+    if world % (tp * pp * ep * cp):
         raise RuntimeError(
-            f"world size {world} not divisible by tp({tp}) x pp({pp}) x ep({ep})"
+            f"world size {world} not divisible by "
+            f"tp({tp}) x pp({pp}) x ep({ep}) x cp({cp})"
         )
-    dp = world // (tp * pp * ep)
+    dp = world // (tp * pp * ep * cp)
     if virtual_pipeline_model_parallel_size is not None:
         if pp <= 2 and virtual_pipeline_model_parallel_size > 1:
             # interleaving requires >2 stages (ref: parallel_state.py:155-160)
@@ -83,8 +87,14 @@ def initialize_model_parallel(
         _VIRTUAL_PP_WORLD_SIZE = virtual_pipeline_model_parallel_size
     _PIPELINE_SPLIT_RANK = pipeline_model_parallel_split_rank
 
-    arr = np.asarray(devs).reshape(dp, ep, pp, tp)
-    _MESH = Mesh(arr, (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS, TENSOR_AXIS))
+    # context sits just outside tensor so the CP ring (ppermute of KV
+    # chunks) also rides ICI-adjacent devices (the reference has no CP;
+    # this axis is the TPU-native long-context extension, SURVEY.md §5
+    # "Long-context").
+    arr = np.asarray(devs).reshape(dp, ep, pp, cp, tp)
+    _MESH = Mesh(
+        arr, (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
+    )
     return _MESH
 
 
@@ -133,6 +143,10 @@ def get_expert_model_parallel_world_size() -> int:
     return _axis_size(EXPERT_AXIS)
 
 
+def get_context_parallel_world_size() -> int:
+    return _axis_size(CONTEXT_AXIS)
+
+
 def get_world_size() -> int:
     m = get_mesh()
     return int(np.prod([m.shape[a] for a in m.axis_names]))
@@ -157,6 +171,10 @@ def get_data_parallel_rank():
 
 def get_expert_model_parallel_rank():
     return jax.lax.axis_index(EXPERT_AXIS)
+
+
+def get_context_parallel_rank():
+    return jax.lax.axis_index(CONTEXT_AXIS)
 
 
 # -- pipeline-stage predicates (host-side, by stage id) --------------------

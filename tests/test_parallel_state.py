@@ -23,7 +23,8 @@ class TestInitializeModelParallel:
         assert ps.get_pipeline_model_parallel_world_size() == pp
         assert ps.get_data_parallel_world_size() == world // (tp * pp)
         assert ps.get_world_size() == world
-        assert mesh.axis_names == ("data", "expert", "pipe", "tensor")
+        assert mesh.axis_names == (
+            "data", "expert", "pipe", "context", "tensor")
 
     def test_indivisible_raises(self):
         with pytest.raises(RuntimeError):
@@ -47,7 +48,7 @@ class TestInitializeModelParallel:
         devs = np.asarray(mesh.devices)
         # along tensor axis, device ids are consecutive
         ids = np.vectorize(lambda d: d.id)(devs)
-        row = ids[0, 0, 0, :]
+        row = ids[0, 0, 0, 0, :]
         np.testing.assert_array_equal(row, np.arange(row[0], row[0] + 4))
 
     def test_virtual_pp(self):
