@@ -1,0 +1,337 @@
+"""Megatron-style BERT — second model family.
+
+TPU re-design of the reference's standalone BERT test fixture
+(ref: apex/transformer/testing/standalone_bert.py: embedding with
+tokentypes + bidirectional padding-mask transformer + pooler +
+BertLMHead (dense-gelu-LN-tied-logits+bias) + binary NSP head, MLM loss
+via vocab-parallel cross entropy). Built from the same apex_tpu
+parallel layers as GPT, so one module covers dense, TP (+SP) inside
+shard_map, and pipeline stages.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.transformer.functional import AttnMaskType, FusedScaleMaskSoftmax
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import _inside_axis
+from apex_tpu.transformer.tensor_parallel.utils import divide
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528
+    max_seq_len: int = 512
+    hidden_size: int = 1024
+    num_layers: int = 24
+    num_heads: int = 16
+    ffn_hidden_size: Optional[int] = None   # default 4*hidden
+    num_tokentypes: int = 2
+    add_binary_head: bool = True
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    sequence_parallel: bool = False
+    softmax_impl: Optional[str] = None
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
+    layernorm_epsilon: float = 1e-5
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+    # BERT-large (BASELINE configs[2]: ref run_bert_minimal_test.py)
+    @staticmethod
+    def bert_large(**kw) -> "BertConfig":
+        return BertConfig(hidden_size=1024, num_layers=24, num_heads=16,
+                          max_seq_len=512, **kw)
+
+
+def bert_extended_attention_mask(attention_mask: jax.Array) -> jax.Array:
+    """(b, s) {0,1} keep-mask -> (b, 1, s, s) boolean, True = masked
+    (ref: standalone_bert.py:20-33 — outer product of the key/query
+    keep vectors, then inverted to masked-out form)."""
+    m = attention_mask.astype(jnp.float32)
+    bss = m[:, None, :] * m[:, :, None]
+    return (bss < 0.5)[:, None, :, :]
+
+
+class BertParallelAttention(nn.Module):
+    """Bidirectional self attention with padding mask: column-parallel
+    fused QKV, fused masked softmax, row-parallel projection (ref
+    standalone_transformer_lm.py ParallelAttention with
+    AttnMaskType.padding)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, *, deterministic=True):
+        cfg = self.config
+        h = cfg.hidden_size
+        inside = _inside_axis(TENSOR_AXIS)
+        tp = lax.axis_size(TENSOR_AXIS) if inside else 1
+        heads_local = cfg.num_heads // tp
+        head_dim = h // cfg.num_heads
+
+        qkv = ColumnParallelLinear(
+            output_size=3 * h, gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="qkv",
+        )(x)
+        s, b = qkv.shape[0], qkv.shape[1]
+        qkv = qkv.reshape(s, b, heads_local, 3 * head_dim)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def to_bhsd(t):
+            return t.transpose(1, 2, 0, 3).reshape(b * heads_local, s, head_dim)
+
+        q, k, v = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+        scores = jnp.einsum(
+            "bsd,btd->bst", q, k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(head_dim).astype(jnp.float32)
+        probs = FusedScaleMaskSoftmax(
+            attn_mask_type=AttnMaskType.padding, impl=cfg.softmax_impl
+        )(scores.reshape(b, heads_local, s, s).astype(cfg.dtype), mask=mask)
+        if cfg.attention_dropout > 0.0 and not deterministic:
+            probs = nn.Dropout(rate=cfg.attention_dropout)(
+                probs, deterministic=False
+            )
+        ctx = jnp.einsum(
+            "bhst,bhtd->bhsd", probs,
+            v.reshape(b, heads_local, s, head_dim),
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, heads_local * head_dim)
+        return RowParallelLinear(
+            output_size=h, input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
+        )(ctx)
+
+
+class BertLayer(nn.Module):
+    """Pre-LN transformer block with padding-mask attention."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, *, deterministic=True):
+        cfg = self.config
+        a = BertParallelAttention(cfg, name="attention")(
+            FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
+                           name="input_norm")(x),
+            mask, deterministic=deterministic,
+        )
+        if cfg.hidden_dropout > 0.0 and not deterministic:
+            a = nn.Dropout(rate=cfg.hidden_dropout)(a, deterministic=False)
+        x = x + a
+        hcol = ColumnParallelLinear(
+            output_size=cfg.ffn, gather_output=False,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="fc1",
+        )(FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
+                         name="post_norm")(x))
+        hcol = jax.nn.gelu(hcol, approximate=True)
+        m = RowParallelLinear(
+            output_size=cfg.hidden_size, input_is_parallel=True,
+            sequence_parallel_enabled=cfg.sequence_parallel,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="fc2",
+        )(hcol)
+        if cfg.hidden_dropout > 0.0 and not deterministic:
+            m = nn.Dropout(rate=cfg.hidden_dropout)(m, deterministic=False)
+        return x + m
+
+
+class BertPooler(nn.Module):
+    """dense+tanh over the [CLS] (first) token (ref
+    standalone_transformer_lm.py Pooler)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x):          # (s, b, h) -> (b, h)
+        cfg = self.config
+        first = x[0]
+        w = self.param("kernel", nn.initializers.normal(stddev=0.02),
+                       (cfg.hidden_size, cfg.hidden_size), cfg.param_dtype)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (cfg.hidden_size,), cfg.param_dtype)
+        out = first.astype(cfg.dtype) @ w.astype(cfg.dtype) + bias.astype(cfg.dtype)
+        return jnp.tanh(out)
+
+
+class BertLMHead(nn.Module):
+    """MLM head: dense -> gelu -> LN -> tied-embedding logits + vocab
+    bias (ref: standalone_bert.py:47-92). The bias is sharded over the
+    local vocab shard like the embedding table."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, word_embedding_table):
+        cfg = self.config
+        w = self.param("kernel", nn.initializers.normal(stddev=0.02),
+                       (cfg.hidden_size, cfg.hidden_size), cfg.param_dtype)
+        b = self.param("dense_bias", nn.initializers.zeros,
+                       (cfg.hidden_size,), cfg.param_dtype)
+        x = x.astype(cfg.dtype) @ w.astype(cfg.dtype) + b.astype(cfg.dtype)
+        x = jax.nn.gelu(x, approximate=True)
+        x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
+                           name="norm")(x)
+
+        if _inside_axis(TENSOR_AXIS):
+            from apex_tpu.transformer.tensor_parallel import (
+                copy_to_tensor_model_parallel_region,
+            )
+            x = copy_to_tensor_model_parallel_region(x)
+            tp = lax.axis_size(TENSOR_AXIS)
+        else:
+            tp = 1
+        vocab_local = divide(cfg.vocab_size, tp)
+        vbias = self.param("bias", nn.initializers.zeros,
+                           (vocab_local,), cfg.param_dtype)
+        logits = jnp.einsum(
+            "sbh,vh->sbv", x.astype(jnp.float32),
+            word_embedding_table.astype(jnp.float32),
+        )
+        return logits + vbias.astype(jnp.float32)
+
+
+class BertModel(nn.Module):
+    """Full BERT. Inputs: token ids (b, s), attention keep-mask (b, s),
+    optional tokentype ids (b, s). Returns (lm_logits (s, b, vocab[/tp]),
+    binary_logits (b, 2) | None) — the Megatron sbh convention
+    (ref: standalone_bert.py:123-203)."""
+
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, tokens, attention_mask, tokentype_ids=None, *,
+                 deterministic=True):
+        cfg = self.config
+        b, s = tokens.shape
+        ext_mask = bert_extended_attention_mask(attention_mask)
+
+        emb = VocabParallelEmbedding(
+            num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="embedding",
+        )
+        x = emb(tokens)                                    # (b, s, h)
+        pos = self.param(
+            "position_embedding", nn.initializers.normal(stddev=0.02),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype,
+        )
+        x = x + pos[:s][None, :, :].astype(cfg.dtype)
+        if cfg.num_tokentypes > 0:
+            tt = self.param(
+                "tokentype_embedding", nn.initializers.normal(stddev=0.02),
+                (cfg.num_tokentypes, cfg.hidden_size), cfg.param_dtype,
+            )
+            if tokentype_ids is None:
+                tokentype_ids = jnp.zeros_like(tokens)
+            x = x + jnp.take(tt.astype(cfg.dtype), tokentype_ids, axis=0)
+        x = x.transpose(1, 0, 2)                           # (s, b, h)
+
+        if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
+            from apex_tpu.transformer.tensor_parallel import (
+                scatter_to_sequence_parallel_region,
+            )
+            x = scatter_to_sequence_parallel_region(x)
+
+        for i in range(cfg.num_layers):
+            x = BertLayer(cfg, name=f"layer_{i}")(
+                x, ext_mask, deterministic=deterministic)
+        x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
+                           name="final_norm")(x)
+
+        if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
+            from apex_tpu.transformer.tensor_parallel import (
+                gather_from_sequence_parallel_region,
+            )
+            x = gather_from_sequence_parallel_region(
+                x, tensor_parallel_output_grad=True
+            )
+
+        binary_logits = None
+        if cfg.add_binary_head:
+            pooled = BertPooler(cfg, name="pooler")(x)
+            wb = self.param("binary_kernel",
+                            nn.initializers.normal(stddev=0.02),
+                            (cfg.hidden_size, 2), cfg.param_dtype)
+            bb = self.param("binary_bias", nn.initializers.zeros,
+                            (2,), cfg.param_dtype)
+            binary_logits = (pooled.astype(jnp.float32)
+                             @ wb.astype(jnp.float32)
+                             + bb.astype(jnp.float32))
+
+        table = emb.variables["params"]["embedding"]
+        lm_logits = BertLMHead(cfg, name="lm_head")(x, table)
+        return lm_logits, binary_logits
+
+
+def bert_loss_fn(
+    lm_logits: jax.Array,
+    binary_logits: Optional[jax.Array],
+    lm_labels: jax.Array,
+    loss_mask: jax.Array,
+    nsp_labels: Optional[jax.Array] = None,
+    axis_name: str = TENSOR_AXIS,
+) -> jax.Array:
+    """Masked-LM loss (+ NSP when heads/labels present), the loss used by
+    ref run_bert_minimal_test.py: per-token vocab-parallel CE averaged
+    over masked positions, plus 2-way CE on the pooled head.
+
+    lm_logits: (s, b, vocab[/tp]); lm_labels/loss_mask: (b, s).
+    """
+    labels_sb = lm_labels.transpose(1, 0)
+    if _inside_axis(axis_name):
+        losses = vocab_parallel_cross_entropy(lm_logits, labels_sb,
+                                              axis_name=axis_name)
+    else:
+        lse = jax.scipy.special.logsumexp(lm_logits, axis=-1)
+        tgt = jnp.take_along_axis(lm_logits, labels_sb[..., None], -1)[..., 0]
+        losses = lse - tgt
+    mask_sb = loss_mask.transpose(1, 0).astype(jnp.float32)
+    lm_loss = jnp.sum(losses * mask_sb) / jnp.maximum(jnp.sum(mask_sb), 1.0)
+    if binary_logits is None or nsp_labels is None:
+        return lm_loss
+    logp = jax.nn.log_softmax(binary_logits, axis=-1)
+    nsp = -jnp.mean(jnp.take_along_axis(logp, nsp_labels[:, None], 1)[:, 0])
+    return lm_loss + nsp
+
+
+def bert_param_specs(params: Any) -> Any:
+    """PartitionSpec tree for BertModel params (same rules as
+    gpt_param_specs plus the vocab-sharded LM-head bias)."""
+
+    def spec_for(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        joined = "/".join(names)
+        if "embedding" in joined and names[-1] == "embedding":
+            return P(TENSOR_AXIS, None)
+        if ("qkv" in joined or "fc1" in joined) and names[-1] == "kernel":
+            return P(TENSOR_AXIS, None)
+        if ("qkv" in joined or "fc1" in joined) and names[-1] == "bias":
+            return P(TENSOR_AXIS)
+        if ("proj" in joined or "fc2" in joined) and names[-1] == "kernel":
+            return P(None, TENSOR_AXIS)
+        if names[-2:] == ["lm_head", "bias"]:   # the vocab-sharded bias only
+            return P(TENSOR_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)

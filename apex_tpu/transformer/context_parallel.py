@@ -39,10 +39,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 from jax import shard_map
 
-from apex_tpu.ops.attention import flash_attention
+from apex_tpu.ops.attention import NEG_INF, flash_attention
 from apex_tpu.transformer.parallel_state import CONTEXT_AXIS, DATA_AXIS
-
-NEG_INF = -1e30
 
 
 # --------------------------------------------------------------------------
@@ -116,6 +114,7 @@ def ring_attention(
     softmax_scale: Optional[float] = None,
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
+    skip_granularity: int = 1,
 ) -> jax.Array:
     """Exact ring attention over the ``axis_name`` device ring.
 
@@ -128,6 +127,14 @@ def ring_attention(
     ``ppermute``; the online-softmax carry merges chunks exactly as the
     Pallas flash kernel does across KV blocks, so the result matches
     single-device attention to fp32 accumulation order.
+
+    ``skip_granularity`` splits Q and KV into that many contiguous
+    sub-blocks and, under causal masking, skips the score matmul for any
+    (q-block, kv-block) pair wholly in the causal future via ``lax.cond``
+    (TPU executes only the taken branch, so skipped pairs are ~free).
+    With contiguous sharding 1 suffices (whole visiting chunks skip);
+    with zig-zag each shard is two chunks, so pass 2 — that is what
+    recovers the ~2x causal FLOP saving that zig-zag balancing is for.
     """
     cp = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -139,44 +146,73 @@ def ring_attention(
         kv_positions = idx * k.shape[2] + jnp.arange(k.shape[2], dtype=jnp.int32)
 
     perm = [(i, (i + 1) % cp) for i in range(cp)]
-    q_max = jnp.max(q_positions)
+    ng = skip_granularity
+    if ng < 1 or s_local % ng or k.shape[2] % ng:
+        raise ValueError(
+            f"skip_granularity {ng} must divide q ({s_local}) and kv "
+            f"({k.shape[2]}) shard lengths")
+
+    def _merge(a, p):
+        m_a, l_a, o_a = a
+        m_p, l_p, o_p = p
+        m_new = jnp.maximum(m_a, m_p)
+        c_a = jnp.exp(m_a - m_new)
+        c_p = jnp.exp(m_p - m_new)
+        return (m_new, l_a * c_a + l_p * c_p,
+                o_a * c_a[..., None] + o_p * c_p[..., None])
 
     def compute(k_c, v_c, kpos):
-        """(m, l, o) partials for one chunk; under causal masking a chunk
-        that lies entirely in this device's causal future is skipped via
-        ``lax.cond`` — no score matmul is issued for it, which is what
-        makes zig-zag layout an actual work-balancer and not just a
-        permutation (the per-device predicate is collective-free, so
-        divergent branches across the ring are fine)."""
+        """(m, l, o) partials of local Q against one visiting KV shard.
+
+        Under causal masking the shard is processed in ``ng`` x ``ng``
+        (q-block, kv-block) sub-tiles; a tile wholly in the q-block's
+        causal future is skipped via ``lax.cond`` so no score matmul is
+        issued for it (the predicate is per-device and collective-free,
+        so divergent branches across the ring are fine)."""
         if not causal:
             return _chunk_attn(q, k_c, v_c, q_positions, kpos, scale, False)
-        return lax.cond(
-            jnp.min(kpos) > q_max,
-            lambda: (jnp.full((b, h, s_local), NEG_INF, jnp.float32),
-                     jnp.zeros((b, h, s_local), jnp.float32),
-                     jnp.zeros((b, h, s_local, d), jnp.float32)),
-            lambda: _chunk_attn(q, k_c, v_c, q_positions, kpos, scale, True),
-        )
+        qs, ks = s_local // ng, k_c.shape[2] // ng
+        m_rows, l_rows, o_rows = [], [], []
+        for qb in range(ng):
+            qsl = slice(qb * qs, (qb + 1) * qs)
+            q_b, qpos_b = q[:, :, qsl], q_positions[qsl]
+            q_max_b = jnp.max(qpos_b)
+            acc = None
+            for kb in range(ng):
+                ksl = slice(kb * ks, (kb + 1) * ks)
+                k_b, v_b, kpos_b = k_c[:, :, ksl], v_c[:, :, ksl], kpos[ksl]
+                part = lax.cond(
+                    jnp.min(kpos_b) > q_max_b,
+                    lambda: (jnp.full((b, h, qs), NEG_INF, jnp.float32),
+                             jnp.zeros((b, h, qs), jnp.float32),
+                             jnp.zeros((b, h, qs, d), jnp.float32)),
+                    lambda k_b=k_b, v_b=v_b, kpos_b=kpos_b, q_b=q_b,
+                    qpos_b=qpos_b: _chunk_attn(
+                        q_b, k_b, v_b, qpos_b, kpos_b, scale, True),
+                )
+                acc = part if acc is None else _merge(acc, part)
+            m_rows.append(acc[0])
+            l_rows.append(acc[1])
+            o_rows.append(acc[2])
+        return (jnp.concatenate(m_rows, axis=2),
+                jnp.concatenate(l_rows, axis=2),
+                jnp.concatenate(o_rows, axis=2))
 
     # chunk 0 is the local KV shard — computed before any rotation, so
     # the ring does exactly cp-1 ppermutes (none wasted).
-    m, l, o = compute(k, v, kv_positions)
+    acc = compute(k, v, kv_positions)
 
     def step(carry, _):
-        o, m, l, k_c, v_c, kpos = carry
+        acc, k_c, v_c, kpos = carry
         k_c = lax.ppermute(k_c, axis_name, perm)
         v_c = lax.ppermute(v_c, axis_name, perm)
         kpos = lax.ppermute(kpos, axis_name, perm)
-        m_c, l_c, o_c = compute(k_c, v_c, kpos)
-        m_new = jnp.maximum(m, m_c)
-        c_old = jnp.exp(m - m_new)
-        c_new = jnp.exp(m_c - m_new)
-        o = o * c_old[..., None] + o_c * c_new[..., None]
-        l = l * c_old + l_c * c_new
-        return (o, m_new, l, k_c, v_c, kpos), None
+        acc = _merge(acc, compute(k_c, v_c, kpos))
+        return (acc, k_c, v_c, kpos), None
 
-    (o, m, l, _, _, _), _ = lax.scan(
-        step, (o, m, l, k, v, kv_positions), None, length=cp - 1)
+    (acc, _, _, _), _ = lax.scan(
+        step, (acc, k, v, kv_positions), None, length=cp - 1)
+    m, l, o = acc
     # guard fully-masked rows (l == 0) — only possible with non-causal
     # external masks; causal self-attention always sees the diagonal.
     out = o / jnp.maximum(l, 1e-30)[..., None]
@@ -200,7 +236,9 @@ def ring_attention_sharded(
 
     With ``zigzag=True`` the sequence is permuted to the balanced layout
     before sharding and un-permuted after — causality stays exact because
-    :func:`ring_attention` masks from global positions.
+    :func:`ring_attention` masks from global positions, and the ring runs
+    with ``skip_granularity=2`` so each shard's two chunks skip their
+    causal-future tiles independently (the actual work balancing).
     """
     cp = mesh.shape[axis_name]
     S = q.shape[2]
@@ -227,6 +265,7 @@ def ring_attention_sharded(
             ql, kl, vl, axis_name=axis_name, causal=causal,
             softmax_scale=softmax_scale,
             q_positions=posl, kv_positions=posl,
+            skip_granularity=2 if zigzag else 1,
         )
 
     out = run(q, k, v, pos)
@@ -292,6 +331,8 @@ def ulysses_attention_sharded(
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     impl: Optional[str] = None,
+    block_q: int = 128,
+    block_k: int = 128,
 ) -> jax.Array:
     """shard_map wrapper for :func:`ulysses_attention` (global arrays in/out)."""
     spec_x = P(batch_axis, None, axis_name, None)
@@ -304,6 +345,7 @@ def ulysses_attention_sharded(
         return ulysses_attention(
             ql, kl, vl, axis_name=axis_name, causal=causal,
             softmax_scale=softmax_scale, impl=impl,
+            block_q=block_q, block_k=block_k,
         )
 
     return run(q, k, v)

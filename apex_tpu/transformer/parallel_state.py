@@ -56,10 +56,10 @@ def initialize_model_parallel(
 ) -> Mesh:
     """Build the global device mesh (ref: parallel_state.py:81-311).
 
-    Axis order is (data, expert, pipe, tensor) outer->inner so TP —
-    the latency-critical axis — maps to physically adjacent devices
+    Axis order is (data, expert, pipe, context, tensor) outer->inner so
+    TP — the latency-critical axis — maps to physically adjacent devices
     (the reference achieves the same by making TP ranks consecutive,
-    parallel_state.py:196-221).
+    parallel_state.py:196-221), with the CP ring next-innermost.
     """
     global _MESH, _VIRTUAL_PP_RANK, _VIRTUAL_PP_WORLD_SIZE, _PIPELINE_SPLIT_RANK
     devs = list(devices if devices is not None else jax.devices())

@@ -1,0 +1,188 @@
+"""BERT fixture tests — minimal end-to-end runs.
+
+Mirrors ref tests/L0/run_transformer/run_bert_minimal_test.py: tiny
+BERT forward/backward with padding mask + MLM/NSP losses, TP-vs-dense
+equivalence, short convergence run on synthetic masked data.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.models.bert import (
+    BertConfig,
+    BertModel,
+    bert_extended_attention_mask,
+    bert_loss_fn,
+    bert_param_specs,
+)
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.transformer import parallel_state as ps
+
+TINY = BertConfig(
+    vocab_size=128, max_seq_len=32, hidden_size=64, num_layers=2,
+    num_heads=4, dtype=jnp.float32,
+)
+
+
+def synth_batch(rng, b, s, vocab, mask_frac=0.15):
+    """MLM-style batch: tokens, keep-mask, labels, loss-mask, NSP labels."""
+    tokens = rng.randint(0, vocab, (b, s))
+    attn = np.ones((b, s), np.int32)
+    attn[:, s - 2:] = 0                       # padded tail
+    loss_mask = (rng.rand(b, s) < mask_frac) & (attn == 1)
+    loss_mask[:, 0] = True                     # ensure non-empty
+    labels = rng.randint(0, vocab, (b, s))
+    nsp = rng.randint(0, 2, (b,))
+    return (jnp.asarray(tokens, jnp.int32), jnp.asarray(attn, jnp.int32),
+            jnp.asarray(labels, jnp.int32),
+            jnp.asarray(loss_mask, jnp.int32), jnp.asarray(nsp, jnp.int32))
+
+
+def test_extended_mask():
+    attn = jnp.asarray([[1, 1, 0]], jnp.int32)
+    m = bert_extended_attention_mask(attn)
+    assert m.shape == (1, 1, 3, 3)
+    # True = masked: any pair touching the padded position
+    np.testing.assert_array_equal(
+        np.asarray(m[0, 0]),
+        np.array([[False, False, True],
+                  [False, False, True],
+                  [True, True, True]]))
+
+
+class TestSingleDevice:
+    def test_forward_shapes(self, rng):
+        model = BertModel(TINY)
+        toks, attn, *_ = synth_batch(rng, 2, 16, TINY.vocab_size)
+        params = model.init(jax.random.PRNGKey(0), toks, attn)
+        lm, nsp = model.apply(params, toks, attn)
+        assert lm.shape == (16, 2, TINY.vocab_size)
+        assert nsp.shape == (2, 2)
+
+    def test_no_binary_head(self, rng):
+        cfg = BertConfig(
+            vocab_size=128, max_seq_len=32, hidden_size=64, num_layers=1,
+            num_heads=4, dtype=jnp.float32, add_binary_head=False,
+        )
+        model = BertModel(cfg)
+        toks, attn, *_ = synth_batch(rng, 2, 16, cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(0), toks, attn)
+        lm, nsp = model.apply(params, toks, attn)
+        assert nsp is None
+
+    def test_tokentypes(self, rng):
+        model = BertModel(TINY)
+        toks, attn, *_ = synth_batch(rng, 2, 16, TINY.vocab_size)
+        tt = jnp.zeros_like(toks).at[:, 8:].set(1)
+        params = model.init(jax.random.PRNGKey(0), toks, attn, tt)
+        out_tt, _ = model.apply(params, toks, attn, tt)
+        out_0, _ = model.apply(params, toks, attn)
+        assert not np.allclose(np.asarray(out_tt), np.asarray(out_0))
+
+    def test_loss_and_grads(self, rng):
+        model = BertModel(TINY)
+        toks, attn, labels, lmask, nsp = synth_batch(rng, 2, 16, TINY.vocab_size)
+        params = model.init(jax.random.PRNGKey(0), toks, attn)
+
+        def loss_fn(p):
+            lm, binary = model.apply(p, toks, attn)
+            return bert_loss_fn(lm, binary, labels, lmask, nsp)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        assert np.isfinite(float(loss))
+        # ~ln(vocab) + ln(2) at random init
+        assert abs(float(loss) - (np.log(TINY.vocab_size) + np.log(2))) < 1.5
+        gsum = sum(float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads))
+        assert gsum > 0
+
+    def test_tiny_convergence(self, rng):
+        model = BertModel(TINY)
+        toks, attn, labels, lmask, nsp = synth_batch(rng, 4, 16, TINY.vocab_size)
+        params = model.init(jax.random.PRNGKey(0), toks, attn)
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            def loss_fn(p):
+                lm, binary = model.apply(p, toks, attn)
+                return bert_loss_fn(lm, binary, labels, lmask, nsp)
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            params, state = opt.step(state, grads)
+            return params, state, loss
+
+        losses = []
+        for _ in range(30):
+            params, state, loss = step(params, state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.7, losses[::10]
+
+
+class TestTensorParallel:
+    @pytest.fixture(autouse=True)
+    def mesh(self):
+        m = ps.initialize_model_parallel(4, 1)
+        yield m
+        ps.destroy_model_parallel()
+
+    @pytest.mark.parametrize("sequence_parallel", [False, True])
+    def test_tp_matches_dense(self, mesh, rng, sequence_parallel):
+        cfg = BertConfig(
+            vocab_size=64, max_seq_len=16, hidden_size=32, num_layers=2,
+            num_heads=4, dtype=jnp.float32,
+            sequence_parallel=sequence_parallel,
+        )
+        model = BertModel(cfg)
+        toks, attn, labels, lmask, nsp = synth_batch(rng, 2, 16, cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(0), toks, attn)
+
+        def loss_fn(p, toks, attn, labels, lmask, nsp):
+            lm, binary = model.apply(p, toks, attn)
+            return bert_loss_fn(lm, binary, labels, lmask, nsp)
+
+        dense_loss = loss_fn(params, toks, attn, labels, lmask, nsp)
+        specs = bert_param_specs(params)
+        loss = jax.jit(
+            shard_map(
+                loss_fn, mesh=mesh,
+                in_specs=(specs, P(), P(), P(), P(), P()),
+                out_specs=P(), check_vma=False,
+            )
+        )(params, toks, attn, labels, lmask, nsp)
+        np.testing.assert_allclose(float(loss), float(dense_loss), rtol=2e-4)
+
+    def test_tp_grads_match_dense(self, mesh, rng):
+        cfg = BertConfig(
+            vocab_size=64, max_seq_len=16, hidden_size=32, num_layers=1,
+            num_heads=4, dtype=jnp.float32,
+        )
+        model = BertModel(cfg)
+        toks, attn, labels, lmask, nsp = synth_batch(rng, 2, 16, cfg.vocab_size)
+        params = model.init(jax.random.PRNGKey(0), toks, attn)
+        specs = bert_param_specs(params)
+
+        def loss_fn(p, toks, attn, labels, lmask, nsp):
+            lm, binary = model.apply(p, toks, attn)
+            return bert_loss_fn(lm, binary, labels, lmask, nsp)
+
+        step = shard_map(
+            lambda p, *a: jax.value_and_grad(loss_fn)(p, *a),
+            mesh=mesh, in_specs=(specs, P(), P(), P(), P(), P()),
+            out_specs=(P(), specs), check_vma=False,
+        )
+        loss_tp, g_tp = jax.jit(step)(params, toks, attn, labels, lmask, nsp)
+        g_dense = jax.grad(
+            lambda p: loss_fn(p, toks, attn, labels, lmask, nsp))(params)
+        np.testing.assert_allclose(
+            float(loss_tp),
+            float(loss_fn(params, toks, attn, labels, lmask, nsp)), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5
+            ),
+            g_tp, g_dense,
+        )
