@@ -1,0 +1,211 @@
+"""Mixture-of-Experts: group-GEMM ops + expert-parallel layer.
+
+The reference has no MoE module, but BASELINE configs[4] specifies a
+"group-GEMM / fused_dense MoE-style expert-parallel microbench" built
+from the fused-dense analogs (ref: apex/fused_dense/fused_dense.py,
+csrc/fused_dense_cuda.cu — cublasLt grouped/batched GEMMs). The TPU
+design provides two complementary paths:
+
+  - **Dropless (megablocks-style)** — :func:`group_gemm` wraps
+    ``lax.ragged_dot`` (the TPU group-GEMM primitive: one MXU pass over
+    tokens sorted by expert with per-expert group sizes) and
+    :class:`GroupedMLP` runs router -> sort -> ragged fc1/gelu/fc2 ->
+    unsort -> weighted combine with NO token dropping. Static shapes
+    throughout (sort + bincount), so it jits cleanly.
+  - **Capacity-based expert parallel (GShard/Switch-style)** —
+    :class:`ExpertParallelMLP` dispatches tokens into a fixed
+    (experts, capacity) buffer via one-hot/cumsum masks, runs batched
+    expert matmuls, and — inside ``shard_map`` over the "expert" mesh
+    axis — exchanges the expert dimension with ``lax.all_to_all`` so
+    each device computes only its local experts. This is the
+    all-to-all EP pattern that rides ICI.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import EXPERT_AXIS
+from apex_tpu.transformer.tensor_parallel.layers import _inside_axis
+
+
+def group_gemm(
+    tokens: jax.Array,
+    weights: jax.Array,
+    group_sizes: jax.Array,
+) -> jax.Array:
+    """Grouped matmul: row block g of ``tokens`` (rows assigned to group
+    g, contiguous, sizes ``group_sizes``) hits ``weights[g]``.
+
+    tokens (n, k), weights (E, k, m), group_sizes (E,) int32 summing to
+    <= n. The TPU lowering tiles each group onto the MXU without
+    padding tokens to per-expert capacity — the group-GEMM of the
+    reference's cublasLt grouped-batched path (ref: setup.py:376-388
+    fused_dense_cuda).
+    """
+    return lax.ragged_dot(
+        tokens, weights, group_sizes,
+        preferred_element_type=jnp.float32,
+    ).astype(tokens.dtype)
+
+
+def router_topk(
+    x: jax.Array,
+    gate_kernel: jax.Array,
+    k: int,
+) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Top-k softmax routing. x (n, h), gate (h, E) ->
+    (weights (n, k) fp32 normalized over the chosen k, expert_ids
+    (n, k) int32, full probs (n, E) fp32 for aux losses)."""
+    logits = jnp.einsum(
+        "nh,he->ne", x, gate_kernel, preferred_element_type=jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    top_vals, top_ids = lax.top_k(probs, k)
+    top_vals = top_vals / jnp.sum(top_vals, axis=-1, keepdims=True)
+    return top_vals, top_ids.astype(jnp.int32), probs
+
+
+def load_balancing_loss(probs: jax.Array, expert_ids: jax.Array) -> jax.Array:
+    """Switch-transformer aux loss: E * sum_e f_e * P_e, where f_e is
+    the fraction of tokens whose top-1 choice is e and P_e the mean
+    router probability of e."""
+    E = probs.shape[-1]
+    f = jnp.mean(
+        jax.nn.one_hot(expert_ids[..., 0], E, dtype=jnp.float32), axis=0)
+    p = jnp.mean(probs, axis=0)
+    return E * jnp.sum(f * p)
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    hidden_size: int
+    ffn_hidden_size: int
+    num_experts: int
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+
+
+class GroupedMLP(nn.Module):
+    """Dropless MoE MLP via sort + group-GEMM (single device, or the
+    per-shard compute of a dropless EP layer). Input (n, h) tokens."""
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        n, h = x.shape
+        E, k = cfg.num_experts, cfg.top_k
+        gate = self.param("gate", nn.initializers.normal(stddev=0.02),
+                          (h, E), cfg.param_dtype)
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (E, h, cfg.ffn_hidden_size), cfg.param_dtype)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (E, cfg.ffn_hidden_size, h), cfg.param_dtype)
+
+        weights, ids, probs = router_topk(x, gate.astype(cfg.dtype), k)
+        self.sow("intermediates", "aux_loss",
+                 load_balancing_loss(probs, ids))
+
+        # flatten k copies, stable-sort by expert so groups are contiguous
+        flat_ids = ids.reshape(-1)                     # (n*k,)
+        order = jnp.argsort(flat_ids, stable=True)
+        inv = jnp.argsort(order)
+        tok_rep = jnp.repeat(x, k, axis=0)[order]      # (n*k, h) sorted
+        group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
+
+        h1 = group_gemm(tok_rep.astype(cfg.dtype), w1.astype(cfg.dtype),
+                        group_sizes)
+        h1 = jax.nn.gelu(h1, approximate=True)
+        h2 = group_gemm(h1, w2.astype(cfg.dtype), group_sizes)
+
+        out = h2[inv].reshape(n, k, h)                 # back to token order
+        return jnp.sum(out * weights[..., None].astype(cfg.dtype), axis=1)
+
+
+class ExpertParallelMLP(nn.Module):
+    """Capacity-based MoE MLP, expert-parallel over the "expert" mesh
+    axis when called inside shard_map (dense fallback otherwise).
+
+    Dispatch: one-hot position-in-expert masks (static (n, E, C)
+    shapes), batched expert GEMMs, combine with router weights. Under
+    EP each device holds E/ep experts; two ``all_to_all`` exchanges move
+    the dispatched buffer expert-major -> token-major and back.
+    Tokens over a full expert's capacity are dropped (their output is
+    the zero vector), matching Switch/GShard semantics.
+    """
+
+    config: MoEConfig
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        n, h = x.shape
+        E, k = cfg.num_experts, cfg.top_k
+        C = max(1, int(cfg.capacity_factor * n * k / E))
+        gate = self.param("gate", nn.initializers.normal(stddev=0.02),
+                          (h, E), cfg.param_dtype)
+        inside = _inside_axis(EXPERT_AXIS)
+        ep = lax.axis_size(EXPERT_AXIS) if inside else 1
+        if E % ep:
+            raise ValueError(f"num_experts {E} not divisible by ep={ep}")
+        e_local = E // ep
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (e_local, h, cfg.ffn_hidden_size), cfg.param_dtype)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (e_local, cfg.ffn_hidden_size, h), cfg.param_dtype)
+
+        weights, ids, probs = router_topk(x, gate.astype(cfg.dtype), k)
+        self.sow("intermediates", "aux_loss",
+                 load_balancing_loss(probs, ids))
+
+        # position of each (token, choice) within its expert's buffer:
+        # cumsum over the flattened (choice-major) one-hot stream so
+        # earlier tokens / lower k win capacity slots.
+        onehot = jax.nn.one_hot(ids, E, dtype=jnp.int32)   # (n, k, E)
+        flat = onehot.transpose(1, 0, 2).reshape(k * n, E)
+        pos_flat = jnp.cumsum(flat, axis=0) - 1            # (k*n, E)
+        pos = (pos_flat * flat).sum(-1).reshape(k, n).transpose(1, 0)  # (n,k)
+        keep = (pos < C) & (onehot.sum(-1) > 0)
+
+        # dispatch mask (n, k, E, C) -> dispatched buffer (E, C, h)
+        disp = (onehot[..., None]
+                * jax.nn.one_hot(pos, C, dtype=jnp.int32)[:, :, None, :]
+                * keep[..., None, None].astype(jnp.int32))
+        disp_f = disp.astype(cfg.dtype)
+        buf = jnp.einsum("nkec,nh->ech", disp_f, x.astype(cfg.dtype))
+
+        if inside:
+            # (E, C, h) = (ep * e_local, C, h) -> gather every device's
+            # slots for MY experts: (e_local, ep * C, h)
+            buf = lax.all_to_all(buf, EXPERT_AXIS, split_axis=0,
+                                 concat_axis=1, tiled=True)
+        h1 = jnp.einsum("ech,ehf->ecf", buf, w1.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32).astype(cfg.dtype)
+        h1 = jax.nn.gelu(h1, approximate=True)
+        h2 = jnp.einsum("ecf,efh->ech", h1, w2.astype(cfg.dtype),
+                        preferred_element_type=jnp.float32).astype(cfg.dtype)
+        if inside:
+            h2 = lax.all_to_all(h2, EXPERT_AXIS, split_axis=1,
+                                concat_axis=0, tiled=True)
+
+        combine = disp_f * weights[..., None, None].astype(cfg.dtype)
+        return jnp.einsum("nkec,ech->nh", combine, h2)
+
+
+__all__ = [
+    "ExpertParallelMLP",
+    "GroupedMLP",
+    "MoEConfig",
+    "group_gemm",
+    "load_balancing_loss",
+    "router_topk",
+]

@@ -7,6 +7,11 @@ fused_lamb.py:96-214), times one full LAMB step for (a) optax.lamb over
 the pytree and (b) apex_tpu.FusedLAMB (flat-buffer fused kernels), and
 prints ONE JSON line. vs_baseline = fused_time / optax_time (< 1 beats
 the baseline, 1.1 is the target ceiling).
+
+Supplementary microbenches (each also ONE JSON line, run explicitly —
+the driver's no-arg invocation prints only the headline metric):
+
+    python bench.py moe    # group-GEMM MoE fwd+bwd vs per-expert loop
 """
 
 import json
@@ -31,20 +36,99 @@ def bert_large_shapes(hidden=1024, layers=24, vocab=30522, seq=512):
     return shapes
 
 
-def time_fn(fn, *args, iters=None, warmup=2):
+def time_fn(fn, *args, iters=None, warmup=2, sync=False):
     import jax
 
     if iters is None:
         iters = 5 if jax.default_backend() == "cpu" else 20
     out = None
+
+    def wait(out):
+        jax.block_until_ready(out)
+        if sync:
+            # force a host round-trip of the smallest leaf — guards
+            # against transports whose block_until_ready is asynchronous
+            leaves = jax.tree.leaves(out)
+            jax.device_get(min(leaves, key=lambda l: getattr(l, "size", 1)))
+
     for _ in range(warmup):
         out = fn(*args)
-        jax.block_until_ready(out)
+        wait(out)
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-        jax.block_until_ready(out)
+        wait(out)
     return (time.perf_counter() - t0) / iters, out
+
+
+def bench_moe():
+    """Group-GEMM MoE microbench (BASELINE configs[4]): dropless
+    GroupedMLP fwd+bwd tokens/sec vs a per-expert dense loop doing the
+    same math (the un-grouped baseline)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu.moe import GroupedMLP, MoEConfig
+
+    on_cpu = jax.default_backend() == "cpu"
+    cfg = MoEConfig(
+        hidden_size=256 if on_cpu else 4096,
+        ffn_hidden_size=512 if on_cpu else 14336,
+        num_experts=8, top_k=2,
+        dtype=jnp.float32 if on_cpu else jnp.bfloat16,
+    )
+    n_tok = 512 if on_cpu else 8192
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(n_tok, cfg.hidden_size), cfg.dtype)
+    model = GroupedMLP(cfg)
+    params = model.init(jax.random.PRNGKey(0), x)
+
+    def grad_scalar(g):
+        # scalar fold of every grad leaf: forces the full backward to
+        # execute while keeping the host transfer tiny
+        return sum(jnp.sum(jnp.abs(l)) for l in jax.tree.leaves(g))
+
+    @jax.jit
+    def fwd_bwd(p, x):
+        return grad_scalar(
+            jax.grad(lambda p: jnp.sum(model.apply(p, x) ** 2))(p))
+
+    t_grouped, _ = time_fn(fwd_bwd, params, x, sync=True)
+
+    # baseline: same routing, per-expert dense matmuls over masked copies
+    from apex_tpu.moe import router_topk
+
+    def loop_apply(p, x):
+        pp = p["params"]
+        w, ids, _ = router_topk(x, pp["gate"].astype(x.dtype), cfg.top_k)
+        out = jnp.zeros_like(x)
+        for e in range(cfg.num_experts):
+            m = (ids == e).astype(x.dtype) * w.astype(x.dtype)  # (n, k)
+            h1 = jax.nn.gelu(x @ pp["w1"][e].astype(x.dtype),
+                             approximate=True)
+            out += m.sum(-1)[:, None] * (h1 @ pp["w2"][e].astype(x.dtype))
+        return out
+
+    @jax.jit
+    def loop_fwd_bwd(p, x):
+        return grad_scalar(
+            jax.grad(lambda p: jnp.sum(loop_apply(p, x) ** 2))(p))
+
+    t_loop, _ = time_fn(loop_fwd_bwd, params, x, sync=True)
+    ratio = t_grouped / t_loop
+    print(json.dumps({
+        "metric": "moe_group_gemm_fwdbwd_vs_dense_loop",
+        "value": round(n_tok / t_grouped, 1),
+        "unit": "tokens/sec (grouped fwd+bwd)",
+        "vs_baseline": round(ratio, 4),
+        "detail": {
+            "t_grouped_ms": round(t_grouped * 1e3, 3),
+            "t_dense_loop_ms": round(t_loop * 1e3, 3),
+            "n_tokens": n_tok, "experts": cfg.num_experts,
+            "backend": jax.default_backend(),
+        },
+    }))
 
 
 def main():
@@ -77,12 +161,16 @@ def main():
     tx = optax.lamb(lr, weight_decay=wd)
     opt_state = tx.init(params)
 
+    # the probe scalar is derived from an UPDATED param leaf so that the
+    # sync device_get (smallest output leaf) cannot complete before the
+    # step itself has run
     @jax.jit
     def optax_step(params, state, grads):
         updates, state = tx.update(grads, state, params)
-        return optax.apply_updates(params, updates), state
+        new_params = optax.apply_updates(params, updates)
+        return new_params, state, jnp.sum(new_params["p3"])
 
-    t_optax, _ = time_fn(optax_step, params, opt_state, grads)
+    t_optax, _ = time_fn(optax_step, params, opt_state, grads, sync=True)
 
     # fused flat-space LAMB
     fused = FusedLAMB(lr=lr, weight_decay=wd, max_grad_norm=0.0,
@@ -91,9 +179,10 @@ def main():
 
     @jax.jit
     def fused_step(state, grads):
-        return fused.step(state, grads)
+        new_params, new_state = fused.step(state, grads)
+        return new_params, new_state, jnp.sum(new_params["p3"])
 
-    t_fused, _ = time_fn(fused_step, fstate, grads)
+    t_fused, _ = time_fn(fused_step, fstate, grads, sync=True)
 
     ratio = t_fused / t_optax
     print(json.dumps({
@@ -112,4 +201,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) > 1 and sys.argv[1] == "moe":
+        bench_moe()
+    else:
+        main()
