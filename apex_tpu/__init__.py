@@ -52,3 +52,4 @@ from apex_tpu import normalization  # noqa: E402,F401
 from apex_tpu import parallel  # noqa: E402,F401
 from apex_tpu import transformer  # noqa: E402,F401
 from apex_tpu import contrib  # noqa: E402,F401
+from apex_tpu import moe  # noqa: E402,F401

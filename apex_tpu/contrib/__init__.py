@@ -7,8 +7,18 @@ Subpackages mirror the reference's contrib surface, re-designed for TPU:
                               distributed_fused_lamb.py)
     contrib.multihead_attn — fused MHA modules (ref: apex/contrib/multihead_attn)
     contrib.fmha           — packed-varlen flash attention (ref: apex/contrib/fmha)
+    contrib.clip_grad      — fused global-norm clipping (ref: apex/contrib/clip_grad)
+    contrib.focal_loss     — fused sigmoid focal loss (ref: apex/contrib/focal_loss)
+    contrib.xentropy       — fused CE with padding_idx (ref: apex/contrib/xentropy)
+    contrib.index_mul_2d   — fused gather-multiply (ref: apex/contrib/index_mul_2d)
+    contrib.transducer     — RNN-T joint/loss (ref: apex/contrib/transducer)
 """
 
 from apex_tpu.contrib import optimizers  # noqa: F401
 from apex_tpu.contrib import multihead_attn  # noqa: F401
 from apex_tpu.contrib import fmha  # noqa: F401
+from apex_tpu.contrib import clip_grad  # noqa: F401
+from apex_tpu.contrib import focal_loss  # noqa: F401
+from apex_tpu.contrib import xentropy  # noqa: F401
+from apex_tpu.contrib import index_mul_2d  # noqa: F401
+from apex_tpu.contrib import transducer  # noqa: F401

@@ -1,0 +1,73 @@
+"""Fused gradient clipping (ref: apex/contrib/clip_grad/clip_grad.py:16-129).
+
+The reference fuses the global L2 norm (multi_tensor_l2norm) and the
+in-place rescale (multi_tensor_scale) over the gradient tensor lists.
+The TPU equivalent is functional: pack the grad pytree into one flat
+fp32 buffer (FlatSpace), one fused sum-of-squares, one fused scale —
+then unpack. Returns new grads (no in-place in JAX) plus the total
+norm, and, like ``torch.nn.utils.clip_grad_norm_``, supports arbitrary
+p-norms and inf-norm via the XLA path.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.multi_tensor.flat_buffer import FlatSpace
+from apex_tpu.multi_tensor.ops import multi_tensor_l2norm, multi_tensor_scale
+
+
+def clip_grad_norm_(
+    grads: Any,
+    max_norm: float,
+    norm_type: float = 2.0,
+    error_if_nonfinite: bool = False,
+    *,
+    impl: Optional[str] = None,
+) -> Tuple[Any, jax.Array]:
+    """Clip the global norm of a gradient pytree.
+
+    Returns ``(clipped_grads, total_norm)`` — the functional analog of
+    the reference's in-place API (grads are carried values on TPU).
+    ``error_if_nonfinite`` raises eagerly when called outside jit;
+    inside jit the non-finite norm propagates (inf/nan-safe callers use
+    the amp scaler's found_inf machinery instead).
+    """
+    leaves = jax.tree.leaves(grads)
+    if not leaves:
+        return grads, jnp.asarray(0.0, jnp.float32)
+    max_norm = float(max_norm)
+    norm_type = float(norm_type)
+
+    if norm_type == 2.0:
+        space = FlatSpace.create(grads)
+        buf = space.pack(grads, dtype=jnp.float32)
+        total_norm, _ = multi_tensor_l2norm(buf, impl=impl)
+        clip_coef = max_norm / (total_norm + 1e-6)
+        coef = jnp.minimum(clip_coef, 1.0)
+        buf, _ = multi_tensor_scale(buf, coef, impl=impl)
+        return space.unpack(buf), total_norm
+
+    if math.isinf(norm_type):
+        total_norm = jnp.max(jnp.stack(
+            [jnp.max(jnp.abs(l.astype(jnp.float32))) for l in leaves]))
+    else:
+        total_norm = jnp.sum(jnp.stack([
+            jnp.sum(jnp.abs(l.astype(jnp.float32)) ** norm_type)
+            for l in leaves])) ** (1.0 / norm_type)
+
+    if error_if_nonfinite and not isinstance(total_norm, jax.core.Tracer):
+        if not bool(jnp.isfinite(total_norm)):
+            raise RuntimeError(
+                f"The total norm of order {norm_type} is non-finite")
+
+    coef = jnp.minimum(max_norm / (total_norm + 1e-6), 1.0)
+    clipped = jax.tree.map(lambda l: (l * coef).astype(l.dtype), grads)
+    return clipped, total_norm
+
+
+__all__ = ["clip_grad_norm_"]

@@ -1,0 +1,56 @@
+"""Fused sigmoid focal loss (ref: apex/contrib/focal_loss/focal_loss.py:6,
+apex/contrib/csrc/focal_loss/focal_loss_cuda_kernel.cu).
+
+Reference semantics (RetinaNet/EfficientDet box-classification loss):
+per-anchor integer targets, ``y == -2`` drops the anchor entirely,
+``y == -1`` means all-negative (background), classes at index >=
+``num_real_classes`` are padding and contribute nothing. Per element:
+
+    q    = 1 - s/2 if positive else s/2          (label smoothing, K=2)
+    bce  = max(p, 0) - p*q + log1p(exp(-|p|))
+    w    = [alpha if positive else 1-alpha] * (1 - p_t)^gamma
+    loss = sum(w * bce) / num_positives_sum
+
+The CUDA kernel saves a fused partial gradient in the forward; XLA gets
+the same effect from fusing this whole expression and its autodiff
+transpose into a couple of elementwise kernels.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def focal_loss(
+    cls_output: jax.Array,
+    cls_targets_at_level: jax.Array,
+    num_positives_sum: jax.Array,
+    num_real_classes: int,
+    alpha: float,
+    gamma: float,
+    label_smoothing: float = 0.0,
+) -> jax.Array:
+    """Scalar focal loss over (..., num_classes) logits and (...,) int
+    targets — same call shape as the reference's ``focal_loss``."""
+    p = cls_output.astype(jnp.float32)
+    y = cls_targets_at_level
+    num_classes = p.shape[-1]
+
+    valid = (y != -2)[..., None]
+    cls_idx = jnp.arange(num_classes)
+    real = (cls_idx < num_real_classes)[(None,) * (p.ndim - 1) + (slice(None),)]
+    positive = (y[..., None] == cls_idx) & (y[..., None] >= 0)
+
+    s = float(label_smoothing)
+    q = jnp.where(positive, 1.0 - s / 2.0, s / 2.0)
+    bce = jnp.maximum(p, 0.0) - p * q + jnp.log1p(jnp.exp(-jnp.abs(p)))
+    sigma = jax.nn.sigmoid(p)
+    p_t = jnp.where(positive, sigma, 1.0 - sigma)
+    w = jnp.where(positive, alpha, 1.0 - alpha) * (1.0 - p_t) ** gamma
+    elem = jnp.where(valid & real, w * bce, 0.0)
+    return jnp.sum(elem) / jnp.maximum(
+        jnp.asarray(num_positives_sum, jnp.float32).reshape(()), 1e-6)
+
+
+__all__ = ["focal_loss"]

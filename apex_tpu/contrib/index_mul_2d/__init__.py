@@ -1,0 +1,28 @@
+"""Fused gather-multiply (ref: apex/contrib/index_mul_2d/index_mul_2d.py:5,
+apex/contrib/csrc/index_mul_2d/index_mul_2d_cuda.cu).
+
+``out[i] = in1[idx[i]] * in2[i]`` over 2-D operands. On TPU the gather
+and the multiply fuse into one XLA kernel, and the autodiff transpose
+(scatter-add into ``in1``) is exactly the reference's backward kernel,
+so a plain jnp expression IS the fused implementation. fp32/bf16/fp16
+supported (the reference is fp32/fp16-only).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def index_mul_2d(in1: jax.Array, in2: jax.Array, idx1: jax.Array) -> jax.Array:
+    """in1 (M, H), in2 (N, H), idx1 (N,) int -> (N, H)."""
+    if in1.ndim != 2 or in2.ndim != 2:
+        raise ValueError("in1 and in2 must be 2-D")
+    if idx1.ndim != 1 or idx1.shape[0] != in2.shape[0]:
+        raise ValueError("idx1 must be 1-D with len == in2.shape[0]")
+    if in1.dtype != in2.dtype:
+        raise ValueError("in1 and in2 must share a dtype")
+    return jnp.take(in1, idx1, axis=0) * in2
+
+
+__all__ = ["index_mul_2d"]

@@ -1,0 +1,52 @@
+"""Fused softmax cross-entropy contrib surface
+(ref: apex/contrib/xentropy/softmax_xentropy.py:4-29).
+
+The kernel lives in apex_tpu/ops/xentropy.py (forward saves only the
+per-row logsumexp, backward recomputes probabilities — the reference's
+memory trick). This module adds the contrib API semantics on top:
+label smoothing plus ``padding_idx`` rows whose loss (and therefore
+gradient) is zeroed, matching ``losses.masked_fill_(labels ==
+padding_idx, 0)``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.ops.xentropy import softmax_cross_entropy_loss
+
+
+def softmax_cross_entropy(
+    logits: jax.Array,
+    labels: jax.Array,
+    smoothing: float = 0.0,
+    padding_idx: int = 0,
+    half_to_float: bool = False,
+    *,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Per-example losses, fp32, with padding rows zeroed.
+
+    ``half_to_float`` is the reference's output-dtype flag; fp32 output
+    is always produced here (the kernel accumulates fp32 regardless).
+    """
+    del half_to_float
+    losses = softmax_cross_entropy_loss(logits, labels, smoothing, impl=impl)
+    return jnp.where(labels == padding_idx, 0.0, losses)
+
+
+class SoftmaxCrossEntropyLoss:
+    """Callable matching ``apex.contrib.xentropy.SoftmaxCrossEntropyLoss
+    .apply(logits, labels, smoothing, padding_idx, half_to_float)``."""
+
+    @staticmethod
+    def apply(logits, labels, smoothing=0.0, padding_idx=0,
+              half_to_float=False):
+        return softmax_cross_entropy(
+            logits, labels, smoothing, padding_idx, half_to_float)
+
+
+__all__ = ["SoftmaxCrossEntropyLoss", "softmax_cross_entropy"]

@@ -169,19 +169,22 @@ class ExpertParallelMLP(nn.Module):
 
         # position of each (token, choice) within its expert's buffer:
         # cumsum over the flattened (choice-major) one-hot stream so
-        # earlier tokens / lower k win capacity slots.
+        # earlier tokens / lower k win capacity slots. O(n*k*E) ints —
+        # the (expert, capacity) buffers below are built by scatter /
+        # gather instead of dispatch-mask einsums, so nothing of size
+        # (n, E, C) is ever materialized (C grows with n).
         onehot = jax.nn.one_hot(ids, E, dtype=jnp.int32)   # (n, k, E)
         flat = onehot.transpose(1, 0, 2).reshape(k * n, E)
         pos_flat = jnp.cumsum(flat, axis=0) - 1            # (k*n, E)
         pos = (pos_flat * flat).sum(-1).reshape(k, n).transpose(1, 0)  # (n,k)
-        keep = (pos < C) & (onehot.sum(-1) > 0)
+        keep = pos < C
 
-        # dispatch mask (n, k, E, C) -> dispatched buffer (E, C, h)
-        disp = (onehot[..., None]
-                * jax.nn.one_hot(pos, C, dtype=jnp.int32)[:, :, None, :]
-                * keep[..., None, None].astype(jnp.int32))
-        disp_f = disp.astype(cfg.dtype)
-        buf = jnp.einsum("nkec,nh->ech", disp_f, x.astype(cfg.dtype))
+        # scatter token copies into the (E*C, h) buffer; dropped copies
+        # get an out-of-range destination and fall away (mode="drop")
+        dest = jnp.where(keep, ids * C + pos, E * C).reshape(-1)   # (n*k,)
+        x_rep = jnp.repeat(x.astype(cfg.dtype), k, axis=0)         # (n*k, h)
+        buf = jnp.zeros((E * C, h), cfg.dtype).at[dest].add(
+            x_rep, mode="drop").reshape(E, C, h)
 
         if inside:
             # (E, C, h) = (ep * e_local, C, h) -> gather every device's
@@ -197,8 +200,11 @@ class ExpertParallelMLP(nn.Module):
             h2 = lax.all_to_all(h2, EXPERT_AXIS, split_axis=1,
                                 concat_axis=0, tiled=True)
 
-        combine = disp_f * weights[..., None, None].astype(cfg.dtype)
-        return jnp.einsum("nkec,ech->nh", combine, h2)
+        # combine: gather each copy's expert output and weight it
+        out = jnp.take(h2.reshape(E * C, h), jnp.minimum(dest, E * C - 1),
+                       axis=0)                                     # (n*k, h)
+        w = (weights.reshape(-1) * keep.reshape(-1)).astype(cfg.dtype)
+        return jnp.sum((out * w[:, None]).reshape(n, k, h), axis=1)
 
 
 __all__ = [
