@@ -12,6 +12,11 @@ Subpackages mirror the reference's contrib surface, re-designed for TPU:
     contrib.xentropy       — fused CE with padding_idx (ref: apex/contrib/xentropy)
     contrib.index_mul_2d   — fused gather-multiply (ref: apex/contrib/index_mul_2d)
     contrib.transducer     — RNN-T joint/loss (ref: apex/contrib/transducer)
+    contrib.bottleneck     — spatial conv parallelism + halo exchange +
+                             fused bottleneck (ref: apex/contrib/bottleneck,
+                             peer_memory, nccl_p2p)
+    contrib.groupbn        — NHWC BN with BN groups (ref: apex/contrib/groupbn)
+    contrib.conv_bias_relu — fused conv epilogues (ref: apex/contrib/conv_bias_relu)
 """
 
 from apex_tpu.contrib import optimizers  # noqa: F401
@@ -22,3 +27,6 @@ from apex_tpu.contrib import focal_loss  # noqa: F401
 from apex_tpu.contrib import xentropy  # noqa: F401
 from apex_tpu.contrib import index_mul_2d  # noqa: F401
 from apex_tpu.contrib import transducer  # noqa: F401
+from apex_tpu.contrib import bottleneck  # noqa: F401
+from apex_tpu.contrib import groupbn  # noqa: F401
+from apex_tpu.contrib import conv_bias_relu  # noqa: F401

@@ -1,0 +1,48 @@
+"""Fused Conv+Bias[+Mask][+ReLU] (ref: apex/contrib/conv_bias_relu/
+conv_bias_relu.py:12-56, csrc/fused_conv_bias_relu.cpp via
+cudnn-frontend runtime fusion).
+
+On TPU these are single XLA fusion regions: the bias add, mask
+multiply, and relu land in the conv's epilogue. The functions pin the
+reference's four entry points; NHWC, HWIO weights, fp32 accumulation.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from apex_tpu.contrib.bottleneck import conv2d_nhwc
+
+
+def conv_bias(x, w, bias, *, stride: int = 1, padding="SAME"):
+    """ConvBias_ (ref conv_bias_relu.py:28)."""
+    return conv2d_nhwc(x, w, stride=stride, padding=padding) + bias.astype(x.dtype)
+
+
+def conv_bias_relu(x, w, bias, *, stride: int = 1, padding="SAME"):
+    """ConvBiasReLU_ (ref conv_bias_relu.py:12)."""
+    return jnp.maximum(conv_bias(x, w, bias, stride=stride, padding=padding),
+                       0.0)
+
+
+def conv_bias_mask_relu(x, w, bias, mask, *, stride: int = 1,
+                        padding="SAME"):
+    """ConvBiasMaskReLU_ (ref conv_bias_relu.py:20): mask multiplies the
+    biased conv output before relu."""
+    y = conv_bias(x, w, bias, stride=stride, padding=padding)
+    return jnp.maximum(y * mask.astype(y.dtype), 0.0)
+
+
+def conv_frozen_relu(x, w, scale, bias, *, stride: int = 1, padding="SAME"):
+    """ConvFrozenScaleBiasReLU_ (ref conv_bias_relu.py:40): folded-BN
+    scale/bias epilogue."""
+    y = conv2d_nhwc(x, w, stride=stride, padding=padding)
+    return jnp.maximum(y * scale.astype(y.dtype) + bias.astype(y.dtype), 0.0)
+
+
+__all__ = [
+    "conv_bias",
+    "conv_bias_mask_relu",
+    "conv_bias_relu",
+    "conv_frozen_relu",
+]

@@ -1,0 +1,73 @@
+"""Group batchnorm, NHWC (ref: apex/contrib/groupbn/batch_norm.py:135
+BatchNorm2d_NHWC, apex/contrib/csrc/groupbn/ incl. ipc.cu).
+
+The reference syncs BN statistics across *subgroups* of GPUs
+(``bn_group``) over CUDA-IPC buffers, with optional fused ReLU and
+fused residual-add. On TPU the IPC machinery disappears: statistics
+are a ``psum`` of (sum, sumsq, count) over ``axis_index_groups`` of the
+data axis (the same mechanism as apex_tpu.parallel.SyncBatchNorm), and
+ReLU/add fuse into the normalize epilogue by XLA.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.parallel.sync_batchnorm import (
+    SyncBatchNorm,
+    create_syncbn_group_assignment,
+)
+from apex_tpu.transformer.parallel_state import DATA_AXIS
+
+
+class BatchNorm2d_NHWC(nn.Module):
+    """NHWC BN with cross-device BN groups + optional fused relu/add
+    (ref batch_norm.py:135: bn_group, fuse_relu, bn_fuse_relu_add).
+
+    ``bn_group > 1`` syncs stats over groups of that size on the data
+    axis — build the groups with ``create_syncbn_group_assignment``
+    semantics (world divided into contiguous groups).
+    """
+
+    features: int
+    fuse_relu: bool = False
+    bn_group: int = 1
+    momentum: float = 0.1
+    eps: float = 1e-5
+    axis_name: Optional[str] = DATA_AXIS
+    world_size: Optional[int] = None  # required when bn_group > 1
+    param_dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, z: Optional[jax.Array] = None,
+                 use_running_stats: bool = False):
+        """x (N, H, W, C); z: optional residual fused before relu
+        (ref's batchnorm_add_relu path)."""
+        groups = None
+        axis = self.axis_name
+        if self.bn_group > 1:
+            if self.world_size is None:
+                raise ValueError("bn_group > 1 requires world_size")
+            groups = create_syncbn_group_assignment(
+                self.world_size, self.bn_group)
+        else:
+            axis = None  # stats stay device-local, like ref bn_group=1
+
+        y = SyncBatchNorm(
+            num_features=self.features, momentum=self.momentum,
+            eps=self.eps, axis_name=axis, axis_index_groups=groups,
+            fuse_relu=self.fuse_relu and z is None,
+            param_dtype=self.param_dtype, name="bn",
+        )(x, use_running_stats=use_running_stats)
+        if z is not None:
+            y = y + z
+            if self.fuse_relu:
+                y = jnp.maximum(y, 0.0)
+        return y
+
+
+__all__ = ["BatchNorm2d_NHWC", "create_syncbn_group_assignment"]

@@ -1,0 +1,221 @@
+"""Spatial conv parallelism / bottleneck / groupbn / conv_bias_relu tests.
+
+The load-bearing check mirrors the reference's spatial-vs-dense
+equivalence (ref apex/contrib/bottleneck tests): an H-sharded 3x3 conv
+with ppermute halo exchange must equal the single-device SAME conv.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.contrib.bottleneck import (
+    Bottleneck,
+    FrozenBatchNorm2d,
+    HaloExchangerAllGather,
+    HaloExchangerPpermute,
+    SpatialBottleneck,
+    conv2d_nhwc,
+    halo_pad_1d,
+    spatial_conv2d,
+)
+from apex_tpu.contrib.conv_bias_relu import (
+    conv_bias,
+    conv_bias_mask_relu,
+    conv_bias_relu,
+)
+from apex_tpu.contrib.groupbn import BatchNorm2d_NHWC
+from apex_tpu.transformer import parallel_state as ps
+
+
+@pytest.fixture
+def sp_mesh():
+    ps.destroy_model_parallel()
+    mesh = ps.initialize_model_parallel(context_parallel_size=4)
+    yield mesh
+    ps.destroy_model_parallel()
+
+
+SPEC = P(None, ps.CONTEXT_AXIS, None, None)  # NHWC sharded on H
+
+
+class TestHaloExchange:
+    @pytest.mark.parametrize("exchanger_cls",
+                             [HaloExchangerPpermute, HaloExchangerAllGather])
+    def test_halo_pad_matches_slices(self, rng, sp_mesh, exchanger_cls):
+        x = jnp.asarray(rng.randn(2, 16, 4, 3), jnp.float32)
+
+        @functools.partial(
+            shard_map, mesh=sp_mesh, in_specs=(SPEC,), out_specs=SPEC,
+            check_vma=False)
+        def pad(xl):
+            return halo_pad_1d(xl, 1, exchanger_cls())
+
+        out = pad(x)  # (2, 16 + 2*4, 4, 3) globally: each shard grew by 2
+        out = np.asarray(out).reshape(2, 4, 6, 4, 3)  # (N, dev, 4+2, W, C)
+        xs = np.asarray(x).reshape(2, 4, 4, 4, 3)
+        for d in range(4):
+            np.testing.assert_array_equal(out[:, d, 1:5], xs[:, d])
+            if d > 0:
+                np.testing.assert_array_equal(out[:, d, 0], xs[:, d - 1, -1])
+            else:
+                np.testing.assert_array_equal(out[:, d, 0], 0.0)
+            if d < 3:
+                np.testing.assert_array_equal(out[:, d, 5], xs[:, d + 1, 0])
+            else:
+                np.testing.assert_array_equal(out[:, d, 5], 0.0)
+
+
+class TestSpatialConv:
+    @pytest.mark.parametrize("stride", [1, 2])
+    def test_matches_dense_conv(self, rng, sp_mesh, stride):
+        x = jnp.asarray(rng.randn(2, 16, 8, 5), jnp.float32)
+        w = jnp.asarray(rng.randn(3, 3, 5, 7) * 0.1, jnp.float32)
+        ref = conv2d_nhwc(x, w, stride=stride)
+
+        @functools.partial(
+            shard_map, mesh=sp_mesh, in_specs=(SPEC, P()), out_specs=SPEC,
+            check_vma=False)
+        def run(xl, w):
+            return spatial_conv2d(xl, w, stride=stride)
+
+        out = run(x, w)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_grads_match_dense(self, rng, sp_mesh):
+        x = jnp.asarray(rng.randn(1, 8, 4, 3), jnp.float32)
+        w = jnp.asarray(rng.randn(3, 3, 3, 3) * 0.1, jnp.float32)
+
+        def loss_sp(x, w):
+            run = shard_map(
+                lambda xl, w: spatial_conv2d(xl, w),
+                mesh=sp_mesh, in_specs=(SPEC, P()), out_specs=SPEC,
+                check_vma=False)
+            return jnp.sum(run(x, w) ** 2)
+
+        def loss_dense(x, w):
+            return jnp.sum(conv2d_nhwc(x, w) ** 2)
+
+        g_sp = jax.grad(loss_sp, argnums=(0, 1))(x, w)
+        g_d = jax.grad(loss_dense, argnums=(0, 1))(x, w)
+        for a, b in zip(g_sp, g_d):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=1e-5)
+
+
+class TestBottleneck:
+    def test_dense_forward(self, rng):
+        ps.destroy_model_parallel()
+        m = Bottleneck(in_channels=8, bottleneck_channels=4, out_channels=8,
+                       dtype=jnp.float32)
+        x = jnp.asarray(rng.randn(2, 8, 8, 8), jnp.float32)
+        params = m.init(jax.random.PRNGKey(0), x)
+        out = m.apply(params, x)
+        assert out.shape == (2, 8, 8, 8)
+        assert (np.asarray(out) >= 0).all()  # final relu
+
+    def test_downsample_stride(self, rng):
+        ps.destroy_model_parallel()
+        m = Bottleneck(in_channels=4, bottleneck_channels=4, out_channels=16,
+                       stride=2, dtype=jnp.float32)
+        x = jnp.asarray(rng.randn(2, 8, 8, 4), jnp.float32)
+        params = m.init(jax.random.PRNGKey(0), x)
+        out = m.apply(params, x)
+        assert out.shape == (2, 4, 4, 16)
+
+    def test_spatial_matches_dense(self, rng, sp_mesh):
+        """SpatialBottleneck over 4 H-shards == dense Bottleneck."""
+        cfgkw = dict(in_channels=6, bottleneck_channels=4, out_channels=6,
+                     dtype=jnp.float32)
+        dense = Bottleneck(**cfgkw)
+        x = jnp.asarray(rng.randn(2, 16, 4, 6), jnp.float32)
+        params = dense.init(jax.random.PRNGKey(1), x)
+        ref = dense.apply(params, x)
+
+        spatial = SpatialBottleneck(**cfgkw)
+
+        @functools.partial(
+            shard_map, mesh=sp_mesh, in_specs=(P(), SPEC), out_specs=SPEC,
+            check_vma=False)
+        def run(p, xl):
+            return spatial.apply(p, xl)
+
+        out = run(params, x)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestFrozenBN:
+    def test_scale_bias_fold(self, rng):
+        m = FrozenBatchNorm2d(4)
+        x = jnp.asarray(rng.randn(2, 3, 3, 4), jnp.float32)
+        params = {"params": {
+            "weight": jnp.asarray([1.0, 2.0, 0.5, 1.5]),
+            "bias": jnp.asarray([0.0, 1.0, -1.0, 0.2]),
+            "running_mean": jnp.asarray([0.1, -0.2, 0.0, 0.3]),
+            "running_var": jnp.asarray([1.0, 4.0, 0.25, 2.0]),
+        }}
+        out = m.apply(params, x)
+        p = params["params"]
+        scale = np.asarray(p["weight"]) / np.sqrt(np.asarray(p["running_var"]) + 1e-5)
+        bias = np.asarray(p["bias"]) - np.asarray(p["running_mean"]) * scale
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(x) * scale + bias, rtol=1e-5)
+
+
+class TestGroupBN:
+    def test_local_bn_matches_reference(self, rng):
+        ps.destroy_model_parallel()
+        m = BatchNorm2d_NHWC(features=5, fuse_relu=True)
+        x = jnp.asarray(rng.randn(4, 3, 3, 5), jnp.float32)
+        vars_ = m.init(jax.random.PRNGKey(0), x)
+        out, _ = m.apply(vars_, x, mutable=["batch_stats"])
+        xn = np.asarray(x)
+        mean = xn.reshape(-1, 5).mean(0)
+        var = xn.reshape(-1, 5).var(0)
+        ref = np.maximum((xn - mean) / np.sqrt(var + 1e-5), 0.0)
+        np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+    def test_bn_group_syncs_stats(self, rng, sp_mesh):
+        """bn_group=2 on the context axis: stats shared within pairs."""
+        m = BatchNorm2d_NHWC(features=3, bn_group=2, world_size=4,
+                             axis_name=ps.CONTEXT_AXIS)
+        x = jnp.asarray(rng.randn(8, 2, 2, 3), jnp.float32)
+        vars_ = m.init(jax.random.PRNGKey(0), x[:2])
+
+        @functools.partial(
+            shard_map, mesh=sp_mesh,
+            in_specs=(P(), P(ps.CONTEXT_AXIS)), out_specs=P(ps.CONTEXT_AXIS),
+            check_vma=False)
+        def run(v, xl):
+            out, _ = m.apply(v, xl, mutable=["batch_stats"])
+            return out
+
+        out = np.asarray(run(vars_, x))
+        # group {0,1}: normalize shards 0-1 with their pooled stats
+        xs = np.asarray(x)
+        pooled = xs[:4].reshape(-1, 3)
+        ref01 = (xs[:4] - pooled.mean(0)) / np.sqrt(pooled.var(0) + 1e-5)
+        np.testing.assert_allclose(out[:4], ref01, rtol=1e-3, atol=1e-4)
+
+
+class TestConvBiasRelu:
+    def test_all_variants(self, rng):
+        x = jnp.asarray(rng.randn(2, 5, 5, 3), jnp.float32)
+        w = jnp.asarray(rng.randn(3, 3, 3, 4) * 0.2, jnp.float32)
+        b = jnp.asarray(rng.randn(4), jnp.float32)
+        base = np.asarray(conv2d_nhwc(x, w)) + np.asarray(b)
+        np.testing.assert_allclose(np.asarray(conv_bias(x, w, b)), base,
+                                   rtol=1e-5, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(conv_bias_relu(x, w, b)),
+                                   np.maximum(base, 0), rtol=1e-5, atol=1e-5)
+        mask = jnp.asarray(rng.rand(2, 5, 5, 4) > 0.5, jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(conv_bias_mask_relu(x, w, b, mask)),
+            np.maximum(base * np.asarray(mask), 0), rtol=1e-5, atol=1e-5)
