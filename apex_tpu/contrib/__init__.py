@@ -17,6 +17,7 @@ Subpackages mirror the reference's contrib surface, re-designed for TPU:
                              peer_memory, nccl_p2p)
     contrib.groupbn        — NHWC BN with BN groups (ref: apex/contrib/groupbn)
     contrib.conv_bias_relu — fused conv epilogues (ref: apex/contrib/conv_bias_relu)
+    contrib.sparsity       — ASP 2:4 structured sparsity (ref: apex/contrib/sparsity)
 """
 
 from apex_tpu.contrib import optimizers  # noqa: F401
@@ -30,3 +31,4 @@ from apex_tpu.contrib import transducer  # noqa: F401
 from apex_tpu.contrib import bottleneck  # noqa: F401
 from apex_tpu.contrib import groupbn  # noqa: F401
 from apex_tpu.contrib import conv_bias_relu  # noqa: F401
+from apex_tpu.contrib import sparsity  # noqa: F401

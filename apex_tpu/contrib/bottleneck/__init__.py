@@ -144,7 +144,10 @@ def spatial_conv2d(x, w, *, stride: int = 1, exchanger=None) -> jax.Array:
     off = halo - pad_top
     n_out = h_local // stride
     xp = xp[:, off:off + (n_out - 1) * stride + kh]
-    pw = max(kw - stride, 0)
+    # W is unsharded: reproduce XLA SAME exactly (depends on W % stride)
+    W = x.shape[2]
+    n_out_w = -(-W // stride)
+    pw = max((n_out_w - 1) * stride + kw - W, 0)
     return conv2d_nhwc(xp, w, stride=stride,
                        padding=((0, 0), (pw // 2, pw - pw // 2)))
 

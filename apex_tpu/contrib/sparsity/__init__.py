@@ -1,0 +1,323 @@
+"""ASP — automatic 2:4 structured sparsity
+(ref: apex/contrib/sparsity/asp.py:28-307, sparse_masklib.py,
+permutation_lib.py).
+
+The reference maintains mask buffers per eligible layer, computes m:n
+structured masks from weight magnitudes (best-pattern search), patches
+``optimizer.step`` to re-apply masks after each update, and searches
+input-channel permutations that preserve accuracy. The TPU build keeps
+the full mask machinery as *functional* transforms on the param pytree
+(no module mutation in JAX):
+
+    masks   = ASP.init_model_for_pruning(params)      # eligibility map
+    masks   = ASP.compute_sparse_masks(params, masks) # magnitude masks
+    params  = ASP.apply_masks(params, masks)          # prune
+    opt2    = ASP.init_optimizer_for_pruning(opt, masks)  # step keeps 2:4
+
+**TPU delta (documented per SURVEY.md §7):** TPUs have no 2:4
+sparse-MMA unit, so masked weights do not accelerate the MXU; the
+masks deliver the model-compression / sparse-training semantics
+(and serialize with the checkpoint), not a kernel speedup.
+
+Mask patterns are computed the reference's way — enumerate all C(m,n)
+binary patterns and argmax the retained magnitude per group
+(ref sparse_masklib.py:25-49) — but vectorized over the whole tensor
+(one (groups, patterns) matmul instead of per-group loops).
+"""
+
+from __future__ import annotations
+
+import functools
+import itertools
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+# --------------------------------------------------------------------------
+# mask calculators (ref: sparse_masklib.py)
+# --------------------------------------------------------------------------
+
+
+@functools.lru_cache(maxsize=None)
+def _valid_patterns_np(m: int, n: int) -> np.ndarray:
+    pats = sorted(set(itertools.permutations([1.0] * n + [0.0] * (m - n))))
+    return np.asarray(pats, np.float32)
+
+
+def _valid_patterns(m: int, n: int) -> jnp.ndarray:
+    """All m-length binary vectors with exactly n ones (ref
+    compute_valid_1d_patterns; cached like the reference's module
+    global)."""
+    return jnp.asarray(_valid_patterns_np(m, n))
+
+
+@functools.lru_cache(maxsize=None)
+def _valid_2d_patterns_np(m: int, n: int) -> np.ndarray:
+    """All m x m binary matrices with every row and column n-sparse."""
+    rows_1d = _valid_patterns_np(m, n)
+    combos = []
+    for rows in itertools.product(range(rows_1d.shape[0]), repeat=m):
+        cand = rows_1d[list(rows)]
+        if (cand.sum(0) == n).all():
+            combos.append(cand)
+    return np.stack(combos)
+
+
+def mn_1d_best(matrix: jax.Array, m: int, n: int) -> jax.Array:
+    """Best m:n mask along the last axis: per group of m entries keep
+    the n largest-magnitude ones (ref mn_1d_best, sparse_masklib.py:37-49).
+    Trailing remainder (last-axis size % m) stays dense."""
+    pats = _valid_patterns(m, n)
+    shape = matrix.shape
+    cols = shape[-1]
+    keep = (cols // m) * m
+    body = jnp.abs(matrix[..., :keep].astype(jnp.float32))
+    groups = body.reshape(-1, m)
+    scores = groups @ pats.T                       # (G, n_patterns)
+    best = jnp.argmax(scores, axis=-1)
+    mask = pats[best].reshape(*shape[:-1], keep)
+    if keep < cols:
+        mask = jnp.concatenate(
+            [mask, jnp.ones((*shape[:-1], cols - keep), jnp.float32)], -1)
+    return mask
+
+
+def m4n2_1d(mat: jax.Array, density: float = 0.5) -> jax.Array:
+    """ref m4n2_1d (density arg kept for signature parity)."""
+    del density
+    return mn_1d_best(mat, 4, 2)
+
+
+def mn_2d_best(matrix: jax.Array, m: int, n: int) -> jax.Array:
+    """Best m:n mask on m x m blocks such that rows AND columns are both
+    m:n sparse (ref mn_2d_best: exhaustive pattern search, used so the
+    transposed weight of the DGRAD pass is also structured). Blocks
+    beyond the divisible region stay dense."""
+    pats = jnp.asarray(_valid_2d_patterns_np(m, n), jnp.float32)  # (P, m, m)
+
+    H, W = matrix.shape
+    hk, wk = (H // m) * m, (W // m) * m
+    body = jnp.abs(matrix[:hk, :wk].astype(jnp.float32))
+    blocks = body.reshape(hk // m, m, wk // m, m).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bcij,pij->bcp", blocks, pats)
+    best = jnp.argmax(scores, axis=-1)
+    mask_blocks = pats[best]                            # (hb, wb, m, m)
+    mask = mask_blocks.transpose(0, 2, 1, 3).reshape(hk, wk)
+    mask = jnp.pad(mask, ((0, H - hk), (0, W - wk)), constant_values=1.0)
+    return mask
+
+
+def m4n2_2d_best(mat: jax.Array, density: float = 0.5) -> jax.Array:
+    del density
+    return mn_2d_best(mat, 4, 2)
+
+
+_CALCULATORS = {
+    "m4n2_1d": m4n2_1d,
+    "m4n2_2d_best": m4n2_2d_best,
+}
+
+
+def _contraction_axis(param: jax.Array) -> int:
+    """Input-channel axis by flax layout: Dense kernels are (in, out)
+    -> 0; conv kernels are HWIO (kh, kw, in, out) -> ndim-2. This is
+    the axis the reference prunes (the C dim of its KCRS conv weights
+    and the columns of its (out, in) linears)."""
+    return 0 if param.ndim == 2 else param.ndim - 2
+
+
+def create_mask(param: jax.Array, pattern: str = "m4n2_1d",
+                axis: Optional[int] = None) -> jax.Array:
+    """Mask for one weight tensor with m:n groups along its
+    input/contraction ``axis`` (inferred from the flax layout by
+    default)."""
+    calc = _CALCULATORS[pattern]
+    if param.ndim < 2:
+        return jnp.ones_like(param, jnp.float32)
+    ax = _contraction_axis(param) if axis is None else axis
+    moved = jnp.moveaxis(param, ax, -1)
+    flat = moved.reshape(-1, param.shape[ax])
+    mask = calc(flat)
+    return jnp.moveaxis(mask.reshape(moved.shape), -1, ax)
+
+
+# --------------------------------------------------------------------------
+# permutation search (ref: permutation_lib.py — channel permutations that
+# raise the magnitude retained by the structured mask)
+# --------------------------------------------------------------------------
+
+
+def permutation_retained_magnitude(weight2d, perm, m=4, n=2):
+    w = weight2d[:, perm]
+    mask = mn_1d_best(w, m, n)
+    return float(jnp.sum(jnp.abs(w) * mask))
+
+
+def search_input_permutation(
+    weight2d: jax.Array,
+    num_rounds: int = 100,
+    seed: int = 0,
+) -> np.ndarray:
+    """Greedy swap hill-climb over input-channel permutations maximizing
+    the magnitude retained under the m4n2 mask — a bounded-budget
+    version of the reference's channel-permutation search
+    (ref permutation_lib.py; the exhaustive/escape phases are replaced
+    by random-pair hill climbing, which captures most of the win at a
+    tiny fraction of the cost)."""
+    rng = np.random.RandomState(seed)
+    C = weight2d.shape[1]
+    perm = np.arange(C)
+    best = permutation_retained_magnitude(weight2d, perm)
+    for _ in range(num_rounds):
+        i, j = rng.randint(0, C, 2)
+        if i == j:
+            continue
+        cand = perm.copy()
+        cand[i], cand[j] = cand[j], cand[i]
+        score = permutation_retained_magnitude(weight2d, cand)
+        if score > best:
+            best, perm = score, cand
+    return perm
+
+
+# --------------------------------------------------------------------------
+# ASP workflow (ref: asp.py)
+# --------------------------------------------------------------------------
+
+
+def _default_eligible(path: Tuple[str, ...], leaf) -> bool:
+    """ref eligible_modules: Linear/Conv weights, not norms/biases.
+    Divisibility is checked on the contraction axis (input channels)."""
+    name = path[-1] if path else ""
+    return (leaf.ndim >= 2 and name in ("kernel", "embedding")
+            and leaf.shape[_contraction_axis(leaf)] % 4 == 0)
+
+
+class ASP:
+    """Functional ASP (classmethod surface mirrors ref asp.py:28)."""
+
+    @classmethod
+    def init_model_for_pruning(
+        cls,
+        params: Any,
+        mask_calculator: str = "m4n2_1d",
+        *,
+        eligible: Callable[[Tuple[str, ...], Any], bool] = _default_eligible,
+        allowed_layer_names: Optional[Sequence[str]] = None,
+        disallowed_layer_names: Sequence[str] = (),
+    ) -> Any:
+        """Build the all-ones mask pytree and remember the eligibility
+        config — class-level state, matching the reference's singleton
+        ASP (asp.py keeps __calculator etc. as class attrs). For
+        multiple concurrently-pruned models, pass the same config
+        explicitly to :meth:`compute_sparse_masks` instead of relying
+        on the stored one."""
+        cls._pattern = mask_calculator
+        cls._eligibility = (eligible, tuple(disallowed_layer_names),
+                            None if allowed_layer_names is None
+                            else tuple(allowed_layer_names))
+        return jax.tree.map(
+            lambda l: jnp.ones_like(l, jnp.float32), params)
+
+    @classmethod
+    def compute_sparse_masks(
+        cls,
+        params: Any,
+        masks: Any,
+        *,
+        mask_calculator: Optional[str] = None,
+        eligible: Optional[Callable] = None,
+        allowed_layer_names: Optional[Sequence[str]] = None,
+        disallowed_layer_names: Optional[Sequence[str]] = None,
+    ) -> Any:
+        """Recompute magnitude masks for eligible leaves
+        (ref asp.py:204-255). Kwargs override the stored config so
+        several models can be pruned with different settings."""
+        if not hasattr(cls, "_eligibility"):
+            raise RuntimeError(
+                "ASP.compute_sparse_masks called before "
+                "ASP.init_model_for_pruning")
+        elig, disallowed, allowed = cls._eligibility
+        pattern = mask_calculator or cls._pattern
+        if eligible is not None:
+            elig = eligible
+        if allowed_layer_names is not None:
+            allowed = tuple(allowed_layer_names)
+        if disallowed_layer_names is not None:
+            disallowed = tuple(disallowed_layer_names)
+
+        def one(path, leaf, mask):
+            names = [str(getattr(k, "key", k)) for k in path]
+            joined = "/".join(names)
+            if any(d in joined for d in disallowed):
+                return mask
+            if allowed is not None and not any(a in joined for a in allowed):
+                return mask
+            if elig(tuple(names), leaf):
+                return create_mask(leaf, pattern)
+            return mask
+
+        return jax.tree_util.tree_map_with_path(one, params, masks)
+
+    @staticmethod
+    def apply_masks(params: Any, masks: Any) -> Any:
+        return jax.tree.map(
+            lambda p, m: (p * m.astype(p.dtype)), params, masks)
+
+    @staticmethod
+    def init_optimizer_for_pruning(optimizer, masks: Any):
+        """Wrap an apex_tpu fused optimizer so every ``step`` re-applies
+        the masks to the updated params (ref asp.py:176-202 patches
+        ``optimizer.step``)."""
+
+        class _SparseOpt:
+            def __init__(self, inner):
+                self._inner = inner
+
+            def init(self, params):
+                return self._inner.init(ASP.apply_masks(params, masks))
+
+            def step(self, state, grads, **kw):
+                params, state = self._inner.step(state, grads, **kw)
+                return ASP.apply_masks(params, masks), state
+
+            def __getattr__(self, name):
+                return getattr(self._inner, name)
+
+        return _SparseOpt(optimizer)
+
+    @staticmethod
+    def restore_pruned_weights(params: Any, dense_params: Any,
+                               masks: Any) -> Any:
+        """Put back the masked-out values from a stashed dense copy
+        (ref asp.py:257-270)."""
+        return jax.tree.map(
+            lambda p, d, m: jnp.where(m > 0, p, d.astype(p.dtype)),
+            params, dense_params, masks)
+
+    @classmethod
+    def is_sparsity_enabled(cls) -> bool:
+        return getattr(cls, "_pattern", None) is not None
+
+    @classmethod
+    def prune_trained_model(cls, params: Any, optimizer):
+        """One-shot recipe (ref asp.py:293-298): init + compute + apply
+        + optimizer wrapping."""
+        masks = cls.init_model_for_pruning(params)
+        masks = cls.compute_sparse_masks(params, masks)
+        return (cls.apply_masks(params, masks), masks,
+                cls.init_optimizer_for_pruning(optimizer, masks))
+
+
+__all__ = [
+    "ASP",
+    "create_mask",
+    "m4n2_1d",
+    "m4n2_2d_best",
+    "mn_1d_best",
+    "mn_2d_best",
+    "search_input_permutation",
+]

@@ -1,0 +1,127 @@
+"""ASP 2:4 sparsity tests (mirrors ref apex/contrib/test/ and the
+sparse_masklib semantics: every group of 4 keeps its 2 largest)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu.contrib.sparsity import (
+    ASP,
+    create_mask,
+    m4n2_1d,
+    m4n2_2d_best,
+    search_input_permutation,
+)
+from apex_tpu.optimizers import FusedAdam
+
+
+class TestMaskCalculators:
+    def test_m4n2_1d_keeps_top2(self, rng):
+        m = jnp.asarray(rng.randn(8, 16), jnp.float32)
+        mask = m4n2_1d(m)
+        a = np.abs(np.asarray(m)).reshape(-1, 4)
+        mk = np.asarray(mask).reshape(-1, 4)
+        assert (mk.sum(-1) == 2).all()
+        # kept entries are the two largest |w| of each group
+        for g in range(a.shape[0]):
+            kept = set(np.flatnonzero(mk[g]))
+            top2 = set(np.argsort(-a[g])[:2])
+            assert kept == top2, (g, a[g], mk[g])
+
+    def test_m4n2_1d_remainder_dense(self, rng):
+        m = jnp.asarray(rng.randn(2, 10), jnp.float32)
+        mask = np.asarray(m4n2_1d(m))
+        assert (mask[:, 8:] == 1).all()
+        assert (mask[:, :8].reshape(-1, 4).sum(-1) == 2).all()
+
+    def test_m4n2_2d_rows_and_cols(self, rng):
+        m = jnp.asarray(rng.randn(8, 8), jnp.float32)
+        mask = np.asarray(m4n2_2d_best(m))
+        blocks = mask.reshape(2, 4, 2, 4).transpose(0, 2, 1, 3)
+        for b in blocks.reshape(-1, 4, 4):
+            assert (b.sum(0) == 2).all() and (b.sum(1) == 2).all()
+
+    def test_create_mask_flax_layout(self, rng):
+        # (in=8, out=6) kernel: groups along axis 0
+        k = jnp.asarray(rng.randn(8, 6), jnp.float32)
+        mask = np.asarray(create_mask(k))
+        assert mask.shape == (8, 6)
+        assert (mask.T.reshape(-1, 4).sum(-1) == 2).all()
+
+    def test_create_mask_conv_kernel_hwio(self, rng):
+        # flax HWIO layout (kh, kw, in, out): groups along the in axis
+        k = jnp.asarray(rng.randn(3, 3, 8, 6), jnp.float32)
+        mask = np.asarray(create_mask(k))
+        assert mask.shape == k.shape
+        fibers = mask.transpose(0, 1, 3, 2).reshape(-1, 4)
+        assert (fibers.sum(-1) == 2).all()
+
+    def test_asp_prunes_hwio_conv(self, rng):
+        p = {"conv": {"kernel": jnp.asarray(rng.randn(3, 3, 8, 6),
+                                            jnp.float32)}}
+        masks = ASP.init_model_for_pruning(p)
+        masks = ASP.compute_sparse_masks(p, masks)
+        mk = np.asarray(masks["conv"]["kernel"])
+        assert (mk.transpose(0, 1, 3, 2).reshape(-1, 4).sum(-1) == 2).all()
+
+
+class TestPermutationSearch:
+    def test_search_improves_or_keeps(self, rng):
+        w = jnp.asarray(rng.randn(8, 16), jnp.float32)
+        from apex_tpu.contrib.sparsity import permutation_retained_magnitude
+        base = permutation_retained_magnitude(w, np.arange(16))
+        perm = search_input_permutation(w, num_rounds=50)
+        assert sorted(perm) == list(range(16))
+        assert permutation_retained_magnitude(w, perm) >= base - 1e-6
+
+
+class TestASPWorkflow:
+    def _params(self, rng):
+        return {
+            "dense1": {"kernel": jnp.asarray(rng.randn(8, 16), jnp.float32),
+                       "bias": jnp.asarray(rng.randn(16), jnp.float32)},
+            "norm": {"scale": jnp.asarray(rng.randn(16), jnp.float32)},
+        }
+
+    def test_masks_and_apply(self, rng):
+        p = self._params(rng)
+        masks = ASP.init_model_for_pruning(p)
+        masks = ASP.compute_sparse_masks(p, masks)
+        pruned = ASP.apply_masks(p, masks)
+        kmask = np.asarray(masks["dense1"]["kernel"])
+        assert (kmask.T.reshape(-1, 4).sum(-1) == 2).all()
+        np.testing.assert_array_equal(np.asarray(masks["dense1"]["bias"]), 1)
+        nz = np.asarray(pruned["dense1"]["kernel"]) != 0
+        np.testing.assert_array_equal(nz, kmask > 0)
+
+    def test_optimizer_keeps_sparsity(self, rng):
+        p = self._params(rng)
+        pruned, masks, opt = ASP.prune_trained_model(
+            p, FusedAdam(lr=1e-2, impl="xla"))
+        state = opt.init(pruned)
+        g = jax.tree.map(lambda l: jnp.ones_like(l), pruned)
+        params2, state = opt.step(state, g)
+        nz = np.asarray(params2["dense1"]["kernel"]) != 0
+        np.testing.assert_array_equal(
+            nz, np.asarray(masks["dense1"]["kernel"]) > 0)
+        # non-eligible leaves updated densely
+        assert (np.asarray(params2["dense1"]["bias"])
+                != np.asarray(pruned["dense1"]["bias"])).all()
+
+    def test_restore(self, rng):
+        p = self._params(rng)
+        masks = ASP.init_model_for_pruning(p)
+        masks = ASP.compute_sparse_masks(p, masks)
+        pruned = ASP.apply_masks(p, masks)
+        restored = ASP.restore_pruned_weights(pruned, p, masks)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b)), restored, p)
+
+    def test_disallowed_names(self, rng):
+        p = self._params(rng)
+        masks = ASP.init_model_for_pruning(
+            p, disallowed_layer_names=["dense1"])
+        masks = ASP.compute_sparse_masks(p, masks)
+        np.testing.assert_array_equal(
+            np.asarray(masks["dense1"]["kernel"]), 1)

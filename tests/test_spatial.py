@@ -129,12 +129,14 @@ class TestBottleneck:
         out = m.apply(params, x)
         assert out.shape == (2, 4, 4, 16)
 
-    def test_spatial_matches_dense(self, rng, sp_mesh):
-        """SpatialBottleneck over 4 H-shards == dense Bottleneck."""
+    @pytest.mark.parametrize("stride,width", [(1, 4), (2, 4), (2, 7)])
+    def test_spatial_matches_dense(self, rng, sp_mesh, stride, width):
+        """SpatialBottleneck over 4 H-shards == dense Bottleneck,
+        including the strided 3x3 + downsample path and odd widths."""
         cfgkw = dict(in_channels=6, bottleneck_channels=4, out_channels=6,
-                     dtype=jnp.float32)
+                     stride=stride, dtype=jnp.float32)
         dense = Bottleneck(**cfgkw)
-        x = jnp.asarray(rng.randn(2, 16, 4, 6), jnp.float32)
+        x = jnp.asarray(rng.randn(2, 16, width, 6), jnp.float32)
         params = dense.init(jax.random.PRNGKey(1), x)
         ref = dense.apply(params, x)
 
