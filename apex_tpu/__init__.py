@@ -53,3 +53,5 @@ from apex_tpu import parallel  # noqa: E402,F401
 from apex_tpu import transformer  # noqa: E402,F401
 from apex_tpu import contrib  # noqa: E402,F401
 from apex_tpu import moe  # noqa: E402,F401
+from apex_tpu import rnn  # noqa: E402,F401
+from apex_tpu import fp16_utils  # noqa: E402,F401

@@ -1,0 +1,176 @@
+"""Legacy fp16 utilities (ref: apex/fp16_utils/: fp16util.py:35-196,
+loss_scaler.py:10-47, fp16_optimizer.py:13).
+
+The modern path is apex_tpu.amp (precision policies O0-O5). This
+module keeps the gen-1 API surface for parity, re-expressed over param
+pytrees: the reference mutates modules and `.data` in place; here every
+function is value -> value, and FP16_Optimizer carries (optimizer
+state, scaler state) as one functional state object. The fp32 master
+copy lives where it already lives on TPU — the fused optimizers' flat
+master buffer (apex_tpu/optimizers/fused.py) — so FP16_Optimizer adds
+only the loss-scale choreography (ref fp16_optimizer.py:253-376:
+scale -> backward -> unscale -> skip-or-step -> adjust scale).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.frontend import _BN_PATTERN, _cast_params
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+
+
+def tofp16(params: Any) -> Any:
+    """Cast every float leaf to fp16 (ref fp16util.py:17-32 tofp16)."""
+    return _cast_params(params, jnp.float16, keep_batchnorm_fp32=False)
+
+
+def bn_convert_float(params: Any) -> Any:
+    """Restore norm leaves to fp32 (ref fp16util.py:44-57
+    BN_convert_float: BatchNorm stays fp32 for cuDNN; on TPU the same
+    leaves stay fp32 for numerics). Uses the amp engine's norm-name
+    pattern, so fp16_utils and amp agree on what counts as a norm."""
+
+    def cast(path, leaf):
+        name = "/".join(str(getattr(k, "key", getattr(k, "name", k)))
+                        for k in path)
+        if _BN_PATTERN.search(name) and jnp.issubdtype(
+                leaf.dtype, jnp.floating):
+            return leaf.astype(jnp.float32)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(cast, params)
+
+
+def network_to_half(params: Any) -> Any:
+    """fp16 everywhere except norm params (ref fp16util.py:35-41)."""
+    return _cast_params(params, jnp.float16, keep_batchnorm_fp32=True)
+
+
+def prep_param_lists(params: Any) -> Tuple[Any, Any]:
+    """(model_params fp16-ish, master_params fp32 copy)
+    (ref fp16util.py:90-133; flat_master corresponds to the fused
+    optimizers' flat buffer and is not needed here)."""
+    master = jax.tree.map(
+        lambda l: l.astype(jnp.float32)
+        if jnp.issubdtype(l.dtype, jnp.floating) else l, params)
+    return params, master
+
+
+def model_grads_to_master_grads(model_grads: Any) -> Any:
+    """fp16 grads -> fp32 (ref fp16util.py:136-155)."""
+    return jax.tree.map(
+        lambda g: g.astype(jnp.float32)
+        if jnp.issubdtype(g.dtype, jnp.floating) else g, model_grads)
+
+
+def master_params_to_model_params(master_params: Any,
+                                  model_params: Any) -> Any:
+    """Copy updated fp32 masters back into the model dtype layout
+    (ref fp16util.py:158-176)."""
+    return jax.tree.map(
+        lambda m, p: m.astype(p.dtype), master_params, model_params)
+
+
+def to_python_float(t) -> float:
+    return float(jax.device_get(t))
+
+
+class DynamicLossScaler(LossScaler):
+    """ref loss_scaler.py:47 — the amp LossScaler in dynamic mode."""
+
+    def __init__(self, init_scale=2.0 ** 32, scale_factor=2.0,
+                 scale_window=1000):
+        # the reference's gen-1 scaler has no growth cap; an inherited
+        # cap below init_scale would snap the scale DOWN on growth
+        super().__init__("dynamic", init_scale=init_scale,
+                         scale_factor=scale_factor,
+                         scale_window=scale_window,
+                         max_loss_scale=float("inf"))
+
+
+class FP16State(NamedTuple):
+    opt_state: Any
+    scaler_state: ScalerState
+
+
+class FP16_Optimizer:
+    """Gen-1 mixed-precision optimizer wrapper (ref fp16_optimizer.py:13).
+
+    Wraps an apex_tpu fused optimizer. Usage::
+
+        opt = FP16_Optimizer(FusedAdam(lr=1e-3), dynamic_loss_scale=True)
+        state = opt.init(params)
+        loss = loss_fn(params)                        # fp16 params fine
+        scaled = opt.scale_loss(loss, state)          # ref: backward(loss)
+        grads = jax.grad(...)(...)                    # grads of scaled loss
+        params, state = opt.step(state, grads)        # unscale+skip inside
+    """
+
+    def __init__(self, optimizer, static_loss_scale: float = 1.0,
+                 dynamic_loss_scale: bool = False,
+                 dynamic_loss_args: Optional[dict] = None,
+                 verbose: bool = False):
+        self.optimizer = optimizer
+        if dynamic_loss_scale:
+            self.loss_scaler = LossScaler(
+                "dynamic", **(dynamic_loss_args or {}))
+        else:
+            self.loss_scaler = LossScaler(static_loss_scale)
+        self.verbose = verbose
+
+    def init(self, params: Any) -> FP16State:
+        return FP16State(self.optimizer.init(params),
+                         self.loss_scaler.init())
+
+    def scale_loss(self, loss, state: FP16State):
+        """ref fp16_optimizer.py backward(): loss * loss_scale."""
+        return self.loss_scaler.scale_loss(loss, state.scaler_state)
+
+    def step(self, state: FP16State, grads: Any, **kw):
+        """Unscale inside the fused update (grad_scale), skip on
+        overflow, and advance the scaler (ref fp16_optimizer.py:253-376)."""
+        params, opt_state = self.optimizer.step(
+            state.opt_state, grads,
+            grad_scale=state.scaler_state.loss_scale,
+            skip_if_nonfinite=True, **kw)
+        scaler_state = self.loss_scaler.update(
+            state.scaler_state, opt_state.found_inf)
+        return params, FP16State(opt_state, scaler_state)
+
+    # parity helpers -------------------------------------------------------
+
+    def loss_scale(self, state: FP16State):
+        """Current numeric loss scale (ref fp16_optimizer.py's
+        ``loss_scale`` property; functional, so it takes the state)."""
+        return state.scaler_state.loss_scale
+
+    def state_dict(self, state: FP16State):
+        return {"opt_state": self.optimizer.state_dict(state.opt_state),
+                "loss_scaler": self.loss_scaler.state_dict(
+                    state.scaler_state)}
+
+    def load_state_dict(self, state: FP16State, d) -> FP16State:
+        """Needs the current state for the optimizer's static layout
+        (FlatSpace), like FlatFusedOptimizer.load_state_dict."""
+        return FP16State(
+            self.optimizer.load_state_dict(state.opt_state, d["opt_state"]),
+            self.loss_scaler.load_state_dict(d["loss_scaler"]))
+
+
+__all__ = [
+    "DynamicLossScaler",
+    "FP16_Optimizer",
+    "FP16State",
+    "LossScaler",
+    "bn_convert_float",
+    "master_params_to_model_params",
+    "model_grads_to_master_grads",
+    "network_to_half",
+    "prep_param_lists",
+    "to_python_float",
+    "tofp16",
+]

@@ -1,0 +1,213 @@
+"""RNN family — LSTM / GRU / ReLU / Tanh / mLSTM
+(ref: apex/RNN/RNNBackend.py:25-365, models.py:19-51, cells.py:12-84).
+
+The reference stacks per-timestep cell modules under Python loops with
+stateful hidden buffers. The TPU design is one ``lax.scan`` per
+(layer, direction): the cell is a pure function on carried state, XLA
+fuses the gate pointwise math (the reference needs rnnFusedPointwise
+CUDA kernels for this), and the scan keeps the whole sequence on
+device. Stacking and bidirectionality are Python-level composition
+exactly as in the reference's stackedRNN/bidirectionalRNN, with
+inter-layer dropout.
+
+Layout: (seq, batch, features); ``batch_first=True`` transposes at the
+boundary (ref RNNBackend.py:222-238).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+# --------------------------------------------------------------------------
+# cell math (pure functions: (params, x_t, state) -> (state, out))
+# --------------------------------------------------------------------------
+
+
+def _linear(x, w, b=None):
+    y = x @ w
+    return y if b is None else y + b
+
+
+def lstm_cell(p, x, state):
+    """ref cells.py mLSTMCell's standard-LSTM core / torch LSTMCell."""
+    h, c = state
+    gates = _linear(x, p["w_ih"], p.get("b_ih")) + _linear(
+        h, p["w_hh"], p.get("b_hh"))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
+def mlstm_cell(p, x, state):
+    """Multiplicative LSTM (ref cells.py:55-84): the hidden input to the
+    gates is m = (x W_mih) * (h W_mhh)."""
+    h, c = state
+    m = _linear(x, p["w_mih"]) * _linear(h, p["w_mhh"])
+    gates = _linear(x, p["w_ih"], p.get("b_ih")) + _linear(
+        m, p["w_hh"], p.get("b_hh"))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c = f * c + i * g
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
+def gru_cell(p, x, state):
+    """torch-convention GRU (ref models.py:26 wraps nn.GRUCell)."""
+    (h,) = state
+    xg = _linear(x, p["w_ih"], p.get("b_ih"))
+    hg = _linear(h, p["w_hh"], p.get("b_hh"))
+    xr, xz, xn = jnp.split(xg, 3, axis=-1)
+    hr, hz, hn = jnp.split(hg, 3, axis=-1)
+    r = jax.nn.sigmoid(xr + hr)
+    z = jax.nn.sigmoid(xz + hz)
+    n = jnp.tanh(xn + r * hn)
+    h = (1 - z) * n + z * h
+    return (h,), h
+
+
+def _simple_cell(act):
+    def cell(p, x, state):
+        (h,) = state
+        h = act(_linear(x, p["w_ih"], p.get("b_ih"))
+                + _linear(h, p["w_hh"], p.get("b_hh")))
+        return (h,), h
+    return cell
+
+
+relu_cell = _simple_cell(jax.nn.relu)
+tanh_cell = _simple_cell(jnp.tanh)
+
+_CELLS = {
+    "lstm": (lstm_cell, 4, 2, False),
+    "mlstm": (mlstm_cell, 4, 2, True),
+    "gru": (gru_cell, 3, 1, False),
+    "relu": (relu_cell, 1, 1, False),
+    "tanh": (tanh_cell, 1, 1, False),
+}
+
+
+class RNN(nn.Module):
+    """Stacked (optionally bidirectional) recurrent network
+    (ref RNNBackend.py bidirectionalRNN/stackedRNN/RNNCell).
+
+    Input (seq, batch, input_size) — or (batch, seq, ...) with
+    ``batch_first``. Returns (output, final_states) where output is the
+    top layer's hidden sequence (directions concatenated) and
+    final_states is a list of per-layer tuples.
+    """
+
+    cell_type: str
+    input_size: int
+    hidden_size: int
+    num_layers: int = 1
+    bias: bool = True
+    batch_first: bool = False
+    dropout: float = 0.0
+    bidirectional: bool = False
+    param_dtype: Any = jnp.float32
+
+    def _cell_params(self, name, in_size):
+        cell, gate_mult, _, has_m = _CELLS[self.cell_type]
+        g = gate_mult * self.hidden_size
+        mk = lambda n, shape: self.param(  # noqa: E731
+            f"{name}_{n}", nn.initializers.lecun_normal(), shape,
+            self.param_dtype)
+        p = {"w_ih": mk("w_ih", (in_size, g)),
+             "w_hh": mk("w_hh", (self.hidden_size, g))}
+        if has_m:
+            p["w_mih"] = mk("w_mih", (in_size, self.hidden_size))
+            p["w_mhh"] = mk("w_mhh", (self.hidden_size, self.hidden_size))
+        if self.bias:
+            z = lambda n, shape: self.param(  # noqa: E731
+                f"{name}_{n}", nn.initializers.zeros, shape,
+                self.param_dtype)
+            p["b_ih"] = z("b_ih", (g,))
+            p["b_hh"] = z("b_hh", (g,))
+        return p
+
+    @nn.compact
+    def __call__(self, x, initial_states=None, *, deterministic=True):
+        cell, _, n_state, _ = _CELLS[self.cell_type]
+        if self.batch_first:
+            x = x.transpose(1, 0, 2)
+        b = x.shape[1]
+        dirs = 2 if self.bidirectional else 1
+
+        def run_scan(p, xs, reverse, init):
+            if init is None:
+                # carry dtype = promoted (input, param) dtype so fp16
+                # inputs against fp32 params scan cleanly
+                cdt = jnp.result_type(xs.dtype, p["w_hh"].dtype)
+                init = tuple(
+                    jnp.zeros((b, self.hidden_size), cdt)
+                    for _ in range(n_state))
+
+            def step(state, x_t):
+                state, out = cell(p, x_t, state)
+                return state, out
+
+            # scan's reverse=True: last-to-first processing with outs in
+            # original order — no materialized sequence reversals
+            return lax.scan(step, init, xs, reverse=reverse)
+
+        finals = []
+        for layer in range(self.num_layers):
+            in_size = (self.input_size if layer == 0
+                       else self.hidden_size * dirs)
+            outs_dirs, finals_layer = [], []
+            for d in range(dirs):
+                p = self._cell_params(f"l{layer}d{d}", in_size)
+                init = (initial_states[layer][d]
+                        if initial_states is not None else None)
+                final, outs = run_scan(p, x, reverse=(d == 1), init=init)
+                outs_dirs.append(outs)
+                finals_layer.append(final)
+            x = (jnp.concatenate(outs_dirs, axis=-1)
+                 if dirs == 2 else outs_dirs[0])
+            finals.append(tuple(finals_layer))
+            if (self.dropout > 0.0 and not deterministic
+                    and layer < self.num_layers - 1):
+                x = nn.Dropout(rate=self.dropout)(x, deterministic=False)
+
+        if self.batch_first:
+            x = x.transpose(1, 0, 2)
+        return x, finals
+
+
+def _ctor(cell_type):
+    def make(input_size, hidden_size, num_layers, bias=True,
+             batch_first=False, dropout=0.0, bidirectional=False,
+             output_size=None, **kw):
+        """ref models.py constructors; output_size is accepted for
+        parity (the reference's w_ho projection) but must equal
+        hidden_size here."""
+        if output_size is not None and output_size != hidden_size:
+            raise NotImplementedError(
+                "output_size != hidden_size projection is not supported")
+        return RNN(cell_type=cell_type, input_size=input_size,
+                   hidden_size=hidden_size, num_layers=num_layers,
+                   bias=bias, batch_first=batch_first, dropout=dropout,
+                   bidirectional=bidirectional, **kw)
+    make.__name__ = cell_type.upper()
+    return make
+
+
+LSTM = _ctor("lstm")
+GRU = _ctor("gru")
+ReLU = _ctor("relu")
+Tanh = _ctor("tanh")
+mLSTM = _ctor("mlstm")
+
+__all__ = ["GRU", "LSTM", "RNN", "ReLU", "Tanh", "mLSTM",
+           "gru_cell", "lstm_cell", "mlstm_cell", "relu_cell", "tanh_cell"]

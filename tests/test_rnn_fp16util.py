@@ -1,0 +1,209 @@
+"""RNN family + fp16_utils legacy API tests
+(mirrors ref tests/L0/run_amp/test_rnn.py and run_fp16util/)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu.fp16_utils import (
+    FP16_Optimizer,
+    master_params_to_model_params,
+    model_grads_to_master_grads,
+    network_to_half,
+    prep_param_lists,
+    tofp16,
+)
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.rnn import GRU, LSTM, RNN, ReLU, Tanh, mLSTM
+
+
+class TestRNN:
+    @pytest.mark.parametrize("ctor", [LSTM, GRU, ReLU, Tanh, mLSTM])
+    def test_shapes_all_cells(self, rng, ctor):
+        model = ctor(input_size=6, hidden_size=8, num_layers=2)
+        x = jnp.asarray(rng.randn(5, 3, 6), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x)
+        out, finals = model.apply(params, x)
+        assert out.shape == (5, 3, 8)
+        assert len(finals) == 2
+
+    def test_lstm_vs_manual_recurrence(self, rng):
+        """Single-layer LSTM scan equals a hand-rolled per-step loop."""
+        model = LSTM(input_size=4, hidden_size=4, num_layers=1, bias=True)
+        x = jnp.asarray(rng.randn(6, 2, 4), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x)
+        out, _ = model.apply(params, x)
+
+        from apex_tpu.rnn import lstm_cell
+        p = {k.split("l0d0_")[1]: v
+             for k, v in params["params"].items()}
+        h = (jnp.zeros((2, 4)), jnp.zeros((2, 4)))
+        for t in range(6):
+            h, o = lstm_cell(p, x[t], h)
+            np.testing.assert_allclose(np.asarray(out[t]), np.asarray(o),
+                                       rtol=1e-5, atol=1e-6)
+
+    def test_bidirectional_concat(self, rng):
+        model = LSTM(input_size=4, hidden_size=3, num_layers=1,
+                     bidirectional=True)
+        x = jnp.asarray(rng.randn(5, 2, 4), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x)
+        out, finals = model.apply(params, x)
+        assert out.shape == (5, 2, 6)
+        # reverse direction's final state corresponds to t=0 output half
+        np.testing.assert_allclose(
+            np.asarray(out[0, :, 3:]), np.asarray(finals[0][1][0]),
+            rtol=1e-6)
+
+    def test_batch_first(self, rng):
+        model = GRU(input_size=4, hidden_size=5, num_layers=1,
+                    batch_first=True)
+        x = jnp.asarray(rng.randn(2, 7, 4), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x)
+        out, _ = model.apply(params, x)
+        assert out.shape == (2, 7, 5)
+
+    def test_lstm_learns(self, rng):
+        """Tiny sequence-sum regression converges (the reference's RNN
+        tests are train-smoke tests under amp)."""
+        model = LSTM(input_size=2, hidden_size=16, num_layers=1)
+        x = jnp.asarray(rng.randn(8, 16, 2), jnp.float32)
+        y = jnp.cumsum(x[..., 0], axis=0)[..., None]
+        head = jnp.asarray(rng.randn(16, 1) * 0.1, jnp.float32)
+        params = {"rnn": model.init(jax.random.PRNGKey(0), x),
+                  "head": head}
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            def loss_fn(p):
+                out, _ = model.apply(p["rnn"], x)
+                return jnp.mean((out @ p["head"] - y) ** 2)
+            loss, g = jax.value_and_grad(loss_fn)(params)
+            params, state = opt.step(state, g)
+            return params, state, loss
+
+        losses = [None] * 0
+        for _ in range(60):
+            params, state, loss = step(params, state)
+            losses.append(float(loss))
+        assert losses[-1] < losses[0] * 0.5, losses[::20]
+
+    def test_initial_states_tbptt(self, rng):
+        """Carrying finals across segments == one long scan (truncated
+        BPTT contract)."""
+        model = LSTM(input_size=3, hidden_size=4, num_layers=2)
+        x = jnp.asarray(rng.randn(10, 2, 3), jnp.float32)
+        params = model.init(jax.random.PRNGKey(0), x)
+        full, _ = model.apply(params, x)
+        o1, s1 = model.apply(params, x[:5])
+        o2, _ = model.apply(params, x[5:], s1)
+        np.testing.assert_allclose(
+            np.asarray(jnp.concatenate([o1, o2])), np.asarray(full),
+            rtol=1e-5, atol=1e-6)
+
+    def test_mlstm_multiplicative_path(self, rng):
+        """mLSTM differs from LSTM given identical shared weights."""
+        x = jnp.asarray(rng.randn(4, 2, 8), jnp.float32)
+        m1 = LSTM(input_size=8, hidden_size=8, num_layers=1)
+        m2 = mLSTM(input_size=8, hidden_size=8, num_layers=1)
+        p1 = m1.init(jax.random.PRNGKey(0), x)
+        p2 = m2.init(jax.random.PRNGKey(0), x)
+        o1, _ = m1.apply(p1, x)
+        o2, _ = m2.apply(p2, x)
+        assert o2.shape == o1.shape
+        assert "l0d0_w_mih" in p2["params"]
+
+
+class TestFP16Util:
+    def _params(self, rng):
+        return {"dense": {"kernel": jnp.asarray(rng.randn(4, 4), jnp.float32),
+                          "bias": jnp.zeros((4,), jnp.float32)},
+                "batch_norm": {"scale": jnp.ones((4,), jnp.float32)}}
+
+    def test_network_to_half_keeps_norms(self, rng):
+        """Only batch/group norms stay fp32 (ref BN_convert_float
+        converts _BatchNorm modules only — dense biases and layer norms
+        go fp16 like everything else)."""
+        p = network_to_half(self._params(rng))
+        assert p["dense"]["kernel"].dtype == jnp.float16
+        assert p["dense"]["bias"].dtype == jnp.float16
+        assert p["batch_norm"]["scale"].dtype == jnp.float32
+
+    def test_tofp16_all(self, rng):
+        p = tofp16(self._params(rng))
+        assert all(l.dtype == jnp.float16 for l in jax.tree.leaves(p))
+
+    def test_prep_and_copy_roundtrip(self, rng):
+        model_p = tofp16(self._params(rng))
+        model_p, master_p = prep_param_lists(model_p)
+        assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(master_p))
+        back = master_params_to_model_params(master_p, model_p)
+        assert all(l.dtype == jnp.float16 for l in jax.tree.leaves(back))
+        g32 = model_grads_to_master_grads(tofp16(self._params(rng)))
+        assert all(l.dtype == jnp.float32 for l in jax.tree.leaves(g32))
+
+
+class TestFP16Optimizer:
+    def test_static_scale_training(self, rng):
+        x = jnp.asarray(rng.randn(32, 8), jnp.float16)
+        w_t = jnp.asarray(rng.randn(8, 4), jnp.float32)
+        y = (np.asarray(x, np.float32) @ np.asarray(w_t)).astype(np.float32)
+        y = jnp.asarray(y)
+        params = {"w": jnp.asarray(rng.randn(8, 4) * 0.1, jnp.float16)}
+        opt = FP16_Optimizer(FusedAdam(lr=5e-2, impl="xla"),
+                             static_loss_scale=128.0)
+        state = opt.init(params)
+
+        @jax.jit
+        def step(params, state):
+            def loss_fn(p):
+                pred = x.astype(jnp.float32) @ p["w"].astype(jnp.float32)
+                return jnp.mean((pred - y) ** 2)
+            loss, g = jax.value_and_grad(
+                lambda p: opt.scale_loss(loss_fn(p), state))(params)
+            params, state = opt.step(state, g)
+            return params, state, loss
+
+        losses = []
+        for _ in range(60):
+            params, state, loss = step(params, state)
+            losses.append(float(loss) / 128.0)
+        assert params["w"].dtype == jnp.float16
+        assert losses[-1] < losses[0] * 0.2, losses[::20]
+
+    def test_dynamic_scale_recovers_from_inf(self, rng):
+        params = {"w": jnp.ones((4,), jnp.float16)}
+        opt = FP16_Optimizer(FusedAdam(lr=1e-2, impl="xla"),
+                             dynamic_loss_scale=True)
+        state = opt.init(params)
+        scale0 = float(state.scaler_state.loss_scale)
+
+        bad = {"w": jnp.asarray([jnp.inf, 1, 1, 1], jnp.float16)}
+        params2, state = opt.step(state, bad)
+        # skipped: params unchanged, scale halved
+        np.testing.assert_allclose(
+            np.asarray(params2["w"], np.float32),
+            np.asarray(params["w"], np.float32))
+        assert float(state.scaler_state.loss_scale) == scale0 / 2
+
+        good = {"w": jnp.ones((4,), jnp.float16)}
+        params3, state = opt.step(state, good)
+        assert (np.asarray(params3["w"], np.float32)
+                != np.asarray(params2["w"], np.float32)).any()
+
+    def test_state_dict_roundtrip(self, rng):
+        params = {"w": jnp.ones((4,), jnp.float16)}
+        opt = FP16_Optimizer(FusedAdam(lr=1e-2, impl="xla"),
+                             dynamic_loss_scale=True)
+        state = opt.init(params)
+        d = opt.state_dict(state)
+        state2 = opt.load_state_dict(state, d)
+        assert float(state2.scaler_state.loss_scale) == float(
+            state.scaler_state.loss_scale)
+        np.testing.assert_array_equal(
+            np.asarray(state2.opt_state.master),
+            np.asarray(state.opt_state.master))
+        assert float(opt.loss_scale(state2)) == float(opt.loss_scale(state))
