@@ -86,18 +86,26 @@ class AmpState(NamedTuple):
 jax.tree_util.register_static(Properties)
 
 
+def _path_name(path) -> str:
+    """Join a pytree key path to a '/'-separated name string."""
+    return "/".join(str(getattr(k, "key", getattr(k, "name", k))) for k in path)
+
+
 def _cast_params(params: Any, dtype, keep_batchnorm_fp32: bool) -> Any:
     """Cast a param pytree, optionally keeping norm params fp32
     (ref: apex/amp/_initialize.py:178-184 convert_network)."""
 
     def cast(path, leaf):
-        if not isinstance(leaf, (jax.Array, jnp.ndarray)) or not jnp.issubdtype(
+        # accept jax arrays AND numpy leaves (checkpoints often load as
+        # numpy); skip anything without a float dtype
+        if not hasattr(leaf, "dtype") or not jnp.issubdtype(
             leaf.dtype, jnp.floating
         ):
             return leaf
+        if not isinstance(leaf, (jax.Array, jnp.ndarray)):
+            leaf = jnp.asarray(leaf)
         if keep_batchnorm_fp32:
-            name = "/".join(str(getattr(k, "key", getattr(k, "name", k))) for k in path)
-            if _BN_PATTERN.search(name):
+            if _BN_PATTERN.search(_path_name(path)):
                 return leaf.astype(jnp.float32)
         return leaf.astype(dtype)
 

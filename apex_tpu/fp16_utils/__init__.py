@@ -19,7 +19,7 @@ from typing import Any, NamedTuple, Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from apex_tpu.amp.frontend import _BN_PATTERN, _cast_params
+from apex_tpu.amp.frontend import _BN_PATTERN, _cast_params, _path_name
 from apex_tpu.amp.scaler import LossScaler, ScalerState
 
 
@@ -35,10 +35,9 @@ def bn_convert_float(params: Any) -> Any:
     pattern, so fp16_utils and amp agree on what counts as a norm."""
 
     def cast(path, leaf):
-        name = "/".join(str(getattr(k, "key", getattr(k, "name", k)))
-                        for k in path)
-        if _BN_PATTERN.search(name) and jnp.issubdtype(
-                leaf.dtype, jnp.floating):
+        if (hasattr(leaf, "dtype")
+                and jnp.issubdtype(leaf.dtype, jnp.floating)
+                and _BN_PATTERN.search(_path_name(path))):
             return leaf.astype(jnp.float32)
         return leaf
 
@@ -50,21 +49,20 @@ def network_to_half(params: Any) -> Any:
     return _cast_params(params, jnp.float16, keep_batchnorm_fp32=True)
 
 
+def _tree_to_fp32(tree: Any) -> Any:
+    return _cast_params(tree, jnp.float32, keep_batchnorm_fp32=False)
+
+
 def prep_param_lists(params: Any) -> Tuple[Any, Any]:
     """(model_params fp16-ish, master_params fp32 copy)
     (ref fp16util.py:90-133; flat_master corresponds to the fused
     optimizers' flat buffer and is not needed here)."""
-    master = jax.tree.map(
-        lambda l: l.astype(jnp.float32)
-        if jnp.issubdtype(l.dtype, jnp.floating) else l, params)
-    return params, master
+    return params, _tree_to_fp32(params)
 
 
 def model_grads_to_master_grads(model_grads: Any) -> Any:
     """fp16 grads -> fp32 (ref fp16util.py:136-155)."""
-    return jax.tree.map(
-        lambda g: g.astype(jnp.float32)
-        if jnp.issubdtype(g.dtype, jnp.floating) else g, model_grads)
+    return _tree_to_fp32(model_grads)
 
 
 def master_params_to_model_params(master_params: Any,
@@ -116,8 +114,9 @@ class FP16_Optimizer:
                  verbose: bool = False):
         self.optimizer = optimizer
         if dynamic_loss_scale:
-            self.loss_scaler = LossScaler(
-                "dynamic", **(dynamic_loss_args or {}))
+            # gen-1 dynamic scaler: 2^32 start, window 1000, no growth
+            # cap (ref fp16_optimizer.py:90-92 builds DynamicLossScaler)
+            self.loss_scaler = DynamicLossScaler(**(dynamic_loss_args or {}))
         else:
             self.loss_scaler = LossScaler(static_loss_scale)
         self.verbose = verbose
@@ -132,11 +131,14 @@ class FP16_Optimizer:
 
     def step(self, state: FP16State, grads: Any, **kw):
         """Unscale inside the fused update (grad_scale), skip on
-        overflow, and advance the scaler (ref fp16_optimizer.py:253-376)."""
+        overflow (dynamic mode only — the gen-1 static LossScaler never
+        checks overflow, ref loss_scaler.py:10-44, so a bad static scale
+        surfaces as NaNs exactly like the reference), and advance the
+        scaler (ref fp16_optimizer.py:253-376)."""
         params, opt_state = self.optimizer.step(
             state.opt_state, grads,
             grad_scale=state.scaler_state.loss_scale,
-            skip_if_nonfinite=True, **kw)
+            skip_if_nonfinite=self.loss_scaler.dynamic, **kw)
         scaler_state = self.loss_scaler.update(
             state.scaler_state, opt_state.found_inf)
         return params, FP16State(opt_state, scaler_state)

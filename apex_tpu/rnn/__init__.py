@@ -34,17 +34,21 @@ def _linear(x, w, b=None):
     return y if b is None else y + b
 
 
+def _lstm_gates(p, x, hidden_in, c):
+    """Shared LSTM gate/state math (i, f, g, o over a 4x gate stack)."""
+    gates = _linear(x, p["w_ih"], p.get("b_ih")) + _linear(
+        hidden_in, p["w_hh"], p.get("b_hh"))
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    c = f * c + i * jnp.tanh(g)
+    h = o * jnp.tanh(c)
+    return (h, c), h
+
+
 def lstm_cell(p, x, state):
     """ref cells.py mLSTMCell's standard-LSTM core / torch LSTMCell."""
     h, c = state
-    gates = _linear(x, p["w_ih"], p.get("b_ih")) + _linear(
-        h, p["w_hh"], p.get("b_hh"))
-    i, f, g, o = jnp.split(gates, 4, axis=-1)
-    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
-    g = jnp.tanh(g)
-    c = f * c + i * g
-    h = o * jnp.tanh(c)
-    return (h, c), h
+    return _lstm_gates(p, x, h, c)
 
 
 def mlstm_cell(p, x, state):
@@ -52,14 +56,7 @@ def mlstm_cell(p, x, state):
     gates is m = (x W_mih) * (h W_mhh)."""
     h, c = state
     m = _linear(x, p["w_mih"]) * _linear(h, p["w_mhh"])
-    gates = _linear(x, p["w_ih"], p.get("b_ih")) + _linear(
-        m, p["w_hh"], p.get("b_hh"))
-    i, f, g, o = jnp.split(gates, 4, axis=-1)
-    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
-    g = jnp.tanh(g)
-    c = f * c + i * g
-    h = o * jnp.tanh(c)
-    return (h, c), h
+    return _lstm_gates(p, x, m, c)
 
 
 def gru_cell(p, x, state):

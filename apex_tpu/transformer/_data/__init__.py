@@ -1,0 +1,125 @@
+"""DP-sharded pretraining batch samplers
+(ref: apex/transformer/_data/_batchsampler.py:38,102).
+
+Pure-Python index samplers: the TPU input pipeline feeds
+``jnp.asarray(dataset[idx_batch])`` per step, so the samplers stay
+host-side and framework-free. Note: the reference's
+``MegatronPretrainingSampler.__iter__`` accumulates only
+``local_minibatch_size`` indices before rank-slicing, which yields
+empty batches for every rank > 0; this implementation keeps upstream
+Megatron-LM's semantics (accumulate ``local_minibatch_size *
+data_parallel_size``, then slice this rank's span) rather than
+reproduce that bug (SURVEY.md §2.1 "fork quirks" policy).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class _Base:
+    def __len__(self):
+        return self.total_samples
+
+    @property
+    def local_minibatch_size(self) -> int:
+        return self._local_minibatch_size
+
+    @local_minibatch_size.setter
+    def local_minibatch_size(self, new) -> None:
+        self._local_minibatch_size = new
+
+
+class MegatronPretrainingSampler(_Base):
+    """Sequential DP-sharded sampler (ref _batchsampler.py:38-99)."""
+
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 local_minibatch_size: int, data_parallel_rank: int,
+                 data_parallel_size: int, drop_last: bool = True):
+        if total_samples <= 0:
+            raise RuntimeError(f"no sample to consume: {total_samples}")
+        if consumed_samples >= total_samples:
+            raise RuntimeError(
+                f"no samples left to consume: {consumed_samples}, "
+                f"{total_samples}")
+        if local_minibatch_size <= 0:
+            raise RuntimeError(
+                f"local minibatch size must be greater than 0: "
+                f"{local_minibatch_size}")
+        if data_parallel_size <= 0:
+            raise RuntimeError(
+                f"data parallel size must be greater than 0: "
+                f"{data_parallel_size}")
+        if data_parallel_rank >= data_parallel_size:
+            raise RuntimeError(
+                f"data_parallel_rank should be smaller than data size: "
+                f"{data_parallel_rank}, {data_parallel_size}")
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self._local_minibatch_size = local_minibatch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.drop_last = drop_last
+
+    def get_start_end_idx(self):
+        start = self.data_parallel_rank * self.local_minibatch_size
+        return start, start + self.local_minibatch_size
+
+    def __iter__(self):
+        batch = []
+        global_bs = self.local_minibatch_size * self.data_parallel_size
+        for idx in range(self.consumed_samples, self.total_samples):
+            batch.append(idx)
+            if len(batch) == global_bs:
+                s, e = self.get_start_end_idx()
+                yield batch[s:e]
+                batch = []
+        if batch and not self.drop_last:
+            s, e = self.get_start_end_idx()
+            yield batch[s:e]
+
+
+class MegatronPretrainingRandomSampler(_Base):
+    """Shuffled DP-sharded sampler with deterministic per-epoch
+    permutations and exact resume from ``consumed_samples``
+    (ref _batchsampler.py:102-180)."""
+
+    def __init__(self, total_samples: int, consumed_samples: int,
+                 local_minibatch_size: int, data_parallel_rank: int,
+                 data_parallel_size: int, seed: int = 0):
+        if total_samples <= 0:
+            raise ValueError(f"no sample to consume: {total_samples}")
+        if local_minibatch_size <= 0:
+            raise ValueError(
+                f"Invalid local_minibatch_size: {local_minibatch_size}")
+        if data_parallel_size <= 0:
+            raise ValueError(
+                f"Invalid data_parallel_size: {data_parallel_size}")
+        if data_parallel_rank >= data_parallel_size:
+            raise ValueError(
+                f"data_parallel_rank should be smaller than data parallel "
+                f"size: {data_parallel_rank} < {data_parallel_size}")
+        self.total_samples = total_samples
+        self.consumed_samples = consumed_samples
+        self._local_minibatch_size = local_minibatch_size
+        self.data_parallel_rank = data_parallel_rank
+        self.data_parallel_size = data_parallel_size
+        self.seed = seed
+        self.epoch = consumed_samples // total_samples
+
+    def __iter__(self):
+        global_bs = self.local_minibatch_size * self.data_parallel_size
+        # drop the tail so every epoch has whole global batches
+        usable = (self.total_samples // global_bs) * global_bs
+        offset = self.consumed_samples % self.total_samples
+        epoch = self.epoch
+        while True:
+            perm = np.random.RandomState(self.seed + epoch).permutation(
+                self.total_samples)[:usable]
+            for i in range(offset, usable, global_bs):
+                s = i + self.data_parallel_rank * self.local_minibatch_size
+                yield perm[s:s + self.local_minibatch_size].tolist()
+            return  # one epoch per __iter__, like the reference
+
+
+__all__ = ["MegatronPretrainingRandomSampler", "MegatronPretrainingSampler"]

@@ -1,0 +1,4 @@
+"""Test-harness utilities (ref: apex/transformer/testing/)."""
+
+from apex_tpu.transformer.testing import arguments  # noqa: F401
+from apex_tpu.transformer.testing import global_vars  # noqa: F401

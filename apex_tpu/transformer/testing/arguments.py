@@ -1,0 +1,100 @@
+"""Megatron-style argument parser for the test/pretrain harness
+(ref: apex/transformer/testing/arguments.py, 971 LoC — condensed to the
+groups the TPU harness consumes; CUDA-only knobs are dropped, mesh
+knobs added).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def parse_args(extra_args_provider=None, args=None, ignore_unknown_args=True):
+    """Build and parse the harness argument namespace
+    (ref arguments.py parse_args)."""
+    parser = argparse.ArgumentParser(
+        description="apex_tpu test-harness arguments",
+        allow_abbrev=False)
+
+    g = parser.add_argument_group("network size")
+    g.add_argument("--num-layers", type=int, default=2)
+    g.add_argument("--hidden-size", type=int, default=64)
+    g.add_argument("--num-attention-heads", type=int, default=4)
+    g.add_argument("--ffn-hidden-size", type=int, default=None)
+    g.add_argument("--seq-length", type=int, default=32)
+    g.add_argument("--max-position-embeddings", type=int, default=32)
+    g.add_argument("--vocab-size", type=int, default=128)
+
+    g = parser.add_argument_group("regularization")
+    g.add_argument("--attention-dropout", type=float, default=0.0)
+    g.add_argument("--hidden-dropout", type=float, default=0.0)
+    g.add_argument("--weight-decay", type=float, default=0.01)
+    g.add_argument("--clip-grad", type=float, default=1.0)
+
+    g = parser.add_argument_group("training")
+    g.add_argument("--micro-batch-size", type=int, default=2)
+    g.add_argument("--global-batch-size", type=int, default=None)
+    g.add_argument("--rampup-batch-size", nargs=3, type=int, default=None)
+    g.add_argument("--train-iters", type=int, default=10)
+    g.add_argument("--optimizer", default="adam",
+                   choices=["adam", "sgd", "lamb"])
+    g.add_argument("--lr", type=float, default=1e-3)
+    g.add_argument("--min-lr", type=float, default=0.0)
+    g.add_argument("--lr-decay-style", default="constant",
+                   choices=["constant", "linear", "cosine"])
+    g.add_argument("--lr-warmup-iters", type=int, default=0)
+    g.add_argument("--seed", type=int, default=1234)
+
+    g = parser.add_argument_group("mixed precision")
+    g.add_argument("--fp16", action="store_true")
+    g.add_argument("--bf16", action="store_true")
+    g.add_argument("--loss-scale", type=float, default=None,
+                   help="static loss scale; None selects dynamic for fp16")
+    g.add_argument("--initial-loss-scale", type=float, default=2.0 ** 16)
+    g.add_argument("--loss-scale-window", type=int, default=1000)
+
+    g = parser.add_argument_group("distributed (mesh)")
+    g.add_argument("--tensor-model-parallel-size", type=int, default=1)
+    g.add_argument("--pipeline-model-parallel-size", type=int, default=1)
+    g.add_argument("--virtual-pipeline-model-parallel-size", type=int,
+                   default=None)
+    g.add_argument("--context-parallel-size", type=int, default=1)
+    g.add_argument("--expert-model-parallel-size", type=int, default=1)
+    g.add_argument("--sequence-parallel", action="store_true")
+    g.add_argument("--use-cpu-initialization", action="store_true")
+
+    g = parser.add_argument_group("checkpointing")
+    g.add_argument("--save", default=None)
+    g.add_argument("--load", default=None)
+    g.add_argument("--save-interval", type=int, default=None)
+
+    g = parser.add_argument_group("data")
+    g.add_argument("--data-path", default=None)
+    g.add_argument("--split", default="969,30,1")
+    g.add_argument("--num-workers", type=int, default=0)
+
+    g = parser.add_argument_group("logging")
+    g.add_argument("--log-interval", type=int, default=100)
+    g.add_argument("--tensorboard-dir", default=None)
+
+    if extra_args_provider is not None:
+        parser = extra_args_provider(parser)
+
+    if ignore_unknown_args:
+        ns, _ = parser.parse_known_args(args)
+    else:
+        ns = parser.parse_args(args)
+
+    # derived values (ref arguments.py validate_args)
+    if ns.ffn_hidden_size is None:
+        ns.ffn_hidden_size = 4 * ns.hidden_size
+    if ns.global_batch_size is None:
+        ns.global_batch_size = ns.micro_batch_size
+    if ns.fp16 and ns.bf16:
+        raise ValueError("--fp16 and --bf16 are mutually exclusive")
+    ns.params_dtype = "float16" if ns.fp16 else (
+        "bfloat16" if ns.bf16 else "float32")
+    return ns
+
+
+__all__ = ["parse_args"]

@@ -1,0 +1,74 @@
+"""Global singletons for the test/pretrain harness
+(ref: apex/transformer/testing/global_vars.py: args, timers,
+tensorboard writer, autoresume hooks).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from apex_tpu.transformer.pipeline_parallel.utils import Timers
+from apex_tpu.transformer.testing.arguments import parse_args
+
+_GLOBAL_ARGS = None
+_GLOBAL_TIMERS: Optional[Timers] = None
+_GLOBAL_TENSORBOARD_WRITER = None
+_GLOBAL_ADLR_AUTORESUME = None
+
+
+def _ensure(var, name):
+    if var is None:
+        raise RuntimeError(f"{name} is not initialized")
+    return var
+
+
+def get_args():
+    """ref global_vars.py get_args."""
+    return _ensure(_GLOBAL_ARGS, "args")
+
+
+def get_timers() -> Timers:
+    return _ensure(_GLOBAL_TIMERS, "timers")
+
+
+def get_tensorboard_writer():
+    """May be None (only set when a writer was configured),
+    like the reference."""
+    return _GLOBAL_TENSORBOARD_WRITER
+
+
+def get_adlr_autoresume():
+    """ADLR autoresume is a stub in the reference too
+    (ref global_vars.py:75-86)."""
+    return _GLOBAL_ADLR_AUTORESUME
+
+
+def set_global_variables(extra_args_provider=None, args_defaults=None,
+                         ignore_unknown_args=True):
+    """Parse args and build the singletons (ref global_vars.py
+    set_global_variables)."""
+    global _GLOBAL_ARGS, _GLOBAL_TIMERS
+    ns = parse_args(extra_args_provider=extra_args_provider,
+                    ignore_unknown_args=ignore_unknown_args)
+    for k, v in (args_defaults or {}).items():
+        setattr(ns, k, v)
+    _GLOBAL_ARGS = ns
+    _GLOBAL_TIMERS = Timers()
+    return ns
+
+
+def destroy_global_vars():
+    global _GLOBAL_ARGS, _GLOBAL_TIMERS, _GLOBAL_TENSORBOARD_WRITER
+    _GLOBAL_ARGS = None
+    _GLOBAL_TIMERS = None
+    _GLOBAL_TENSORBOARD_WRITER = None
+
+
+__all__ = [
+    "destroy_global_vars",
+    "get_adlr_autoresume",
+    "get_args",
+    "get_tensorboard_writer",
+    "get_timers",
+    "set_global_variables",
+]

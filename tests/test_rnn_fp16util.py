@@ -85,7 +85,7 @@ class TestRNN:
             params, state = opt.step(state, g)
             return params, state, loss
 
-        losses = [None] * 0
+        losses = []
         for _ in range(60):
             params, state, loss = step(params, state)
             losses.append(float(loss))
