@@ -1,0 +1,183 @@
+// Host-side runtime: flat-buffer staging + dtype casts.
+//
+// TPU re-design of the reference's host/C++ runtime pieces:
+//   - apex_C flatten/unflatten of tensor lists
+//     (ref: csrc/flatten_unflatten.cpp — torch's flatten_dense_tensors)
+//   - the host half of the multi-tensor launcher's chunking
+//     (ref: csrc/multi_tensor_apply.cuh:44-147 packs tensor addresses)
+//   - the imagenet example's data prefetcher staging copies
+//     (ref: examples/imagenet/main_amp.py data_prefetcher)
+//
+// On TPU the device-side work belongs to XLA/Pallas; what remains
+// native is exactly this: many small host buffers <-> one aligned
+// buffer (fewer, larger host->device transfers), and fp32<->bf16
+// casting for compressed host staging/checkpoints. All entry points
+// are plain C ABI for ctypes; copies are parallelized across a
+// persistent thread pool.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+class ThreadPool {
+ public:
+  explicit ThreadPool(int n) : stop_(false) {
+    for (int i = 0; i < n; ++i) {
+      workers_.emplace_back([this] {
+        for (;;) {
+          std::function<void()> job;
+          {
+            std::unique_lock<std::mutex> lk(mu_);
+            cv_.wait(lk, [this] { return stop_ || !jobs_.empty(); });
+            if (stop_ && jobs_.empty()) return;
+            job = std::move(jobs_.back());
+            jobs_.pop_back();
+          }
+          job();
+          if (pending_.fetch_sub(1) == 1) {
+            std::lock_guard<std::mutex> lk(done_mu_);
+            done_cv_.notify_all();
+          }
+        }
+      });
+    }
+  }
+
+  ~ThreadPool() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& w : workers_) w.join();
+  }
+
+  void run(std::vector<std::function<void()>> jobs) {
+    pending_.fetch_add(static_cast<int>(jobs.size()));
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      for (auto& j : jobs) jobs_.push_back(std::move(j));
+    }
+    cv_.notify_all();
+    std::unique_lock<std::mutex> lk(done_mu_);
+    done_cv_.wait(lk, [this] { return pending_.load() == 0; });
+  }
+
+ private:
+  std::vector<std::thread> workers_;
+  std::vector<std::function<void()>> jobs_;
+  std::mutex mu_, done_mu_;
+  std::condition_variable cv_, done_cv_;
+  std::atomic<int> pending_{0};
+  bool stop_;
+};
+
+ThreadPool& pool() {
+  static ThreadPool p(
+      std::max(2u, std::thread::hardware_concurrency() / 2));
+  return p;
+}
+
+constexpr int64_t kParallelCutoff = 1 << 20;  // bytes; small jobs stay inline
+
+inline uint16_t f32_to_bf16_rne(uint32_t u) {
+  // round-to-nearest-even truncation; NaN stays NaN
+  if ((u & 0x7fffffffu) > 0x7f800000u) return uint16_t((u >> 16) | 0x40);
+  return uint16_t((u + 0x7fffu + ((u >> 16) & 1u)) >> 16);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Copy n_tensors host buffers into one flat buffer at given byte
+// offsets (the apex_C flatten). srcs[i] -> dst + offsets[i], sizes in
+// bytes. Large copies are split across the pool.
+void apex_flatten(char* dst, const char** srcs, const int64_t* offsets,
+                  const int64_t* sizes, int64_t n_tensors) {
+  std::vector<std::function<void()>> jobs;
+  int64_t total = 0;
+  for (int64_t i = 0; i < n_tensors; ++i) total += sizes[i];
+  if (total < kParallelCutoff) {
+    for (int64_t i = 0; i < n_tensors; ++i)
+      std::memcpy(dst + offsets[i], srcs[i], size_t(sizes[i]));
+    return;
+  }
+  jobs.reserve(size_t(n_tensors));
+  for (int64_t i = 0; i < n_tensors; ++i) {
+    jobs.emplace_back([dst, srcs, offsets, sizes, i] {
+      std::memcpy(dst + offsets[i], srcs[i], size_t(sizes[i]));
+    });
+  }
+  pool().run(std::move(jobs));
+}
+
+// The inverse (apex_C unflatten): flat buffer -> n_tensors buffers.
+void apex_unflatten(const char* src, char** dsts, const int64_t* offsets,
+                    const int64_t* sizes, int64_t n_tensors) {
+  int64_t total = 0;
+  for (int64_t i = 0; i < n_tensors; ++i) total += sizes[i];
+  if (total < kParallelCutoff) {
+    for (int64_t i = 0; i < n_tensors; ++i)
+      std::memcpy(dsts[i], src + offsets[i], size_t(sizes[i]));
+    return;
+  }
+  std::vector<std::function<void()>> jobs;
+  jobs.reserve(size_t(n_tensors));
+  for (int64_t i = 0; i < n_tensors; ++i) {
+    jobs.emplace_back([src, dsts, offsets, sizes, i] {
+      std::memcpy(dsts[i], src + offsets[i], size_t(sizes[i]));
+    });
+  }
+  pool().run(std::move(jobs));
+}
+
+// fp32 -> bf16 with round-to-nearest-even, parallelized.
+void apex_cast_f32_bf16(const uint32_t* src, uint16_t* dst, int64_t n) {
+  auto body = [src, dst](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) dst[i] = f32_to_bf16_rne(src[i]);
+  };
+  if (n * 4 < kParallelCutoff) {
+    body(0, n);
+    return;
+  }
+  int shards = int(std::max(2u, std::thread::hardware_concurrency() / 2));
+  int64_t step = (n + shards - 1) / shards;
+  std::vector<std::function<void()>> jobs;
+  for (int64_t lo = 0; lo < n; lo += step) {
+    int64_t hi = std::min(n, lo + step);
+    jobs.emplace_back([body, lo, hi] { body(lo, hi); });
+  }
+  pool().run(std::move(jobs));
+}
+
+// bf16 -> fp32 (exact), parallelized.
+void apex_cast_bf16_f32(const uint16_t* src, uint32_t* dst, int64_t n) {
+  auto body = [src, dst](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      dst[i] = uint32_t(src[i]) << 16;
+  };
+  if (n * 2 < kParallelCutoff) {
+    body(0, n);
+    return;
+  }
+  int shards = int(std::max(2u, std::thread::hardware_concurrency() / 2));
+  int64_t step = (n + shards - 1) / shards;
+  std::vector<std::function<void()>> jobs;
+  for (int64_t lo = 0; lo < n; lo += step) {
+    int64_t hi = std::min(n, lo + step);
+    jobs.emplace_back([body, lo, hi] { body(lo, hi); });
+  }
+  pool().run(std::move(jobs));
+}
+
+int apex_host_runtime_abi_version() { return 1; }
+
+}  // extern "C"

@@ -1,0 +1,295 @@
+"""Native host runtime: flat staging buffers + prefetching input pipeline.
+
+The reference's host-side C++ runtime maps here (SURVEY.md §2.1/§2.8):
+
+  - ``HostFlatSpace.flatten/unflatten`` — apex_C's tensor-list
+    flatten/unflatten (ref: csrc/flatten_unflatten.cpp), backed by the
+    C++ thread-pool library in apex_tpu/csrc/host_runtime.cpp. One
+    aligned buffer per transfer instead of hundreds of small ones.
+  - ``cast_f32_bf16 / cast_bf16_f32`` — parallel host casts for
+    compressed staging/checkpoints (the host analog of the e5m2
+    compressed-allgather option, ref distributed_fused_lamb.py:83-91).
+  - ``PrefetchLoader`` — background-thread host->device pipeline (the
+    TPU analog of the CUDA-stream data_prefetcher in
+    ref examples/imagenet/main_amp.py:256-300): while the device runs
+    step N, worker threads stage and ``jax.device_put`` batch N+1.
+
+The C++ library is compiled on first use with g++ (cached under
+``apex_tpu/_build``); every entry point falls back to numpy when the
+toolchain is unavailable, so behavior is identical either way.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import queue
+import subprocess
+import threading
+from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
+
+import numpy as np
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_HERE, "..", "csrc", "host_runtime.cpp")
+_BUILD_DIR = os.path.join(_HERE, "..", "_build")
+_LIB_PATH = os.path.join(_BUILD_DIR, "libapex_host_runtime.so")
+
+_lib = None
+_lib_tried = False
+
+
+def _load_library():
+    """Compile (once) and dlopen the native library; None on failure."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    try:
+        if not os.path.exists(_LIB_PATH) or (
+            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+        ):
+            os.makedirs(_BUILD_DIR, exist_ok=True)
+            subprocess.run(
+                ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                 "-pthread", _SRC, "-o", _LIB_PATH],
+                check=True, capture_output=True)
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.apex_host_runtime_abi_version.restype = ctypes.c_int
+        if lib.apex_host_runtime_abi_version() != 1:
+            return None
+        lib.apex_flatten.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64]
+        lib.apex_unflatten.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int64),
+            ctypes.c_int64]
+        lib.apex_cast_f32_bf16.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        lib.apex_cast_bf16_f32.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int64]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def native_available() -> bool:
+    return _load_library() is not None
+
+
+def _as_c_buffers(arrays: Sequence[np.ndarray]):
+    ptrs = (ctypes.c_char_p * len(arrays))()
+    for i, a in enumerate(arrays):
+        ptrs[i] = ctypes.cast(a.ctypes.data, ctypes.c_char_p)
+    return ptrs
+
+
+class HostFlatSpace:
+    """Static layout of N host arrays in one aligned byte buffer
+    (the host mirror of apex_tpu.multi_tensor.FlatSpace; alignment in
+    bytes, default 128 to match lane tiling on the device side)."""
+
+    def __init__(self, shapes: Sequence[tuple], dtypes: Sequence[Any],
+                 align: int = 128):
+        self.shapes = [tuple(s) for s in shapes]
+        self.dtypes = [np.dtype(d) for d in dtypes]
+        self.align = align
+        self.offsets, self.nbytes = [], []
+        off = 0
+        for s, d in zip(self.shapes, self.dtypes):
+            n = int(np.prod(s, dtype=np.int64)) * d.itemsize if s else d.itemsize
+            self.offsets.append(off)
+            self.nbytes.append(n)
+            off += ((n + align - 1) // align) * align
+        self.total_bytes = off
+
+    @classmethod
+    def for_arrays(cls, arrays: Sequence[np.ndarray],
+                   align: int = 128) -> "HostFlatSpace":
+        return cls([a.shape for a in arrays], [a.dtype for a in arrays],
+                   align)
+
+    def _check(self, arrays):
+        if len(arrays) != len(self.shapes):
+            raise ValueError(
+                f"expected {len(self.shapes)} arrays, got {len(arrays)}")
+        for a, s, d in zip(arrays, self.shapes, self.dtypes):
+            if a.size != int(np.prod(s, dtype=np.int64)) or a.dtype != d:
+                raise ValueError(
+                    f"array {a.shape}/{a.dtype} does not match layout "
+                    f"{s}/{d}")
+
+    def flatten(self, arrays: Sequence[np.ndarray],
+                out: Optional[np.ndarray] = None) -> np.ndarray:
+        """arrays -> one uint8 buffer (ref apex_C flatten)."""
+        # note: ascontiguousarray promotes 0-d to 1-d, hence the
+        # size-based (not shape-based) layout check
+        arrays = [np.ascontiguousarray(a) for a in arrays]
+        self._check(arrays)
+        if out is None:
+            out = np.zeros(self.total_bytes, np.uint8)
+        elif (out.dtype != np.uint8 or out.size != self.total_bytes
+              or not out.flags.c_contiguous):
+            raise ValueError(
+                f"out must be a contiguous uint8 buffer of "
+                f"{self.total_bytes} bytes")
+        lib = _load_library()
+        if lib is not None:
+            offs = (ctypes.c_int64 * len(arrays))(*self.offsets)
+            szs = (ctypes.c_int64 * len(arrays))(*self.nbytes)
+            lib.apex_flatten(
+                ctypes.cast(out.ctypes.data, ctypes.c_char_p),
+                _as_c_buffers(arrays), offs, szs, len(arrays))
+        else:
+            for a, off, n in zip(arrays, self.offsets, self.nbytes):
+                out[off:off + n] = a.reshape(-1).view(np.uint8)
+        return out
+
+    def unflatten(self, buf: np.ndarray) -> list:
+        """One uint8 buffer -> list of arrays (ref apex_C unflatten)."""
+        buf = np.ascontiguousarray(buf).view(np.uint8).reshape(-1)
+        if buf.size != self.total_bytes:
+            raise ValueError(
+                f"buffer has {buf.size} bytes, layout needs "
+                f"{self.total_bytes}")
+        outs = [np.empty(s, d) for s, d in zip(self.shapes, self.dtypes)]
+        lib = _load_library()
+        if lib is not None:
+            offs = (ctypes.c_int64 * len(outs))(*self.offsets)
+            szs = (ctypes.c_int64 * len(outs))(*self.nbytes)
+            lib.apex_unflatten(
+                ctypes.cast(buf.ctypes.data, ctypes.c_char_p),
+                _as_c_buffers(outs), offs, szs, len(outs))
+        else:
+            for o, off, n in zip(outs, self.offsets, self.nbytes):
+                o.reshape(-1).view(np.uint8)[:] = buf[off:off + n]
+        return outs
+
+
+def cast_f32_bf16(x: np.ndarray) -> np.ndarray:
+    """fp32 -> bf16 bits (uint16 view) with round-to-nearest-even."""
+    x = np.ascontiguousarray(x, np.float32)
+    out = np.empty(x.shape, np.uint16)
+    lib = _load_library()
+    if lib is not None:
+        lib.apex_cast_f32_bf16(x.ctypes.data, out.ctypes.data, x.size)
+    else:
+        u = x.view(np.uint32)
+        nan = (u & 0x7FFFFFFF) > 0x7F800000
+        r = ((u.astype(np.uint64) + 0x7FFF + ((u >> 16) & 1)) >> 16)
+        r = r.astype(np.uint32)
+        r[nan] = (u[nan] >> 16) | 0x40
+        out[...] = r.astype(np.uint16)
+    try:
+        import ml_dtypes
+        return out.view(ml_dtypes.bfloat16)
+    except ImportError:  # raw bits still round-trip via cast_bf16_f32
+        return out
+
+
+def cast_bf16_f32(x: np.ndarray) -> np.ndarray:
+    """bf16 (or its uint16 bit view) -> fp32, exact."""
+    bits = np.ascontiguousarray(x).view(np.uint16)
+    out = np.empty(bits.shape, np.float32)
+    lib = _load_library()
+    if lib is not None:
+        lib.apex_cast_bf16_f32(bits.ctypes.data, out.ctypes.data, bits.size)
+    else:
+        out.view(np.uint32)[...] = bits.astype(np.uint32) << 16
+    return out
+
+
+class PrefetchLoader:
+    """Background host->device pipeline (ref examples/imagenet
+    main_amp.py data_prefetcher: CUDA-stream prefetch -> worker thread
+    + async ``jax.device_put``).
+
+    Wraps an iterable of numpy batches (pytrees ok). ``depth`` batches
+    are staged ahead: while the device computes step N, the worker
+    stages/transfers N+1..N+depth. Optional ``transform`` runs on the
+    worker thread (host-side augmentation/cast).
+    """
+
+    def __init__(self, batches: Iterable, depth: int = 2,
+                 transform: Optional[Callable] = None, device=None):
+        self._batches = batches
+        self._depth = depth
+        self._transform = transform
+        self._device = device
+        self._consumed = False
+
+    def __iter__(self) -> Iterator:
+        # eager check (a generator body would defer it to first next())
+        if self._consumed:
+            raise RuntimeError(
+                "PrefetchLoader is single-pass: wrap a fresh iterable "
+                "per epoch (two concurrent workers on one source would "
+                "race and drop batches)")
+        self._consumed = True
+        return self._run()
+
+    def _run(self) -> Iterator:
+        import jax
+
+        q: "queue.Queue" = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+        END = object()
+
+        def put(item) -> bool:
+            """Enqueue, backing off so the worker notices a stopped
+            consumer instead of blocking on a full queue forever."""
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def worker():
+            try:
+                for b in self._batches:
+                    if stop.is_set():
+                        return
+                    if self._transform is not None:
+                        b = self._transform(b)
+                    b = jax.tree.map(
+                        lambda a: jax.device_put(a, self._device), b)
+                    if not put(b):
+                        return
+                put(END)
+            except BaseException as e:  # propagate to the consumer
+                put(e)
+
+        t = threading.Thread(target=worker, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is END:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            # consumer stopped (exhausted, errored, or abandoned):
+            # release the worker and its staged device batches
+            stop.set()
+            try:
+                while True:
+                    q.get_nowait()
+            except queue.Empty:
+                pass
+            t.join()
+
+
+__all__ = [
+    "HostFlatSpace",
+    "PrefetchLoader",
+    "cast_bf16_f32",
+    "cast_f32_bf16",
+    "native_available",
+]

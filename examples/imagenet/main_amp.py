@@ -90,11 +90,24 @@ def main(argv=None):
     model = ResNet(cfg)
 
     # synthetic imagenet-shaped data (the reference's folder pipeline
-    # feeds the same shapes)
+    # feeds the same shapes), staged through the native prefetch
+    # pipeline (ref main_amp.py data_prefetcher)
+    from apex_tpu.runtime import PrefetchLoader
+
     rng = np.random.RandomState(0)
-    x = jnp.asarray(rng.rand(args.batch_size, size, size, 3), jnp.float32)
-    y = jnp.asarray(rng.randint(0, cfg.num_classes, args.batch_size),
-                    jnp.int32)
+
+    def batches():
+        while True:
+            yield {
+                "x": rng.rand(args.batch_size, size, size, 3).astype(
+                    np.float32),
+                "y": rng.randint(0, cfg.num_classes,
+                                 args.batch_size).astype(np.int32),
+            }
+
+    loader = iter(PrefetchLoader(batches(), depth=2))
+    first = next(loader)
+    x, y = first["x"], first["y"]
 
     variables = model.init(jax.random.PRNGKey(0), x[:2], train=True)
     params, batch_stats = variables["params"], variables.get(
@@ -140,6 +153,8 @@ def main(argv=None):
 
     t0 = time.perf_counter()
     for i in range(start_step, args.steps):
+        batch = next(loader)
+        x, y = batch["x"], batch["y"]
         ctx = (jax.profiler.StepTraceAnnotation("train", step_num=i)
                if args.profile_dir else _null())
         with ctx:
