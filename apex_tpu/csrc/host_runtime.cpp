@@ -20,6 +20,7 @@
 #include <cstdint>
 #include <cstring>
 #include <functional>
+#include <memory>
 #include <mutex>
 #include <thread>
 #include <vector>
@@ -27,6 +28,15 @@
 namespace {
 
 class ThreadPool {
+  // Completion is tracked per run() batch (not globally) so concurrent
+  // callers — e.g. the prefetch worker casting while the main thread
+  // flattens — only wait for their own jobs.
+  struct Batch {
+    std::mutex mu;
+    std::condition_variable cv;
+    int remaining;
+  };
+
  public:
   explicit ThreadPool(int n) : stop_(false) {
     for (int i = 0; i < n; ++i) {
@@ -41,10 +51,6 @@ class ThreadPool {
             jobs_.pop_back();
           }
           job();
-          if (pending_.fetch_sub(1) == 1) {
-            std::lock_guard<std::mutex> lk(done_mu_);
-            done_cv_.notify_all();
-          }
         }
       });
     }
@@ -60,22 +66,28 @@ class ThreadPool {
   }
 
   void run(std::vector<std::function<void()>> jobs) {
-    pending_.fetch_add(static_cast<int>(jobs.size()));
+    auto batch = std::make_shared<Batch>();
+    batch->remaining = static_cast<int>(jobs.size());
     {
       std::lock_guard<std::mutex> lk(mu_);
-      for (auto& j : jobs) jobs_.push_back(std::move(j));
+      for (auto& j : jobs) {
+        jobs_.push_back([batch, job = std::move(j)] {
+          job();
+          std::lock_guard<std::mutex> lk(batch->mu);
+          if (--batch->remaining == 0) batch->cv.notify_all();
+        });
+      }
     }
     cv_.notify_all();
-    std::unique_lock<std::mutex> lk(done_mu_);
-    done_cv_.wait(lk, [this] { return pending_.load() == 0; });
+    std::unique_lock<std::mutex> lk(batch->mu);
+    batch->cv.wait(lk, [&] { return batch->remaining == 0; });
   }
 
  private:
   std::vector<std::thread> workers_;
   std::vector<std::function<void()>> jobs_;
-  std::mutex mu_, done_mu_;
-  std::condition_variable cv_, done_cv_;
-  std::atomic<int> pending_{0};
+  std::mutex mu_;
+  std::condition_variable cv_;
   bool stop_;
 };
 

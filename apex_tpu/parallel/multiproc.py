@@ -1,0 +1,71 @@
+"""Multi-host bootstrap (ref: apex/parallel/multiproc.py — the
+pre-torchrun one-process-per-GPU launcher).
+
+On TPU the per-device process model disappears: one Python process per
+host drives all local chips, and SPMD partitioning replaces per-rank
+scripts. What remains of the launcher is cluster bootstrap, which JAX
+provides via ``jax.distributed.initialize``; this module wraps it with
+the reference launcher's env-var conventions so launch tooling can
+stay the same.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Connect this host into the cluster.
+
+    Falls back to the reference launcher's environment variables
+    (MASTER_ADDR/MASTER_PORT, WORLD_SIZE, RANK) when arguments are not
+    given; single-host runs (no env, no args) are a no-op.
+    """
+    import jax
+
+    if coordinator_address is None:
+        addr = os.environ.get("MASTER_ADDR")
+        port = os.environ.get("MASTER_PORT", "12355")
+        coordinator_address = f"{addr}:{port}" if addr else None
+    if num_processes is None and "WORLD_SIZE" in os.environ:
+        num_processes = int(os.environ["WORLD_SIZE"])
+    if process_id is None and "RANK" in os.environ:
+        process_id = int(os.environ["RANK"])
+
+    if coordinator_address is None or num_processes in (None, 1):
+        return  # single host
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+
+
+def local_rank() -> int:
+    """ref launcher's --local_rank was the per-node device index; with
+    one JAX process driving all local chips it is always 0 (use
+    ``jax.local_devices()`` for per-chip work)."""
+    return 0
+
+
+def process_index() -> int:
+    """Global rank of this host's process (the reference's RANK)."""
+    import jax
+
+    return jax.process_index()
+
+
+def world_size() -> int:
+    import jax
+
+    return jax.process_count()
+
+
+__all__ = ["initialize_distributed", "local_rank", "process_index",
+           "world_size"]

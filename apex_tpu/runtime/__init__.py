@@ -32,11 +32,23 @@ import numpy as np
 
 _HERE = os.path.dirname(os.path.abspath(__file__))
 _SRC = os.path.join(_HERE, "..", "csrc", "host_runtime.cpp")
-_BUILD_DIR = os.path.join(_HERE, "..", "_build")
-_LIB_PATH = os.path.join(_BUILD_DIR, "libapex_host_runtime.so")
 
 _lib = None
 _lib_tried = False
+
+
+def _build_dir() -> str:
+    """Writable cache dir: APEX_TPU_BUILD_DIR env override, the package
+    tree when writable, else ~/.cache/apex_tpu (read-only installs)."""
+    env = os.environ.get("APEX_TPU_BUILD_DIR")
+    if env:
+        return env
+    pkg = os.path.join(_HERE, "..", "_build")
+    parent = os.path.dirname(pkg)
+    if os.access(parent, os.W_OK):
+        return pkg
+    return os.path.join(
+        os.path.expanduser("~"), ".cache", "apex_tpu", "_build")
 
 
 def _load_library():
@@ -46,15 +58,22 @@ def _load_library():
         return _lib
     _lib_tried = True
     try:
-        if not os.path.exists(_LIB_PATH) or (
-            os.path.getmtime(_LIB_PATH) < os.path.getmtime(_SRC)
+        build_dir = _build_dir()
+        lib_path = os.path.join(build_dir, "libapex_host_runtime.so")
+        if not os.path.exists(lib_path) or (
+            os.path.getmtime(lib_path) < os.path.getmtime(_SRC)
         ):
-            os.makedirs(_BUILD_DIR, exist_ok=True)
+            os.makedirs(build_dir, exist_ok=True)
+            # compile to a process-unique temp path, then atomically
+            # rename — concurrent builders can't serve each other a
+            # half-written ELF
+            tmp = f"{lib_path}.{os.getpid()}.tmp"
             subprocess.run(
                 ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                 "-pthread", _SRC, "-o", _LIB_PATH],
+                 "-pthread", _SRC, "-o", tmp],
                 check=True, capture_output=True)
-        lib = ctypes.CDLL(_LIB_PATH)
+            os.replace(tmp, lib_path)
+        lib = ctypes.CDLL(lib_path)
         lib.apex_host_runtime_abi_version.restype = ctypes.c_int
         if lib.apex_host_runtime_abi_version() != 1:
             return None
@@ -117,7 +136,12 @@ class HostFlatSpace:
             raise ValueError(
                 f"expected {len(self.shapes)} arrays, got {len(arrays)}")
         for a, s, d in zip(arrays, self.shapes, self.dtypes):
-            if a.size != int(np.prod(s, dtype=np.int64)) or a.dtype != d:
+            # ascontiguousarray promotes 0-d to (1,): size-1 arrays only
+            # need the size to agree; everything else matches shape
+            # exactly (equal-size wrong shapes would scramble data)
+            ok = (tuple(a.shape) == s
+                  or (a.size == 1 and int(np.prod(s, dtype=np.int64)) == 1))
+            if not ok or a.dtype != d:
                 raise ValueError(
                     f"array {a.shape}/{a.dtype} does not match layout "
                     f"{s}/{d}")
@@ -170,24 +194,16 @@ class HostFlatSpace:
 
 
 def cast_f32_bf16(x: np.ndarray) -> np.ndarray:
-    """fp32 -> bf16 bits (uint16 view) with round-to-nearest-even."""
+    """fp32 -> bf16 with round-to-nearest-even."""
+    import ml_dtypes  # a hard dependency of jax, always present
+
     x = np.ascontiguousarray(x, np.float32)
-    out = np.empty(x.shape, np.uint16)
     lib = _load_library()
-    if lib is not None:
-        lib.apex_cast_f32_bf16(x.ctypes.data, out.ctypes.data, x.size)
-    else:
-        u = x.view(np.uint32)
-        nan = (u & 0x7FFFFFFF) > 0x7F800000
-        r = ((u.astype(np.uint64) + 0x7FFF + ((u >> 16) & 1)) >> 16)
-        r = r.astype(np.uint32)
-        r[nan] = (u[nan] >> 16) | 0x40
-        out[...] = r.astype(np.uint16)
-    try:
-        import ml_dtypes
-        return out.view(ml_dtypes.bfloat16)
-    except ImportError:  # raw bits still round-trip via cast_bf16_f32
-        return out
+    if lib is None:
+        return x.astype(ml_dtypes.bfloat16)
+    out = np.empty(x.shape, np.uint16)
+    lib.apex_cast_f32_bf16(x.ctypes.data, out.ctypes.data, x.size)
+    return out.view(ml_dtypes.bfloat16)
 
 
 def cast_bf16_f32(x: np.ndarray) -> np.ndarray:

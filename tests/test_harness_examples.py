@@ -187,3 +187,21 @@ class TestExamples:
         lD, lG = ex.main(["--steps", "4", "--batch-size", "8",
                           "--image-size", "16"])
         assert np.isfinite(lD) and np.isfinite(lG)
+
+
+class TestMultiproc:
+    def test_single_host_noop(self, monkeypatch):
+        from apex_tpu.parallel import multiproc
+        for var in ("MASTER_ADDR", "WORLD_SIZE", "RANK"):
+            monkeypatch.delenv(var, raising=False)
+        multiproc.initialize_distributed()  # no cluster env: no-op
+        assert multiproc.local_rank() == 0
+        assert multiproc.world_size() == 1
+
+    def test_world_size_one_noop(self, monkeypatch):
+        from apex_tpu.parallel import multiproc
+        monkeypatch.setenv("MASTER_ADDR", "127.0.0.1")
+        monkeypatch.setenv("WORLD_SIZE", "1")
+        monkeypatch.setenv("RANK", "0")
+        multiproc.initialize_distributed()  # world of 1: no-op
+        assert multiproc.world_size() == 1
