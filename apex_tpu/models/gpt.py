@@ -52,6 +52,11 @@ class GPTConfig:
     softmax_impl: Optional[str] = None
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
+    # "softmax" (fused masked softmax), "flash" (Pallas flash kernel),
+    # or "ring" (context-parallel ring attention over the "context"
+    # axis — run the model inside shard_map with tokens sharded along
+    # seq and pass global `positions`)
+    attention_backend: str = "softmax"
 
     @property
     def ffn(self) -> int:
@@ -72,7 +77,7 @@ class ParallelAttention(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, deterministic=True):
+    def __call__(self, x, *, positions=None, deterministic=True):
         cfg = self.config
         h = cfg.hidden_size
         inside = _inside_axis(TENSOR_AXIS)
@@ -89,6 +94,28 @@ class ParallelAttention(nn.Module):
         s, b = qkv.shape[0], qkv.shape[1]
         qkv = qkv.reshape(s, b, heads_local, 3 * head_dim)
         q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        if cfg.attention_backend in ("flash", "ring"):
+            # (s, b, hl, d) -> (b, hl, s, d)
+            qb, kb, vb = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+            if cfg.attention_backend == "ring":
+                from apex_tpu.transformer.context_parallel import (
+                    ring_attention,
+                )
+                ctx = ring_attention(
+                    qb, kb, vb, causal=True,
+                    q_positions=positions, kv_positions=positions)
+            else:
+                from apex_tpu.ops.attention import flash_attention
+                ctx = flash_attention(qb, kb, vb, causal=True,
+                                      impl=cfg.softmax_impl)
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(
+                s, b, heads_local * head_dim)
+            return RowParallelLinear(
+                output_size=h, input_is_parallel=True,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
+            )(ctx)
 
         # (b*heads, s, d)
         def to_bhsd(t):
@@ -147,11 +174,11 @@ class GPTLayer(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, deterministic=True):
+    def __call__(self, x, *, positions=None, deterministic=True):
         cfg = self.config
         a = ParallelAttention(cfg, name="attention")(
             FusedLayerNorm(cfg.hidden_size, name="input_norm")(x),
-            deterministic=deterministic,
+            positions=positions, deterministic=deterministic,
         )
         if cfg.hidden_dropout > 0.0 and not deterministic:
             a = nn.Dropout(rate=cfg.hidden_dropout)(a, deterministic=False)
@@ -171,7 +198,10 @@ class GPTModel(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, tokens, *, deterministic=True):
+    def __call__(self, tokens, *, positions=None, deterministic=True):
+        """``positions`` (s,) int32 are the *global* token positions of
+        this shard — pass them when the sequence is context-sharded
+        (attention_backend="ring"); defaults to arange(s)."""
         cfg = self.config
         b, s = tokens.shape
         emb = VocabParallelEmbedding(
@@ -184,7 +214,11 @@ class GPTModel(nn.Module):
             nn.initializers.normal(stddev=0.02),
             (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype,
         )
-        x = x + pos[:s][None, :, :].astype(cfg.dtype)
+        if positions is None:
+            pos_emb = pos[:s]
+        else:
+            pos_emb = jnp.take(pos, positions, axis=0)
+        x = x + pos_emb[None, :, :].astype(cfg.dtype)
         x = x.transpose(1, 0, 2)                          # (s, b, h)
 
         if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
@@ -194,7 +228,8 @@ class GPTModel(nn.Module):
             x = scatter_to_sequence_parallel_region(x)
 
         for i in range(cfg.num_layers):
-            x = GPTLayer(cfg, name=f"layer_{i}")(x, deterministic=deterministic)
+            x = GPTLayer(cfg, name=f"layer_{i}")(
+                x, positions=positions, deterministic=deterministic)
         x = FusedLayerNorm(cfg.hidden_size, name="final_norm")(x)
 
         if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):

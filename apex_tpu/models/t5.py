@@ -1,0 +1,298 @@
+"""Megatron-style encoder-decoder LM — third model family.
+
+TPU re-design of the reference's encoder-decoder support
+(ref: apex/transformer/testing/standalone_transformer_lm.py:
+ParallelAttention with AttnType.cross_attn (:358-583),
+ParallelTransformerLayer with LayerType.decoder (:598-778),
+get_language_model(add_decoder=True) (:1167-1206); pipeline split rank
+parallel_state.py:178-180,423-460). Architecture: shared vocab
+embedding, bidirectional encoder over padding masks, decoder with
+causal self-attention + cross-attention into the encoder output, tied
+LM head, vocab-parallel CE — a T5/Megatron-enc-dec shape built from
+the same apex_tpu parallel layers as GPT/BERT, so dense, TP, and
+TP+SP-on-the-encoder all come from one definition.
+
+With pipeline parallelism the stage split follows the reference's
+``pipeline_model_parallel_split_rank``: encoder layers occupy stages
+[0, split) and decoder layers [split, pp) — the per-stage layer counts
+are computed by :func:`encoder_decoder_stage_layout`.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.transformer.enums import AttnMaskType, AttnType
+from apex_tpu.transformer.functional import FusedScaleMaskSoftmax
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel import (
+    ColumnParallelLinear,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    vocab_parallel_cross_entropy,
+)
+from apex_tpu.transformer.tensor_parallel.layers import _inside_axis
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    max_seq_len: int = 512
+    hidden_size: int = 768
+    num_encoder_layers: int = 12
+    num_decoder_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden_size: Optional[int] = None
+    dtype: Any = jnp.bfloat16
+    param_dtype: Any = jnp.float32
+    softmax_impl: Optional[str] = None
+
+    @property
+    def ffn(self) -> int:
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+
+def encoder_decoder_stage_layout(
+    num_encoder_layers: int,
+    num_decoder_layers: int,
+    pipeline_size: int,
+    split_rank: int,
+) -> Tuple[Tuple[str, int], ...]:
+    """Per-stage (kind, n_layers) for enc-dec pipelining (ref
+    parallel_state.py:423-460 + get_num_layers,
+    standalone_transformer_lm.py:1038-1096): encoder on stages
+    [0, split_rank), decoder on [split_rank, pp)."""
+    if not (0 < split_rank < pipeline_size):
+        raise ValueError(
+            f"split_rank {split_rank} must be inside (0, {pipeline_size})")
+    if num_encoder_layers % split_rank:
+        raise ValueError("encoder layers must divide encoder stages")
+    if num_decoder_layers % (pipeline_size - split_rank):
+        raise ValueError("decoder layers must divide decoder stages")
+    enc_per = num_encoder_layers // split_rank
+    dec_per = num_decoder_layers // (pipeline_size - split_rank)
+    return tuple(
+        ("encoder", enc_per) if s < split_rank else ("decoder", dec_per)
+        for s in range(pipeline_size))
+
+
+class _Attention(nn.Module):
+    """Self or cross parallel attention (ref ParallelAttention,
+    standalone_transformer_lm.py:358-583): column-parallel projections,
+    fused masked softmax, row-parallel output."""
+
+    config: T5Config
+    attn_type: Any = AttnType.self_attn
+    mask_type: Any = AttnMaskType.padding
+
+    @nn.compact
+    def __call__(self, x, kv_source=None, mask=None):
+        cfg = self.config
+        h = cfg.hidden_size
+        inside = _inside_axis(TENSOR_AXIS)
+        tp = lax.axis_size(TENSOR_AXIS) if inside else 1
+        heads_local = cfg.num_heads // tp
+        head_dim = h // cfg.num_heads
+
+        if self.attn_type == AttnType.self_attn:
+            qkv = ColumnParallelLinear(
+                output_size=3 * h, gather_output=False,
+                param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="qkv",
+            )(x)
+            sq, b = qkv.shape[0], qkv.shape[1]
+            qkv = qkv.reshape(sq, b, heads_local, 3 * head_dim)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            sk = sq
+        else:
+            # cross attention: Q from decoder hidden, KV from encoder
+            # output (ref :406-421 separate query/key_value projections)
+            q = ColumnParallelLinear(
+                output_size=h, gather_output=False,
+                param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="q",
+            )(x)
+            kv = ColumnParallelLinear(
+                output_size=2 * h, gather_output=False,
+                param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="kv",
+            )(kv_source)
+            sq, b = q.shape[0], q.shape[1]
+            sk = kv.shape[0]
+            q = q.reshape(sq, b, heads_local, head_dim)
+            kv = kv.reshape(sk, b, heads_local, 2 * head_dim)
+            k, v = jnp.split(kv, 2, axis=-1)
+
+        def to_bhsd(t, s):
+            return t.transpose(1, 2, 0, 3).reshape(b * heads_local, s,
+                                                   head_dim)
+
+        q, k, v = to_bhsd(q, sq), to_bhsd(k, sk), to_bhsd(v, sk)
+        scores = jnp.einsum(
+            "bsd,btd->bst", q, k, preferred_element_type=jnp.float32
+        ) / jnp.sqrt(head_dim).astype(jnp.float32)
+        probs = FusedScaleMaskSoftmax(
+            attn_mask_type=self.mask_type, impl=cfg.softmax_impl
+        )(scores.reshape(b, heads_local, sq, sk).astype(cfg.dtype),
+          mask=mask)
+        ctx = jnp.einsum(
+            "bhst,bhtd->bhsd", probs,
+            v.reshape(b, heads_local, sk, head_dim),
+            preferred_element_type=jnp.float32,
+        ).astype(cfg.dtype)
+        ctx = ctx.transpose(2, 0, 1, 3).reshape(sq, b,
+                                                heads_local * head_dim)
+        return RowParallelLinear(
+            output_size=h, input_is_parallel=True,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
+        )(ctx)
+
+
+class _MLP(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        y = ColumnParallelLinear(
+            output_size=cfg.ffn, gather_output=False,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="fc1",
+        )(x)
+        y = jax.nn.gelu(y, approximate=True)
+        return RowParallelLinear(
+            output_size=cfg.hidden_size, input_is_parallel=True,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="fc2",
+        )(y)
+
+
+class EncoderLayer(nn.Module):
+    """Pre-LN: bidirectional self-attn + MLP (ref
+    ParallelTransformerLayer with LayerType.encoder)."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, enc_mask):
+        cfg = self.config
+        x = x + _Attention(cfg, AttnType.self_attn, AttnMaskType.padding,
+                           name="self_attention")(
+            FusedLayerNorm(cfg.hidden_size, name="input_norm")(x),
+            mask=enc_mask)
+        x = x + _MLP(cfg, name="mlp")(
+            FusedLayerNorm(cfg.hidden_size, name="post_norm")(x))
+        return x
+
+
+class DecoderLayer(nn.Module):
+    """Pre-LN: causal self-attn + cross-attn + MLP (ref
+    ParallelTransformerLayer with LayerType.decoder, :690-778)."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, x, enc_out, cross_mask):
+        cfg = self.config
+        x = x + _Attention(cfg, AttnType.self_attn, AttnMaskType.causal,
+                           name="self_attention")(
+            FusedLayerNorm(cfg.hidden_size, name="input_norm")(x))
+        x = x + _Attention(cfg, AttnType.cross_attn, AttnMaskType.padding,
+                           name="inter_attention")(
+            FusedLayerNorm(cfg.hidden_size, name="post_attn_norm")(x),
+            kv_source=enc_out, mask=cross_mask)
+        x = x + _MLP(cfg, name="mlp")(
+            FusedLayerNorm(cfg.hidden_size, name="post_norm")(x))
+        return x
+
+
+class T5Model(nn.Module):
+    """Encoder-decoder LM. Inputs: encoder tokens (b, s_enc) + keep
+    mask (b, s_enc), decoder tokens (b, s_dec). Returns vocab[/tp]
+    logits (s_dec, b, v) in Megatron sbh convention."""
+
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, enc_tokens, enc_mask, dec_tokens):
+        cfg = self.config
+        b, s_enc = enc_tokens.shape
+        s_dec = dec_tokens.shape[1]
+
+        emb = VocabParallelEmbedding(
+            num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
+            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="embedding",
+        )
+        pos = self.param(
+            "position_embedding", nn.initializers.normal(stddev=0.02),
+            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype,
+        )
+
+        # (b, 1, sq, sk) True = masked
+        m = enc_mask.astype(jnp.float32)
+        enc_attn_mask = (m[:, None, :] * m[:, :, None] < 0.5)[:, None]
+        cross_mask = (m[:, None, :] < 0.5)[:, None].repeat(s_dec, axis=2)
+
+        x = emb(enc_tokens) + pos[:s_enc][None].astype(cfg.dtype)
+        x = x.transpose(1, 0, 2)
+        for i in range(cfg.num_encoder_layers):
+            x = EncoderLayer(cfg, name=f"encoder_{i}")(x, enc_attn_mask)
+        enc_out = FusedLayerNorm(cfg.hidden_size, name="encoder_norm")(x)
+
+        y = emb(dec_tokens) + pos[:s_dec][None].astype(cfg.dtype)
+        y = y.transpose(1, 0, 2)
+        for i in range(cfg.num_decoder_layers):
+            y = DecoderLayer(cfg, name=f"decoder_{i}")(
+                y, enc_out, cross_mask)
+        y = FusedLayerNorm(cfg.hidden_size, name="decoder_norm")(y)
+
+        # tied LM head (ref parallel_lm_logits :1130-1164)
+        if _inside_axis(TENSOR_AXIS):
+            from apex_tpu.transformer.tensor_parallel import (
+                copy_to_tensor_model_parallel_region,
+            )
+            y = copy_to_tensor_model_parallel_region(y)
+        table = emb.variables["params"]["embedding"]
+        return jnp.einsum("sbh,vh->sbv", y.astype(jnp.float32),
+                          table.astype(jnp.float32))
+
+
+def t5_loss_fn(logits, labels, loss_mask, axis_name: str = TENSOR_AXIS):
+    """Masked mean CE over decoder tokens; vocab-parallel under TP.
+    logits (s_dec, b, v[/tp]); labels/loss_mask (b, s_dec)."""
+    labels_sb = labels.transpose(1, 0)
+    if _inside_axis(axis_name):
+        losses = vocab_parallel_cross_entropy(logits, labels_sb,
+                                              axis_name=axis_name)
+    else:
+        lse = jax.scipy.special.logsumexp(logits, axis=-1)
+        tgt = jnp.take_along_axis(logits, labels_sb[..., None], -1)[..., 0]
+        losses = lse - tgt
+    mask_sb = loss_mask.transpose(1, 0).astype(jnp.float32)
+    return jnp.sum(losses * mask_sb) / jnp.maximum(jnp.sum(mask_sb), 1.0)
+
+
+def t5_param_specs(params: Any) -> Any:
+    """PartitionSpec tree (same rules as gpt_param_specs, plus the
+    cross-attention q/kv columns)."""
+
+    def spec_for(path, leaf):
+        names = [str(getattr(k, "key", k)) for k in path]
+        joined = "/".join(names)
+        if "embedding" in joined and names[-1] == "embedding":
+            return P(TENSOR_AXIS, None)
+        col = any(f"/{n}/" in f"/{joined}/" or joined.endswith(f"/{n}")
+                  for n in ("qkv", "fc1", "q", "kv"))
+        row = any(f"/{n}/" in f"/{joined}/" for n in ("proj", "fc2"))
+        if col and names[-1] == "kernel":
+            return P(TENSOR_AXIS, None)
+        if col and names[-1] == "bias":
+            return P(TENSOR_AXIS)
+        if row and names[-1] == "kernel":
+            return P(None, TENSOR_AXIS)
+        return P()
+
+    return jax.tree_util.tree_map_with_path(spec_for, params)
