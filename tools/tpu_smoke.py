@@ -1,0 +1,214 @@
+"""Hardware smoke + parity sweep for every Pallas kernel.
+
+The test suite runs kernels in interpreter mode on CPU (tests/conftest.py);
+this tool runs the SAME kernel-vs-XLA comparisons compiled for the real
+backend (TPU via Mosaic), mirroring how the reference validates its CUDA
+exts on-device (ref: tests/L0/run_amp/test_multi_tensor_scale.py style).
+
+    python tools/tpu_smoke.py          # parity PASS/FAIL per op + timing
+    python tools/tpu_smoke.py --perf   # adds a perf table (pallas vs xla)
+
+Exit code is the number of failing ops.
+"""
+
+import argparse
+import sys
+import time
+
+import numpy as np
+
+
+def _time(fn, *args, iters=10, warmup=2):
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+        jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def run(perf=False, kimpl="pallas"):
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.RandomState(0)
+    results = []
+
+    def check(name, fn, *args, tol=2e-2, grad_wrt=None):
+        """Compare impl='pallas' vs impl='xla' outputs (and grads)."""
+        import functools
+
+        try:
+            f_p = jax.jit(functools.partial(fn, impl=kimpl))
+            f_x = jax.jit(functools.partial(fn, impl="xla"))
+            out_p = jax.tree.leaves(f_p(*args))
+            out_x = jax.tree.leaves(f_x(*args))
+            def rel_err(pairs):
+                # max relative error, absolute below unit scale
+                return max(
+                    float(jnp.max(
+                        jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32))
+                        / (1.0 + jnp.abs(b.astype(jnp.float32)))))
+                    for a, b in zip(*pairs) if hasattr(a, "dtype"))
+
+            err = rel_err((out_p, out_x))
+            ok = err < tol
+            if grad_wrt is not None and ok:
+                def loss(impl_):
+                    def g(*a):
+                        out = fn(*a, impl=impl_)
+                        lv = jax.tree.leaves(out)[0]
+                        return jnp.sum(lv.astype(jnp.float32) ** 2)
+                    return g
+                gp = jax.tree.leaves(
+                    jax.jit(jax.grad(loss(kimpl), argnums=grad_wrt))(*args))
+                gx = jax.tree.leaves(
+                    jax.jit(jax.grad(loss("xla"), argnums=grad_wrt))(*args))
+                gerr = rel_err((gp, gx))
+                ok = gerr < tol * 10
+                err = max(err, gerr)
+            t_p = t_x = None
+            if perf and ok:
+                t_p = _time(f_p, *args)
+                t_x = _time(f_x, *args)
+            results.append((name, ok, err, t_p, t_x))
+            mark = "PASS" if ok else "FAIL"
+            extra = ""
+            if t_p is not None:
+                extra = f"  pallas {t_p*1e3:8.3f} ms  xla {t_x*1e3:8.3f} ms  ({t_x/t_p:4.2f}x)"
+            print(f"  [{mark}] {name:42s} max_err {err:.2e}{extra}")
+        except Exception as e:  # noqa: BLE001 — report, keep sweeping
+            results.append((name, False, float("inf"), None, None))
+            msg = str(e).split("\n")[0][:140]
+            print(f"  [FAIL] {name:42s} {type(e).__name__}: {msg}")
+
+    print(f"backend: {jax.default_backend()}  devices: {len(jax.devices())}")
+
+    # ---- multi_tensor engine ops over a flat buffer -------------------
+    from apex_tpu import multi_tensor as mt
+
+    tree = {f"p{i}": jnp.asarray(rng.randn(*s).astype(np.float32))
+            for i, s in enumerate([(1024, 1024), (4096,), (513, 255), (7,)])}
+    space = mt.FlatSpace.create(tree)
+    buf = space.pack(tree)
+    gbuf = space.pack(jax.tree.map(
+        lambda v: jnp.asarray(rng.randn(*v.shape).astype(np.float32)), tree))
+
+    check("multi_tensor_scale", lambda b, impl: mt.multi_tensor_scale(b, 0.5, impl=impl), buf)
+    check("multi_tensor_axpby", lambda b, g, impl: mt.multi_tensor_axpby(b, g, 2.0, -0.5, impl=impl), buf, gbuf)
+    check("multi_tensor_l2norm", lambda b, impl: mt.multi_tensor_l2norm(b, impl=impl), buf)
+    check("per_tensor_l2norm", lambda b, impl: mt.per_tensor_l2norm(b, space, impl=impl), buf, tol=1e-1)
+
+    m = jnp.zeros_like(buf)
+    v = jnp.zeros_like(buf)
+    check("fused_adam_update",
+          lambda p, g, m_, v_, impl: mt.fused_adam_update(
+              p, m_, v_, g, lr=1e-3, beta1=0.9, beta2=0.999, eps=1e-8,
+              step=1, weight_decay=0.01, impl=impl),
+          buf, gbuf, m, v, tol=1e-4)
+    check("fused_sgd_update",
+          lambda p, g, m_, impl: mt.fused_sgd_update(
+              p, g, m_, lr=1e-2, momentum=0.9, weight_decay=1e-4,
+              nesterov=True, impl=impl),
+          buf, gbuf, m, tol=1e-4)
+    check("fused_lamb_update",
+          lambda p, g, m_, v_, impl: mt.fused_lamb_update(
+              p, m_, v_, g, space, lr=1e-3, beta1=0.9, beta2=0.999,
+              eps=1e-6, step=1, weight_decay=0.01, impl=impl),
+          buf, gbuf, m, v, tol=1e-4)
+    check("fused_novograd_update",
+          lambda p, g, m_, impl: mt.fused_novograd_update(
+              p, m_, jnp.zeros((space.num_leaves,), jnp.float32), g, space,
+              lr=1e-3, beta1=0.95, beta2=0.98, eps=1e-8, step=1,
+              weight_decay=0.01, impl=impl),
+          buf, gbuf, m, tol=1e-4)
+
+    # ---- layer norm / rms norm ---------------------------------------
+    from apex_tpu import ops
+
+    x = jnp.asarray(rng.randn(8 * 512, 1024).astype(np.float32))
+    w = jnp.asarray(rng.randn(1024).astype(np.float32))
+    b = jnp.asarray(rng.randn(1024).astype(np.float32))
+    check("fused_layer_norm (fwd+bwd)",
+          lambda x_, w_, b_, impl: ops.fused_layer_norm(x_, w_, b_, impl=impl),
+          x, w, b, grad_wrt=(0, 1, 2), tol=1e-3)
+    check("fused_rms_norm (fwd+bwd)",
+          lambda x_, w_, impl: ops.fused_rms_norm(x_, w_, impl=impl),
+          x, w, grad_wrt=(0, 1), tol=1e-3)
+    xb = x.astype(jnp.bfloat16)
+    check("fused_layer_norm bf16",
+          lambda x_, w_, b_, impl: ops.fused_layer_norm(x_, w_, b_, impl=impl),
+          xb, w, b, tol=1e-1)
+
+    # ---- softmax family ----------------------------------------------
+    s4 = jnp.asarray(rng.randn(4, 8, 512, 512).astype(np.float32))
+    mask = jnp.asarray(rng.rand(4, 1, 512, 512) < 0.2)
+    check("scaled_softmax (fwd+bwd)",
+          lambda a, impl: ops.scaled_softmax(a, 0.5, impl=impl),
+          s4, grad_wrt=(0,), tol=1e-3)
+    s3 = s4.reshape(32, 512, 512)  # (attn_batches, sq, sk)
+    check("scaled_upper_triang_masked_softmax",
+          lambda a, impl: ops.scaled_upper_triang_masked_softmax(a, 0.5, impl=impl),
+          s3, grad_wrt=(0,), tol=1e-3)
+    check("scaled_masked_softmax",
+          lambda a, m_, impl: ops.scaled_masked_softmax(a, m_, 0.5, impl=impl),
+          s4, mask, tol=1e-3)
+    s4b = s4.astype(jnp.bfloat16)
+    check("scaled_softmax bf16",
+          lambda a, impl: ops.scaled_softmax(a, 0.5, impl=impl), s4b, tol=1e-2)
+
+    # ---- rope ---------------------------------------------------------
+    t = jnp.asarray(rng.randn(512, 4, 8, 128).astype(np.float32))
+    freqs = jnp.asarray(rng.randn(512, 1, 1, 128).astype(np.float32))
+    # rope is pure-XLA by design (elementwise; fusion is enough) — still
+    # exercised here so the compiled fwd+bwd is validated on hardware.
+    check("fused_apply_rotary_pos_emb",
+          lambda t_, f_, impl: ops.fused_apply_rotary_pos_emb(t_, f_),
+          t, freqs, grad_wrt=(0,), tol=1e-3)
+
+    # ---- xentropy -----------------------------------------------------
+    logits = jnp.asarray(rng.randn(4096, 32000).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 32000, (4096,)), jnp.int32)
+    check("softmax_cross_entropy_loss (fwd+bwd)",
+          lambda lg, lb, impl: ops.softmax_cross_entropy_loss(
+              lg, lb, smoothing=0.1, impl=impl),
+          logits, labels, grad_wrt=(0,), tol=1e-3)
+
+    # ---- flash attention ---------------------------------------------
+    q = jnp.asarray(rng.randn(2, 8, 1024, 128).astype(np.float32) * 0.1)
+    k = jnp.asarray(rng.randn(2, 8, 1024, 128).astype(np.float32) * 0.1)
+    v_ = jnp.asarray(rng.randn(2, 8, 1024, 128).astype(np.float32) * 0.1)
+    check("flash_attention causal (fwd+bwd)",
+          lambda q_, k_, vv, impl: ops.flash_attention(
+              q_, k_, vv, causal=True, impl=impl),
+          q, k, v_, grad_wrt=(0, 1, 2), tol=2e-2)
+    seg = jnp.asarray(
+        np.repeat(np.arange(4), 256)[None, :].repeat(2, 0), jnp.int32)
+    check("flash_attention packed-varlen",
+          lambda q_, k_, vv, impl: ops.flash_attention(
+              q_, k_, vv, segment_ids=seg, impl=impl),
+          q, k, v_, tol=2e-2)
+    qb, kb, vb = (a.astype(jnp.bfloat16) for a in (q, k, v_))
+    check("flash_attention bf16 causal",
+          lambda q_, k_, vv, impl: ops.flash_attention(
+              q_, k_, vv, causal=True, impl=impl),
+          qb, kb, vb, tol=5e-2)
+
+    n_fail = sum(1 for _, ok, *_ in results if not ok)
+    print(f"\n{len(results) - n_fail}/{len(results)} ops pass on "
+          f"{jax.default_backend()}")
+    return n_fail
+
+
+if __name__ == "__main__":
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--perf", action="store_true")
+    ap.add_argument("--impl", default="pallas",
+                    choices=("pallas", "interpret"),
+                    help="kernel impl to compare against the XLA path "
+                         "(interpret = CPU logic check)")
+    args = ap.parse_args()
+    sys.exit(run(perf=args.perf, kimpl=args.impl))
