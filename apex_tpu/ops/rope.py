@@ -2,17 +2,23 @@
 
 TPU re-design of ref apex/transformer/functional/fused_rope.py:19-291 and
 csrc/megatron/fused_rotary_positional_embedding{.h,_cuda.cu}. RoPE is a
-bandwidth-bound elementwise op; on TPU the optimal implementation is XLA
-fusion into the surrounding matmuls (a standalone Pallas kernel would
-*add* an HBM round-trip the CUDA version needs but XLA elides). The
-custom VJP mirrors the reference's backward — apply the rotation with
-negated sin — so no cos/sin recomputation or residual stash of t.
+bandwidth-bound elementwise op; inside a transformer block the best TPU
+implementation is usually XLA fusion into the surrounding matmuls (the
+``impl="xla"`` path — a standalone kernel adds an HBM round-trip that the
+CUDA version needs but XLA elides). A Pallas kernel (``impl="pallas"``)
+is provided for the standalone-op case, processing row tiles with the
+per-position cos/sin resident in VMEM — the direct analog of the
+reference's one-thread-block-per-(s,b) kernel. The custom VJP mirrors the
+reference's backward — apply the rotation with negated sin — so no
+cos/sin recomputation or residual stash of t in either impl.
 
 Layouts follow the reference:
   sbhd   t: (seq, batch, heads, dim)
   cached precomputed cos/sin: (seq, 1, 1, dim)
   thd    packed varlen t: (tokens, heads, dim) + cu_seqlens
-  2d     image t: (batch, h, w, heads, dim), separate freqs for h and w
+  2d     image t: (batch, h*w, heads, dim), separate freqs for h and w
+         (always XLA: its cos/sin broadcast along interior dims, which
+         fuses cleanly and has no row-major kernel advantage)
 """
 
 from __future__ import annotations
@@ -22,6 +28,10 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from apex_tpu._backend import interpret_flag, resolve_impl
 
 
 def _rotate_half(t):
@@ -42,46 +52,114 @@ def _apply(t, cos, sin):
     return out
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=())
-def _rope_cached(t, cos, sin):
-    return _apply(t, cos, sin)
+# -- Pallas kernel ----------------------------------------------------------
 
 
-def _rope_cached_fwd(t, cos, sin):
-    return _apply(t, cos, sin), (cos, sin)
+def _rope_kernel(t_ref, cos_ref, sin_ref, o_ref, *, rot):
+    x = t_ref[...].astype(jnp.float32)            # (ts, rows, d)
+    c = cos_ref[...].astype(jnp.float32)[:, None, :]
+    s = sin_ref[...].astype(jnp.float32)[:, None, :]
+    xr = x[..., :rot]
+    half = rot // 2
+    x1, x2 = xr[..., :half], xr[..., half:]
+    rotated = jnp.concatenate([-x2, x1], axis=-1)
+    out = xr * c + rotated * s
+    if x.shape[-1] > rot:
+        out = jnp.concatenate([out, x[..., rot:]], axis=-1)
+    o_ref[...] = out.astype(o_ref.dtype)
 
 
-def _rope_cached_bwd(res, g):
+def _rope_pallas(t, cos, sin, interpret):
+    """Row-tiled kernel for layouts where cos/sin vary along axis 0 only
+    (sbhd, cached, thd): t (n, ..., d), cos/sin broadcastable with
+    shape (n, 1..., rot)."""
+    n, d = t.shape[0], t.shape[-1]
+    rot = cos.shape[-1]
+    rows = 1
+    for s_ in t.shape[1:-1]:
+        rows *= s_
+    t3 = t.reshape(n, rows, d)
+    cos2 = cos.reshape(n, rot)
+    sin2 = sin.reshape(n, rot)
+
+    # pick a position-tile that keeps the block under ~2 MB of fp32
+    budget = (2 * 1024 * 1024) // max(rows * d * 4, 1)
+    ts = max(min(budget, n), 1)
+    while n % ts:
+        ts -= 1
+
+    out = pl.pallas_call(
+        functools.partial(_rope_kernel, rot=rot),
+        grid=(n // ts,),
+        in_specs=[
+            pl.BlockSpec((ts, rows, d), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ts, rot), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((ts, rot), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((ts, rows, d), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(t3.shape, t.dtype),
+        interpret=interpret,
+    )(t3, cos2, sin2)
+    return out.reshape(t.shape)
+
+
+def _rope_any(t, cos, sin, impl):
+    # kernel path requires cos/sin that vary along axis 0 only (all
+    # interior dims 1); anything else broadcasts through the XLA path
+    rows_only = (cos.shape[0] == t.shape[0]
+                 and cos.size == cos.shape[0] * cos.shape[-1])
+    if impl == "xla" or not rows_only:
+        return _apply(t, cos, sin)
+    return _rope_pallas(t, cos, sin, interpret_flag(impl))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _rope_cached(t, cos, sin, impl="xla"):
+    return _rope_any(t, cos, sin, impl)
+
+
+def _rope_cached_fwd(t, cos, sin, impl):
+    return _rope_any(t, cos, sin, impl), (cos, sin)
+
+
+def _rope_cached_bwd(impl, res, g):
     cos, sin = res
     # backward rotation = forward with -sin (ref fused_rope.py backward)
-    return _apply(g, cos, -sin), None, None
+    return _rope_any(g, cos, -sin, impl), None, None
 
 
 _rope_cached.defvjp(_rope_cached_fwd, _rope_cached_bwd)
 
 
 def fused_apply_rotary_pos_emb(
-    t: jax.Array, freqs: jax.Array, transpose_output_memory: bool = False
+    t: jax.Array, freqs: jax.Array, transpose_output_memory: bool = False,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """sbhd variant (ref fused_rope.py:19-88): t (s, b, h, d),
     freqs (s, 1, 1, d_rot) of angles; cos/sin computed here."""
     del transpose_output_memory  # layout is XLA's concern on TPU
     cos = jnp.cos(freqs).astype(jnp.float32)
     sin = jnp.sin(freqs).astype(jnp.float32)
-    return _rope_cached(t, cos, sin)
+    return _rope_cached(t, cos, sin, resolve_impl(impl))
 
 
 def fused_apply_rotary_pos_emb_cached(
     t: jax.Array, cos_: jax.Array, sin_: jax.Array,
-    transpose_output_memory: bool = False,
+    transpose_output_memory: bool = False, impl: Optional[str] = None,
 ) -> jax.Array:
     """cached-cos/sin variant (ref fused_rope.py:91-160)."""
     del transpose_output_memory
-    return _rope_cached(t, cos_.astype(jnp.float32), sin_.astype(jnp.float32))
+    return _rope_cached(t, cos_.astype(jnp.float32),
+                        sin_.astype(jnp.float32), resolve_impl(impl))
 
 
 def fused_apply_rotary_pos_emb_thd(
-    t: jax.Array, cu_seqlens: jax.Array, freqs: jax.Array
+    t: jax.Array, cu_seqlens: jax.Array, freqs: jax.Array,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Packed-varlen (THD) variant (ref fused_rope.py:163-225):
     t (tokens, h, d); cu_seqlens (nseq+1,) cumulative boundaries; each
@@ -95,7 +173,8 @@ def fused_apply_rotary_pos_emb_thd(
     angles = freqs.reshape(freqs.shape[0], -1)[pos]      # (tokens, d_rot)
     cos = jnp.cos(angles)[:, None, :]
     sin = jnp.sin(angles)[:, None, :]
-    return _rope_cached(t, cos.astype(jnp.float32), sin.astype(jnp.float32))
+    return _rope_cached(t, cos.astype(jnp.float32), sin.astype(jnp.float32),
+                        resolve_impl(impl))
 
 
 def fused_apply_rotary_pos_emb_2d(
@@ -114,6 +193,6 @@ def fused_apply_rotary_pos_emb_2d(
     sh = sin_h.reshape(1, img_h, 1, 1, half).astype(jnp.float32)
     cw = cos_w.reshape(1, 1, img_w, 1, half).astype(jnp.float32)
     sw = sin_w.reshape(1, 1, img_w, 1, half).astype(jnp.float32)
-    oh = _rope_cached(th, ch, sh)
-    ow = _rope_cached(tw, cw, sw)
+    oh = _rope_cached(th, ch, sh, "xla")
+    ow = _rope_cached(tw, cw, sw, "xla")
     return jnp.concatenate([oh, ow], axis=-1).reshape(b, hw, heads, d)

@@ -229,30 +229,60 @@ def np_rope(t, freqs):
 
 
 class TestFusedRope:
-    def test_sbhd(self, rng):
+    def test_sbhd(self, rng, impl):
         s, b, h, d = 16, 2, 4, 32
         t = rng.randn(s, b, h, d).astype(np.float32)
         freqs = rng.randn(s, 1, 1, 24).astype(np.float32)
-        y = fused_apply_rotary_pos_emb(jnp.asarray(t), jnp.asarray(freqs))
+        y = fused_apply_rotary_pos_emb(jnp.asarray(t), jnp.asarray(freqs),
+                                       impl=impl)
         np.testing.assert_allclose(np.asarray(y), np_rope(t, freqs), rtol=1e-5, atol=1e-5)
 
-    def test_cached(self, rng):
+    def test_sbhd_grad(self, rng, impl):
+        # bwd = fwd with -sin in both impls (ref fused_rope.py backward)
+        s, b, h, d = 8, 2, 2, 32
+        t = jnp.asarray(rng.randn(s, b, h, d).astype(np.float32))
+        freqs = jnp.asarray(rng.randn(s, 1, 1, d).astype(np.float32))
+
+        def loss(t_, im):
+            return jnp.sum(fused_apply_rotary_pos_emb(t_, freqs, impl=im) ** 2)
+
+        g = jax.grad(lambda t_: loss(t_, impl))(t)
+        g_ref = jax.grad(lambda t_: loss(t_, "xla"))(t)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_cached(self, rng, impl):
         s, b, h, d = 8, 2, 2, 16
         t = rng.randn(s, b, h, d).astype(np.float32)
         freqs = rng.randn(s, 1, 1, d).astype(np.float32)
-        y1 = fused_apply_rotary_pos_emb(jnp.asarray(t), jnp.asarray(freqs))
+        y1 = fused_apply_rotary_pos_emb(jnp.asarray(t), jnp.asarray(freqs),
+                                        impl=impl)
         y2 = fused_apply_rotary_pos_emb_cached(
-            jnp.asarray(t), jnp.cos(jnp.asarray(freqs)), jnp.sin(jnp.asarray(freqs))
+            jnp.asarray(t), jnp.cos(jnp.asarray(freqs)), jnp.sin(jnp.asarray(freqs)),
+            impl=impl,
         )
         np.testing.assert_allclose(np.asarray(y1), np.asarray(y2), rtol=1e-6)
 
-    def test_thd_restarts_positions(self, rng):
+    def test_cached_per_batch_cos(self, rng, impl):
+        # cos/sin with non-unit interior dims can't use the row-tiled
+        # kernel; every impl must broadcast through the XLA path
+        s, b, h, d = 4, 2, 3, 8
+        t = rng.randn(s, b, h, d).astype(np.float32)
+        freqs = rng.randn(s, b, 1, d).astype(np.float32)
+        y = fused_apply_rotary_pos_emb_cached(
+            jnp.asarray(t), jnp.cos(jnp.asarray(freqs)),
+            jnp.sin(jnp.asarray(freqs)), impl=impl)
+        np.testing.assert_allclose(np.asarray(y), np_rope(t, freqs),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_thd_restarts_positions(self, rng, impl):
         # two sequences of length 6 and 10 packed; positions restart
         d = 8
         freqs = rng.randn(16, 1, 1, d).astype(np.float32)
         t = rng.randn(16, 2, d).astype(np.float32)
         cu = jnp.asarray([0, 6, 16], jnp.int32)
-        y = fused_apply_rotary_pos_emb_thd(jnp.asarray(t), cu, jnp.asarray(freqs))
+        y = fused_apply_rotary_pos_emb_thd(jnp.asarray(t), cu, jnp.asarray(freqs),
+                                           impl=impl)
         # sequence 0: positions 0..5 ; sequence 1: positions 0..9
         t_sbhd0 = t[:6][:, None]          # (6, 1, 2, d)
         t_sbhd1 = t[6:][:, None]

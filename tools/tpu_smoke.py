@@ -163,10 +163,8 @@ def run(perf=False, kimpl="pallas"):
     # ---- rope ---------------------------------------------------------
     t = jnp.asarray(rng.randn(512, 4, 8, 128).astype(np.float32))
     freqs = jnp.asarray(rng.randn(512, 1, 1, 128).astype(np.float32))
-    # rope is pure-XLA by design (elementwise; fusion is enough) — still
-    # exercised here so the compiled fwd+bwd is validated on hardware.
-    check("fused_apply_rotary_pos_emb",
-          lambda t_, f_, impl: ops.fused_apply_rotary_pos_emb(t_, f_),
+    check("fused_apply_rotary_pos_emb (fwd+bwd)",
+          lambda t_, f_, impl: ops.fused_apply_rotary_pos_emb(t_, f_, impl=impl),
           t, freqs, grad_wrt=(0,), tol=1e-3)
 
     # ---- xentropy -----------------------------------------------------
