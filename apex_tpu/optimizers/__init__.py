@@ -1,7 +1,7 @@
 """Fused optimizers (ref: apex/optimizers/__init__.py).
 
-`FusedAdam`, `FusedLAMB`, `FusedSGD`, `FusedNovoGrad`, `FusedAdagrad`,
-`FusedLARS` — functional flat-space optimizers with fp32 master weights
+`FusedAdam`, `FusedLAMB`, `FusedMixedPrecisionLamb`, `FusedSGD`,
+`FusedNovoGrad`, `FusedAdagrad`, `FusedLARS` — functional flat-space optimizers with fp32 master weights
 and in-kernel found_inf. `as_optax` adapts any of them to an
 `optax.GradientTransformation` for drop-in use in optax training loops.
 """
@@ -13,6 +13,7 @@ from apex_tpu.optimizers.fused import (
     FusedAdam,
     FusedLAMB,
     FusedLARS,
+    FusedMixedPrecisionLamb,
     FusedNovoGrad,
     FusedSGD,
 )
@@ -23,6 +24,7 @@ __all__ = [
     "FlatOptState",
     "FusedAdam",
     "FusedLAMB",
+    "FusedMixedPrecisionLamb",
     "FusedSGD",
     "FusedNovoGrad",
     "FusedAdagrad",

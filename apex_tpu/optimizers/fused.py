@@ -317,3 +317,45 @@ class FusedLARS(FlatFusedOptimizer):
             impl=self.impl,
         )
         return p2, {"momentum": mom2, "initialized": jnp.ones((), jnp.float32)}, found
+
+
+class FusedMixedPrecisionLamb(FusedLAMB):
+    """LAMB with explicit mixed-precision model weights
+    (ref: apex/optimizers/fused_mixed_precision_lamb.py:8-140,
+    csrc/multi_tensor_lamb_mp.cu).
+
+    The reference variant exists because its base FusedLAMB mutates
+    params in their storage dtype: this class adds device-tensor
+    lr/step (sync-free execution), fp32 master copies for
+    reduced-precision params, and grad-scaler found_inf handling. All
+    three are already structural in `FlatFusedOptimizer`: lr accepts a
+    traced scalar/schedule, `step`/`count` and the fp32 master buffer
+    live in carried state, and ``skip_if_nonfinite`` gates the update
+    in-kernel. The flat engine keeps an
+    fp32 master for every leaf and `step` returns each param in its
+    input dtype, which reproduces the reference's master->model cast
+    for reduced-precision leaves and its direct fp32 update for the
+    rest; ``reduced_precision_dtype`` here validates the reference's
+    dtype contract (params are fp32 or that dtype) at init.
+    """
+
+    def __init__(self, *args, reduced_precision_dtype=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.reduced_precision_dtype = (
+            jnp.dtype(reduced_precision_dtype)
+            if reduced_precision_dtype is not None else None)
+
+    def init(self, params):
+        if self.reduced_precision_dtype is not None:
+            # the reference's contract: model params are fp32 or the
+            # declared reduced dtype (fused_mixed_precision_lamb.py:82-108
+            # cast map); anything else is a wiring mistake
+            bad = {
+                str(l.dtype) for l in jax.tree.leaves(params)
+                if l.dtype not in (jnp.float32, self.reduced_precision_dtype)
+            }
+            if bad:
+                raise ValueError(
+                    f"params must be float32 or "
+                    f"{self.reduced_precision_dtype}; found {sorted(bad)}")
+        return super().init(params)

@@ -377,11 +377,5 @@ def main():
 
 
 if __name__ == "__main__":
-    if len(sys.argv) > 1 and sys.argv[1] == "moe":
-        bench_moe()
-    elif len(sys.argv) > 1 and sys.argv[1] == "gpt":
-        bench_gpt()
-    elif len(sys.argv) > 1 and sys.argv[1] == "attn":
-        bench_attn()
-    else:
-        main()
+    modes = {"moe": bench_moe, "gpt": bench_gpt, "attn": bench_attn}
+    modes.get(sys.argv[1] if len(sys.argv) > 1 else "", main)()

@@ -234,3 +234,46 @@ class TestOptaxAdapter:
         np.testing.assert_allclose(np.asarray(p1["w"]), 0.9 * np.ones(64), rtol=1e-6)
         p2, state = opt.step(state, g)       # lr = 0.05
         np.testing.assert_allclose(np.asarray(p2["w"]), 0.85 * np.ones(64), rtol=1e-6)
+
+
+class TestFusedMixedPrecisionLamb:
+    """ref: apex/optimizers/fused_mixed_precision_lamb.py — bf16 model
+    weights with fp32 masters, fp32 params updated directly."""
+
+    def test_mixed_tree_dtypes_roundtrip(self, rng):
+        from apex_tpu.optimizers import FusedMixedPrecisionLamb
+
+        params = {
+            "w_bf16": jnp.asarray(rng.randn(128, 64), jnp.bfloat16),
+            "w_fp32": jnp.asarray(rng.randn(64), jnp.float32),
+        }
+        opt = FusedMixedPrecisionLamb(
+            lr=0.01, reduced_precision_dtype=jnp.bfloat16, impl="xla")
+        state = opt.init(params)
+        grads = jax.tree.map(lambda p: jnp.ones_like(p, jnp.float32), params)
+        new_params, state = opt.step(state, grads)
+        assert new_params["w_bf16"].dtype == jnp.bfloat16
+        assert new_params["w_fp32"].dtype == jnp.float32
+        # masters stay fp32 for every leaf
+        masters = opt.master_params(state)
+        assert all(m.dtype == jnp.float32 for m in jax.tree.leaves(masters))
+
+    def test_rejects_undeclared_dtype(self, rng):
+        from apex_tpu.optimizers import FusedMixedPrecisionLamb
+
+        params = {"w": jnp.asarray(rng.randn(8), jnp.float16)}
+        opt = FusedMixedPrecisionLamb(
+            lr=0.01, reduced_precision_dtype=jnp.bfloat16, impl="xla")
+        with pytest.raises(ValueError, match="float32 or"):
+            opt.init(params)
+
+    def test_matches_fused_lamb_on_fp32(self, rng):
+        from apex_tpu.optimizers import FusedLAMB, FusedMixedPrecisionLamb
+
+        params = {"w": jnp.asarray(rng.randn(256), jnp.float32)}
+        grads = {"w": jnp.asarray(rng.randn(256).astype(np.float32) * 0.1)}
+        a = FusedLAMB(lr=0.01, impl="xla")
+        b = FusedMixedPrecisionLamb(lr=0.01, impl="xla")
+        pa, _ = a.step(a.init(params), grads)
+        pb, _ = b.step(b.init(params), grads)
+        np.testing.assert_array_equal(np.asarray(pa["w"]), np.asarray(pb["w"]))
