@@ -14,7 +14,11 @@ Subpackages mirror the reference's contrib surface, re-designed for TPU:
     contrib.transducer     — RNN-T joint/loss (ref: apex/contrib/transducer)
     contrib.bottleneck     — spatial conv parallelism + halo exchange +
                              fused bottleneck (ref: apex/contrib/bottleneck,
-                             peer_memory, nccl_p2p)
+                             nccl_p2p)
+    contrib.peer_memory    — halo exchange over ppermute + pool config
+                             object (ref: apex/contrib/peer_memory)
+    contrib.layer_norm     — FastLayerNorm surface over the Pallas LN
+                             kernels (ref: apex/contrib/layer_norm)
     contrib.groupbn        — NHWC BN with BN groups (ref: apex/contrib/groupbn)
     contrib.conv_bias_relu — fused conv epilogues (ref: apex/contrib/conv_bias_relu)
     contrib.sparsity       — ASP 2:4 structured sparsity (ref: apex/contrib/sparsity)
@@ -28,6 +32,8 @@ from apex_tpu.contrib import focal_loss  # noqa: F401
 from apex_tpu.contrib import xentropy  # noqa: F401
 from apex_tpu.contrib import index_mul_2d  # noqa: F401
 from apex_tpu.contrib import transducer  # noqa: F401
+from apex_tpu.contrib import layer_norm  # noqa: F401
+from apex_tpu.contrib import peer_memory  # noqa: F401
 from apex_tpu.contrib import bottleneck  # noqa: F401
 from apex_tpu.contrib import groupbn  # noqa: F401
 from apex_tpu.contrib import conv_bias_relu  # noqa: F401

@@ -1,0 +1,32 @@
+"""FastLayerNorm — name-compatible surface for the reference's
+high-performance layer norm (ref: apex/contrib/layer_norm/layer_norm.py:8-54,
+apex/contrib/csrc/layer_norm/ 2228 LoC of per-hidden-size templated
+kernels; note the reference fork never wires that extension into
+setup.py — SURVEY.md §2.1 "fork quirks").
+
+The reference needs a second, faster LN implementation because its
+csrc/layer_norm_cuda kernels leave perf on the table for hidden sizes
+<= 65k. Here there is exactly one implementation to make fast — the
+Pallas layer-norm kernels in `apex_tpu.ops.layer_norm` — so
+``FastLayerNorm`` is the same module as
+:class:`apex_tpu.normalization.FusedLayerNorm`, re-exported under the
+reference's import path and constructor signature.
+"""
+
+from __future__ import annotations
+
+from apex_tpu.normalization import FusedLayerNorm as _FusedLayerNorm
+from apex_tpu.ops.layer_norm import fused_layer_norm
+
+
+def FastLayerNorm(hidden_size: int, eps: float = 1e-5, **kwargs):
+    """ref apex/contrib/layer_norm/layer_norm.py:40-54: LN over the last
+    dim with affine params; hidden size <= 65536 in the reference (a
+    kernel-template limit that does not apply here). Returns a
+    :class:`~apex_tpu.normalization.FusedLayerNorm` module (flax linen
+    modules are frozen dataclasses, so the reference's ctor signature is
+    provided as a factory)."""
+    return _FusedLayerNorm(normalized_shape=hidden_size, eps=eps, **kwargs)
+
+
+__all__ = ["FastLayerNorm", "fused_layer_norm"]

@@ -1,0 +1,85 @@
+"""peer_memory — name-compatible surface for the reference's CUDA-IPC
+peer-to-peer halo machinery (ref: apex/contrib/peer_memory/peer_memory.py:5,
+peer_halo_exchanger_1d.py:5-67, apex/contrib/csrc/peer_memory/ 829 LoC).
+
+The reference allocates a CUDA-IPC memory pool so neighboring GPUs can
+write each other's halo buffers directly, bypassing NCCL. On TPU,
+neighbor transfer over ICI *is* the hardware primitive — `lax.ppermute`
+compiles to exactly the direct neighbor copy the IPC pool was built to
+reach — so there is no pool to manage:
+
+- :class:`PeerMemoryPool` survives as a configuration object for API
+  compatibility (group math preserved; no allocation happens — XLA owns
+  device memory).
+- :class:`PeerHaloExchanger1d` is the real functionality: the halo
+  exchange of a spatially-sharded NHWC activation, as a pure function
+  over the mesh axis, built on the same ppermute exchanger the spatial
+  bottleneck uses (`apex_tpu.contrib.bottleneck`).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.contrib.bottleneck import SPATIAL_AXIS, HaloExchangerPpermute
+
+
+class PeerMemoryPool:
+    """ref peer_memory.py:5-46: per-node peer group bookkeeping around a
+    raw IPC allocation. Here only the group math survives; ``static_size``
+    and ``dynamic_size`` are accepted and recorded for compatibility but
+    nothing is allocated (buffers are XLA-managed device memory)."""
+
+    def __init__(self, static_size: int = 0, dynamic_size: int = 0,
+                 peer_ranks: Optional[Sequence[int]] = None,
+                 alignment: int = 256):
+        self.alignment = alignment
+        self.static_size = (static_size + alignment - 1) // alignment * alignment
+        self.dynamic_size = (dynamic_size + alignment - 1) // alignment * alignment
+        self.peer_ranks = None if peer_ranks is None else tuple(peer_ranks)
+
+    def reset(self):  # ref peer_memory.py __init__ offset reset
+        pass
+
+
+class PeerHaloExchanger1d:
+    """ref peer_halo_exchanger_1d.py:5-67 — exchange the output-halo
+    rows of a spatially-sharded activation with both neighbors and fill
+    the input-halo rows; the group edges receive zeros (ref low_zero /
+    high_zero).
+
+    Functional translation: ``y`` is the local NHWC block whose sharded
+    dim (H if ``H_split`` else W) carries ``half_halo`` input-halo slots
+    at each end; returns a new ``y`` with those slots filled from the
+    neighbors' adjacent interior rows. Call inside ``shard_map`` over
+    ``axis_name``. The ``peer_pool`` argument is accepted for signature
+    parity and unused (ICI neighbor copies need no staging pool).
+    """
+
+    def __init__(self, ranks=None, rank_in_group=None,
+                 peer_pool: Optional[PeerMemoryPool] = None,
+                 half_halo: int = 1, axis_name: str = SPATIAL_AXIS):
+        del ranks, rank_in_group, peer_pool  # mesh axis carries the group
+        self.half_halo = half_halo
+        self.axis_name = axis_name
+        self._exchanger = HaloExchangerPpermute(axis_name)
+
+    def __call__(self, y: jax.Array, H_split: bool = True) -> jax.Array:
+        hh = self.half_halo
+        axis = 1 if H_split else 2            # NHWC
+        y = jnp.moveaxis(y, axis, 1)
+        n = y.shape[1] - 2 * hh               # interior length
+        low_out = y[:, hh:2 * hh]             # my top interior rows
+        high_out = y[:, n:n + hh]             # my bottom interior rows
+        from_low, from_high = self._exchanger.left_right_halo_exchange(
+            low_out, high_out)
+        y = y.at[:, :hh].set(from_low)
+        y = y.at[:, n + hh:].set(from_high)
+        return jnp.moveaxis(y, 1, axis)
+
+
+__all__ = ["PeerMemoryPool", "PeerHaloExchanger1d"]
