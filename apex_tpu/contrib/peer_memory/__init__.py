@@ -23,7 +23,6 @@ from typing import Optional, Sequence
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 
 from apex_tpu.contrib.bottleneck import SPATIAL_AXIS, HaloExchangerPpermute
 
@@ -73,6 +72,10 @@ class PeerHaloExchanger1d:
         axis = 1 if H_split else 2            # NHWC
         y = jnp.moveaxis(y, axis, 1)
         n = y.shape[1] - 2 * hh               # interior length
+        if n < hh:
+            raise ValueError(
+                f"sharded dim {y.shape[1]} too small for half_halo={hh}: "
+                f"needs >= {3 * hh} (interior >= halo size)")
         low_out = y[:, hh:2 * hh]             # my top interior rows
         high_out = y[:, n:n + hh]             # my bottom interior rows
         from_low, from_high = self._exchanger.left_right_halo_exchange(

@@ -1,0 +1,20 @@
+"""Library logging helpers (ref: apex/transformer/log_util.py).
+
+The reference names loggers after the calling file and exposes a
+severity setter on apex's root library logger; same surface here over
+the ``apex_tpu`` root logger installed in ``apex_tpu/__init__.py``.
+"""
+
+import logging
+import os
+
+
+def get_transformer_logger(name: str) -> logging.Logger:
+    name_wo_ext = os.path.splitext(name)[0]
+    return logging.getLogger(name_wo_ext)
+
+
+def set_logging_level(verbosity) -> None:
+    """Change the apex_tpu library logger's severity
+    (ref log_util.py:10-18)."""
+    logging.getLogger("apex_tpu").setLevel(verbosity)

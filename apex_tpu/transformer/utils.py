@@ -1,0 +1,49 @@
+"""Transformer-level utilities (ref: apex/transformer/utils.py).
+
+``ensure_divisibility``/``divide`` re-export the tensor_parallel
+versions. The 1-D chunk scatter/gather pair backs the reference's
+scatter-gather pipeline-transfer optimization
+(ref utils.py:21-40, p2p_communication.py:186-198): a replicated
+activation is split into per-TP-rank 1-D chunks before a pipeline hop
+and re-gathered after. Call inside ``shard_map`` over the tensor axis.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.transformer.parallel_state import TENSOR_AXIS
+from apex_tpu.transformer.tensor_parallel.utils import (  # noqa: F401
+    divide,
+    ensure_divisibility,
+)
+
+
+def split_tensor_into_1d_equal_chunks(
+    tensor: jax.Array, axis_name: str = TENSOR_AXIS
+) -> jax.Array:
+    """This rank's 1-D chunk of the flattened tensor (ref utils.py:21-29).
+    The size must divide by the axis size."""
+    flat = tensor.reshape(-1)
+    n = lax.axis_size(axis_name)
+    chunk = divide(flat.shape[0], n)
+    rank = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(flat, rank * chunk, chunk)
+
+
+def gather_split_1d_tensor(
+    tensor: jax.Array, axis_name: str = TENSOR_AXIS
+) -> jax.Array:
+    """Inverse: all-gather the per-rank chunks back into the full flat
+    tensor (ref utils.py:32-40, _all_gather_base)."""
+    return lax.all_gather(tensor, axis_name, tiled=True)
+
+
+__all__ = [
+    "ensure_divisibility",
+    "divide",
+    "split_tensor_into_1d_equal_chunks",
+    "gather_split_1d_tensor",
+]

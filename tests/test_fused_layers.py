@@ -430,3 +430,19 @@ class TestFusedDenseMLP:
 
         g = jax.grad(loss)(params)
         assert float(jnp.abs(g["params"]["kernel_0"]).sum()) > 0
+
+
+class TestFastLayerNormShim:
+    """ref apex/contrib/layer_norm — name surface over the same kernels."""
+
+    def test_fast_layer_norm_shim(self, rng):
+        from apex_tpu.contrib.layer_norm import FastLayerNorm
+
+        ln = FastLayerNorm(64, eps=1e-5)
+        x = jnp.asarray(rng.randn(4, 64).astype(np.float32))
+        params = ln.init(jax.random.PRNGKey(0), x)
+        y = ln.apply(params, x)
+        ref = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
+            x.var(-1, keepdims=True) + 1e-5)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=1e-4, atol=1e-4)

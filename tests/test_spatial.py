@@ -266,15 +266,3 @@ class TestPeerMemoryShims:
             want_high = (np.zeros_like(got[:, -hh:]) if dev == n_dev - 1
                          else np.asarray(x[:, lo + 8:lo + 8 + hh]))
             np.testing.assert_array_equal(got[:, -hh:], want_high)
-
-    def test_fast_layer_norm_shim(self, rng):
-        from apex_tpu.contrib.layer_norm import FastLayerNorm
-
-        ln = FastLayerNorm(64, eps=1e-5)
-        x = jnp.asarray(rng.randn(4, 64).astype(np.float32))
-        params = ln.init(jax.random.PRNGKey(0), x)
-        y = ln.apply(params, x)
-        ref = (x - x.mean(-1, keepdims=True)) / jnp.sqrt(
-            x.var(-1, keepdims=True) + 1e-5)
-        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
-                                   rtol=1e-4, atol=1e-4)

@@ -98,3 +98,55 @@ class TestModelParallelGradScaler:
         )(jnp.asarray(2.0 ** 16, jnp.float32))
         # every rank backed off together
         np.testing.assert_allclose(np.asarray(out), 2.0 ** 15 * np.ones(4))
+
+
+class TestTransformerUtils:
+    """ref apex/transformer/utils.py — 1-D chunk scatter/gather round trip."""
+
+    def test_split_gather_roundtrip(self, rng):
+        import functools
+
+        from jax import shard_map
+        from jax.sharding import PartitionSpec as P
+
+        from apex_tpu.transformer import parallel_state as ps
+        from apex_tpu.transformer.utils import (
+            gather_split_1d_tensor,
+            split_tensor_into_1d_equal_chunks,
+        )
+
+        ps.destroy_model_parallel()
+        mesh = ps.initialize_model_parallel(tensor_model_parallel_size=4)
+        try:
+            x = jnp.asarray(rng.randn(8, 16).astype(np.float32))
+
+            def body(x):
+                chunk = split_tensor_into_1d_equal_chunks(x)
+                assert chunk.shape == (8 * 16 // 4,)
+                return gather_split_1d_tensor(chunk).reshape(x.shape)
+
+            run = functools.partial(
+                shard_map, mesh=mesh, in_specs=(P(),), out_specs=P(),
+                check_vma=False)
+            out = jax.jit(run(body))(x)
+            np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
+        finally:
+            ps.destroy_model_parallel()
+
+    def test_log_util(self):
+        import logging
+
+        from apex_tpu.transformer.log_util import (
+            get_transformer_logger,
+            set_logging_level,
+        )
+
+        lg = get_transformer_logger("some/module.py")
+        assert lg.name == "some/module"
+        root = logging.getLogger("apex_tpu")
+        before = root.level
+        try:
+            set_logging_level(logging.DEBUG)
+            assert root.level == logging.DEBUG
+        finally:
+            root.setLevel(before)
