@@ -34,4 +34,5 @@ setup(
     package_data={"apex_tpu": ["csrc/*.cpp", "_build/*.so"]},
     python_requires=">=3.10",
     install_requires=["jax", "flax", "numpy"],
+    extras_require={"test": ["pytest", "optax", "orbax-checkpoint", "torch"]},
 )
