@@ -56,3 +56,4 @@ from apex_tpu import moe  # noqa: E402,F401
 from apex_tpu import rnn  # noqa: E402,F401
 from apex_tpu import fp16_utils  # noqa: E402,F401
 from apex_tpu import runtime  # noqa: E402,F401
+from apex_tpu import profiler  # noqa: E402,F401

@@ -1,0 +1,65 @@
+"""Tracing / profiling hooks (SURVEY.md §5 "Tracing / profiling").
+
+The reference marks hot regions with NVTX ranges behind ``prof`` flags
+(ref: apex/parallel/distributed.py:360-361,403-404,517-518,556-557;
+examples/imagenet/main_amp.py:401 ``--prof``). The TPU equivalents:
+
+- :func:`range` / :func:`mark_range` — ``jax.named_scope``: names the
+  enclosing ops in HLO metadata so they show up in XLA/perfetto traces
+  exactly where nvtx ranges would in nsight.
+- :func:`start_trace` / :func:`stop_trace` / :func:`trace` —
+  ``jax.profiler`` capture to a TensorBoard-loadable directory
+  (replaces ``torch.cuda.profiler.start/stop`` + nsys).
+- Host-side timing lives in
+  :class:`apex_tpu.transformer.pipeline_parallel.Timers`, whose
+  start/stop block on device work the way the reference's timers
+  ``torch.cuda.synchronize()`` (ref _timers.py:6-83).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+import jax
+
+# jax.named_scope is itself a context manager AND decorator
+range = jax.named_scope  # noqa: A001 — mirrors the nvtx range concept
+mark_range = jax.named_scope
+
+
+def start_trace(log_dir: str = "/tmp/apex_tpu_trace") -> None:
+    """Begin a profiler capture (ref: --prof windows around iterations)."""
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_trace() -> None:
+    jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def trace(log_dir: str = "/tmp/apex_tpu_trace",
+          enabled: bool = True) -> Iterator[None]:
+    """``with profiler.trace(...):`` capture window; ``enabled=False``
+    makes it a no-op so callers can keep the reference's prof-flag
+    pattern (``if args.prof and i == start_iter: ...``) inline."""
+    if not enabled:
+        yield
+        return
+    start_trace(log_dir)
+    try:
+        yield
+    finally:
+        stop_trace()
+
+
+def annotate(name: Optional[str] = None):
+    """Decorator form: name a function's ops in traces
+    (ref: nvtx.range_push/pop pairs around functions)."""
+    def wrap(fn):
+        return jax.named_scope(name or fn.__qualname__)(fn)
+    return wrap
+
+
+__all__ = ["range", "mark_range", "start_trace", "stop_trace", "trace",
+           "annotate"]

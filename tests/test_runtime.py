@@ -1,6 +1,8 @@
 """Native host runtime tests: C++ flatten/unflatten vs numpy, bf16
 casts vs ml_dtypes, prefetch pipeline ordering."""
 
+import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -167,3 +169,59 @@ class TestPrefetchLoader:
         time.sleep(0.5)
         assert len(produced) >= 2
         list(it)
+
+
+class TestProfiler:
+    """SURVEY §5 tracing hooks (ref nvtx ranges / --prof windows)."""
+
+    def test_named_range_and_annotate(self):
+        from apex_tpu import profiler
+
+        @profiler.annotate("my_op")
+        def f(x):
+            with profiler.range("inner"):
+                return x * 2
+
+        out = jax.jit(f)(jnp.ones((4,)))
+        np.testing.assert_array_equal(np.asarray(out), 2 * np.ones(4))
+
+    def test_trace_capture(self, tmp_path):
+        from apex_tpu import profiler
+
+        with profiler.trace(str(tmp_path), enabled=True):
+            jax.block_until_ready(jnp.ones((8, 8)) @ jnp.ones((8, 8)))
+        # a TensorBoard-loadable trace directory was produced
+        assert any(tmp_path.rglob("*.pb")) or any(tmp_path.rglob("*.json.gz"))
+
+    def test_trace_disabled_noop(self, tmp_path):
+        from apex_tpu import profiler
+
+        with profiler.trace(str(tmp_path / "off"), enabled=False):
+            pass
+        assert not (tmp_path / "off").exists()
+
+    def test_ddp_prof_flag(self, rng):
+        from apex_tpu.parallel import DistributedDataParallel
+        from apex_tpu.transformer import parallel_state as ps
+
+        ps.destroy_model_parallel()
+        mesh = ps.initialize_model_parallel()
+        try:
+            import functools
+
+            from jax import shard_map
+            from jax.sharding import PartitionSpec as P
+
+            ddp = DistributedDataParallel(prof=True)
+            x = jnp.asarray(rng.randn(8, 4).astype(np.float32))
+
+            run = functools.partial(
+                shard_map, mesh=mesh,
+                in_specs=(P(ps.DATA_AXIS, None),), out_specs=P(),
+                check_vma=False)
+            out = jax.jit(run(lambda g: ddp.allreduce_grads(g)))(x)
+            np.testing.assert_allclose(
+                np.asarray(out), np.mean(np.asarray(x).reshape(8, -1, 4), 0),
+                rtol=1e-6)
+        finally:
+            ps.destroy_model_parallel()
