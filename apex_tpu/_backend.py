@@ -28,7 +28,41 @@ def default_impl() -> str:
                 f"APEX_TPU_IMPL={env!r} invalid; expected one of {VALID_IMPLS}"
             )
         return env
-    return "pallas" if is_tpu() else "xla"
+    if is_tpu() and pallas_available():
+        return "pallas"
+    return "xla"
+
+
+@lru_cache(maxsize=None)
+def pallas_available() -> bool:
+    """One-time probe: can Mosaic compile and run a trivial kernel on
+    this backend? The runtime analog of the reference's
+    ``multi_tensor_applier.available`` extension check
+    (ref: apex/multi_tensor_apply/multi_tensor_apply.py:8-25). A failure
+    downgrades the default to the XLA paths with a warning instead of
+    breaking every fused op."""
+    import logging
+
+    try:
+        import jax.numpy as jnp
+        from jax.experimental import pallas as pl
+
+        def kernel(x_ref, o_ref):
+            o_ref[...] = x_ref[...] * 2.0
+
+        x = jnp.ones((16, 128), jnp.float32)
+        out = pl.pallas_call(
+            kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype)
+        )(x)
+        jax.block_until_ready(out)
+        return True
+    except Exception as e:  # noqa: BLE001 — any failure means "degrade"
+        logging.getLogger("apex_tpu").warning(
+            "Pallas probe failed on backend %r (%s: %s) — fused ops "
+            "default to the XLA implementations. Set APEX_TPU_IMPL=pallas "
+            "to force kernels.", jax.default_backend(),
+            type(e).__name__, str(e).split("\n")[0][:200])
+        return False
 
 
 @lru_cache(maxsize=None)
