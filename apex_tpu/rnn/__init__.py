@@ -107,6 +107,12 @@ class RNN(nn.Module):
     cell_type: str
     input_size: int
     hidden_size: int
+    # recurrent output projection (ref RNNBackend.py:258-262,361-363):
+    # h is projected hidden_size -> output_size after every step; the
+    # projected h is both the carried recurrent input (w_hh consumes
+    # output_size) and the emitted output. Cell-interior state (LSTM c)
+    # stays hidden_size. None = no projection.
+    output_size: Any = None
     num_layers: int = 1
     bias: bool = True
     batch_first: bool = False
@@ -114,17 +120,39 @@ class RNN(nn.Module):
     bidirectional: bool = False
     param_dtype: Any = jnp.float32
 
+    @property
+    def _out_size(self):
+        if self.output_size is None:
+            return self.hidden_size
+        if self.output_size <= 0:
+            raise ValueError(f"output_size must be positive, got {self.output_size}")
+        return self.output_size
+
     def _cell_params(self, name, in_size):
         cell, gate_mult, _, has_m = _CELLS[self.cell_type]
         g = gate_mult * self.hidden_size
+        out = self._out_size
         mk = lambda n, shape: self.param(  # noqa: E731
             f"{name}_{n}", nn.initializers.lecun_normal(), shape,
             self.param_dtype)
         p = {"w_ih": mk("w_ih", (in_size, g)),
-             "w_hh": mk("w_hh", (self.hidden_size, g))}
+             "w_hh": mk("w_hh", (out, g))}
+        if out != self.hidden_size:
+            if self.cell_type == "gru":
+                # the GRU recurrence's (1-z)*n + z*h term mixes the
+                # hidden-width gates with the carried h, which is
+                # output_size-wide under projection — undefined (the
+                # reference crashes on this path too: torch GRUCell's
+                # z*(hidden-newgate) has the same width mismatch)
+                raise NotImplementedError(
+                    "GRU does not support output_size != hidden_size")
+            p["w_ho"] = mk("w_ho", (self.hidden_size, out))
         if has_m:
-            p["w_mih"] = mk("w_mih", (in_size, self.hidden_size))
-            p["w_mhh"] = mk("w_mhh", (self.hidden_size, self.hidden_size))
+            # ref cells.py mLSTMRNNCell: the multiplicative path is
+            # output_size-wide — w_mih (out, in), w_mhh (out, out), and
+            # w_hh consumes m (out) — so m matches w_hh's (out, g)
+            p["w_mih"] = mk("w_mih", (in_size, out))
+            p["w_mhh"] = mk("w_mhh", (out, out))
         if self.bias:
             z = lambda n, shape: self.param(  # noqa: E731
                 f"{name}_{n}", nn.initializers.zeros, shape,
@@ -144,14 +172,21 @@ class RNN(nn.Module):
         def run_scan(p, xs, reverse, init):
             if init is None:
                 # carry dtype = promoted (input, param) dtype so fp16
-                # inputs against fp32 params scan cleanly
+                # inputs against fp32 params scan cleanly; state[0] (the
+                # carried h) is output_size-wide under projection, the
+                # rest stay hidden_size (ref init_hidden, RNNBackend.py:325)
                 cdt = jnp.result_type(xs.dtype, p["w_hh"].dtype)
                 init = tuple(
-                    jnp.zeros((b, self.hidden_size), cdt)
-                    for _ in range(n_state))
+                    jnp.zeros(
+                        (b, self._out_size if i == 0 else self.hidden_size),
+                        cdt)
+                    for i in range(n_state))
 
             def step(state, x_t):
                 state, out = cell(p, x_t, state)
+                if "w_ho" in p:
+                    out = out @ p["w_ho"]
+                    state = (out,) + tuple(state[1:])
                 return state, out
 
             # scan's reverse=True: last-to-first processing with outs in
@@ -161,7 +196,7 @@ class RNN(nn.Module):
         finals = []
         for layer in range(self.num_layers):
             in_size = (self.input_size if layer == 0
-                       else self.hidden_size * dirs)
+                       else self._out_size * dirs)
             outs_dirs, finals_layer = [], []
             for d in range(dirs):
                 p = self._cell_params(f"l{layer}d{d}", in_size)
@@ -186,16 +221,13 @@ def _ctor(cell_type):
     def make(input_size, hidden_size, num_layers, bias=True,
              batch_first=False, dropout=0.0, bidirectional=False,
              output_size=None, **kw):
-        """ref models.py constructors; output_size is accepted for
-        parity (the reference's w_ho projection) but must equal
-        hidden_size here."""
-        if output_size is not None and output_size != hidden_size:
-            raise NotImplementedError(
-                "output_size != hidden_size projection is not supported")
+        """ref models.py constructors; output_size enables the
+        reference's w_ho recurrent projection (RNNBackend.py:258-262)."""
         return RNN(cell_type=cell_type, input_size=input_size,
                    hidden_size=hidden_size, num_layers=num_layers,
                    bias=bias, batch_first=batch_first, dropout=dropout,
-                   bidirectional=bidirectional, **kw)
+                   bidirectional=bidirectional, output_size=output_size,
+                   **kw)
     make.__name__ = cell_type.upper()
     return make
 
