@@ -12,6 +12,7 @@ from apex_tpu.amp.frontend import (
     initialize,
     load_state_dict,
     make_scaler,
+    master_params,
     state_dict,
 )
 from apex_tpu.amp.functional import (
@@ -20,6 +21,10 @@ from apex_tpu.amp.functional import (
     float_function,
     half_function,
     promote_function,
+    register_bfloat16_function,
+    register_float_function,
+    register_half_function,
+    register_promote_function,
 )
 from apex_tpu.amp.scaler import LossScaler, ScalerState
 
@@ -38,4 +43,9 @@ __all__ = [
     "float_function",
     "promote_function",
     "compute_cast",
+    "register_half_function",
+    "register_bfloat16_function",
+    "register_float_function",
+    "register_promote_function",
+    "master_params",
 ]

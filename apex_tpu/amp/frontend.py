@@ -212,3 +212,11 @@ def load_state_dict(amp_state: AmpState, d: Dict[str, Any]) -> AmpState:
         else:
             scalers.append(s)
     return AmpState(properties=amp_state.properties, scalers=tuple(scalers))
+
+
+def master_params(optimizer, state):
+    """fp32 master-weight view of a fused optimizer's state
+    (ref: apex/amp/_amp_state.py:49-59 master_params(optimizer) iterator;
+    functional form takes the carried state). Yields leaves, matching the
+    reference's flat iteration order."""
+    return iter(jax.tree.leaves(optimizer.master_params(state)))

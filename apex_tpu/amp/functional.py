@@ -86,3 +86,30 @@ def compute_cast(fn: Callable, compute_dtype) -> Callable:
         return _cast_floats(out, jnp.float32)
 
     return wrapper
+
+
+def _register(module, name: str, deco: Callable) -> None:
+    fn = getattr(module, name)
+    setattr(module, name, deco(fn))
+
+
+def register_half_function(module, function_name: str) -> None:
+    """Replace ``module.function_name`` with its half_function-wrapped
+    form (ref: apex/amp/amp.py:48-53 registry + patch; here the rebind
+    happens immediately — there is no deferred amp.init patching pass)."""
+    _register(module, function_name, half_function)
+
+
+def register_bfloat16_function(module, function_name: str) -> None:
+    """ref fork: apex/amp/amp.py:55-59."""
+    _register(module, function_name, bfloat16_function)
+
+
+def register_float_function(module, function_name: str) -> None:
+    """ref: apex/amp/amp.py:61-65."""
+    _register(module, function_name, float_function)
+
+
+def register_promote_function(module, function_name: str) -> None:
+    """ref: apex/amp/amp.py:67-71."""
+    _register(module, function_name, promote_function)

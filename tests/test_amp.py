@@ -228,3 +228,43 @@ class TestFunctionCasts:
         x, n = f(jnp.ones((4,), jnp.float32), jnp.arange(4))
         assert x.dtype == jnp.float16
         assert n.dtype == jnp.int32
+
+
+class TestRegisterFunctions:
+    """ref apex/amp/amp.py:48-71 user registries — here the rebind is
+    immediate (no deferred amp.init patch pass)."""
+
+    def test_register_half_and_float(self):
+        import types
+
+        from apex_tpu import amp
+
+        mod = types.SimpleNamespace(
+            f=lambda x: x.dtype, g=lambda x: x.dtype)
+        amp.register_half_function(mod, "f")
+        amp.register_float_function(mod, "g")
+        x = jnp.ones((4,), jnp.float32)
+        assert mod.f(x) == jnp.float16
+        assert mod.g(x.astype(jnp.float16)) == jnp.float32
+
+    def test_register_promote(self):
+        import types
+
+        from apex_tpu import amp
+
+        mod = types.SimpleNamespace(add=lambda a, b: (a + b).dtype)
+        amp.register_promote_function(mod, "add")
+        out = mod.add(jnp.ones((2,), jnp.float16), jnp.ones((2,), jnp.float32))
+        assert out == jnp.float32
+
+    def test_master_params_iterator(self, rng):
+        from apex_tpu import amp
+        from apex_tpu.optimizers import FusedAdam
+
+        params = {"w": jnp.asarray(rng.randn(8, 2), jnp.bfloat16),
+                  "b": jnp.zeros((2,), jnp.bfloat16)}
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        state = opt.init(params)
+        masters = list(amp.master_params(opt, state))
+        assert len(masters) == 2
+        assert all(m.dtype == jnp.float32 for m in masters)
