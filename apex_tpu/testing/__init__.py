@@ -1,0 +1,23 @@
+"""Test-support helpers (ref: apex/testing/common_utils.py).
+
+The reference gates flaky/platform-specific tests behind env vars
+(APEX_TEST_WITH_ROCM / APEX_SKIP_FLAKY_TEST). Same mechanism here with
+TPU-shaped conditions: the hardware split is TPU-vs-CPU-simulated
+rather than CUDA-vs-ROCm.
+"""
+
+from apex_tpu.testing.common_utils import (
+    SKIP_FLAKY_TEST,
+    TEST_ON_TPU,
+    skipFlakyTest,
+    skipIfNotTpu,
+    skipIfTpu,
+)
+
+__all__ = [
+    "SKIP_FLAKY_TEST",
+    "TEST_ON_TPU",
+    "skipFlakyTest",
+    "skipIfNotTpu",
+    "skipIfTpu",
+]

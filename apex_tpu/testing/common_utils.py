@@ -1,0 +1,52 @@
+"""Env-gated test skips (ref: apex/testing/common_utils.py:12-33).
+
+Works under both pytest and unittest: the skip is raised as
+``unittest.SkipTest``, which pytest also understands.
+"""
+
+from __future__ import annotations
+
+import os
+import unittest
+from functools import wraps
+
+
+def _env_flag(name: str) -> bool:
+    return os.getenv(name, "0") == "1"
+
+
+SKIP_FLAKY_TEST = _env_flag("APEX_TPU_SKIP_FLAKY_TEST")
+# explicit opt-in marker that the suite is running against real TPU
+# hardware (kernel impls compiled by Mosaic, not interpreted)
+TEST_ON_TPU = _env_flag("APEX_TPU_TEST_ON_TPU")
+
+
+def _skip_when(cond_fn, reason: str):
+    def deco(fn):
+        @wraps(fn)
+        def wrapper(*args, **kwargs):
+            if cond_fn():
+                raise unittest.SkipTest(reason)
+            return fn(*args, **kwargs)
+
+        return wrapper
+
+    return deco
+
+
+def skipFlakyTest(fn):
+    """ref common_utils.py:26-33 (APEX_SKIP_FLAKY_TEST analog)."""
+    return _skip_when(lambda: SKIP_FLAKY_TEST, "Test is flaky.")(fn)
+
+
+def skipIfTpu(fn):
+    """Skip when running against real TPU hardware (the reference's
+    skipIfRocm platform gate, common_utils.py:16-23, with the TPU
+    build's platform split)."""
+    return _skip_when(lambda: TEST_ON_TPU,
+                      "test doesn't currently run on real TPU.")(fn)
+
+
+def skipIfNotTpu(fn):
+    return _skip_when(lambda: not TEST_ON_TPU,
+                      "test needs real TPU hardware.")(fn)

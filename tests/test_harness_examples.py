@@ -205,3 +205,50 @@ class TestMultiproc:
         monkeypatch.setenv("RANK", "0")
         multiproc.initialize_distributed()  # world of 1: no-op
         assert multiproc.world_size() == 1
+
+
+class TestCommonUtils:
+    """ref apex/testing/common_utils.py env-gated skips."""
+
+    def test_skip_flaky_honors_env(self, monkeypatch):
+        import unittest
+
+        from apex_tpu.testing import common_utils
+
+        calls = []
+        monkeypatch.setattr(common_utils, "SKIP_FLAKY_TEST", True)
+
+        @common_utils.skipFlakyTest
+        def flaky():
+            calls.append(1)
+
+        with pytest.raises(unittest.SkipTest):
+            flaky()
+        monkeypatch.setattr(common_utils, "SKIP_FLAKY_TEST", False)
+
+        @common_utils.skipFlakyTest
+        def fine():
+            calls.append(2)
+
+        fine()
+        assert calls == [2]
+
+    def test_tpu_gates(self, monkeypatch):
+        import unittest
+
+        from apex_tpu.testing import common_utils
+
+        monkeypatch.setattr(common_utils, "TEST_ON_TPU", False)
+
+        @common_utils.skipIfNotTpu
+        def needs_tpu():
+            pass
+
+        with pytest.raises(unittest.SkipTest):
+            needs_tpu()
+
+        @common_utils.skipIfTpu
+        def cpu_ok():
+            return "ran"
+
+        assert cpu_ok() == "ran"
