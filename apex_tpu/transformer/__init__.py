@@ -3,7 +3,25 @@
 from apex_tpu.transformer import amp
 from apex_tpu.transformer import context_parallel
 from apex_tpu.transformer import functional
+from apex_tpu.transformer import log_util
 from apex_tpu.transformer import parallel_state
 from apex_tpu.transformer import pipeline_parallel
 from apex_tpu.transformer import tensor_parallel
+from apex_tpu.transformer import utils
+from apex_tpu.transformer.enums import AttnMaskType, AttnType, LayerType
 from apex_tpu.transformer.layers import FusedLayerNorm
+
+__all__ = [
+    "amp",
+    "context_parallel",
+    "functional",
+    "log_util",
+    "parallel_state",
+    "pipeline_parallel",
+    "tensor_parallel",
+    "utils",
+    "LayerType",
+    "AttnType",
+    "AttnMaskType",
+    "FusedLayerNorm",
+]
