@@ -57,3 +57,4 @@ from apex_tpu import rnn  # noqa: E402,F401
 from apex_tpu import fp16_utils  # noqa: E402,F401
 from apex_tpu import runtime  # noqa: E402,F401
 from apex_tpu import profiler  # noqa: E402,F401
+from apex_tpu import testing  # noqa: E402,F401
