@@ -56,7 +56,7 @@ def _pick_block(seq: int, want: int) -> int:
     return max(b, 1)
 
 
-def _mask_block(iq, ik, bq, bk, sq, sk, causal, q_seg, k_seg):
+def _mask_block(iq, ik, bq, bk, sq, sk, causal, window, q_seg, k_seg):
     """fp32 additive mask (bq, bk) for the (iq, ik) block pair."""
     row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
@@ -64,9 +64,23 @@ def _mask_block(iq, ik, bq, bk, sq, sk, causal, q_seg, k_seg):
     if causal:
         # query i attends to keys j <= i + (sk - sq) (supports sk >= sq)
         neg = jnp.where(col > row + (sk - sq), NEG_INF, neg)
+    if window is not None:
+        # sliding window: the last `window` keys up to the diagonal
+        neg = jnp.where(col <= row + (sk - sq) - window, NEG_INF, neg)
     if q_seg is not None:
         neg = jnp.where(q_seg[:, None] != k_seg[None, :], NEG_INF, neg)
     return neg
+
+
+def _block_live(iq, ik, bq, bk, sq, sk, causal, window):
+    """Whether the (iq, ik) block pair can contain any unmasked score."""
+    run = True
+    if causal:
+        run = (ik * bk) <= (iq * bq + bq - 1 + (sk - sq))
+    if window is not None:
+        run = jnp.logical_and(
+            run, (ik * bk + bk - 1) >= (iq * bq + (sk - sq) - (window - 1)))
+    return run
 
 
 # --------------------------------------------------------------------------
@@ -76,7 +90,7 @@ def _mask_block(iq, ik, bq, bk, sq, sk, causal, q_seg, k_seg):
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
                 o_ref, lse_ref, acc_sc, m_sc, l_sc,
-                *, scale, causal, nk, bq, bk, sq, sk):
+                *, scale, causal, window, nk, bq, bk, sq, sk):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -86,10 +100,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
         m_sc[...] = jnp.full_like(m_sc, NEG_INF)
         l_sc[...] = jnp.zeros_like(l_sc)
 
-    # causal: whole block above the diagonal contributes nothing
-    run = True
-    if causal:
-        run = (ik * bk) <= (iq * bq + bq - 1 + (sk - sq))
+    # whole blocks above the diagonal / below the window are skipped
+    run = _block_live(iq, ik, bq, bk, sq, sk, causal, window)
 
     @pl.when(run)
     def _step():
@@ -103,7 +115,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
             s = s + bias_ref[0].astype(jnp.float32)
         q_seg = qs_ref[0] if qs_ref is not None else None
         k_seg = ks_ref[0] if ks_ref is not None else None
-        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, q_seg, k_seg)
+        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, window,
+                            q_seg, k_seg)
 
         m_prev = m_sc[:, :1]                       # (bq, 1)
         l_prev = l_sc[:, :1]
@@ -133,7 +146,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
                                0.0).astype(jnp.float32)
 
 
-def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal,
+def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
                       bq, bk, interpret):
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -190,8 +203,8 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal,
         o_ref, lse_ref, acc_sc, m_sc, l_sc = refs[len(live_specs):]
         _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
                     o_ref, lse_ref, acc_sc, m_sc, l_sc,
-                    scale=scale, causal=causal, nk=nk, bq=bq, bk=bk,
-                    sq=sq, sk=sk)
+                    scale=scale, causal=causal, window=window, nk=nk,
+                    bq=bq, bk=bk, sq=sq, sk=sk)
 
     out, lse = pl.pallas_call(
         kernel,
@@ -224,7 +237,7 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal,
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
                    bias_ref, qs_ref, ks_ref, dq_ref, dq_sc,
-                   *, scale, causal, nk, bq, bk, sq, sk):
+                   *, scale, causal, window, nk, bq, bk, sq, sk):
     ik = pl.program_id(2)
     iq = pl.program_id(1)
 
@@ -232,9 +245,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    run = True
-    if causal:
-        run = (ik * bk) <= (iq * bq + bq - 1 + (sk - sq))
+    run = _block_live(iq, ik, bq, bk, sq, sk, causal, window)
 
     @pl.when(run)
     def _step():
@@ -250,7 +261,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
             s = s + bias_ref[0].astype(jnp.float32)
         q_seg = qs_ref[0] if qs_ref is not None else None
         k_seg = ks_ref[0] if ks_ref is not None else None
-        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, q_seg, k_seg)
+        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, window,
+                            q_seg, k_seg)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -266,7 +278,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
                     bias_ref, qs_ref, ks_ref, dk_ref, dv_ref, dk_sc, dv_sc,
-                    *, scale, causal, nq, bq, bk, sq, sk):
+                    *, scale, causal, window, nq, bq, bk, sq, sk):
     iq = pl.program_id(2)
     ik = pl.program_id(1)
 
@@ -275,9 +287,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    run = True
-    if causal:
-        run = (ik * bk) <= (iq * bq + bq - 1 + (sk - sq))
+    run = _block_live(iq, ik, bq, bk, sq, sk, causal, window)
 
     @pl.when(run)
     def _step():
@@ -293,7 +303,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
             s = s + bias_ref[0].astype(jnp.float32)
         q_seg = qs_ref[0] if qs_ref is not None else None
         k_seg = ks_ref[0] if ks_ref is not None else None
-        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, q_seg, k_seg)
+        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, window,
+                            q_seg, k_seg)
         p = jnp.exp(s - lse)                       # (bq, bk)
         dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
             p, do, (((0,), (0,)), ((), ())),
@@ -311,7 +322,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(res, g, delta, scale, causal, bq, bk, interpret):
+def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
+                      interpret):
     q, k, v, bias, q_seg, k_seg, out, lse = res
     b, h, sq, d = q.shape
     sk = k.shape[2]
@@ -379,8 +391,8 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, bq, bk, interpret):
         ks_ref = next(it) if q_seg is not None else None
         dq_ref, dq_sc = refs[n:]
         _bwd_dq_kernel(*base, bias_ref, qs_ref, ks_ref, dq_ref, dq_sc,
-                       scale=scale, causal=causal, nk=nk, bq=bq, bk=bk,
-                       sq=sq, sk=sk)
+                       scale=scale, causal=causal, window=window, nk=nk,
+                       bq=bq, bk=bk, sq=sq, sk=sk)
 
     dq = pl.pallas_call(
         dq_kernel,
@@ -407,8 +419,8 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, bq, bk, interpret):
         dk_ref, dv_ref, dk_sc, dv_sc = refs[n:]
         _bwd_dkv_kernel(*base, bias_ref, qs_ref, ks_ref,
                         dk_ref, dv_ref, dk_sc, dv_sc,
-                        scale=scale, causal=causal, nq=nq, bq=bq, bk=bk,
-                        sq=sq, sk=sk)
+                        scale=scale, causal=causal, window=window, nq=nq,
+                        bq=bq, bk=bk, sq=sq, sk=sk)
 
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -443,17 +455,17 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, bq, bk, interpret):
 
 
 def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
-                   dropout_rate=0.0, dropout_rng=None):
+                   window=None, dropout_rate=0.0, dropout_rng=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
                    k.astype(jnp.float32))
     if bias is not None:
         s = s + bias.astype(jnp.float32)
-    if causal:
-        row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-        col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-        s = jnp.where(col > row + (sk - sq), NEG_INF, s)
+    if causal or window is not None:
+        # one (sq, sk) block = the full matrix; same mask code as the kernel
+        s = s + _mask_block(0, 0, sq, sk, sq, sk, causal, window, None,
+                            None)[None, None]
     if q_seg is not None:
         seg = q_seg[:, None, :, None] != k_seg[:, None, None, :]
         s = jnp.where(seg, NEG_INF, s)
@@ -475,25 +487,26 @@ def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10))
-def _flash(q, k, v, bias, q_seg, k_seg, scale, causal, bq, bk, interpret):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, bias, q_seg, k_seg, scale, causal, window, bq, bk,
+           interpret):
     out, _ = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal,
-                               bq, bk, interpret)
+                               window, bq, bk, interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, bias, q_seg, k_seg, scale, causal, bq, bk,
-                    interpret):
+def _flash_fwd_rule(q, k, v, bias, q_seg, k_seg, scale, causal, window,
+                    bq, bk, interpret):
     out, lse = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal,
-                                 bq, bk, interpret)
+                                 window, bq, bk, interpret)
     return out, (q, k, v, bias, q_seg, k_seg, out, lse)
 
 
-def _flash_bwd_rule(scale, causal, bq, bk, interpret, res, g):
+def _flash_bwd_rule(scale, causal, window, bq, bk, interpret, res, g):
     q, k, v, bias, q_seg, k_seg, out, lse = res
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
-    dq, dk, dv = _flash_bwd_pallas(res, g, delta, scale, causal, bq, bk,
-                                   interpret)
+    dq, dk, dv = _flash_bwd_pallas(res, g, delta, scale, causal, window,
+                                   bq, bk, interpret)
     dbias = None
     if bias is not None:
         # bias grad by recompute, one (batch, head) slice at a time —
@@ -512,10 +525,9 @@ def _flash_bwd_rule(scale, causal, bq, bk, interpret, res, g):
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             s = s + bias[ib % b_b, ih % h_b].astype(jnp.float32)
-            if causal:
-                row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
-                col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
-                s = jnp.where(col > row + (sk - sq), NEG_INF, s)
+            if causal or window is not None:
+                s = s + _mask_block(0, 0, sq, sk, sq, sk, causal, window,
+                                    None, None)
             if q_seg is not None:
                 seg = q_seg[ib][:, None] != k_seg[ib][None, :]
                 s = jnp.where(seg, NEG_INF, s)
@@ -556,6 +568,7 @@ def flash_attention(
     segment_ids: Optional[jax.Array] = None,
     kv_segment_ids: Optional[jax.Array] = None,
     causal: bool = False,
+    window_size: Optional[int] = None,
     softmax_scale: Optional[float] = None,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
@@ -570,8 +583,13 @@ def flash_attention(
     reference's cu_seqlens packed layout, ref apex/contrib/fmha/fmha.py:33-74).
     ``bias`` is an additive fp32 logit bias broadcastable to
     (batch, heads, seq_q, seq_k) — covers the reference's additive-mask
-    multihead_attn variants. Dropout (on attention probabilities) is only
-    supported on the XLA path (``impl="xla"`` is auto-selected then).
+    multihead_attn variants. ``window_size=w`` (sliding-window / local
+    attention, beyond the reference) restricts each query to its last
+    ``w`` keys up to the diagonal; blocks wholly outside the band skip
+    their MXU work (O(S·w) FLOPs — block DMA still walks the full grid,
+    so bandwidth remains O(S²/block); a banded grid is future work). Dropout (on attention probabilities)
+    is only supported on the XLA path (``impl="xla"`` is auto-selected
+    then).
     """
     impl = resolve_impl(impl)
     if bias is not None:
@@ -583,6 +601,11 @@ def flash_attention(
             raise ValueError(
                 f"bias must be 4-D with each dim 1 or full "
                 f"({(b, h, sq, sk)}); got shape {bias.shape}")
+    if window_size is not None:
+        if not causal:
+            raise ValueError("window_size requires causal=True")
+        if window_size < 1:
+            raise ValueError(f"window_size must be >= 1, got {window_size}")
     if softmax_scale is None:
         softmax_scale = q.shape[-1] ** -0.5
     if segment_ids is not None and kv_segment_ids is None:
@@ -598,10 +621,10 @@ def flash_attention(
         impl = "xla"
     if impl == "xla":
         return _attention_xla(q, k, v, bias, segment_ids, kv_segment_ids,
-                              softmax_scale, causal, dropout_rate,
-                              dropout_rng)
+                              softmax_scale, causal, window_size,
+                              dropout_rate, dropout_rng)
     return _flash(q, k, v, bias, segment_ids, kv_segment_ids,
-                  softmax_scale, causal, block_q, block_k,
+                  softmax_scale, causal, window_size, block_q, block_k,
                   interpret_flag(impl))
 
 
