@@ -149,18 +149,23 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
 def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
                       bq, bk, interpret):
     b, h, sq, d = q.shape
+    hk = k.shape[1]
+    group = h // hk          # GQA: q heads per shared kv head
     sk = k.shape[2]
     bq = _pick_block(sq, bq)
     bk = _pick_block(sk, bk)
     nq, nk = sq // bq, sk // bk
     qf = q.reshape(b * h, sq, d)
-    kf = k.reshape(b * h, sk, d)
-    vf = v.reshape(b * h, sk, d)
+    kf = k.reshape(b * hk, sk, d)
+    vf = v.reshape(b * hk, sk, d)
 
     in_specs = [
         pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
-        pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
-        pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh, ik, 0)),
+        # kv heads are shared across each group of q heads — the index
+        # map reads the same kv block for the whole group, so GQA costs
+        # no materialized repeat
+        pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh // group, ik, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh // group, ik, 0)),
     ]
     args = [qf, kf, vf]
     if bias is not None:
@@ -458,6 +463,9 @@ def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
                    window=None, dropout_rate=0.0, dropout_rng=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
+    if k.shape[1] != h:                 # GQA: repeat shared kv heads
+        k = jnp.repeat(k, h // k.shape[1], axis=1)
+        v = jnp.repeat(v, h // v.shape[1], axis=1)
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
                    k.astype(jnp.float32))
     if bias is not None:
@@ -505,8 +513,37 @@ def _flash_fwd_rule(q, k, v, bias, q_seg, k_seg, scale, causal, window,
 def _flash_bwd_rule(scale, causal, window, bq, bk, interpret, res, g):
     q, k, v, bias, q_seg, k_seg, out, lse = res
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
+    b, h, _, d = q.shape
+    hk = k.shape[1]
+    if hk != h:
+        # GQA backward: run the (head-matched) kernels on repeated kv,
+        # then sum each group's dk/dv into the shared head. Costs a
+        # materialized repeat in the backward only; a grouped dkv grid
+        # is future hardware-validated work.
+        group = h // hk
+        k_full = jnp.repeat(k, group, axis=1)
+        v_full = jnp.repeat(v, group, axis=1)
+        res_full = (q, k_full, v_full, bias, q_seg, k_seg, out, lse)
+        dq, dk, dv = _flash_bwd_pallas(res_full, g, delta, scale, causal,
+                                       window, bq, bk, interpret)
+        sk = k.shape[2]
+        # group-sum in fp32: the per-head dk/dv come back already rounded
+        # to the input dtype, so accumulate the group in fp32 and round
+        # once (mirrors the dkv kernel's fp32 VMEM accumulation)
+        dk = (dk.astype(jnp.float32).reshape(b, hk, group, sk, d)
+              .sum(2).astype(k.dtype))
+        dv = (dv.astype(jnp.float32).reshape(b, hk, group, sk, d)
+              .sum(2).astype(v.dtype))
+        return _finish_bwd(res, g, delta, dq, dk, dv, scale, causal, window)
     dq, dk, dv = _flash_bwd_pallas(res, g, delta, scale, causal, window,
                                    bq, bk, interpret)
+    return _finish_bwd(res, g, delta, dq, dk, dv, scale, causal, window)
+
+
+def _finish_bwd(res, g, delta, dq, dk, dv, scale, causal, window):
+    """Shared tail of the backward rule: bias cotangent by recompute
+    plus the integer (segment-id) cotangents."""
+    q, k, v, bias, q_seg, k_seg, out, lse = res
     dbias = None
     if bias is not None:
         # bias grad by recompute, one (batch, head) slice at a time —
@@ -514,6 +551,7 @@ def _flash_bwd_rule(scale, causal, window, bq, bk, interpret, res, g):
         # broadcast-shaped) bias cotangent.
         b, h, sq, _ = q.shape
         sk = k.shape[2]
+        group = h // k.shape[1]         # GQA: kv head shared per group
         b_b, h_b, sq_b, sk_b = bias.shape
         bmap = _bias_index_map(b_b, h_b, h)
 
@@ -521,7 +559,7 @@ def _flash_bwd_rule(scale, causal, window, bq, bk, interpret, res, g):
             ib, ih = bh // h, bh % h
             s = jax.lax.dot_general(
                 q[ib, ih].astype(jnp.float32) * scale,
-                k[ib, ih].astype(jnp.float32),
+                k[ib, ih // group].astype(jnp.float32),
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             s = s + bias[ib % b_b, ih % h_b].astype(jnp.float32)
@@ -534,7 +572,7 @@ def _flash_bwd_rule(scale, causal, window, bq, bk, interpret, res, g):
             p = jnp.exp(s - lse[ib, ih][:, None])
             dp = jax.lax.dot_general(
                 g[ib, ih].astype(jnp.float32),
-                v[ib, ih].astype(jnp.float32),
+                v[ib, ih // group].astype(jnp.float32),
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
             ds = p * (dp - delta[ib, ih][:, None])
@@ -601,6 +639,10 @@ def flash_attention(
             raise ValueError(
                 f"bias must be 4-D with each dim 1 or full "
                 f"({(b, h, sq, sk)}); got shape {bias.shape}")
+    if q.shape[1] % k.shape[1] or k.shape[1] != v.shape[1]:
+        raise ValueError(
+            f"kv heads ({k.shape[1]}/{v.shape[1]}) must be equal and "
+            f"divide q heads ({q.shape[1]})")
     if window_size is not None:
         if not causal:
             raise ValueError("window_size requires causal=True")
