@@ -194,6 +194,16 @@ def run(perf=False, kimpl="pallas"):
           lambda q_, k_, vv, impl: ops.flash_attention(
               q_, k_, vv, causal=True, impl=impl),
           qb, kb, vb, tol=5e-2)
+    check("flash_attention sliding-window",
+          lambda q_, k_, vv, impl: ops.flash_attention(
+              q_, k_, vv, causal=True, window_size=256, impl=impl),
+          q, k, v_, grad_wrt=(0, 1, 2), tol=2e-2)
+    kg = jnp.asarray(rng.randn(2, 2, 1024, 128).astype(np.float32) * 0.1)
+    vg = jnp.asarray(rng.randn(2, 2, 1024, 128).astype(np.float32) * 0.1)
+    check("flash_attention GQA (8q/2kv, fwd+bwd)",
+          lambda q_, k_, vv, impl: ops.flash_attention(
+              q_, k_, vv, causal=True, impl=impl),
+          q, kg, vg, grad_wrt=(0, 1, 2), tol=2e-2)
 
     n_fail = sum(1 for _, ok, *_ in results if not ok)
     print(f"\n{len(results) - n_fail}/{len(results)} ops pass on "
