@@ -1,0 +1,198 @@
+"""Defensive JAX-backend bring-up for driver-invoked entry points.
+
+The reference assumes a healthy CUDA runtime and simply crashes when it
+is absent (ref: apex/__init__.py:13-24 raises on missing torch CUDA
+extensions). A TPU-tunnel environment is weaker: the backend plugin can
+*hang* during initialization (tunnel down) or raise mid-setup (tunnel
+flaky), and both failure modes previously took the whole entry point
+down with them (round-1 artifacts: bench rc=1, multichip dryrun rc=124).
+
+This module makes backend acquisition total:
+
+- :func:`probe_default_backend` tests the default backend in a
+  **subprocess with a hard timeout**, so a hanging plugin can never hang
+  the caller.
+- :func:`force_cpu_backend` unregisters hijacking plugin hooks and
+  forces the XLA CPU backend with a simulated device count, working
+  both before first backend init and (best-effort, via
+  ``jax.extend.backend.clear_backends``) after a failed one.
+- :func:`ensure_backend` composes the two: healthy default backend if
+  one answers within the timeout, CPU fallback otherwise — always
+  returning a report of what happened instead of raising.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import sys
+from dataclasses import dataclass, field
+
+_PROBE_TIMEOUT_ENV = "APEX_TPU_BACKEND_PROBE_TIMEOUT"
+_DEFAULT_PROBE_TIMEOUT = 120.0
+
+_PROBE_SRC = (
+    "import jax; ds = jax.devices(); "
+    "print('PROBE_OK', jax.default_backend(), len(ds), flush=True)"
+)
+
+
+@dataclass
+class BackendReport:
+    """What :func:`ensure_backend` did and why."""
+
+    platform: str               # resolved jax.default_backend()
+    n_devices: int
+    fallback: bool              # True = CPU fallback was forced
+    note: str = ""              # human-readable reason for a fallback
+    probe: dict = field(default_factory=dict)
+
+    def as_detail(self) -> dict:
+        d = {"backend": self.platform, "n_devices": self.n_devices}
+        if self.fallback:
+            d["backend_fallback"] = self.note or "forced-cpu"
+        return d
+
+
+def _strip_plugin_hooks() -> None:
+    """Unregister the axon tunnel plugin's backend hooks (idempotent).
+
+    The plugin injects itself via a ``sitecustomize`` on PYTHONPATH and
+    wraps ``jax._src.xla_bridge._get_backend_uncached``; with the tunnel
+    down, any backend lookup then blocks for minutes. Same dance as
+    tests/conftest.py.
+    """
+    sys.path[:] = [p for p in sys.path if ".axon_site" not in p]
+    os.environ.pop("PYTHONPATH", None)
+
+    import jax._src.xla_bridge as xb
+
+    xb._backend_factories.pop("axon", None)
+    hook = xb._get_backend_uncached
+    if getattr(hook, "__name__", "") == "_axon_get_backend_uncached":
+        for cell in hook.__closure__ or ():
+            if callable(cell.cell_contents):
+                xb._get_backend_uncached = cell.cell_contents
+
+
+def force_cpu_backend(n_devices: int = 1) -> None:
+    """Force the XLA CPU backend with ``n_devices`` simulated devices.
+
+    Safe to call before any backend init; after a (failed) init it
+    clears cached backends so the platform/device-count changes take
+    effect. A CPU backend that is already up with enough devices is
+    left untouched (the simulated count cannot change post-init).
+    """
+    import jax
+    import jax._src.xla_bridge as xb
+
+    _strip_plugin_hooks()
+
+    if xb.backends_are_initialized():
+        try:
+            if (jax.default_backend() == "cpu"
+                    and jax.device_count() >= n_devices):
+                return
+        except Exception:  # noqa: BLE001 — broken init, clear below
+            pass
+        jax.extend.backend.clear_backends()
+
+    # Never SHRINK a preset simulated-device count (e.g. a test harness
+    # that already exported an 8-device mesh before calling entry()).
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(r"xla_force_host_platform_device_count=(\d+)", flags)
+    preset = int(m.group(1)) if m else 0
+    preset = max(preset, getattr(jax.config, "jax_num_cpu_devices", 0) or 0)
+    n_devices = max(n_devices, preset)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (
+            f"{flags} --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    try:
+        # Authoritative post-import knob (XLA_FLAGS is only re-read on a
+        # fresh client; this config is read at every client creation).
+        jax.config.update("jax_num_cpu_devices", n_devices)
+    except Exception:  # noqa: BLE001 — older jax or already-up backend
+        pass
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    jax.config.update("jax_platforms", "cpu")
+
+
+def probe_default_backend(timeout: float | None = None) -> dict:
+    """Test the default backend in a subprocess with a hard timeout.
+
+    Returns ``{"ok": True, "platform": ..., "n_devices": ...}`` on
+    success, else ``{"ok": False, "error": ...}``. Never raises and
+    never hangs past ``timeout`` (env ``APEX_TPU_BACKEND_PROBE_TIMEOUT``
+    overrides the default 120 s).
+    """
+    if timeout is None:
+        timeout = float(
+            os.environ.get(_PROBE_TIMEOUT_ENV, _DEFAULT_PROBE_TIMEOUT))
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", _PROBE_SRC],
+            capture_output=True, text=True, timeout=timeout,
+        )
+    except subprocess.TimeoutExpired:
+        return {"ok": False, "error": f"probe timed out after {timeout:.0f}s"}
+    except Exception as e:  # noqa: BLE001
+        return {"ok": False, "error": f"probe failed to launch: {e}"}
+
+    for line in res.stdout.splitlines():
+        if line.startswith("PROBE_OK"):
+            _, platform, n = line.split()
+            return {"ok": True, "platform": platform, "n_devices": int(n)}
+    tail = (res.stderr or res.stdout or "").strip().splitlines()
+    return {
+        "ok": False,
+        "error": (f"probe rc={res.returncode}: "
+                  + (tail[-1][:200] if tail else "no output")),
+    }
+
+
+def ensure_backend(min_devices: int = 1,
+                   probe_timeout: float | None = None) -> BackendReport:
+    """Guarantee a usable backend with >= ``min_devices`` devices.
+
+    Order of preference: (1) a backend already initialized in-process,
+    (2) the default backend if a subprocess probe confirms it healthy
+    within the timeout, (3) forced CPU with ``min_devices`` simulated
+    devices. Total: always returns, never hangs on a dead tunnel.
+    """
+    import jax
+    import jax._src.xla_bridge as xb
+
+    if xb.backends_are_initialized():
+        try:
+            n = jax.device_count()
+            if n >= min_devices:
+                return BackendReport(jax.default_backend(), n, fallback=False)
+            note = (f"initialized backend has {n} devices, "
+                    f"need {min_devices}")
+        except Exception as e:  # noqa: BLE001
+            note = f"initialized backend broken: {type(e).__name__}: {e}"
+        force_cpu_backend(min_devices)
+        return BackendReport(
+            "cpu", jax.device_count(), fallback=True, note=note)
+
+    # If the environment already pins CPU, don't waste a probe.
+    if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        force_cpu_backend(min_devices)
+        return BackendReport("cpu", jax.device_count(), fallback=False,
+                             note="JAX_PLATFORMS=cpu preset")
+
+    probe = probe_default_backend(probe_timeout)
+    if probe.get("ok") and probe["n_devices"] >= min_devices:
+        # Probe just succeeded seconds ago; in-process init is safe.
+        return BackendReport(
+            jax.default_backend(), jax.device_count(),
+            fallback=False, probe=probe)
+
+    note = (probe.get("error")
+            or (f"default backend has {probe.get('n_devices')} devices, "
+                f"need {min_devices}"))
+    force_cpu_backend(min_devices)
+    return BackendReport(
+        "cpu", jax.device_count(), fallback=True, note=note, probe=probe)

@@ -45,6 +45,14 @@ class GPTConfig:
     hidden_size: int = 1024
     num_layers: int = 24
     num_heads: int = 16
+    # GQA/MQA: number of shared kv heads; None = num_heads (MHA). The
+    # fused QKV projection narrows to h + 2*num_kv_heads*head_dim and
+    # the flash kernel shares each kv head across its q-head group
+    # without materializing a repeat (ops/attention.py index maps).
+    num_kv_heads: Optional[int] = None
+    # sliding-window (local) attention: each query sees its last
+    # `attention_window` keys up to the diagonal. flash backend only.
+    attention_window: Optional[int] = None
     ffn_hidden_size: Optional[int] = None   # default 4*hidden
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
@@ -56,7 +64,32 @@ class GPTConfig:
     # or "ring" (context-parallel ring attention over the "context"
     # axis — run the model inside shard_map with tokens sharded along
     # seq and pass global `positions`)
-    attention_backend: str = "softmax"
+    attention_backend: str = "flash"
+
+    def __post_init__(self):
+        if self.num_kv_heads is not None and self.num_kv_heads < 1:
+            raise ValueError(
+                f"num_kv_heads must be >= 1 or None, got {self.num_kv_heads}")
+        nkv = self.kv_heads
+        if self.num_heads % nkv:
+            raise ValueError(
+                f"num_kv_heads ({nkv}) must divide num_heads "
+                f"({self.num_heads})")
+        if self.attention_window is not None:
+            if self.attention_backend != "flash":
+                raise ValueError(
+                    "attention_window requires attention_backend='flash' "
+                    f"(got {self.attention_backend!r})")
+            if self.attention_window < 1:
+                raise ValueError("attention_window must be >= 1")
+        if nkv != self.num_heads and self.attention_backend == "ring":
+            raise ValueError(
+                "GQA (num_kv_heads != num_heads) is not supported by the "
+                "ring backend")
+
+    @property
+    def kv_heads(self) -> int:
+        return self.num_kv_heads or self.num_heads
 
     @property
     def ffn(self) -> int:
@@ -82,21 +115,40 @@ class ParallelAttention(nn.Module):
         h = cfg.hidden_size
         inside = _inside_axis(TENSOR_AXIS)
         tp = lax.axis_size(TENSOR_AXIS) if inside else 1
+        if cfg.num_heads % tp or cfg.kv_heads % tp:
+            raise ValueError(
+                f"tensor-parallel size {tp} must divide num_heads "
+                f"({cfg.num_heads}) and kv heads ({cfg.kv_heads})")
         heads_local = cfg.num_heads // tp
+        kv_local = cfg.kv_heads // tp
         head_dim = h // cfg.num_heads
 
+        # Fused QKV projection, GQA-narrowed: full width is
+        # h + 2*kv_heads*head_dim, laid out as one chunk per kv group —
+        # [q_0..q_{g-1} | k | v] x kv_heads, g = q heads per kv head.
+        # A contiguous TP slice of the output dim is then whole kv
+        # groups, so the dense and TP-sharded interpretations of the
+        # same weights agree exactly (Megatron's fused-QKV slab trick;
+        # for MHA this degenerates to the per-head [q|k|v] layout).
+        group = heads_local // kv_local
         qkv = ColumnParallelLinear(
-            output_size=3 * h, gather_output=False,
+            output_size=(cfg.num_heads + 2 * cfg.kv_heads) * head_dim,
+            gather_output=False,
             sequence_parallel_enabled=cfg.sequence_parallel,
             param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="qkv",
         )(x)
-        # (s, b, 3h/tp) -> (s, b, heads_local, 3, head_dim)
         s, b = qkv.shape[0], qkv.shape[1]
-        qkv = qkv.reshape(s, b, heads_local, 3 * head_dim)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        qkv = qkv.reshape(s, b, kv_local, (group + 2) * head_dim)
+        q, k, v = jnp.split(
+            qkv, [group * head_dim, (group + 1) * head_dim], axis=-1)
+        # q head g*group+j shares kv head g — matches the flash kernel's
+        # `q_head // group` kv index map (ops/attention.py)
+        q = q.reshape(s, b, heads_local, head_dim)
+        k = k.reshape(s, b, kv_local, head_dim)
+        v = v.reshape(s, b, kv_local, head_dim)
 
         if cfg.attention_backend in ("flash", "ring"):
-            # (s, b, hl, d) -> (b, hl, s, d)
+            # (s, b, heads, d) -> (b, heads, s, d)
             qb, kb, vb = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
             if cfg.attention_backend == "ring":
                 from apex_tpu.transformer.context_parallel import (
@@ -107,8 +159,16 @@ class ParallelAttention(nn.Module):
                     q_positions=positions, kv_positions=positions)
             else:
                 from apex_tpu.ops.attention import flash_attention
-                ctx = flash_attention(qb, kb, vb, causal=True,
-                                      impl=cfg.softmax_impl)
+                drop = (cfg.attention_dropout
+                        if cfg.attention_dropout > 0.0 and not deterministic
+                        else 0.0)
+                ctx = flash_attention(
+                    qb, kb, vb, causal=True,
+                    window_size=cfg.attention_window,
+                    dropout_rate=drop,
+                    dropout_rng=(self.make_rng("dropout")
+                                 if drop > 0.0 else None),
+                    impl=cfg.softmax_impl)
             ctx = ctx.transpose(2, 0, 1, 3).reshape(
                 s, b, heads_local * head_dim)
             return RowParallelLinear(
@@ -116,6 +176,13 @@ class ParallelAttention(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
             )(ctx)
+
+        # softmax backend materializes (s, s) scores; share kv heads by
+        # broadcast (the O(S^2) buffer dominates memory here anyway)
+        if kv_local != heads_local:
+            rep = heads_local // kv_local
+            k = jnp.repeat(k, rep, axis=2)
+            v = jnp.repeat(v, rep, axis=2)
 
         # (b*heads, s, d)
         def to_bhsd(t):

@@ -61,5 +61,7 @@ def annotate(name: Optional[str] = None):
     return wrap
 
 
-__all__ = ["range", "mark_range", "start_trace", "stop_trace", "trace",
-           "annotate"]
+# ``range`` stays importable as an attribute for nvtx-name parity, but
+# is deliberately NOT in __all__: star-importing this module must not
+# shadow the ``range`` builtin in user code (advisor finding, round 1).
+__all__ = ["mark_range", "start_trace", "stop_trace", "trace", "annotate"]

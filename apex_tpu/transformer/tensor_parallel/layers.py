@@ -149,6 +149,17 @@ class RowParallelLinear(nn.Module):
 
     sequence_parallel: output is reduce-scattered over the sequence dim
     instead of all-reduced (ref layers.py:355-363, mappings.py:245).
+
+    reduce_in_fp32 (default True): the cross-rank partial sums are
+    reduced in fp32 and rounded to the compute dtype once, after the
+    collective. The reference all-reduces in the compute dtype (bf16 at
+    tp=8 costs ~3 bits of the partial-sum mantissa); since every matmul
+    here already accumulates in fp32 (``preferred_element_type``), the
+    TP reduction is the one remaining place precision could leak, so the
+    same discipline is applied there. Costs 2x collective bytes on the
+    activation all-reduce; set False to trade precision for bandwidth
+    (reference-matching behavior). Pinned by
+    tests/test_tensor_parallel.py::test_row_parallel_fp32_reduce.
     """
 
     output_size: int
@@ -156,6 +167,7 @@ class RowParallelLinear(nn.Module):
     input_is_parallel: bool = True
     skip_bias_add: bool = False
     sequence_parallel_enabled: bool = False
+    reduce_in_fp32: bool = True
     axis_name: str = TENSOR_AXIS
     param_dtype: Any = jnp.float32
     dtype: Optional[Any] = None
@@ -183,12 +195,15 @@ class RowParallelLinear(nn.Module):
             x.astype(dtype), w.astype(dtype),
             dimension_numbers=(((x.ndim - 1,), (1,)), ((), ())),
             preferred_element_type=jnp.float32,
-        ).astype(dtype)
+        )
+        if not (inside and self.reduce_in_fp32):
+            y = y.astype(dtype)
         if inside:
             if self.sequence_parallel_enabled:
                 y = reduce_scatter_to_sequence_parallel_region(y, self.axis_name)
             else:
                 y = reduce_from_tensor_model_parallel_region(y, self.axis_name)
+        y = y.astype(dtype)
         # bias added AFTER the reduction, replicated (ref layers.py:752-776)
         if self.skip_bias_add:
             return y, (b.astype(dtype) if b is not None else None)

@@ -22,6 +22,19 @@ import json
 import sys
 import time
 
+# Set by __main__ after the backend guard runs; benches fold it into
+# their JSON detail so every record names the backend that actually ran
+# and whether it was a forced fallback.
+_BACKEND_REPORT = None
+
+
+def backend_detail():
+    if _BACKEND_REPORT is not None:
+        return _BACKEND_REPORT.as_detail()
+    import jax
+
+    return {"backend": jax.default_backend()}
+
 
 def bert_large_shapes(hidden=1024, layers=24, vocab=30522, seq=512):
     shapes = [(vocab, hidden), (seq, hidden), (2, hidden), (hidden,), (hidden,)]
@@ -130,7 +143,7 @@ def bench_moe():
             "t_grouped_ms": round(t_grouped * 1e3, 3),
             "t_dense_loop_ms": round(t_loop * 1e3, 3),
             "n_tokens": n_tok, "experts": cfg.num_experts,
-            "backend": jax.default_backend(),
+            **backend_detail(),
         },
     }))
 
@@ -187,7 +200,7 @@ def bench_attn():
             "t_flash_ms": round(t_k * 1e3, 3),
             "t_xla_ms": round(t_x * 1e3, 3) if t_x is not None else None,
             "shape_bhsd": [b, h, s, d], "dtype": str(dt.__name__),
-            "backend": jax.default_backend(),
+            **backend_detail(),
         },
     }))
 
@@ -256,7 +269,7 @@ def bench_gpt():
             "t_flash_ms": round(times["flash"] * 1e3, 3),
             "t_softmax_ms": round(times["softmax"] * 1e3, 3),
             "batch": batch, "seq": seq,
-            "backend": jax.default_backend(),
+            **backend_detail(),
         },
     }))
 
@@ -320,10 +333,10 @@ def main():
     # fused flat-space LAMB: carry = (opt state, probe); params are
     # materialized (unpacked + cast) every step exactly as a training
     # loop needs them, and folded into the probe so the unpack is live.
-    # Both impls of the flat engine are measured — the faster one is
-    # what a user gets by passing impl= — and if one fails on this
-    # backend (e.g. a Mosaic regression) the other still produces the
-    # record.
+    # Both impls of the flat engine are measured for the detail table,
+    # but the headline ratio is the DEFAULT-resolved impl's time — what
+    # a user gets without passing impl= (only if the default impl fails
+    # does the record fall back to the surviving one, with a note).
     from apex_tpu._backend import resolve_impl
 
     fused_times = {}
@@ -354,28 +367,62 @@ def main():
                   file=sys.stderr)
     if not fused_times:
         raise SystemExit("fused LAMB failed under every impl")
-    impl_used = min(fused_times, key=fused_times.get)
+    default_impl = resolve_impl(None)
+    impl_used = (default_impl if default_impl in fused_times
+                 else min(fused_times, key=fused_times.get))
     t_fused = fused_times[impl_used]
 
     ratio = t_fused / t_optax
+    detail = {
+        "n_params": n_params,
+        "n_tensors": len(shapes),
+        "t_optax_ms": round(t_optax * 1e3, 3),
+        "t_fused_ms": round(t_fused * 1e3, 3),
+        "impl": impl_used,
+        "fused_ms_by_impl": {k: round(v * 1e3, 3)
+                             for k, v in fused_times.items()},
+        **backend_detail(),
+    }
+    if impl_used != default_impl:
+        detail["impl_note"] = (
+            f"default impl {default_impl!r} failed; ratio is from "
+            f"{impl_used!r}")
     print(json.dumps({
         "metric": "fused_lamb_step_time_vs_optax",
         "value": round(ratio, 4),
         "unit": "x (fused/optax, lower is better; target <= 1.1)",
         "vs_baseline": round(ratio, 4),
-        "detail": {
-            "n_params": n_params,
-            "n_tensors": len(shapes),
-            "t_optax_ms": round(t_optax * 1e3, 3),
-            "t_fused_ms": round(t_fused * 1e3, 3),
-            "impl": impl_used,
-            "fused_ms_by_impl": {k: round(v * 1e3, 3)
-                                 for k, v in fused_times.items()},
-            "backend": jax.default_backend(),
-        },
+        "detail": detail,
     }))
 
 
 if __name__ == "__main__":
+    # Backend guard FIRST: the tunnel plugin in this environment can
+    # hang or die during backend init (round-1 BENCH_r01.json: rc=1,
+    # raw traceback, zero numbers). ensure_backend probes the default
+    # backend in a subprocess with a hard timeout and falls back to
+    # CPU, so a bench record — with the backend named — always exists.
+    import apex_tpu.backend_guard as _guard
+
+    _BACKEND_REPORT = _guard.ensure_backend(min_devices=1)
+    if _BACKEND_REPORT.fallback:
+        print(f"# backend fallback: {_BACKEND_REPORT.note}", file=sys.stderr)
+
+    mode = sys.argv[1] if len(sys.argv) > 1 else ""
     modes = {"moe": bench_moe, "gpt": bench_gpt, "attn": bench_attn}
-    modes.get(sys.argv[1] if len(sys.argv) > 1 else "", main)()
+    try:
+        modes.get(mode, main)()
+    except BaseException as e:  # noqa: BLE001 — always leave a record
+        if isinstance(e, KeyboardInterrupt):
+            raise
+        print(json.dumps({
+            "metric": f"bench_{mode or 'headline'}_error",
+            "value": None,
+            "unit": "error (no measurement)",
+            "vs_baseline": None,
+            "detail": {
+                "error": f"{type(e).__name__}: {str(e)[:300]}",
+                **backend_detail(),
+            },
+        }))
+        sys.exit(1)
