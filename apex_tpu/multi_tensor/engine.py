@@ -235,10 +235,12 @@ def fused_sumsq_partials(
         return jnp.sum(x * x, axis=1)
 
     def kernel(in_ref, out_ref):
-        i = pl.program_id(0)
         x = in_ref[...].astype(jnp.float32)
-        out_ref[0, 0] = jnp.sum(x * x)
-        del i
+        # reduce the sublane (row) dim in-kernel; the cross-lane sum is a
+        # tiny XLA reduction. The (num_tiles, 1, LANES) output layout
+        # keeps the last-two block dims (1, LANES) legal under Mosaic's
+        # tiling rule (a (1, 1) SMEM block per grid step is not).
+        out_ref[0] = jnp.sum(x * x, axis=0, keepdims=True)
 
     out = pl.pallas_call(
         kernel,
@@ -246,8 +248,9 @@ def fused_sumsq_partials(
         in_specs=[
             pl.BlockSpec((tile_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
         ],
-        out_specs=pl.BlockSpec((1, 1), lambda i: (i, 0), memory_space=pltpu.SMEM),
-        out_shape=jax.ShapeDtypeStruct((num_tiles, 1), jnp.float32),
+        out_specs=pl.BlockSpec((1, 1, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((num_tiles, 1, LANES), jnp.float32),
         interpret=interpret_flag(impl),
     )(_pad_to(buf, padded_n).reshape(padded_n // LANES, LANES))
-    return out[:, 0]
+    return jnp.sum(out, axis=(1, 2))

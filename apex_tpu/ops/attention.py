@@ -57,7 +57,11 @@ def _pick_block(seq: int, want: int) -> int:
 
 
 def _mask_block(iq, ik, bq, bk, sq, sk, causal, window, q_seg, k_seg):
-    """fp32 additive mask (bq, bk) for the (iq, ik) block pair."""
+    """fp32 additive mask (bq, bk) for the (iq, ik) block pair.
+
+    ``q_seg``/``k_seg`` are column (bq, 1) / row (1, bk) int32 blocks
+    (the kernel segment layouts); the XLA path masks segments itself.
+    """
     row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     neg = jnp.zeros((bq, bk), jnp.float32)
@@ -68,7 +72,7 @@ def _mask_block(iq, ik, bq, bk, sq, sk, causal, window, q_seg, k_seg):
         # sliding window: the last `window` keys up to the diagonal
         neg = jnp.where(col <= row + (sk - sq) - window, NEG_INF, neg)
     if q_seg is not None:
-        neg = jnp.where(q_seg[:, None] != k_seg[None, :], NEG_INF, neg)
+        neg = jnp.where(q_seg != k_seg, NEG_INF, neg)
     return neg
 
 
@@ -142,8 +146,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
         valid = m > NEG_INF * 0.5
         safe = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = jnp.where(valid, acc_sc[...] / safe, 0.0).astype(o_ref.dtype)
-        lse_ref[0] = jnp.where(valid[:, 0], m[:, 0] + jnp.log(safe[:, 0]),
-                               0.0).astype(jnp.float32)
+        # lse block is (1, bq, 1): a column vector per q block
+        lse_ref[0] = jnp.where(valid, m + jnp.log(safe), 0.0)
 
 
 def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
@@ -184,12 +188,16 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
         in_specs.append(None)
         args.append(None)
     if q_seg is not None:
-        # (b, seq) read per grid step via bh // h — no h-fold copy
+        # (b, seq) read per grid step via bh // h — no h-fold copy.
+        # Layouts: q segs as a (b, sq, 1) column, k segs as a (b, 1, sk)
+        # row, so the size-1 block dims equal the array dims (Mosaic's
+        # last-two-dims tiling rule rejects 2-D (1, blk) blocks).
         in_specs.append(
-            pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh // h, iq)))
+            pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh // h, iq, 0)))
         in_specs.append(
-            pl.BlockSpec((1, bk), lambda bh, iq, ik: (bh // h, ik)))
-        args += [q_seg, k_seg]
+            pl.BlockSpec((1, 1, bk), lambda bh, iq, ik: (bh // h, 0, ik)))
+        args += [q_seg.reshape(*q_seg.shape, 1),
+                 k_seg.reshape(k_seg.shape[0], 1, k_seg.shape[1])]
     else:
         in_specs += [None, None]
         args += [None, None]
@@ -217,11 +225,11 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
         in_specs=live_specs,
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, bq), lambda bh, iq, ik: (bh, iq)),
+            pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
-            jax.ShapeDtypeStruct((b * h, sq), jnp.float32),
+            jax.ShapeDtypeStruct((b * h, sq, 1), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),
@@ -232,7 +240,7 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*live_args)
-    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)
+    return out.reshape(b, h, sq, d), lse.reshape(b, h, sq)  # lse drops the lane dim
 
 
 # --------------------------------------------------------------------------
@@ -258,8 +266,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = dl_ref[0][:, None]
+        lse = lse_ref[0]                           # (bq, 1) column block
+        delta = dl_ref[0]
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if bias_ref is not None:
@@ -283,11 +291,15 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
                     bias_ref, qs_ref, ks_ref, dk_ref, dv_ref, dk_sc, dv_sc,
-                    *, scale, causal, window, nq, bq, bk, sq, sk):
-    iq = pl.program_id(2)
+                    *, scale, causal, window, nq, n_inner, bq, bk, sq, sk):
+    # inner grid dim sweeps (q-head of the GQA group) x (q block):
+    # t = g * nq + iq. The kv block stays resident; dk/dv accumulate in
+    # VMEM across the whole group — no materialized kv repeat.
+    t = pl.program_id(2)
+    iq = t % nq
     ik = pl.program_id(1)
 
-    @pl.when(iq == 0)
+    @pl.when(t == 0)
     def _init():
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
@@ -300,8 +312,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         k = k_ref[0].astype(jnp.float32)
         v = v_ref[0].astype(jnp.float32)
         do = do_ref[0].astype(jnp.float32)
-        lse = lse_ref[0][:, None]
-        delta = dl_ref[0][:, None]
+        lse = lse_ref[0]                           # (bq, 1) column block
+        delta = dl_ref[0]
         s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         if bias_ref is not None:
@@ -321,7 +333,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    @pl.when(iq == nq - 1)
+    @pl.when(t == n_inner - 1)
     def _fin():
         dk_ref[0] = dk_sc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
@@ -331,61 +343,69 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
                       interpret):
     q, k, v, bias, q_seg, k_seg, out, lse = res
     b, h, sq, d = q.shape
+    hk = k.shape[1]
+    group = h // hk          # GQA: q heads per shared kv head
     sk = k.shape[2]
     bq = _pick_block(sq, bq)
     bk = _pick_block(sk, bk)
     nq, nk = sq // bq, sk // bk
 
-    def flat(t, s):
-        return t.reshape(b * h, s, -1)
-
-    qf, kf, vf = flat(q, sq), flat(k, sk), flat(v, sk)
-    dof = flat(g, sq)
-    lsef = lse.reshape(b * h, sq)
-    dlf = delta.reshape(b * h, sq)
+    qf = q.reshape(b * h, sq, d)
+    kf = k.reshape(b * hk, sk, d)
+    vf = v.reshape(b * hk, sk, d)
+    dof = g.reshape(b * h, sq, d)
+    lsef = lse.reshape(b * h, sq, 1)     # column layout (Mosaic tiling)
+    dlf = delta.reshape(b * h, sq, 1)
     if bias is not None:
         b_b, h_b, sq_b, sk_b = bias.shape
         bias_f = bias.reshape(b_b * h_b, sq_b, sk_b)
-        bmap = _bias_index_map(b_b, h_b, h)
 
-    def build(order_kv_major):
-        # the two kernels differ only in grid meaning:
-        # dq: grid=(bh, iq, ik); dkv: grid=(bh, ik, iq)
-        if order_kv_major:
-            iq_of = lambda a, b_: b_             # noqa: E731
-            ik_of = lambda a, b_: a              # noqa: E731
-        else:
-            iq_of = lambda a, b_: a              # noqa: E731
-            ik_of = lambda a, b_: b_             # noqa: E731
-        qi = lambda bh, a, b_: (bh, iq_of(a, b_), 0)   # noqa: E731
-        ki = lambda bh, a, b_: (bh, ik_of(a, b_), 0)   # noqa: E731
-        rowi = lambda bh, a, b_: (bh, iq_of(a, b_))    # noqa: E731
+    def build(iq_of, ik_of, qh_of, kvh_of, batch_of):
+        """Block specs for (q, k, v, do, lse, dl [, bias][, segs]).
+
+        ``*_of`` map grid indices -> q-block / k-block / flat-q-head /
+        flat-kv-head / batch index; the dq and dkv passes differ only in
+        those maps.
+        """
+        qi = lambda *g_: (qh_of(*g_), iq_of(*g_), 0)   # noqa: E731
+        ki = lambda *g_: (kvh_of(*g_), ik_of(*g_), 0)  # noqa: E731
         specs = [
             pl.BlockSpec((1, bq, d), qi),
             pl.BlockSpec((1, bk, d), ki),
             pl.BlockSpec((1, bk, d), ki),
             pl.BlockSpec((1, bq, d), qi),
-            pl.BlockSpec((1, bq), rowi),
-            pl.BlockSpec((1, bq), rowi),
+            pl.BlockSpec((1, bq, 1), qi),
+            pl.BlockSpec((1, bq, 1), qi),
         ]
         arr = [qf, kf, vf, dof, lsef, dlf]
         if bias is not None:
+            def bias_idx(*g_):
+                ib = batch_of(*g_)
+                ih = qh_of(*g_) - ib * h      # head within the batch
+                return (ib % b_b * h_b + ih % h_b,
+                        iq_of(*g_) if sq_b > 1 else 0,
+                        ik_of(*g_) if sk_b > 1 else 0)
             specs.append(pl.BlockSpec(
                 (1, bq if sq_b > 1 else 1, bk if sk_b > 1 else 1),
-                lambda bh, a, b_: (bmap(bh),
-                                   iq_of(a, b_) if sq_b > 1 else 0,
-                                   ik_of(a, b_) if sk_b > 1 else 0)))
+                bias_idx))
             arr.append(bias_f)
         if q_seg is not None:
             specs.append(pl.BlockSpec(
-                (1, bq), lambda bh, a, b_: (bh // h, iq_of(a, b_))))
+                (1, bq, 1), lambda *g_: (batch_of(*g_), iq_of(*g_), 0)))
             specs.append(pl.BlockSpec(
-                (1, bk), lambda bh, a, b_: (bh // h, ik_of(a, b_))))
-            arr += [q_seg, k_seg]
+                (1, 1, bk), lambda *g_: (batch_of(*g_), 0, ik_of(*g_))))
+            arr += [q_seg.reshape(*q_seg.shape, 1),
+                    k_seg.reshape(k_seg.shape[0], 1, k_seg.shape[1])]
         return specs, arr
 
-    # dq pass
-    specs, arr = build(order_kv_major=False)
+    # dq pass: grid (b*h, iq, ik); kv heads shared via the index map
+    specs, arr = build(
+        iq_of=lambda bh, a, b_: a,
+        ik_of=lambda bh, a, b_: b_,
+        qh_of=lambda bh, a, b_: bh,
+        kvh_of=lambda bh, a, b_: bh // group,
+        batch_of=lambda bh, a, b_: bh // h,
+    )
 
     def dq_kernel(*refs):
         n = len(specs)
@@ -411,8 +431,20 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
         interpret=interpret,
     )(*arr)
 
-    # dk/dv pass
-    specs, arr = build(order_kv_major=True)
+    # dk/dv pass: grid (b*hk, ik, group*nq) — the kv block stays put
+    # while the inner dim walks every (q head of the group, q block);
+    # dk/dv accumulate in VMEM so GQA needs no materialized repeat and
+    # backward peak memory is independent of h/hk.
+    n_inner = group * nq
+    qhead = lambda bhk, a, t: (                      # noqa: E731
+        (bhk // hk) * h + (bhk % hk) * group + t // nq)
+    specs, arr = build(
+        iq_of=lambda bhk, a, t: t % nq,
+        ik_of=lambda bhk, a, t: a,
+        qh_of=qhead,
+        kvh_of=lambda bhk, a, t: bhk,
+        batch_of=lambda bhk, a, t: bhk // hk,
+    )
 
     def dkv_kernel(*refs):
         n = len(specs)
@@ -425,19 +457,19 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
         _bwd_dkv_kernel(*base, bias_ref, qs_ref, ks_ref,
                         dk_ref, dv_ref, dk_sc, dv_sc,
                         scale=scale, causal=causal, window=window, nq=nq,
-                        bq=bq, bk=bk, sq=sq, sk=sk)
+                        n_inner=n_inner, bq=bq, bk=bk, sq=sq, sk=sk)
 
     dk, dv = pl.pallas_call(
         dkv_kernel,
-        grid=(b * h, nk, nq),
+        grid=(b * hk, nk, n_inner),
         in_specs=specs,
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda bh, ik, iq: (bh, ik, 0)),
-            pl.BlockSpec((1, bk, d), lambda bh, ik, iq: (bh, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda bhk, ik, t: (bhk, ik, 0)),
+            pl.BlockSpec((1, bk, d), lambda bhk, ik, t: (bhk, ik, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((b * h, sk, d), k.dtype),
-            jax.ShapeDtypeStruct((b * h, sk, d), v.dtype),
+            jax.ShapeDtypeStruct((b * hk, sk, d), k.dtype),
+            jax.ShapeDtypeStruct((b * hk, sk, d), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((bk, d), jnp.float32),
@@ -448,10 +480,9 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
         interpret=interpret,
     )(*arr)
 
-    def unflat(t, s):
-        return t.reshape(b, h, s, d)
-
-    return unflat(dq, sq), unflat(dk, sk), unflat(dv, sk)
+    return (dq.reshape(b, h, sq, d),
+            dk.reshape(b, hk, sk, d),
+            dv.reshape(b, hk, sk, d))
 
 
 # --------------------------------------------------------------------------
@@ -513,28 +544,6 @@ def _flash_fwd_rule(q, k, v, bias, q_seg, k_seg, scale, causal, window,
 def _flash_bwd_rule(scale, causal, window, bq, bk, interpret, res, g):
     q, k, v, bias, q_seg, k_seg, out, lse = res
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
-    b, h, _, d = q.shape
-    hk = k.shape[1]
-    if hk != h:
-        # GQA backward: run the (head-matched) kernels on repeated kv,
-        # then sum each group's dk/dv into the shared head. Costs a
-        # materialized repeat in the backward only; a grouped dkv grid
-        # is future hardware-validated work.
-        group = h // hk
-        k_full = jnp.repeat(k, group, axis=1)
-        v_full = jnp.repeat(v, group, axis=1)
-        res_full = (q, k_full, v_full, bias, q_seg, k_seg, out, lse)
-        dq, dk, dv = _flash_bwd_pallas(res_full, g, delta, scale, causal,
-                                       window, bq, bk, interpret)
-        sk = k.shape[2]
-        # group-sum in fp32: the per-head dk/dv come back already rounded
-        # to the input dtype, so accumulate the group in fp32 and round
-        # once (mirrors the dkv kernel's fp32 VMEM accumulation)
-        dk = (dk.astype(jnp.float32).reshape(b, hk, group, sk, d)
-              .sum(2).astype(k.dtype))
-        dv = (dv.astype(jnp.float32).reshape(b, hk, group, sk, d)
-              .sum(2).astype(v.dtype))
-        return _finish_bwd(res, g, delta, dq, dk, dv, scale, causal, window)
     dq, dk, dv = _flash_bwd_pallas(res, g, delta, scale, causal, window,
                                    bq, bk, interpret)
     return _finish_bwd(res, g, delta, dq, dk, dv, scale, causal, window)

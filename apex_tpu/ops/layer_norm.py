@@ -86,9 +86,11 @@ def _bwd_kernel(x_ref, w_ref, mean_ref, rstd_ref, g_ref,
         c1 = jnp.mean(wg, axis=-1, keepdims=True)
         dx = rstd * (wg - c1 - xhat * c2)
     dx_ref[...] = dx.astype(dx_ref.dtype)
-    # per-tile partial param grads (summed over tiles in XLA)
-    dw_ref[...] = jnp.sum(g * xhat, axis=0, keepdims=True)
-    db_ref[...] = jnp.sum(g, axis=0, keepdims=True)
+    # per-tile partial param grads (summed over tiles in XLA); the
+    # (num_tiles, 1, hidden) layout keeps a size-1 middle dim so the
+    # (1, 1, hidden) block satisfies Mosaic's last-two-dims tiling rule
+    dw_ref[0] = jnp.sum(g * xhat, axis=0, keepdims=True)
+    db_ref[0] = jnp.sum(g, axis=0, keepdims=True)
 
 
 def _fwd_pallas(x2, w, b, eps, rms, affine, has_bias, impl):
@@ -141,17 +143,17 @@ def _bwd_pallas(x2, w, mean, rstd, g2, rms, affine, impl):
         ],
         out_specs=[
             pl.BlockSpec((tile, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, hidden), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, hidden), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, hidden), lambda i: (i, 0, 0), memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((rows, hidden), x2.dtype),
-            jax.ShapeDtypeStruct((grid[0], hidden), jnp.float32),
-            jax.ShapeDtypeStruct((grid[0], hidden), jnp.float32),
+            jax.ShapeDtypeStruct((grid[0], 1, hidden), jnp.float32),
+            jax.ShapeDtypeStruct((grid[0], 1, hidden), jnp.float32),
         ],
         interpret=interpret_flag(impl),
     )(x2, wa.reshape(1, hidden), mean, rstd, g2)
-    return dx, jnp.sum(dw_p, axis=0), jnp.sum(db_p, axis=0)
+    return dx, jnp.sum(dw_p, axis=(0, 1)), jnp.sum(db_p, axis=(0, 1))
 
 
 # ---------------------------------------------------------------------------
@@ -205,10 +207,17 @@ def _norm(x2, w, b, eps, rms, impl):
     return y
 
 
+def _tileable(x2):
+    # Mosaic needs the row-tile divisible by 8 (sublane) unless it covers
+    # all rows; ragged/small row counts take the XLA path instead.
+    rows = x2.shape[0]
+    return rows % 8 == 0 or rows == _row_tile(rows, x2.shape[1])
+
+
 def _norm_fwd_impl(x2, w, b, eps, rms, impl):
     affine = w is not None
     has_bias = b is not None
-    if impl == "xla":
+    if impl == "xla" or not _tileable(x2):
         return _fwd_xla(x2, w, b, eps, rms, affine, has_bias)
     return _fwd_pallas(x2, w, b, eps, rms, affine, has_bias, impl)
 
@@ -221,7 +230,7 @@ def _norm_fwd(x2, w, b, eps, rms, impl):
 def _norm_bwd(eps, rms, impl, res, g):
     x2, w, b, mean, rstd = res
     affine = w is not None
-    if impl == "xla":
+    if impl == "xla" or not _tileable(x2):
         dx, dw, db = _bwd_xla(x2, w, mean, rstd, g, rms, affine)
     else:
         dx, dw, db = _bwd_pallas(x2, w, mean, rstd, g, rms, affine, impl)

@@ -1,0 +1,77 @@
+"""Empirical probe: which BlockSpec shapes does Mosaic accept on this chip?
+
+Run on real TPU to pin down the tiling rules the interpreter never checks.
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def probe(name, arr_shape, block_shape, index_map, grid):
+    x = jnp.asarray(np.random.RandomState(0).rand(*arr_shape), jnp.float32)
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2.0
+
+    try:
+        out = pl.pallas_call(
+            kernel,
+            grid=grid,
+            in_specs=[pl.BlockSpec(block_shape, index_map)],
+            out_specs=pl.BlockSpec(block_shape, index_map),
+            out_shape=jax.ShapeDtypeStruct(arr_shape, x.dtype),
+        )(x)
+        ok = bool(jnp.allclose(out, x * 2.0))
+        print(f"  [{'PASS' if ok else 'WRONG'}] {name}")
+    except Exception as e:
+        msg = str(e).split("\n")[0][:110]
+        print(f"  [FAIL] {name}: {msg}")
+
+
+print("backend:", jax.default_backend())
+# 2D array, (1, 128) block — the lse/segment pattern
+probe("2d (1,128) of (16,256)", (16, 256), (1, 128),
+      lambda i, j: (i, j), (16, 2))
+# 2D array, full trailing dim
+probe("2d (1,256) of (16,256)", (16, 256), (1, 256),
+      lambda i: (i, 0), (16,))
+# 3D array, (1,1,128) block
+probe("3d (1,1,128) of (4,4,256)", (4, 4, 256), (1, 1, 128),
+      lambda i, j, k: (i, j, k), (4, 4, 2))
+# 2D (8,128) block
+probe("2d (8,128) of (16,256)", (16, 256), (8, 128),
+      lambda i, j: (i, j), (2, 2))
+# 2D (1,1) scalar block
+probe("2d (1,1) of (16,16)", (16, 16), (1, 1),
+      lambda i, j: (i, j), (16, 16))
+# 2D (tile,1) partials
+probe("2d (128,1) of (256,4)", (256, 4), (128, 1),
+      lambda i, j: (i, j), (2, 4))
+# 3D q-style (1, 128, 64) where 64 == full dim
+probe("3d (1,128,64) of (8,256,64)", (8, 256, 64), (1, 128, 64),
+      lambda i, j: (i, j, 0), (8, 2))
+# 2D block (1, 512) == full row
+probe("2d (1,512) of (8,512)", (8, 512), (1, 512),
+      lambda i: (i, 0), (8,))
+# grid-index-arithmetic index map (banded pattern)
+probe("3d banded index map", (8, 256, 128), (1, 128, 128),
+      lambda i, j: (i, jnp.minimum(j, 1), 0), (8, 2))
+# row-stat layouts: trailing singleton vs middle singleton
+probe("3d (1,128,1) of (8,256,1)", (8, 256, 1), (1, 128, 1),
+      lambda i, j: (i, j, 0), (8, 2))
+probe("3d (1,1,128) of (8,1,256)", (8, 1, 256), (1, 1, 128),
+      lambda i, j: (i, 0, j), (8, 2))
+# int32 segment-id style
+probe("2d (8,128) int-ish of (64,256)", (64, 256), (8, 128),
+      lambda i, j: (i, j), (8, 2))
+# scalar output (1,1) of (1,1)
+probe("2d (1,1) of (1,1)", (1, 1), (1, 1), lambda: (0, 0), ())
+# (bq,128) scratch-like full-dim equality: (16,128) of (16,128)
+probe("2d (16,128) of (16,128)", (16, 128), (16, 128), lambda: (0, 0), ())

@@ -12,21 +12,28 @@ Exit code is the number of failing ops.
 """
 
 import argparse
+import os
 import sys
 import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import numpy as np
 
 
-def _time(fn, *args, iters=10, warmup=2):
+def _time(fn, *args, iters=30, warmup=2):
     import jax
 
     for _ in range(warmup):
         jax.block_until_ready(fn(*args))
+    # queue all iterations, sync once: device execution is serialized, so
+    # per-call host->device dispatch latency (large through the axon
+    # tunnel) overlaps instead of being counted iters times
     t0 = time.perf_counter()
+    out = None
     for _ in range(iters):
         out = fn(*args)
-        jax.block_until_ready(out)
+    jax.block_until_ready(out)
     return (time.perf_counter() - t0) / iters
 
 
