@@ -54,6 +54,7 @@ def fused_elementwise(
     per_tensor: Sequence[jax.Array] = (),
     impl: Optional[str] = None,
     tile_rows: Optional[int] = None,
+    aliases: Optional[dict] = None,
 ):
     """Run ``fn`` element-wise over 1-D buffers in one fused kernel.
 
@@ -61,6 +62,14 @@ def fused_elementwise(
     ``ins`` are same-shape blocks, ``scalars`` are 0-d values and
     ``tensor_scalars`` are values broadcastable against the blocks
     (per-tensor values resolved through ``tile_ids``).
+
+    ``aliases`` maps input position (into ``inputs``) -> output position:
+    the output may reuse the input's buffer (the TPU analog of the
+    reference's in-place multi-tensor updates, ref
+    csrc/multi_tensor_apply.cuh:44-147 — kernels write through the same
+    tensor pointers). XLA inserts a copy when the input is still live,
+    so this is always safe; in a jitted train step whose optimizer state
+    flows through, it eliminates the fresh allocation per updated buffer.
 
     Returns ``(outputs, found_inf)`` where ``found_inf`` is a float32
     scalar in {0, 1} covering the ``check_finite`` input indices.
@@ -168,10 +177,19 @@ def fused_elementwise(
         jax.ShapeDtypeStruct((padded_n // LANES, LANES), dt) for dt in out_dtypes
     ] + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
 
+    io_aliases = {}
+    if aliases:
+        # alias indices count ALL pallas inputs, scalar-prefetch args first
+        n_prefetch = len(prefetch)
+        for in_idx, out_idx in aliases.items():
+            if jnp.dtype(inputs[in_idx].dtype) == jnp.dtype(out_dtypes[out_idx]):
+                io_aliases[n_prefetch + in_idx] = out_idx
+
     results = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=out_shapes,
+        input_output_aliases=io_aliases,
         interpret=interpret_flag(impl),
     )(*prefetch, *[b.reshape(padded_n // LANES, LANES) for b in bufs])
 

@@ -141,6 +141,7 @@ def fused_adam_update(
                  1.0 / jnp.asarray(grad_scale, jnp.float32)],
         num_outputs=3, out_dtypes=[p.dtype, m.dtype, v.dtype],
         check_finite=(3,), impl=impl,
+        aliases={0: 0, 1: 1, 2: 2},   # in-place p/m/v (ref in-place semantics)
     )
     return p2, m2, v2, found
 
@@ -167,6 +168,7 @@ def fused_adagrad_update(p, h, g, *, lr, eps=1e-10, weight_decay=0.0,
         scalars=[lr, eps, weight_decay, 1.0 / jnp.asarray(grad_scale, jnp.float32)],
         num_outputs=2, out_dtypes=[p.dtype, h.dtype],
         check_finite=(2,), impl=impl,
+        aliases={0: 0, 1: 1},
     )
     return p2, h2, found
 
@@ -208,6 +210,7 @@ def fused_sgd_update(
                  1.0 if wd_after_momentum else 0.0],
         num_outputs=2, out_dtypes=[p.dtype, mom.dtype],
         check_finite=(2,), impl=impl,
+        aliases={0: 0, 1: 1},
     )
     return p2, mom2, found
 
@@ -253,6 +256,7 @@ def fused_lamb_compute_update_term(
                  bias_correction1, bias_correction2, mode, inv_scale],
         num_outputs=3, out_dtypes=[jnp.float32, m.dtype, v.dtype],
         check_finite=(3,), impl=impl,
+        aliases={3: 0, 1: 1, 2: 2},   # g's buffer becomes the update term
     )
 
 
@@ -324,6 +328,7 @@ def fused_lamb_update(
         scalars=[lr], per_tensor=[ratio],
         tile_ids=space.tile_leaf_ids(_PT_TILE),
         num_outputs=1, out_dtypes=[p.dtype], impl=impl,
+        aliases={0: 0},
     )
     return p2, m2, v2, found
 
@@ -374,6 +379,7 @@ def fused_novograd_update(
         per_tensor=[denom], tile_ids=space.tile_leaf_ids(_PT_TILE),
         num_outputs=2, out_dtypes=[p.dtype, m.dtype],
         check_finite=(2,), impl=impl,
+        aliases={0: 0, 1: 1},
     )
     return p2, m2, v2, found
 
@@ -413,5 +419,6 @@ def fused_lars_update(
         per_tensor=[adaptive], tile_ids=space.tile_leaf_ids(_PT_TILE),
         num_outputs=2, out_dtypes=[p.dtype, mom.dtype],
         check_finite=(2,), impl=impl,
+        aliases={0: 0, 1: 1},
     )
     return p2, mom2, found

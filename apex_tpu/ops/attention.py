@@ -76,6 +76,32 @@ def _mask_block(iq, ik, bq, bk, sq, sk, causal, window, q_seg, k_seg):
     return neg
 
 
+def _dropout_keep(seed, bh, row, col, rate):
+    """Deterministic keep mask from a murmur3-finalizer hash of
+    (seed, batch*head index, row, col).
+
+    Counter-based (no carried RNG state), so the forward and both
+    backward kernels regenerate the identical mask from the same seed —
+    the fusion the reference gets from its softmax+dropout CUDA kernels
+    (ref: apex/contrib/csrc/multihead_attn/). The same math runs in the
+    XLA path, so cross-impl gradient parity is exact for a given seed.
+
+    ``row``/``col``/``bh`` broadcast against each other; returns bool.
+    """
+    x = (row.astype(jnp.uint32) * jnp.uint32(0x9E3779B1)
+         ^ col.astype(jnp.uint32) * jnp.uint32(0x85EBCA77))
+    x = x ^ (jnp.asarray(bh).astype(jnp.uint32) * jnp.uint32(0xC2B2AE3D))
+    x = x ^ jnp.asarray(seed).astype(jnp.uint32)
+    # murmur3 fmix32: full avalanche so neighboring (row, col) decorrelate
+    x = x ^ (x >> 16)
+    x = x * jnp.uint32(0x85EBCA6B)
+    x = x ^ (x >> 13)
+    x = x * jnp.uint32(0xC2B2AE35)
+    x = x ^ (x >> 16)
+    thresh = jnp.uint32(min(int(rate * 2.0 ** 32), 2 ** 32 - 1))
+    return x >= thresh
+
+
 def _block_live(iq, ik, bq, bk, sq, sk, causal, window):
     """Whether the (iq, ik) block pair can contain any unmasked score."""
     run = True
@@ -87,34 +113,66 @@ def _block_live(iq, ik, bq, bk, sq, sk, causal, window):
     return run
 
 
+def _band_k_lo(iq, bq, bk, off, window):
+    """First k-block index intersecting q-block ``iq``'s sliding window."""
+    return jnp.maximum(0, (iq * bq + off - (window - 1)) // bk)
+
+
+def _band_q_lo(ik, bq, bk, off):
+    """First q-block index whose window reaches k-block ``ik``."""
+    return jnp.maximum(0, (ik * bk - off) // bq)
+
+
+def _band_steps(span_block, other_block, window):
+    """Blocks of size ``other_block`` overlapped by a window band swept
+    across one ``span_block``: ceil((span + window - 1)/other) + 1."""
+    return (span_block + window - 1 + other_block - 1) // other_block + 1
+
+
 # --------------------------------------------------------------------------
 # forward kernel
 # --------------------------------------------------------------------------
 
 
-def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
+def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref, seed_ref,
                 o_ref, lse_ref, acc_sc, m_sc, l_sc,
-                *, scale, causal, window, nk, bq, bk, sq, sk):
-    ik = pl.program_id(2)
+                *, scale, causal, window, rate, nk, n_inner, banded,
+                bq, bk, sq, sk):
+    j = pl.program_id(2)
     iq = pl.program_id(1)
+    bh = pl.program_id(0)   # hoisted: program_id inside a pl.when branch
+    # leaks into the cond jaxpr, which interpret mode can't substitute
+    if banded:
+        # sliding window: the inner dim walks only the band's k blocks;
+        # steps past the last block clamp (redundant DMA) and are masked
+        ik = jnp.minimum(_band_k_lo(iq, bq, bk, sk - sq, window) + j, nk - 1)
+        in_range = _band_k_lo(iq, bq, bk, sk - sq, window) + j < nk
+    else:
+        ik = j
+        in_range = True
 
-    @pl.when(ik == 0)
+    @pl.when(j == 0)
     def _init():
         acc_sc[...] = jnp.zeros_like(acc_sc)
         m_sc[...] = jnp.full_like(m_sc, NEG_INF)
         l_sc[...] = jnp.zeros_like(l_sc)
 
     # whole blocks above the diagonal / below the window are skipped
-    run = _block_live(iq, ik, bq, bk, sq, sk, causal, window)
+    run = jnp.logical_and(
+        _block_live(iq, ik, bq, bk, sq, sk, causal, window), in_range)
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)          # (bq, d)
-        k = k_ref[0].astype(jnp.float32)          # (bk, d)
-        v = v_ref[0].astype(jnp.float32)
+        # matmuls run in the input dtype (bf16 hits the MXU's fast path)
+        # with fp32 accumulation; softmax math stays fp32. The scale is
+        # applied to the fp32 scores, not the inputs, so no bits are
+        # lost pre-matmul.
+        q = q_ref[0]                               # (bq, d)
+        k = k_ref[0]                               # (bk, d)
+        v = v_ref[0]
         s = jax.lax.dot_general(
-            q * scale, k, (((1,), (1,)), ((), ())),
-            preferred_element_type=jnp.float32)    # (bq, bk)
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32) * scale    # (bq, bk)
         if bias_ref is not None:
             s = s + bias_ref[0].astype(jnp.float32)
         q_seg = qs_ref[0] if qs_ref is not None else None
@@ -128,14 +186,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
         m_new = jnp.maximum(m_prev, m_cur)
         p = jnp.exp(s - m_new)                     # (bq, bk)
         corr = jnp.exp(m_prev - m_new)             # (bq, 1)
+        # l accumulates the UNdropped sum (the softmax normalizer);
+        # dropout applies to the normalized probabilities, i.e. only to
+        # the p @ v accumulation below
         l_new = corr * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        if rate > 0.0:
+            row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            keep = _dropout_keep(seed_ref[0], bh, row, col, rate)
+            p = jnp.where(keep, p / (1.0 - rate), 0.0)
         acc_sc[...] = acc_sc[...] * corr + jax.lax.dot_general(
-            p, v, (((1,), (0,)), ((), ())),
+            p.astype(v.dtype), v, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         m_sc[...] = jnp.broadcast_to(m_new, m_sc.shape)
         l_sc[...] = jnp.broadcast_to(l_new, l_sc.shape)
 
-    @pl.when(ik == nk - 1)
+    @pl.when(j == n_inner - 1)
     def _fin():
         l = l_sc[:, :1]
         m = m_sc[:, :1]
@@ -150,8 +216,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
         lse_ref[0] = jnp.where(valid, m + jnp.log(safe), 0.0)
 
 
-def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
-                      bq, bk, interpret):
+def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale, causal,
+                      window, rate, bq, bk, interpret):
     b, h, sq, d = q.shape
     hk = k.shape[1]
     group = h // hk          # GQA: q heads per shared kv head
@@ -159,17 +225,28 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
     bq = _pick_block(sq, bq)
     bk = _pick_block(sk, bk)
     nq, nk = sq // bq, sk // bk
+    # banded sliding window: the inner grid dim covers only the k blocks
+    # a q block's window can touch, so DMA traffic is O(S*w) not O(S^2)
+    banded = window is not None and _band_steps(bq, bk, window) < nk
+    n_inner = _band_steps(bq, bk, window) if banded else nk
+    if banded:
+        def ik_of(iq, j):
+            return jnp.minimum(
+                _band_k_lo(iq, bq, bk, sk - sq, window) + j, nk - 1)
+    else:
+        def ik_of(iq, j):
+            return j
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * hk, sk, d)
     vf = v.reshape(b * hk, sk, d)
 
     in_specs = [
-        pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+        pl.BlockSpec((1, bq, d), lambda bh, iq, j: (bh, iq, 0)),
         # kv heads are shared across each group of q heads — the index
         # map reads the same kv block for the whole group, so GQA costs
         # no materialized repeat
-        pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh // group, ik, 0)),
-        pl.BlockSpec((1, bk, d), lambda bh, iq, ik: (bh // group, ik, 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, iq, j: (bh // group, ik_of(iq, j), 0)),
+        pl.BlockSpec((1, bk, d), lambda bh, iq, j: (bh // group, ik_of(iq, j), 0)),
     ]
     args = [qf, kf, vf]
     if bias is not None:
@@ -180,9 +257,9 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
         bmap = _bias_index_map(b_b, h_b, h)
         in_specs.append(pl.BlockSpec(
             (1, bq if sq_b > 1 else 1, bk if sk_b > 1 else 1),
-            lambda bh, iq, ik: (bmap(bh),
-                                iq if sq_b > 1 else 0,
-                                ik if sk_b > 1 else 0)))
+            lambda bh, iq, j: (bmap(bh),
+                               iq if sq_b > 1 else 0,
+                               ik_of(iq, j) if sk_b > 1 else 0)))
         args.append(bias_f)
     else:
         in_specs.append(None)
@@ -193,14 +270,22 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
         # row, so the size-1 block dims equal the array dims (Mosaic's
         # last-two-dims tiling rule rejects 2-D (1, blk) blocks).
         in_specs.append(
-            pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh // h, iq, 0)))
+            pl.BlockSpec((1, bq, 1), lambda bh, iq, j: (bh // h, iq, 0)))
         in_specs.append(
-            pl.BlockSpec((1, 1, bk), lambda bh, iq, ik: (bh // h, 0, ik)))
+            pl.BlockSpec((1, 1, bk),
+                         lambda bh, iq, j: (bh // h, 0, ik_of(iq, j))))
         args += [q_seg.reshape(*q_seg.shape, 1),
                  k_seg.reshape(k_seg.shape[0], 1, k_seg.shape[1])]
     else:
         in_specs += [None, None]
         args += [None, None]
+    if rate > 0.0:
+        # dropout seed rides in SMEM (whole (1,) array each grid step)
+        in_specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+        args.append(jnp.asarray(seed, jnp.uint32).reshape(1))
+    else:
+        in_specs.append(None)
+        args.append(None)
 
     live_specs = [s for s in in_specs if s is not None]
     live_args = [a for a in args if a is not None]
@@ -213,19 +298,21 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
         bias_ref = next(it) if bias is not None else None
         qs_ref = next(it) if q_seg is not None else None
         ks_ref = next(it) if q_seg is not None else None
+        seed_ref = next(it) if rate > 0.0 else None
         o_ref, lse_ref, acc_sc, m_sc, l_sc = refs[len(live_specs):]
-        _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref,
+        _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref, seed_ref,
                     o_ref, lse_ref, acc_sc, m_sc, l_sc,
-                    scale=scale, causal=causal, window=window, nk=nk,
+                    scale=scale, causal=causal, window=window, rate=rate,
+                    nk=nk, n_inner=n_inner, banded=banded,
                     bq=bq, bk=bk, sq=sq, sk=sk)
 
     out, lse = pl.pallas_call(
         kernel,
-        grid=(b * h, nq, nk),
+        grid=(b * h, nq, n_inner),
         in_specs=live_specs,
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
-            pl.BlockSpec((1, bq, 1), lambda bh, iq, ik: (bh, iq, 0)),
+            pl.BlockSpec((1, bq, d), lambda bh, iq, j: (bh, iq, 0)),
+            pl.BlockSpec((1, bq, 1), lambda bh, iq, j: (bh, iq, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
@@ -249,27 +336,36 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal, window,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                   bias_ref, qs_ref, ks_ref, dq_ref, dq_sc,
-                   *, scale, causal, window, nk, bq, bk, sq, sk):
-    ik = pl.program_id(2)
+                   bias_ref, qs_ref, ks_ref, seed_ref, dq_ref, dq_sc,
+                   *, scale, causal, window, rate, nk, n_inner, banded,
+                   bq, bk, sq, sk):
+    j = pl.program_id(2)
     iq = pl.program_id(1)
+    bh = pl.program_id(0)   # hoisted out of the pl.when branch (see fwd)
+    if banded:
+        ik = jnp.minimum(_band_k_lo(iq, bq, bk, sk - sq, window) + j, nk - 1)
+        in_range = _band_k_lo(iq, bq, bk, sk - sq, window) + j < nk
+    else:
+        ik = j
+        in_range = True
 
-    @pl.when(ik == 0)
+    @pl.when(j == 0)
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    run = _block_live(iq, ik, bq, bk, sq, sk, causal, window)
+    run = jnp.logical_and(
+        _block_live(iq, ik, bq, bk, sq, sk, causal, window), in_range)
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                           # (bq, 1) column block
         delta = dl_ref[0]
-        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
             s = s + bias_ref[0].astype(jnp.float32)
         q_seg = qs_ref[0] if qs_ref is not None else None
@@ -279,43 +375,63 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        if rate > 0.0:
+            # dP flows only through kept probabilities: dD = dO V^T,
+            # dP = keep/(1-r) * dD; delta = rowsum(dO*O) still equals
+            # rowsum(P*dP) because the dropout scale cancels in the sum
+            row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            keep = _dropout_keep(seed_ref[0], bh, row, col, rate)
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+        ds = (p * (dp - delta)).astype(k.dtype)
         dq_sc[...] = dq_sc[...] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
 
-    @pl.when(ik == nk - 1)
+    @pl.when(j == n_inner - 1)
     def _fin():
         dq_ref[0] = dq_sc[...].astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                    bias_ref, qs_ref, ks_ref, dk_ref, dv_ref, dk_sc, dv_sc,
-                    *, scale, causal, window, nq, n_inner, bq, bk, sq, sk):
+                    bias_ref, qs_ref, ks_ref, seed_ref,
+                    dk_ref, dv_ref, dk_sc, dv_sc,
+                    *, scale, causal, window, rate, nq, nq_inner, banded,
+                    h, hk, bq, bk, sq, sk):
     # inner grid dim sweeps (q-head of the GQA group) x (q block):
-    # t = g * nq + iq. The kv block stays resident; dk/dv accumulate in
-    # VMEM across the whole group — no materialized kv repeat.
+    # t = g * nq_inner + j. The kv block stays resident; dk/dv accumulate
+    # in VMEM across the whole group — no materialized kv repeat. With a
+    # sliding window, j walks only the band's q blocks (see fwd).
     t = pl.program_id(2)
-    iq = t % nq
+    j = t % nq_inner
     ik = pl.program_id(1)
+    bhk = pl.program_id(0)  # hoisted out of the pl.when branch (see fwd)
+    n_inner = (h // hk) * nq_inner
+    if banded:
+        iq = jnp.minimum(_band_q_lo(ik, bq, bk, sk - sq) + j, nq - 1)
+        in_range = _band_q_lo(ik, bq, bk, sk - sq) + j < nq
+    else:
+        iq = j
+        in_range = True
 
     @pl.when(t == 0)
     def _init():
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    run = _block_live(iq, ik, bq, bk, sq, sk, causal, window)
+    run = jnp.logical_and(
+        _block_live(iq, ik, bq, bk, sq, sk, causal, window), in_range)
 
     @pl.when(run)
     def _step():
-        q = q_ref[0].astype(jnp.float32)
-        k = k_ref[0].astype(jnp.float32)
-        v = v_ref[0].astype(jnp.float32)
-        do = do_ref[0].astype(jnp.float32)
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0]
         lse = lse_ref[0]                           # (bq, 1) column block
         delta = dl_ref[0]
-        s = jax.lax.dot_general(q * scale, k, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
         if bias_ref is not None:
             s = s + bias_ref[0].astype(jnp.float32)
         q_seg = qs_ref[0] if qs_ref is not None else None
@@ -323,12 +439,22 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, window,
                             q_seg, k_seg)
         p = jnp.exp(s - lse)                       # (bq, bk)
-        dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
-            p, do, (((0,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)    # (bk, d)
+        p_v = p                                    # what multiplied V
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
-        ds = p * (dp - delta)
+        if rate > 0.0:
+            # flat q-head index for the mask: this kv head's group,
+            # offset by the inner sweep's q-head g = t // nq_inner
+            bh = (bhk // hk) * h + (bhk % hk) * (h // hk) + t // nq_inner
+            row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            keep = _dropout_keep(seed_ref[0], bh, row, col, rate)
+            p_v = jnp.where(keep, p / (1.0 - rate), 0.0)   # dropped probs
+            dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
+        dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
+            p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)    # (bk, d)
+        ds = (p * (dp - delta)).astype(q.dtype)
         dk_sc[...] = dk_sc[...] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -339,8 +465,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         dv_ref[0] = dv_sc[...].astype(dv_ref.dtype)
 
 
-def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
-                      interpret):
+def _flash_bwd_pallas(res, g, delta, seed, scale, causal, window, rate,
+                      bq, bk, interpret):
     q, k, v, bias, q_seg, k_seg, out, lse = res
     b, h, sq, d = q.shape
     hk = k.shape[1]
@@ -396,12 +522,27 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
                 (1, 1, bk), lambda *g_: (batch_of(*g_), 0, ik_of(*g_))))
             arr += [q_seg.reshape(*q_seg.shape, 1),
                     k_seg.reshape(k_seg.shape[0], 1, k_seg.shape[1])]
+        if rate > 0.0:
+            specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
+            arr.append(jnp.asarray(seed, jnp.uint32).reshape(1))
         return specs, arr
 
-    # dq pass: grid (b*h, iq, ik); kv heads shared via the index map
+    # banded sliding window (see _flash_fwd_pallas): inner dims walk only
+    # the band's blocks, clamped + masked at the edges
+    dq_banded = window is not None and _band_steps(bq, bk, window) < nk
+    nk_inner = _band_steps(bq, bk, window) if dq_banded else nk
+    if dq_banded:
+        def dq_ik_of(iq, j):
+            return jnp.minimum(
+                _band_k_lo(iq, bq, bk, sk - sq, window) + j, nk - 1)
+    else:
+        def dq_ik_of(iq, j):
+            return j
+
+    # dq pass: grid (b*h, iq, j); kv heads shared via the index map
     specs, arr = build(
         iq_of=lambda bh, a, b_: a,
-        ik_of=lambda bh, a, b_: b_,
+        ik_of=lambda bh, a, b_: dq_ik_of(a, b_),
         qh_of=lambda bh, a, b_: bh,
         kvh_of=lambda bh, a, b_: bh // group,
         batch_of=lambda bh, a, b_: bh // h,
@@ -414,16 +555,19 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
         bias_ref = next(it) if bias is not None else None
         qs_ref = next(it) if q_seg is not None else None
         ks_ref = next(it) if q_seg is not None else None
+        seed_ref = next(it) if rate > 0.0 else None
         dq_ref, dq_sc = refs[n:]
-        _bwd_dq_kernel(*base, bias_ref, qs_ref, ks_ref, dq_ref, dq_sc,
-                       scale=scale, causal=causal, window=window, nk=nk,
-                       bq=bq, bk=bk, sq=sq, sk=sk)
+        _bwd_dq_kernel(*base, bias_ref, qs_ref, ks_ref, seed_ref,
+                       dq_ref, dq_sc,
+                       scale=scale, causal=causal, window=window,
+                       rate=rate, nk=nk, n_inner=nk_inner,
+                       banded=dq_banded, bq=bq, bk=bk, sq=sq, sk=sk)
 
     dq = pl.pallas_call(
         dq_kernel,
-        grid=(b * h, nq, nk),
+        grid=(b * h, nq, nk_inner),
         in_specs=specs,
-        out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq, ik: (bh, iq, 0)),
+        out_specs=pl.BlockSpec((1, bq, d), lambda bh, iq, j: (bh, iq, 0)),
         out_shape=jax.ShapeDtypeStruct((b * h, sq, d), q.dtype),
         scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
@@ -431,15 +575,24 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
         interpret=interpret,
     )(*arr)
 
-    # dk/dv pass: grid (b*hk, ik, group*nq) — the kv block stays put
-    # while the inner dim walks every (q head of the group, q block);
-    # dk/dv accumulate in VMEM so GQA needs no materialized repeat and
-    # backward peak memory is independent of h/hk.
-    n_inner = group * nq
+    # dk/dv pass: grid (b*hk, ik, group*nq_inner) — the kv block stays
+    # put while the inner dim walks every (q head of the group, q block
+    # in the band); dk/dv accumulate in VMEM so GQA needs no
+    # materialized repeat and backward peak memory is independent of
+    # h/hk.
+    dkv_banded = window is not None and _band_steps(bk, bq, window) < nq
+    nq_inner = _band_steps(bk, bq, window) if dkv_banded else nq
+    if dkv_banded:
+        def dkv_iq_of(ik, j):
+            return jnp.minimum(_band_q_lo(ik, bq, bk, sk - sq) + j, nq - 1)
+    else:
+        def dkv_iq_of(ik, j):
+            return j
+    n_inner = group * nq_inner
     qhead = lambda bhk, a, t: (                      # noqa: E731
-        (bhk // hk) * h + (bhk % hk) * group + t // nq)
+        (bhk // hk) * h + (bhk % hk) * group + t // nq_inner)
     specs, arr = build(
-        iq_of=lambda bhk, a, t: t % nq,
+        iq_of=lambda bhk, a, t: dkv_iq_of(a, t % nq_inner),
         ik_of=lambda bhk, a, t: a,
         qh_of=qhead,
         kvh_of=lambda bhk, a, t: bhk,
@@ -453,11 +606,14 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
         bias_ref = next(it) if bias is not None else None
         qs_ref = next(it) if q_seg is not None else None
         ks_ref = next(it) if q_seg is not None else None
+        seed_ref = next(it) if rate > 0.0 else None
         dk_ref, dv_ref, dk_sc, dv_sc = refs[n:]
-        _bwd_dkv_kernel(*base, bias_ref, qs_ref, ks_ref,
+        _bwd_dkv_kernel(*base, bias_ref, qs_ref, ks_ref, seed_ref,
                         dk_ref, dv_ref, dk_sc, dv_sc,
-                        scale=scale, causal=causal, window=window, nq=nq,
-                        n_inner=n_inner, bq=bq, bk=bk, sq=sq, sk=sk)
+                        scale=scale, causal=causal, window=window,
+                        rate=rate, nq=nq, nq_inner=nq_inner,
+                        banded=dkv_banded, h=h, hk=hk,
+                        bq=bq, bk=bk, sq=sq, sk=sk)
 
     dk, dv = pl.pallas_call(
         dkv_kernel,
@@ -491,7 +647,7 @@ def _flash_bwd_pallas(res, g, delta, scale, causal, window, bq, bk,
 
 
 def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
-                   window=None, dropout_rate=0.0, dropout_rng=None):
+                   window=None, dropout_rate=0.0, dropout_seed=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if k.shape[1] != h:                 # GQA: repeat shared kv heads
@@ -515,7 +671,12 @@ def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
     # fully-masked rows emit 0 (matches the Pallas kernel's guard)
     p = jnp.where(m > NEG_INF * 0.5, p, 0.0)
     if dropout_rate > 0.0:
-        keep = jax.random.bernoulli(dropout_rng, 1.0 - dropout_rate, p.shape)
+        # same counter-based mask as the Pallas kernels — bit-identical
+        # dropout across impls for a given seed
+        bh = jnp.arange(b * h, dtype=jnp.uint32).reshape(b, h, 1, 1)
+        row = jax.lax.broadcasted_iota(jnp.int32, (1, 1, sq, sk), 2)
+        col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, sq, sk), 3)
+        keep = _dropout_keep(dropout_seed, bh, row, col, dropout_rate)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     return out.astype(q.dtype)
@@ -526,32 +687,35 @@ def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(6, 7, 8, 9, 10, 11))
-def _flash(q, k, v, bias, q_seg, k_seg, scale, causal, window, bq, bk,
-           interpret):
-    out, _ = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal,
-                               window, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+def _flash(q, k, v, bias, q_seg, k_seg, seed, scale, causal, window, rate,
+           bq, bk, interpret):
+    out, _ = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale,
+                               causal, window, rate, bq, bk, interpret)
     return out
 
 
-def _flash_fwd_rule(q, k, v, bias, q_seg, k_seg, scale, causal, window,
-                    bq, bk, interpret):
-    out, lse = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, scale, causal,
-                                 window, bq, bk, interpret)
-    return out, (q, k, v, bias, q_seg, k_seg, out, lse)
+def _flash_fwd_rule(q, k, v, bias, q_seg, k_seg, seed, scale, causal,
+                    window, rate, bq, bk, interpret):
+    out, lse = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale,
+                                 causal, window, rate, bq, bk, interpret)
+    return out, (q, k, v, bias, q_seg, k_seg, seed, out, lse)
 
 
-def _flash_bwd_rule(scale, causal, window, bq, bk, interpret, res, g):
-    q, k, v, bias, q_seg, k_seg, out, lse = res
+def _flash_bwd_rule(scale, causal, window, rate, bq, bk, interpret, res, g):
+    q, k, v, bias, q_seg, k_seg, seed, out, lse = res
+    core = (q, k, v, bias, q_seg, k_seg, out, lse)
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
-    dq, dk, dv = _flash_bwd_pallas(res, g, delta, scale, causal, window,
-                                   bq, bk, interpret)
-    return _finish_bwd(res, g, delta, dq, dk, dv, scale, causal, window)
+    dq, dk, dv = _flash_bwd_pallas(core, g, delta, seed, scale, causal,
+                                   window, rate, bq, bk, interpret)
+    return _finish_bwd(core, g, delta, dq, dk, dv, seed, scale, causal,
+                       window, rate)
 
 
-def _finish_bwd(res, g, delta, dq, dk, dv, scale, causal, window):
+def _finish_bwd(res, g, delta, dq, dk, dv, seed, scale, causal, window,
+                rate):
     """Shared tail of the backward rule: bias cotangent by recompute
-    plus the integer (segment-id) cotangents."""
+    plus the integer (segment-id / seed) cotangents."""
     q, k, v, bias, q_seg, k_seg, out, lse = res
     dbias = None
     if bias is not None:
@@ -584,6 +748,11 @@ def _finish_bwd(res, g, delta, dq, dk, dv, scale, causal, window):
                 v[ib, ih // group].astype(jnp.float32),
                 (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32)
+            if rate > 0.0:
+                row = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+                col = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+                keep = _dropout_keep(seed, bh, row, col, rate)
+                dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
             ds = p * (dp - delta[ib, ih][:, None])
             if sq_b == 1:
                 ds = jnp.sum(ds, axis=0, keepdims=True)
@@ -600,7 +769,7 @@ def _finish_bwd(res, g, delta, dq, dk, dv, scale, causal, window):
         return (None if a is None
                 else np.zeros(a.shape, dtype=jax.dtypes.float0))
 
-    return (dq, dk, dv, dbias, int_ct(q_seg), int_ct(k_seg))
+    return (dq, dk, dv, dbias, int_ct(q_seg), int_ct(k_seg), int_ct(seed))
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -619,8 +788,8 @@ def flash_attention(
     softmax_scale: Optional[float] = None,
     dropout_rate: float = 0.0,
     dropout_rng: Optional[jax.Array] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 1024,
+    block_k: int = 1024,
     impl: Optional[str] = None,
 ) -> jax.Array:
     """Memory-efficient attention over (batch, heads, seq, head_dim).
@@ -632,11 +801,15 @@ def flash_attention(
     (batch, heads, seq_q, seq_k) — covers the reference's additive-mask
     multihead_attn variants. ``window_size=w`` (sliding-window / local
     attention, beyond the reference) restricts each query to its last
-    ``w`` keys up to the diagonal; blocks wholly outside the band skip
-    their MXU work (O(S·w) FLOPs — block DMA still walks the full grid,
-    so bandwidth remains O(S²/block); a banded grid is future work). Dropout (on attention probabilities)
-    is only supported on the XLA path (``impl="xla"`` is auto-selected
-    then).
+    ``w`` keys up to the diagonal. The kernel grids are banded: the
+    inner dimension walks only the k (resp. q) blocks each band
+    touches, so both FLOPs and DMA traffic scale O(S·w), not O(S²).
+
+    ``dropout_rate`` applies dropout to the attention probabilities
+    inside the kernel (the reference's fused softmax+dropout, ref
+    apex/contrib/csrc/multihead_attn/): the mask comes from a
+    counter-based hash seeded by ``dropout_rng``, so the forward and
+    backward kernels — and the XLA path — regenerate the identical mask.
     """
     impl = resolve_impl(impl)
     if bias is not None:
@@ -666,17 +839,27 @@ def flash_attention(
         # queries are all segment 0 and attend only to segment-0 keys.
         segment_ids = jnp.zeros(
             (q.shape[0], q.shape[2]), kv_segment_ids.dtype)
+    seed = None
+    if not (0.0 <= dropout_rate < 1.0):
+        raise ValueError(f"dropout_rate must be in [0, 1), got {dropout_rate}")
     if dropout_rate > 0.0:
         if dropout_rng is None:
             raise ValueError("dropout_rate > 0 requires dropout_rng")
-        impl = "xla"
+        # fold the key into one uint32 seed for the counter-based mask
+        # (accepts typed PRNG keys and legacy raw uint32 key arrays)
+        if jnp.issubdtype(jnp.asarray(dropout_rng).dtype, jax.dtypes.prng_key):
+            kd = jax.random.key_data(dropout_rng)
+        else:
+            kd = jnp.asarray(dropout_rng)
+        kd = kd.astype(jnp.uint32).ravel()
+        seed = kd[0] if kd.size == 1 else kd[0] ^ kd[1]
     if impl == "xla":
         return _attention_xla(q, k, v, bias, segment_ids, kv_segment_ids,
                               softmax_scale, causal, window_size,
-                              dropout_rate, dropout_rng)
-    return _flash(q, k, v, bias, segment_ids, kv_segment_ids,
-                  softmax_scale, causal, window_size, block_q, block_k,
-                  interpret_flag(impl))
+                              dropout_rate, seed)
+    return _flash(q, k, v, bias, segment_ids, kv_segment_ids, seed,
+                  softmax_scale, causal, window_size, float(dropout_rate),
+                  block_q, block_k, interpret_flag(impl))
 
 
 __all__ = ["flash_attention"]

@@ -71,11 +71,41 @@ def time_fn(fn, *args, iters=None, warmup=2, sync=False):
     for _ in range(warmup):
         out = fn(*args)
         wait(out)
+    # queue every iteration, then sync ONCE: device execution is
+    # serialized in submission order, so one end-of-run wait bounds all
+    # iters; waiting per-iteration would add a full host<->device round
+    # trip (milliseconds through a tunneled transport) to every sample
     t0 = time.perf_counter()
     for _ in range(iters):
         out = fn(*args)
-        wait(out)
+    wait(out)
     return (time.perf_counter() - t0) / iters, out
+
+
+def time_fn_threaded(fn, carry, *rest, iters=None, warmup=2):
+    """Time ``fn(carry, *rest) -> (carry', aux)`` threading the carry.
+
+    For optimizer-state benches: jit ``fn`` with ``donate_argnums=(0,)``
+    and each queued call consumes its predecessor's output, so in-flight
+    memory stays at ONE state no matter how many iterations are queued
+    (the jit-level donation the reference gets from in-place updates).
+    Sync protocol matches time_fn: queue all, one device_get at the end.
+    """
+    import jax
+
+    if iters is None:
+        iters = 3 if jax.default_backend() == "cpu" else 8
+    for _ in range(warmup):
+        out = fn(carry, *rest)
+        carry = out[0]
+        jax.block_until_ready(out)
+        jax.device_get(jax.tree.leaves(out[-1])[0])
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(carry, *rest)
+        carry = out[0]
+    jax.device_get(jax.tree.leaves(out[-1])[0])
+    return (time.perf_counter() - t0) / iters, carry
 
 
 def bench_moe():
@@ -232,20 +262,27 @@ def bench_gpt():
     inputs, labels = toks[:, :-1], toks[:, 1:]
 
     times = {}
+    params = state = out = None
     for backend in ("flash", "softmax"):
         if on_cpu:
             cfg = GPTConfig(attention_backend=backend, **base)
         else:
             cfg = GPTConfig.gpt2_345m(attention_backend=backend, **base)
         model = GPTModel(cfg)
+        # drop the previous backend's params/opt-state/output before this
+        # one allocates (~10 GB at 345M scale — two live copies OOM)
+        params = state = out = None
         params = model.init(jax.random.PRNGKey(0), inputs)
         opt = FusedAdam(lr=1e-4, weight_decay=0.01)
         state = opt.init(params)
+        params = None     # the step unpacks from state.master; free the init copy
 
         def loss_fn(p, model=model):
             return gpt_loss_fn(model.apply(p, inputs), labels)
 
-        @jax.jit
+        import functools
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
         def k_steps(state, opt=opt, loss_fn=loss_fn):
             def body(_, carry):
                 state, probe = carry
@@ -256,8 +293,9 @@ def bench_gpt():
 
             return jax.lax.fori_loop(0, k, body, (state, jnp.float32(0.0)))
 
-        t, _ = time_fn(k_steps, state, iters=iters, sync=True)
+        t, out = time_fn_threaded(k_steps, state, iters=iters)
         times[backend] = t / k
+    params = state = out = None
 
     tok_s = batch * seq / times["flash"]
     print(json.dumps({
@@ -315,20 +353,33 @@ def main():
     def probe_all(p):
         return sum(jnp.sum(l) for l in jax.tree.leaves(p))
 
-    # optax baseline: carry = (params, state, probe)
-    @jax.jit
-    def optax_k_steps(params, state, grads):
-        def body(_, carry):
-            params, state, probe = carry
+    # optax baseline: carry = (params, state); donated so queued timing
+    # iterations reuse one buffer set (same discipline as the fused path)
+    import functools
+
+    @functools.partial(jax.jit, donate_argnums=(0,))
+    def optax_k_steps(carry, grads):
+        def body(_, c):
+            params, state, probe = c
             updates, state = tx.update(grads, state, params)
             params = optax.apply_updates(params, updates)
             return params, state, probe + probe_all(params)
 
-        return jax.lax.fori_loop(
-            0, K, body, (params, state, jnp.float32(0.0)))
+        params, state, probe = jax.lax.fori_loop(
+            0, K, body, (*carry, jnp.float32(0.0)))
+        return (params, state), probe
 
-    t_optax, _ = time_fn(optax_k_steps, params, opt_state, grads, sync=True)
+    # device-side copy survives the donation of `params` into the carry
+    # (re-uploading 1.3 GB through a tunneled transport is far slower)
+    params_keep = jax.tree.map(jnp.copy, params)
+    t_optax, ocarry = time_fn_threaded(optax_k_steps, (params, opt_state),
+                                       grads)
     t_optax /= K
+    # release the baseline's buffers (final carry + Adam moments, ~6.7 GB
+    # at BERT-large scale) before the fused states allocate — holding
+    # both OOMs 16 GB chips
+    del ocarry, opt_state
+    params = params_keep
 
     # fused flat-space LAMB: carry = (opt state, probe); params are
     # materialized (unpacked + cast) every step exactly as a training
@@ -340,6 +391,7 @@ def main():
     from apex_tpu._backend import resolve_impl
 
     fused_times = {}
+    fstate = out = None
     for impl in (None, "xla"):
         name = resolve_impl(impl)
         if name in fused_times:
@@ -347,9 +399,10 @@ def main():
         try:
             fused = FusedLAMB(lr=lr, weight_decay=wd, max_grad_norm=0.0,
                               use_nvlamb=True, impl=impl)
+            fstate = out = None     # drop the previous impl's 3x-params
             fstate = fused.init(params)
 
-            @jax.jit
+            @functools.partial(jax.jit, donate_argnums=(0,))
             def fused_k_steps(state, grads, fused=fused):
                 def body(_, carry):
                     state, probe = carry
@@ -359,12 +412,13 @@ def main():
                 return jax.lax.fori_loop(
                     0, K, body, (state, jnp.float32(0.0)))
 
-            t, _ = time_fn(fused_k_steps, fstate, grads, sync=True)
+            t, out = time_fn_threaded(fused_k_steps, fstate, grads)
             fused_times[name] = t / K
         except Exception as e:  # noqa: BLE001 — keep the record flowing
             msg = str(e).split("\n")[0][:120]
             print(f"# fused impl={name} failed: {type(e).__name__}: {msg}",
                   file=sys.stderr)
+    del fstate, out
     if not fused_times:
         raise SystemExit("fused LAMB failed under every impl")
     default_impl = resolve_impl(None)

@@ -407,3 +407,79 @@ class TestGQA:
         for a, b_ in zip(g, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=2e-4, atol=2e-4)
+
+
+class TestDropout:
+    """Fused softmax+dropout inside the flash kernel (ref: the
+    softmax+dropout fusion in apex/contrib/csrc/multihead_attn/)."""
+
+    def test_keep_rate_and_scaling(self, rng, impl):
+        b, h, s, d = 2, 4, 128, 64
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * .3)
+                   for _ in range(3))
+        rate = 0.3
+        out = flash_attention(q, k, v, dropout_rate=rate,
+                              dropout_rng=jax.random.PRNGKey(0), impl=impl)
+        ref = flash_attention(q, k, v, impl=impl)
+        # dropped outputs are unbiased: E[out] = ref; mean over many
+        # independent (row, head) masks converges
+        np.testing.assert_allclose(float(jnp.mean(out)), float(jnp.mean(ref)),
+                                   atol=5e-3)
+        assert not np.allclose(np.asarray(out), np.asarray(ref))
+
+    def test_grads_match_xla_same_mask(self, rng, impl):
+        """Same seed -> bit-identical mask across impls, so grads agree
+        to kernel tolerance (the VERDICT 'grads match XLA-with-same-mask'
+        acceptance)."""
+        b, h, s, d = 2, 4, 64, 32
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * .3)
+                   for _ in range(3))
+        key = jax.random.PRNGKey(42)
+
+        def loss(q, k, v, im):
+            o = flash_attention(q, k, v, causal=True, dropout_rate=0.2,
+                                dropout_rng=key, block_q=32, block_k=32,
+                                impl=im)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        l_k = loss(q, k, v, impl)
+        l_x = loss(q, k, v, "xla")
+        np.testing.assert_allclose(float(l_k), float(l_x), rtol=1e-4)
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, impl)
+        g_ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, "xla")
+        for a, b_ in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_deterministic_per_seed(self, rng, impl):
+        b, h, s, d = 1, 2, 64, 32
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * .3)
+                   for _ in range(3))
+        f = lambda key: flash_attention(  # noqa: E731
+            q, k, v, dropout_rate=0.5, dropout_rng=key, impl=impl)
+        a1 = f(jax.random.PRNGKey(1))
+        a2 = f(jax.random.PRNGKey(1))
+        b2 = f(jax.random.PRNGKey(2))
+        np.testing.assert_array_equal(np.asarray(a1), np.asarray(a2))
+        assert not np.allclose(np.asarray(a1), np.asarray(b2))
+
+    def test_gqa_dropout_grads(self, rng, impl):
+        """Dropout mask uses the flat q-head index in the grouped dkv
+        grid — GQA must agree with the (repeated-kv) XLA path."""
+        b, hq, hk, s, d = 2, 4, 2, 64, 32
+        q = jnp.asarray(rng.randn(b, hq, s, d).astype(np.float32) * .3)
+        k = jnp.asarray(rng.randn(b, hk, s, d).astype(np.float32) * .3)
+        v = jnp.asarray(rng.randn(b, hk, s, d).astype(np.float32) * .3)
+        key = jax.random.PRNGKey(3)
+
+        def loss(q, k, v, im):
+            o = flash_attention(q, k, v, causal=True, dropout_rate=0.15,
+                                dropout_rng=key, block_q=32, block_k=32,
+                                impl=im)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, impl)
+        g_ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, "xla")
+        for a, b_ in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-4)
