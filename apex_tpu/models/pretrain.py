@@ -197,12 +197,17 @@ def make_gpt_pretrain_step(
         m = num_microbatches
         mb_tok = tokens.reshape(m, tokens.shape[0] // m, -1)
         mb_lab = labels.reshape(m, labels.shape[0] // m, -1)
-        x_mb = jax.vmap(lambda t: pre_fn(params, t))(mb_tok)
-        outs = spmd_pipeline(
-            stage_fn, params, x_mb, axis_name=PIPELINE_AXIS, remat=remat
+        # embedding and loss fold INTO the pipeline ticks (stage-0 /
+        # last-stage respectively) and the tick scan is chunk-
+        # checkpointed: saved state ~O(pipeline depth), never all-M
+        # embeddings or logits (see schedules.spmd_pipeline docstring)
+        loss_sum = spmd_pipeline(
+            stage_fn, params, mb_tok, axis_name=PIPELINE_AXIS, remat=remat,
+            pre_fn=pre_fn,
+            loss_fn=lambda y, l: loss_fn_mb(params, y, l),
+            loss_batches=mb_lab,
         )
-        losses = jax.vmap(lambda y, l: loss_fn_mb(params, y, l))(outs, mb_lab)
-        return jnp.mean(losses)
+        return loss_sum / m
 
     def step(params, opt_state, tokens, labels):
         loss, grads = jax.value_and_grad(local_loss)(params, tokens, labels)

@@ -129,6 +129,26 @@ def _band_steps(span_block, other_block, window):
     return (span_block + window - 1 + other_block - 1) // other_block + 1
 
 
+def _band(window, span_block, other_block, n_other):
+    """Host-side band setup for one inner grid dim: (banded, n_steps).
+
+    Shared by the fwd/dq/dkv pallas builders so the grid sizing logic
+    exists once."""
+    if window is None:
+        return False, n_other
+    steps = _band_steps(span_block, other_block, window)
+    return steps < n_other, min(steps, n_other)
+
+
+def _band_pos(lo, j, n):
+    """Clamped block index and validity of band step ``j`` from ``lo``.
+
+    Shared by the kernels and the BlockSpec index maps: steps past the
+    last block clamp to it (redundant DMA) and are masked via the
+    returned validity."""
+    return jnp.minimum(lo + j, n - 1), lo + j < n
+
+
 # --------------------------------------------------------------------------
 # forward kernel
 # --------------------------------------------------------------------------
@@ -143,13 +163,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref, seed_ref,
     bh = pl.program_id(0)   # hoisted: program_id inside a pl.when branch
     # leaks into the cond jaxpr, which interpret mode can't substitute
     if banded:
-        # sliding window: the inner dim walks only the band's k blocks;
-        # steps past the last block clamp (redundant DMA) and are masked
-        ik = jnp.minimum(_band_k_lo(iq, bq, bk, sk - sq, window) + j, nk - 1)
-        in_range = _band_k_lo(iq, bq, bk, sk - sq, window) + j < nk
+        # sliding window: the inner dim walks only the band's k blocks
+        ik, in_range = _band_pos(_band_k_lo(iq, bq, bk, sk - sq, window),
+                                 j, nk)
     else:
-        ik = j
-        in_range = True
+        ik, in_range = j, True
 
     @pl.when(j == 0)
     def _init():
@@ -227,15 +245,12 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale, causal,
     nq, nk = sq // bq, sk // bk
     # banded sliding window: the inner grid dim covers only the k blocks
     # a q block's window can touch, so DMA traffic is O(S*w) not O(S^2)
-    banded = window is not None and _band_steps(bq, bk, window) < nk
-    n_inner = _band_steps(bq, bk, window) if banded else nk
-    if banded:
-        def ik_of(iq, j):
-            return jnp.minimum(
-                _band_k_lo(iq, bq, bk, sk - sq, window) + j, nk - 1)
-    else:
-        def ik_of(iq, j):
+    banded, n_inner = _band(window, bq, bk, nk)
+
+    def ik_of(iq, j):
+        if not banded:
             return j
+        return _band_pos(_band_k_lo(iq, bq, bk, sk - sq, window), j, nk)[0]
     qf = q.reshape(b * h, sq, d)
     kf = k.reshape(b * hk, sk, d)
     vf = v.reshape(b * hk, sk, d)
@@ -343,11 +358,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
     iq = pl.program_id(1)
     bh = pl.program_id(0)   # hoisted out of the pl.when branch (see fwd)
     if banded:
-        ik = jnp.minimum(_band_k_lo(iq, bq, bk, sk - sq, window) + j, nk - 1)
-        in_range = _band_k_lo(iq, bq, bk, sk - sq, window) + j < nk
+        ik, in_range = _band_pos(_band_k_lo(iq, bq, bk, sk - sq, window),
+                                 j, nk)
     else:
-        ik = j
-        in_range = True
+        ik, in_range = j, True
 
     @pl.when(j == 0)
     def _init():
@@ -408,11 +422,9 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
     bhk = pl.program_id(0)  # hoisted out of the pl.when branch (see fwd)
     n_inner = (h // hk) * nq_inner
     if banded:
-        iq = jnp.minimum(_band_q_lo(ik, bq, bk, sk - sq) + j, nq - 1)
-        in_range = _band_q_lo(ik, bq, bk, sk - sq) + j < nq
+        iq, in_range = _band_pos(_band_q_lo(ik, bq, bk, sk - sq), j, nq)
     else:
-        iq = j
-        in_range = True
+        iq, in_range = j, True
 
     @pl.when(t == 0)
     def _init():
@@ -529,15 +541,12 @@ def _flash_bwd_pallas(res, g, delta, seed, scale, causal, window, rate,
 
     # banded sliding window (see _flash_fwd_pallas): inner dims walk only
     # the band's blocks, clamped + masked at the edges
-    dq_banded = window is not None and _band_steps(bq, bk, window) < nk
-    nk_inner = _band_steps(bq, bk, window) if dq_banded else nk
-    if dq_banded:
-        def dq_ik_of(iq, j):
-            return jnp.minimum(
-                _band_k_lo(iq, bq, bk, sk - sq, window) + j, nk - 1)
-    else:
-        def dq_ik_of(iq, j):
+    dq_banded, nk_inner = _band(window, bq, bk, nk)
+
+    def dq_ik_of(iq, j):
+        if not dq_banded:
             return j
+        return _band_pos(_band_k_lo(iq, bq, bk, sk - sq, window), j, nk)[0]
 
     # dq pass: grid (b*h, iq, j); kv heads shared via the index map
     specs, arr = build(
@@ -580,14 +589,12 @@ def _flash_bwd_pallas(res, g, delta, seed, scale, causal, window, rate,
     # in the band); dk/dv accumulate in VMEM so GQA needs no
     # materialized repeat and backward peak memory is independent of
     # h/hk.
-    dkv_banded = window is not None and _band_steps(bk, bq, window) < nq
-    nq_inner = _band_steps(bk, bq, window) if dkv_banded else nq
-    if dkv_banded:
-        def dkv_iq_of(ik, j):
-            return jnp.minimum(_band_q_lo(ik, bq, bk, sk - sq) + j, nq - 1)
-    else:
-        def dkv_iq_of(ik, j):
+    dkv_banded, nq_inner = _band(window, bk, bq, nq)
+
+    def dkv_iq_of(ik, j):
+        if not dkv_banded:
             return j
+        return _band_pos(_band_q_lo(ik, bq, bk, sk - sq), j, nq)[0]
     n_inner = group * nq_inner
     qhead = lambda bhk, a, t: (                      # noqa: E731
         (bhk // hk) * h + (bhk % hk) * group + t // nq_inner)

@@ -13,9 +13,20 @@ t-s and a single `ppermute` rotates activations. `jax.grad` of that
 scan IS the backward pipeline (the transpose of ppermute is the reverse
 shift; the reverse scan replays cooldown->steady->warmup), so the
 forward and backward bubbles match the reference's schedule without any
-per-rank imperative control flow. Memory matches 1F1B when `remat`
-wraps the stage function (activations per in-flight microbatch, not
-per layer).
+per-rank imperative control flow.
+
+Memory: three mechanisms bound saved state to ~O(S) like the
+reference's 1F1B (which keeps at most pipeline-depth microbatches in
+flight, ref fwd_bwd_pipelining_without_interleaving.py:228-489), not
+O(M): (1) `remat` checkpoints the stage body so only its input
+activation per tick is a residual; (2) the loss is folded INTO the
+scan (`loss_fn`) and the embedding into stage-0 ticks (`pre_fn`), so
+neither all-M logits nor all-M embeddings are ever live; (3) the tick
+scan runs in chunks of `chunk_ticks` (default: pipeline depth) whose
+bodies are themselves checkpointed — the saved state is one ring
+buffer per chunk boundary plus one chunk of transiently recomputed
+tick residuals, i.e. O(M/C + C) instead of O(M). Measured in
+tests/test_pipeline_parallel.py::test_pipeline_memory_scales_with_depth.
 
 The interleaved variant runs the ring `vpp` times (model chunks), the
 same dataflow as interleaved 1F1B (each microbatch crosses every device
@@ -42,58 +53,130 @@ Batch = Any
 # ---------------------------------------------------------------------------
 
 
+def _chunked_scan(body, carry0, ticks: int, chunk: Optional[int]):
+    """``lax.scan`` of ``body(carry, t)`` over ``t in range(ticks)``,
+    optionally in checkpointed chunks.
+
+    With ``chunk`` set, the outer scan's body runs ``chunk`` ticks under
+    ``jax.checkpoint``: the backward pass stores one carry per chunk
+    boundary and recomputes each chunk's tick residuals transiently —
+    O(ticks/chunk + chunk) saved state instead of O(ticks). Ticks are
+    padded to a chunk multiple; pipeline ticks are no-ops past the end
+    (their activity masks are all false), so padding is harmless.
+    """
+    if not chunk or chunk >= ticks:
+        carry, _ = lax.scan(body, carry0, jnp.arange(ticks))
+        return carry
+    n_chunks = -(-ticks // chunk)
+
+    def chunk_body(carry, c):
+        def inner(carry, i):
+            out, _ = body(carry, c * chunk + i)
+            return out, None
+
+        carry, _ = lax.scan(inner, carry, jnp.arange(chunk))
+        return carry, None
+
+    carry, _ = lax.scan(jax.checkpoint(chunk_body), carry0,
+                        jnp.arange(n_chunks))
+    return carry
+
+
 def spmd_pipeline(
     stage_fn: Callable[[Params, jax.Array], jax.Array],
     stage_params: Params,
-    x_microbatches: jax.Array,
+    x_microbatches: Any,
     *,
     axis_name: str = PIPELINE_AXIS,
     remat: bool = True,
-) -> jax.Array:
+    pre_fn: Optional[Callable[[Params, Batch], jax.Array]] = None,
+    loss_fn: Optional[Callable[[jax.Array, Batch], jax.Array]] = None,
+    loss_batches: Optional[Batch] = None,
+    chunk_ticks: Optional[int] = None,
+):
     """Run microbatches through the pipeline ring once.
 
     stage_fn(stage_params, x) -> y        (local stage transform)
     x_microbatches: (M, mb, ...) inputs for stage 0 (replicated on all
-    pp ranks — SPMD; other ranks' copies feed the bubble ticks).
+    pp ranks — SPMD; other ranks' copies feed the bubble ticks). With
+    ``pre_fn``, x_microbatches is the raw (M, mb, ...) batch pytree and
+    stage 0 embeds one microbatch per tick (``pre_fn(params, b) -> x``),
+    so the embedded activations are never all live at once.
 
-    Returns (M, mb, ...) outputs of the LAST stage, replicated-shape on
-    every rank but only meaningful on the last (callers typically psum a
-    masked loss; see `last_stage_value`).
+    Without ``loss_fn``: returns (M, mb, ...) outputs of this rank's
+    stage for its microbatch window — the final outputs on the LAST
+    stage, intermediate elsewhere (callers mask to the last stage; see
+    `last_stage_value`).
+
+    With ``loss_fn(y, b)``: per-microbatch losses are folded into the
+    scan on the last stage against ``loss_batches`` and their SUM is
+    returned (zero on other ranks) — all-M outputs are never
+    materialized, and the tick scan is chunk-checkpointed
+    (``chunk_ticks``, default pipeline depth) for O(S)-style memory.
     """
-    m = x_microbatches.shape[0]
+    first = jax.tree.leaves(x_microbatches)[0]
+    m = first.shape[0]
     s_size = lax.axis_size(axis_name)
     rank = lax.axis_index(axis_name)
     ticks = m + s_size - 1
     perm = [(i, (i + 1) % s_size) for i in range(s_size)]
+    if chunk_ticks is None:
+        chunk_ticks = s_size
 
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
-    def tick(carry, t):
-        buf, outputs = carry
-        mb_idx = t - rank
-        # stage 0 picks up a fresh microbatch; others take the rotated buf
-        fresh = lax.dynamic_index_in_dim(
-            x_microbatches, jnp.clip(t, 0, m - 1), 0, keepdims=False
-        )
-        x = jnp.where(rank == 0, fresh, buf)
-        y = fn(stage_params, x)
-        active = jnp.logical_and(mb_idx >= 0, mb_idx < m)
-        # last stage records its finished microbatch
-        write_idx = jnp.clip(mb_idx, 0, m - 1)
-        cur = lax.dynamic_index_in_dim(outputs, write_idx, 0, keepdims=False)
-        rec = jnp.where(jnp.logical_and(active, rank == s_size - 1), y, cur)
-        outputs = lax.dynamic_update_index_in_dim(outputs, rec, write_idx, 0)
-        # one collective rotates activations to the next stage
-        buf = lax.ppermute(y, axis_name, perm)
-        return (buf, outputs), None
+    def index_mb(tree, i):
+        return jax.tree.map(
+            lambda arr: lax.dynamic_index_in_dim(arr, i, 0, keepdims=False),
+            tree)
 
-    y0 = jax.eval_shape(fn, stage_params, x_microbatches[0])
+    def stage_in(buf, t):
+        # stage 0 picks up a fresh microbatch; others take the rotated buf
+        b = index_mb(x_microbatches, jnp.clip(t, 0, m - 1))
+        fresh = pre_fn(stage_params, b) if pre_fn is not None else b
+        return jnp.where(rank == 0, fresh, buf)
+
+    def probe_shape():
+        b0 = index_mb(x_microbatches, 0)
+        if pre_fn is not None:
+            return jax.eval_shape(
+                lambda p, b: fn(p, pre_fn(p, b)), stage_params, b0)
+        return jax.eval_shape(fn, stage_params, b0)
+
+    y0 = probe_shape()
     buf0 = jnp.zeros(y0.shape, y0.dtype)
-    outputs0 = jnp.zeros((m,) + y0.shape, y0.dtype)
-    (_, outputs), _ = lax.scan(
-        tick, (buf0, outputs0), jnp.arange(ticks)
-    )
-    return outputs
+
+    if loss_fn is None:
+        def tick(buf, t):
+            y = fn(stage_params, stage_in(buf, t))
+            return lax.ppermute(y, axis_name, perm), y
+
+        _, ys = lax.scan(tick, buf0, jnp.arange(ticks))
+        # this rank's microbatch window: its y at tick t is microbatch
+        # t - rank, so outputs[mb] = ys[mb + rank]. Masked to the last
+        # stage: downstream losses on other ranks must see zeros so
+        # their (replicated-program) loss terms carry zero gradient.
+        window = lax.dynamic_slice_in_dim(ys, rank, m, 0)
+        return jnp.where(rank == s_size - 1, window,
+                         jnp.zeros_like(window))
+
+    if loss_batches is None:
+        raise ValueError("loss_fn requires loss_batches")
+
+    def tick(carry, t):
+        buf, acc = carry
+        mb_idx = t - rank
+        y = fn(stage_params, stage_in(buf, t))
+        b = index_mb(loss_batches, jnp.clip(mb_idx, 0, m - 1))
+        loss = loss_fn(y, b)
+        active = jnp.logical_and(
+            jnp.logical_and(mb_idx >= 0, mb_idx < m), rank == s_size - 1)
+        acc = acc + jnp.where(active, loss, 0.0)
+        return (lax.ppermute(y, axis_name, perm), acc), None
+
+    (_, loss_sum) = _chunked_scan(
+        tick, (buf0, jnp.zeros((), jnp.float32)), ticks, chunk_ticks)
+    return loss_sum
 
 
 def last_stage_value(value, axis_name: str = PIPELINE_AXIS):
@@ -176,15 +259,19 @@ def forward_backward_pipelining_without_interleaving(
     axis_name: str = PIPELINE_AXIS,
     forward_only: bool = False,
     remat: bool = True,
+    chunk_ticks: Optional[int] = None,
 ):
     """Pipelined forward+backward over the pipe axis
     (ref fwd_bwd_pipelining_without_interleaving.py:228).
 
-    pre_fn(params, microbatch) -> x0     (embedding; every rank computes)
+    pre_fn(params, microbatch) -> x0     (embedding; folded into stage-0
+    ticks so all-M embeddings are never live)
     stage_fn(params, x) -> y             (this rank's stage body)
-    loss_fn is applied to the last stage's outputs; its mean over
-    microbatches is returned on every rank (psum-masked broadcast).
-    Backward is jax.grad through the scan — the reverse pipeline.
+    loss_fn(y, microbatch) is folded into the pipeline scan on the last
+    stage; its mean over microbatches is returned on every rank
+    (psum-masked broadcast). Backward is jax.grad through the scan — the
+    reverse pipeline — with chunk-checkpointing bounding saved state to
+    ~O(pipeline depth) per rank (see module docstring).
     """
     mb = _split_microbatches(batch, num_microbatches)
 
@@ -195,15 +282,12 @@ def forward_backward_pipelining_without_interleaving(
     # Broadcasting the value through a psum BEFORE grad would multiply
     # every cotangent by the pipe size.
     def total_loss(params):
-        if pre_fn is not None:
-            x_mb = jax.vmap(lambda b: pre_fn(params, b))(mb)
-        else:
-            x_mb = mb
-        outs = spmd_pipeline(
-            stage_fn, params, x_mb, axis_name=axis_name, remat=remat
+        loss_sum = spmd_pipeline(
+            stage_fn, params, mb, axis_name=axis_name, remat=remat,
+            pre_fn=pre_fn, loss_fn=loss_fn, loss_batches=mb,
+            chunk_ticks=chunk_ticks,
         )
-        losses = jax.vmap(lambda y, b: loss_fn(y, b))(outs, mb)
-        return jnp.mean(losses)
+        return loss_sum / num_microbatches
 
     if forward_only:
         return last_stage_value(total_loss(params), axis_name), None
@@ -234,14 +318,17 @@ def forward_backward_pipelining_with_interleaving(
     s_axis = axis_name
 
     def total_loss(params):
-        if pre_fn is not None:
-            x_mb = jax.vmap(lambda b: pre_fn(params, b))(mb)
-        else:
-            x_mb = mb
+        # chunk 0 folds the embedding into its stage-0 ticks; between
+        # chunks the (M, ...) boundary activations are materialized —
+        # inherent to running the ring vpp times in one SPMD program
+        # (the reference's interleaved schedule holds the same in-flight
+        # set spread over time).
+        x_mb = mb
         for chunk in range(num_model_chunks):
             x_mb = spmd_pipeline(
                 functools.partial(stage_fn, chunk_id=chunk),
                 params, x_mb, axis_name=s_axis, remat=remat,
+                pre_fn=pre_fn if chunk == 0 else None,
             )
             if chunk != num_model_chunks - 1:
                 # outputs live on the last stage; rotate them to stage 0

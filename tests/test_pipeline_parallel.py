@@ -303,3 +303,47 @@ class TestLtorMasks:
         np.testing.assert_array_equal(np.asarray(pos[0]), [0, 1, 0, 1])
         m = np.asarray(mask[0, 0])
         assert m[2, 0]  # token 2 (new doc) cannot see token 0
+
+
+def test_pipeline_memory_scales_with_depth(pp_mesh, rng):
+    """VERDICT #6 acceptance: compiled peak temp memory of the 1F1B
+    schedule grows ~O(pipeline depth), not O(num_microbatches) — the
+    chunk-checkpointed scan stores one ring buffer per chunk boundary
+    plus one transiently recomputed chunk (ref 1F1B bounds in-flight
+    activations to the depth, fwd_bwd_pipelining_without_
+    interleaving.py:228-489)."""
+    width, mbsz = 64, 4
+
+    def stage_fn(params, h):
+        for i in range(2):
+            h = jnp.tanh(h @ params[0, i])
+        return h
+
+    def loss_fn(y, mb):
+        return jnp.mean(y ** 2)
+
+    def temp_bytes(m):
+        ws = jnp.asarray(rng.randn(PP, 2, width, width) * 0.2, jnp.float32)
+        batch = jnp.asarray(rng.randn(m * mbsz, width), jnp.float32)
+        fn = shard_map(
+            lambda p, b: forward_backward_pipelining_without_interleaving(
+                stage_fn, loss_fn, None, p, b, num_microbatches=m,
+            ),
+            mesh=pp_mesh,
+            in_specs=(P("pipe", None, None, None), P()),
+            out_specs=(P(), P("pipe", None, None, None)),
+            check_vma=False,
+        )
+        compiled = jax.jit(fn).lower(ws, batch).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            pytest.skip("backend reports no memory analysis")
+        return ma.temp_size_in_bytes
+
+    t8 = temp_bytes(8)
+    t32 = temp_bytes(32)
+    # O(M) saved state would grow ~4x going 8 -> 32 microbatches; the
+    # chunked schedule's transient chunk is fixed-size, so the growth
+    # must stay well under 2x (some O(M) terms remain: the raw input
+    # microbatches and per-chunk boundary carries)
+    assert t32 < 2.0 * t8, (t8, t32)
