@@ -123,6 +123,12 @@ def spmd_pipeline(
     if chunk_ticks is None:
         chunk_ticks = s_size
 
+    if loss_fn is None and chunk_ticks != s_size:
+        # the no-loss path returns all-M outputs, which dominate memory
+        # regardless — chunk checkpointing only exists in the loss mode
+        raise ValueError("chunk_ticks requires loss_fn (the outputs mode "
+                         "materializes O(M) results either way)")
+
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
 
     def index_mb(tree, i):

@@ -52,3 +52,9 @@ def impl(request):
     kernel (interpreter mode on CPU), mirroring the reference's
     kernel-vs-reference test style (ref: tests/L0/run_amp/test_multi_tensor_scale.py)."""
     return request.param
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "l1: cross-product integration tier (ref tests/L1/cross_product)")

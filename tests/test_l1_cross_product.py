@@ -1,0 +1,178 @@
+"""L1 cross-product consistency tier.
+
+One small model trained end-to-end under every mixed-precision opt
+level x loss-scale combination; the per-iteration loss trajectories
+must agree across configurations (ref: tests/L1/common/main_amp.py
+dumps per-iteration loss, tests/L1/cross_product/run.sh runs the
+opt-level x loss-scale grid, tests/L1/common/compare.py asserts
+run-to-run agreement).
+
+The reference compares full-dataset imagenet runs; here the workload is
+a deterministic tanh-MLP regression (same synthetic data for every
+config) so the whole grid runs in seconds on the CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp.frontend import OPT_LEVELS, make_scaler
+from apex_tpu.optimizers import FusedSGD
+
+STEPS = 40
+LR = 0.05
+
+
+def _data(rng):
+    x = jnp.asarray(rng.randn(256, 16).astype(np.float32))
+    w_true = jnp.asarray(rng.randn(16, 4).astype(np.float32) * 0.5)
+    y = jnp.tanh(x @ w_true)
+    return x, y
+
+
+def _init_params(rng):
+    return {
+        "w1": jnp.asarray(rng.randn(16, 32).astype(np.float32) * 0.3),
+        "b1": jnp.zeros((32,), jnp.float32),
+        "w2": jnp.asarray(rng.randn(32, 4).astype(np.float32) * 0.3),
+        "b2": jnp.zeros((4,), jnp.float32),
+    }
+
+
+def _forward(params, x, compute_dtype):
+    """Patch-style levels run matmuls in compute_dtype (the whitelist
+    cast); cast-style levels pass already-cast params."""
+    if compute_dtype is not None:
+        params = jax.tree.map(lambda p: p.astype(compute_dtype), params)
+        x = x.astype(compute_dtype)
+    h = jnp.tanh(x @ params["w1"] + params["b1"])
+    out = h @ params["w2"] + params["b2"]
+    return out.astype(jnp.float32)
+
+
+def _train(opt_level, loss_scale, rng_seed=0):
+    """Train the fixture under one (opt_level, loss_scale) config and
+    return the per-step loss trajectory (the compare.py artifact)."""
+    rng = np.random.RandomState(rng_seed)
+    x, y = _data(rng)
+    params0 = _init_params(rng)
+
+    opt = FusedSGD(lr=LR, momentum=0.9, impl="xla")
+    cast_params, opt_state, amp_state = amp.initialize(
+        params0, optimizers=opt, opt_level=opt_level, loss_scale=loss_scale)
+    props = amp_state.properties
+    scaler = make_scaler(props)
+    sst = amp_state.scalers[0]
+
+    @jax.jit
+    def step(model_params, opt_state, sst):
+        def loss_fn(p):
+            pred = _forward(p, x, props.compute_dtype)
+            return jnp.mean((pred - y) ** 2)
+
+        # loss/grads on the MODEL params (cast dtype for O2/O3/O5),
+        # scaled by the carried loss scale
+        loss = loss_fn(model_params)
+        grads = jax.grad(
+            lambda p: scaler.scale_loss(loss_fn(p), sst))(model_params)
+        # fused optimizer: unscale + inf-check + update one kernel pass;
+        # the fp32 master lives in opt_state, step returns fp32 params
+        new_params, opt_state = opt.step(
+            opt_state, grads, grad_scale=sst.loss_scale,
+            skip_if_nonfinite=True)
+        sst2 = scaler.update(sst, opt_state.found_inf)
+        # master -> model copy (the reference's post-step
+        # master_params_to_model_params)
+        if props.cast_model_type is not None:
+            new_params = jax.tree.map(
+                lambda p, m: p.astype(m.dtype), new_params, model_params)
+        return loss, new_params, opt_state, sst2
+
+    losses = []
+    model_params = cast_params
+    for _ in range(STEPS):
+        loss, model_params, opt_state, sst = step(
+            model_params, opt_state, sst)
+        losses.append(float(loss))
+    return np.asarray(losses)
+
+
+GRID = [
+    ("O0", None),
+    ("O1", None),          # dynamic (level default)
+    ("O1", 128.0),         # static
+    ("O2", None),
+    ("O2", 128.0),
+    ("O3", 128.0),         # pure fp16 wants a static scale
+    ("O4", None),          # bf16, no scaling
+    ("O5", None),
+]
+
+
+@pytest.mark.l1
+class TestCrossProduct:
+    @pytest.fixture(scope="class")
+    def trajectories(self):
+        return {cfg: _train(*cfg) for cfg in GRID}
+
+    def test_all_configs_learn(self, trajectories):
+        for cfg, tr in trajectories.items():
+            assert np.isfinite(tr).all(), cfg
+            assert tr[-1] < tr[0] / 3.0, (cfg, tr[0], tr[-1])
+
+    def test_trajectories_match_fp32(self, trajectories):
+        """Every mixed config tracks the O0 fp32 trajectory (loose: the
+        compute dtype rounds every matmul)."""
+        ref = trajectories[("O0", None)]
+        for cfg, tr in trajectories.items():
+            np.testing.assert_allclose(
+                tr, ref, rtol=0.15, atol=2e-3,
+                err_msg=f"{cfg} diverged from fp32 baseline")
+
+    def test_loss_scale_invariance(self, trajectories):
+        """Same level, different loss scale: trajectories agree tightly
+        (scaling must be numerically transparent, ref compare.py's
+        run-to-run assertion)."""
+        np.testing.assert_allclose(
+            trajectories[("O1", None)], trajectories[("O1", 128.0)],
+            rtol=2e-2, atol=1e-4)
+        np.testing.assert_allclose(
+            trajectories[("O2", None)], trajectories[("O2", 128.0)],
+            rtol=2e-2, atol=1e-4)
+
+    def test_patch_vs_cast_agreement(self, trajectories):
+        """O1 ~ O2 (both fp16 math) and O4 ~ O5 (both bf16 math)."""
+        np.testing.assert_allclose(
+            trajectories[("O1", None)], trajectories[("O2", None)],
+            rtol=5e-2, atol=5e-4)
+        np.testing.assert_allclose(
+            trajectories[("O4", None)], trajectories[("O5", None)],
+            rtol=5e-2, atol=5e-4)
+
+    def test_dynamic_scaler_stayed_sane(self):
+        """A dynamic-scale run's scaler must not collapse (no spurious
+        overflow spiral) on a well-conditioned problem."""
+        rng = np.random.RandomState(0)
+        x, y = _data(rng)
+        params = _init_params(rng)
+        opt = FusedSGD(lr=LR, momentum=0.9, impl="xla")
+        cast_params, opt_state, amp_state = amp.initialize(
+            params, optimizers=opt, opt_level="O2")
+        scaler = make_scaler(amp_state.properties)
+        sst = amp_state.scalers[0]
+        model_params = cast_params
+        for _ in range(10):
+            def loss_fn(p):
+                pred = _forward(p, x, None)
+                return jnp.mean((pred - y) ** 2)
+            grads = jax.grad(
+                lambda p: scaler.scale_loss(loss_fn(p), sst))(model_params)
+            new_params, opt_state = opt.step(
+                opt_state, grads, grad_scale=sst.loss_scale,
+                skip_if_nonfinite=True)
+            sst = scaler.update(sst, opt_state.found_inf)
+            model_params = jax.tree.map(
+                lambda p, m: p.astype(m.dtype), new_params, model_params)
+        assert float(sst.loss_scale) >= 2.0 ** 13, float(sst.loss_scale)
