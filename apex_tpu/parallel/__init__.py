@@ -4,7 +4,11 @@
 `SyncBatchNorm` + `convert_syncbn_model` + BN process groups, and `LARC`.
 """
 
-from apex_tpu.parallel.distributed import DistributedDataParallel, Reducer
+from apex_tpu.parallel.distributed import (
+    DistributedDataParallel,
+    Reducer,
+    sync_deviation,
+)
 from apex_tpu.parallel.larc import LARC, larc_transform
 from apex_tpu.parallel.sync_batchnorm import (
     SyncBatchNorm,
@@ -15,6 +19,7 @@ from apex_tpu.parallel.sync_batchnorm import (
 __all__ = [
     "DistributedDataParallel",
     "Reducer",
+    "sync_deviation",
     "SyncBatchNorm",
     "convert_syncbn_model",
     "create_syncbn_group_assignment",

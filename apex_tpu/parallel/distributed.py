@@ -53,6 +53,7 @@ class DistributedDataParallel:
         allreduce_always_fp32: bool = False,
         axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
         prof: bool = False,
+        check_reduction: bool = False,
     ):
         self.axis_name = axis_name
         self.gradient_average = gradient_average
@@ -60,6 +61,7 @@ class DistributedDataParallel:
         self.allreduce_always_fp32 = allreduce_always_fp32
         self.axis_index_groups = axis_index_groups
         self.prof = prof
+        self.check_reduction = check_reduction
 
     def allreduce_grads(self, grads: Any) -> Any:
         """All-reduce a grad pytree over the data axis
@@ -92,6 +94,76 @@ class DistributedDataParallel:
 
     # parity alias matching the reference's module-method name
     __call__ = allreduce_grads
+
+    def check_synchronized(self, tree: Any) -> jax.Array:
+        """Debug epilogue check: warn (jax.debug.print) unless ``tree``
+        is replicated across the data axis, returning the deviation.
+
+        Call it on the grads the OPTIMIZER consumes — a tree that merely
+        passed through :meth:`allreduce_grads` is replicated by
+        construction; the hazard is a leaf that bypassed the reduction
+        (the reference's epilogue asserts catch exactly that class, ref
+        apex/parallel/distributed.py:336-349; torch DDP calls the knob
+        ``check_reduction``). Enabled by ``check_reduction=True`` or an
+        explicit call; inside jit/shard_map.
+        """
+        dev = sync_deviation(tree, self.axis_name, self.axis_index_groups)
+
+        def warn(_):
+            jax.debug.print(
+                "apex_tpu DDP check_reduction: grads DIVERGE across "
+                "{a} (max dev {d}) — an unsynced or rank-dependent "
+                "grad is reaching the optimizer",
+                a=self.axis_name, d=dev)
+            return 0
+
+        # ~(dev <= 0) so a NaN deviation (inf/NaN leaves — genuinely
+        # diverged or overflowed grads) also warns
+        lax.cond(jnp.logical_not(dev <= 0), warn, lambda _: 0, None)
+        return dev
+
+
+def sync_deviation(tree: Any, axis_name: str = DATA_AXIS,
+                   axis_index_groups=None) -> jax.Array:
+    """Max |x - first_rank(x)| over ``axis_name`` across all leaves —
+    exactly 0 iff the (finite) pytree is replicated on the axis; +inf
+    if any leaf holds inf/NaN anywhere (a collective max would swallow
+    NaN, so non-finite local deviations are sanitized to +inf).
+
+    The runtime defensive check replacing the reference's DDP epilogue
+    asserts + 2-GPU race test (ref: apex/parallel/distributed.py:336-349,
+    tests/distributed/DDP/ddp_race_condition_test.py): after grad sync,
+    every rank must hold identical grads; a nonzero (or NaN) deviation
+    means an unsynced (rank-dependent) value is about to reach the
+    optimizer. Call inside shard_map on the tree the optimizer consumes;
+    assert on the (replicated) result outside jit, or gate on it with
+    ``lax.cond`` / :meth:`DistributedDataParallel.check_synchronized`.
+    """
+    def dev(x):
+        x = x.astype(jnp.float32)
+        # compare against the first rank's copy via a masked psum (one
+        # nonzero contribution -> bitwise exact), not pmean: summing N
+        # identical fp32 values rounds at the ulp level, which would
+        # report a spurious nonzero deviation for replicated trees
+        idx = lax.axis_index(axis_name)
+        min_idx = lax.pmin(idx, axis_name,
+                           axis_index_groups=axis_index_groups)
+        first = (idx == min_idx).astype(jnp.float32)
+        ref = lax.psum(x * first, axis_name,
+                       axis_index_groups=axis_index_groups)
+        if not x.size:
+            return jnp.float32(0.0)
+        d = jnp.max(jnp.abs(x - ref))
+        # inf inputs poison the masked psum with NaN; report them as
+        # +inf so the cross-rank pmax can't swallow the signal
+        return jnp.where(jnp.isfinite(d), d, jnp.inf)
+
+    leaves = [dev(l) for l in jax.tree.leaves(tree)]
+    if not leaves:
+        return jnp.float32(0.0)
+    # one collective for the whole tree: local max across leaves first
+    return lax.pmax(jnp.max(jnp.stack(leaves)), axis_name,
+                    axis_index_groups=axis_index_groups)
 
 
 class Reducer:

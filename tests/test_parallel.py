@@ -243,3 +243,66 @@ class TestLARC:
         updates, state = tx.update(g, state, params)
         new = optax.apply_updates(params, updates)
         assert new["w"].shape == (64,)
+
+
+class TestSyncDeviation:
+    """SPMD analog of the reference's DDP epilogue asserts + race test
+    (ref distributed.py:336-349, tests/distributed/DDP/
+    ddp_race_condition_test.py): reduced grads must be replicated."""
+
+    def test_zero_after_allreduce_nonzero_before(self, mesh):
+        from apex_tpu.parallel import DistributedDataParallel
+        from apex_tpu.parallel.distributed import sync_deviation
+
+        ddp = DistributedDataParallel(axis_name="data")
+
+        def f(x):
+            g = {"w": x * (1.0 + jax.lax.axis_index("data"))}  # rank-dependent
+            before = sync_deviation(g, "data")
+            g = ddp.allreduce_grads(g)
+            after = sync_deviation(g, "data")
+            return before, after
+
+        before, after = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=P("data"), out_specs=(P(), P()),
+            check_vma=False,
+        ))(jnp.ones((8, 4)))
+        assert float(np.ravel(before)[0]) > 0.0
+        assert float(np.ravel(after)[0]) == 0.0
+
+    def test_check_synchronized_detects_bypass(self, mesh):
+        """check_synchronized on the tree the optimizer consumes flags
+        a leaf that bypassed the reduction (torch DDP check_reduction)."""
+        from apex_tpu.parallel import DistributedDataParallel
+
+        ddp = DistributedDataParallel(axis_name="data",
+                                      check_reduction=True)
+
+        def f(x):
+            synced = ddp.allreduce_grads({"w": x})
+            # "forgot" to reduce a second tree — rank-dependent
+            bad = {"w": synced["w"], "extra": x * 1.0}
+            return ddp.check_synchronized(synced), ddp.check_synchronized(bad)
+
+        rank_dep = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+        good, bad = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=P("data"), out_specs=(P(), P()),
+            check_vma=False,
+        ))(rank_dep)
+        assert float(np.ravel(good)[0]) == 0.0
+        assert float(np.ravel(bad)[0]) > 0.0
+
+    def test_sync_deviation_nan_propagates(self, mesh):
+        """inf/NaN anywhere must not be reported as 'in sync'."""
+        from apex_tpu.parallel.distributed import sync_deviation
+
+        def f(x):
+            bad = jnp.where(jax.lax.axis_index("data") == 1,
+                            jnp.inf, 0.0) + x
+            return sync_deviation({"w": bad}, "data")
+
+        dev = jax.jit(shard_map(
+            f, mesh=mesh, in_specs=P("data"), out_specs=P(),
+            check_vma=False,
+        ))(jnp.ones((8, 4)))
+        assert not (float(np.ravel(dev)[0]) <= 0.0)

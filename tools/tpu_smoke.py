@@ -21,20 +21,37 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def _time(fn, *args, iters=30, warmup=2):
+def _time(fn, *args, iters=30, warmup=2, chain=20):
+    """Per-call device time of ``fn``: ``chain`` iterations run inside
+    ONE jitted fori_loop (an optimization_barrier ties each iteration's
+    inputs to the previous outputs, so XLA can neither CSE nor overlap
+    them), amortizing host dispatch — which costs ~ms through the axon
+    tunnel and would otherwise dominate every sub-ms kernel. The outer
+    loop then queues all calls and syncs once (block_until_ready alone
+    is async through the tunnel; device_get of a scalar is the fence).
+    """
     import jax
+    import jax.numpy as jnp
 
+    def chained(*a):
+        def body(_, carry):
+            out = fn(*carry)
+            # tie the carry to `out` so iteration i+1 depends on i
+            carry2, _ = jax.lax.optimization_barrier((carry, out))
+            return carry2
+
+        final = jax.lax.fori_loop(0, chain, body, a)
+        return jnp.sum(jax.tree.leaves(final)[0].ravel()[:1])
+
+    f = jax.jit(chained)
     for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
-    # queue all iterations, sync once: device execution is serialized, so
-    # per-call host->device dispatch latency (large through the axon
-    # tunnel) overlaps instead of being counted iters times
+        jax.device_get(f(*args))
     t0 = time.perf_counter()
     out = None
     for _ in range(iters):
-        out = fn(*args)
-    jax.block_until_ready(out)
-    return (time.perf_counter() - t0) / iters
+        out = f(*args)
+    jax.device_get(out)
+    return (time.perf_counter() - t0) / (iters * chain)
 
 
 def run(perf=False, kimpl="pallas"):
