@@ -104,9 +104,13 @@ class DistributedDataParallel:
         construction; the hazard is a leaf that bypassed the reduction
         (the reference's epilogue asserts catch exactly that class, ref
         apex/parallel/distributed.py:336-349; torch DDP calls the knob
-        ``check_reduction``). Enabled by ``check_reduction=True`` or an
-        explicit call; inside jit/shard_map.
+        ``check_reduction``). Gated on ``check_reduction=True`` so the
+        call can stay in the step permanently and cost nothing when the
+        debug flag is off (use :func:`sync_deviation` directly for an
+        unconditional measurement); inside jit/shard_map.
         """
+        if not self.check_reduction:
+            return jnp.float32(0.0)
         dev = sync_deviation(tree, self.axis_name, self.axis_index_groups)
 
         def warn(_):
@@ -139,31 +143,36 @@ def sync_deviation(tree: Any, axis_name: str = DATA_AXIS,
     assert on the (replicated) result outside jit, or gate on it with
     ``lax.cond`` / :meth:`DistributedDataParallel.check_synchronized`.
     """
+    leaves = [l for l in jax.tree.leaves(tree) if l.size]
+    if not leaves:
+        return jnp.float32(0.0)
+
+    # first rank of (the local group of) the axis, computed once for
+    # the whole tree; statically rank 0 without groups
+    idx = lax.axis_index(axis_name)
+    if axis_index_groups is None:
+        first = (idx == 0).astype(jnp.float32)
+    else:
+        min_idx = lax.pmin(idx, axis_name,
+                           axis_index_groups=axis_index_groups)
+        first = (idx == min_idx).astype(jnp.float32)
+
     def dev(x):
         x = x.astype(jnp.float32)
         # compare against the first rank's copy via a masked psum (one
         # nonzero contribution -> bitwise exact), not pmean: summing N
         # identical fp32 values rounds at the ulp level, which would
         # report a spurious nonzero deviation for replicated trees
-        idx = lax.axis_index(axis_name)
-        min_idx = lax.pmin(idx, axis_name,
-                           axis_index_groups=axis_index_groups)
-        first = (idx == min_idx).astype(jnp.float32)
         ref = lax.psum(x * first, axis_name,
                        axis_index_groups=axis_index_groups)
-        if not x.size:
-            return jnp.float32(0.0)
         d = jnp.max(jnp.abs(x - ref))
         # inf inputs poison the masked psum with NaN; report them as
         # +inf so the cross-rank pmax can't swallow the signal
         return jnp.where(jnp.isfinite(d), d, jnp.inf)
 
-    leaves = [dev(l) for l in jax.tree.leaves(tree)]
-    if not leaves:
-        return jnp.float32(0.0)
-    # one collective for the whole tree: local max across leaves first
-    return lax.pmax(jnp.max(jnp.stack(leaves)), axis_name,
-                    axis_index_groups=axis_index_groups)
+    # one cross-rank collective for the whole tree: local max first
+    return lax.pmax(jnp.max(jnp.stack([dev(l) for l in leaves])),
+                    axis_name, axis_index_groups=axis_index_groups)
 
 
 class Reducer:
