@@ -324,26 +324,31 @@ def forward_backward_pipelining_with_interleaving(
     s_axis = axis_name
 
     def total_loss(params):
-        # chunk 0 folds the embedding into its stage-0 ticks; between
-        # chunks the (M, ...) boundary activations are materialized —
-        # inherent to running the ring vpp times in one SPMD program
-        # (the reference's interleaved schedule holds the same in-flight
-        # set spread over time).
+        # chunk 0 folds the embedding into its stage-0 ticks and the
+        # LAST chunk folds the loss into its last-stage ticks (so the
+        # all-M logits are never live); between chunks the (M, ...)
+        # boundary activations are materialized — inherent to running
+        # the ring vpp times in one SPMD program (the reference's
+        # interleaved schedule holds the same in-flight set spread over
+        # time).
         x_mb = mb
+        last = num_model_chunks - 1
         for chunk in range(num_model_chunks):
+            is_last = chunk == last
             x_mb = spmd_pipeline(
                 functools.partial(stage_fn, chunk_id=chunk),
                 params, x_mb, axis_name=s_axis, remat=remat,
                 pre_fn=pre_fn if chunk == 0 else None,
+                loss_fn=loss_fn if is_last else None,
+                loss_batches=mb if is_last else None,
             )
-            if chunk != num_model_chunks - 1:
+            if not is_last:
                 # outputs live on the last stage; rotate them to stage 0
                 # for the next chunk's ring traversal
                 size = lax.axis_size(s_axis)
                 perm = [(i, (i + 1) % size) for i in range(size)]
                 x_mb = lax.ppermute(x_mb, s_axis, perm)
-        losses = jax.vmap(lambda y, b: loss_fn(y, b))(x_mb, mb)
-        return jnp.mean(losses)   # raw per-rank loss; see note above
+        return x_mb / num_microbatches   # raw per-rank loss; see note above
 
     if forward_only:
         return last_stage_value(total_loss(params), s_axis), None

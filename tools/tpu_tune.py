@@ -1,0 +1,185 @@
+"""Kernel tuning sweep — run on the real chip to pick tile/block sizes.
+
+Chained-iteration timing (see tpu_smoke._time): each candidate config
+runs K iterations inside one jitted fori_loop, so per-op numbers are
+kernel time, not tunnel dispatch. Prints a table per op family; the
+winner feeds the defaults in the op modules.
+
+    python tools/tpu_tune.py            # everything
+    python tools/tpu_tune.py attn ln    # subset
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from tpu_smoke import _time  # noqa: E402  (chained timer)
+
+
+def tune_attn():
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    for (b, h, s, d), dt in [((4, 16, 2048, 128), jnp.bfloat16),
+                             ((2, 16, 4096, 128), jnp.bfloat16),
+                             ((8, 16, 512, 64), jnp.bfloat16)]:
+        q, k, v = (jnp.asarray(
+            rng.randn(b, h, s, d).astype(np.float32) * 0.1, dt)
+            for _ in range(3))
+        print(f"flash fwd+bwd bhsd={(b, h, s, d)} {dt.__name__}")
+        base = None
+        for bq, bk in [(256, 256), (512, 512), (512, 1024), (1024, 512),
+                       (1024, 1024), (2048, 1024), (1024, 2048)]:
+            if bq > s or bk > s:
+                continue
+
+            def fwd_bwd(q, k, v, bq=bq, bk=bk):
+                def loss(q, k, v):
+                    o = flash_attention(q, k, v, causal=True, impl="pallas",
+                                        block_q=bq, block_k=bk)
+                    return jnp.sum(o.astype(jnp.float32) ** 2)
+                l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+                return (l, *g)
+
+            try:
+                t = _time(fwd_bwd, q, k, v, iters=3, chain=10)
+                base = base or t
+                print(f"  bq={bq:5d} bk={bk:5d}  {t*1e3:8.3f} ms "
+                      f"({base/t:4.2f}x)")
+            except Exception as e:  # noqa: BLE001
+                print(f"  bq={bq:5d} bk={bk:5d}  FAIL {str(e)[:60]}")
+
+        def xla_fb(q, k, v):
+            def loss(q, k, v):
+                o = flash_attention(q, k, v, causal=True, impl="xla")
+                return jnp.sum(o.astype(jnp.float32) ** 2)
+            l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+            return (l, *g)
+
+        try:
+            t = _time(xla_fb, q, k, v, iters=3, chain=10)
+            print(f"  xla reference   {t*1e3:8.3f} ms")
+        except Exception as e:  # noqa: BLE001
+            print(f"  xla reference   FAIL {str(e)[:60]}")
+
+
+def tune_ln():
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops import layer_norm as ln_mod
+    from apex_tpu.ops.layer_norm import fused_layer_norm
+
+    rng = np.random.RandomState(0)
+    rows, hidden = 8192, 4096
+    x = jnp.asarray(rng.randn(rows, hidden).astype(np.float32),
+                    jnp.bfloat16)
+    w = jnp.asarray(rng.randn(hidden).astype(np.float32))
+    b = jnp.asarray(rng.randn(hidden).astype(np.float32))
+
+    def fwd_bwd(x, w, b, impl):
+        def loss(x, w, b):
+            return jnp.sum(
+                fused_layer_norm(x, w, b, impl=impl).astype(jnp.float32)
+                ** 2)
+        l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(x, w, b)
+        return (l, *g)
+
+    print(f"layer_norm fwd+bwd rows={rows} hidden={hidden} bf16 x")
+    orig = ln_mod._DEF_ROWS
+    for tile_rows in (64, 128, 256, 512, 1024):
+        ln_mod._DEF_ROWS = tile_rows
+        try:
+            t = _time(lambda x, w, b: fwd_bwd(x, w, b, "pallas"),
+                      x, w, b, iters=3, chain=20)
+            print(f"  tile_rows={tile_rows:5d}  {t*1e3:8.3f} ms")
+        except Exception as e:  # noqa: BLE001
+            print(f"  tile_rows={tile_rows:5d}  FAIL {str(e)[:60]}")
+    ln_mod._DEF_ROWS = orig
+    t = _time(lambda x, w, b: fwd_bwd(x, w, b, "xla"), x, w, b,
+              iters=3, chain=20)
+    print(f"  xla reference     {t*1e3:8.3f} ms")
+
+
+def tune_softmax():
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.softmax import scaled_upper_triang_masked_softmax
+
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(32, 1024, 1024).astype(np.float32),
+                    jnp.bfloat16)
+
+    def fwd_bwd(x, impl):
+        def loss(x):
+            return jnp.sum(
+                scaled_upper_triang_masked_softmax(x, 0.5, impl=impl)
+                .astype(jnp.float32) ** 2)
+        return jax.value_and_grad(loss)(x)
+
+    print("causal softmax fwd+bwd (32,1024,1024) bf16")
+    for impl in ("pallas", "xla"):
+        t = _time(lambda x: fwd_bwd(x, impl), x, iters=3, chain=20)
+        print(f"  {impl:8s}  {t*1e3:8.3f} ms")
+
+
+def tune_opt():
+    import jax
+    import jax.numpy as jnp
+
+    import apex_tpu.multi_tensor as mt
+
+    rng = np.random.RandomState(0)
+    n = 64_000_000   # ~BERT-large scale flat buffer
+    p = jnp.asarray(rng.randn(n).astype(np.float32))
+    g = jnp.asarray(rng.randn(n).astype(np.float32) * 1e-3)
+    m = jnp.zeros_like(p)
+    v = jnp.zeros_like(p)
+
+    print(f"fused adam update n={n}")
+    from apex_tpu.multi_tensor import engine
+    orig = engine.DEFAULT_TILE_ROWS
+    for tile_rows in (128, 256, 512, 1024, 2048):
+        engine.DEFAULT_TILE_ROWS = tile_rows
+
+        def step(p, m, v, g):
+            p2, m2, v2, f = mt.fused_adam_update(
+                p, m, v, g, lr=1e-3, step=2, weight_decay=0.01,
+                impl="pallas")
+            return (p2, m2, v2)
+
+        try:
+            t = _time(step, p, m, v, g, iters=3, chain=5)
+            gbps = 7 * n * 4 / t / 1e9   # 4 reads + 3 writes
+            print(f"  tile_rows={tile_rows:5d}  {t*1e3:8.3f} ms "
+                  f"({gbps:6.1f} GB/s)")
+        except Exception as e:  # noqa: BLE001
+            print(f"  tile_rows={tile_rows:5d}  FAIL {str(e)[:60]}")
+    engine.DEFAULT_TILE_ROWS = orig
+
+    def xla_step(p, m, v, g):
+        p2, m2, v2, f = mt.fused_adam_update(
+            p, m, v, g, lr=1e-3, step=2, weight_decay=0.01, impl="xla")
+        return (p2, m2, v2)
+
+    t = _time(xla_step, p, m, v, g, iters=3, chain=5)
+    print(f"  xla reference     {t*1e3:8.3f} ms ({7*n*4/t/1e9:6.1f} GB/s)")
+
+
+ALL = {"attn": tune_attn, "ln": tune_ln, "softmax": tune_softmax,
+       "opt": tune_opt}
+
+if __name__ == "__main__":
+    import jax
+
+    print("backend:", jax.default_backend())
+    which = sys.argv[1:] or list(ALL)
+    for name in which:
+        ALL[name]()
