@@ -182,6 +182,20 @@ class TestExamples:
                          "--resume", ckpt])
         assert np.isfinite(loss2)
 
+    def test_gpt_pretrain(self, tmp_path):
+        """The L5 example: tp x pp x dp mesh train loop + orbax resume."""
+        ex = _load_example("examples/gpt_pretrain/pretrain_gpt.py",
+                           "ex_gpt_pretrain")
+        save = str(tmp_path / "ck")
+        argv = ["--steps", "4", "--tp", "2", "--pp", "2",
+                "--hidden", "64", "--layers", "2", "--seq", "32",
+                "--vocab", "128", "--save", save]
+        loss = ex.main(argv)
+        assert np.isfinite(loss)
+        # resume continues from the saved step (same flags, more steps)
+        loss2 = ex.main(argv[:1] + ["6"] + argv[2:])
+        assert np.isfinite(loss2)
+
     def test_dcgan(self):
         ex = _load_example("examples/dcgan/main_amp.py", "ex_dcgan")
         lD, lG = ex.main(["--steps", "4", "--batch-size", "8",
