@@ -156,7 +156,8 @@ class ParallelAttention(nn.Module):
                 )
                 ctx = ring_attention(
                     qb, kb, vb, causal=True,
-                    q_positions=positions, kv_positions=positions)
+                    q_positions=positions, kv_positions=positions,
+                    impl=cfg.softmax_impl)
             else:
                 from apex_tpu.ops.attention import flash_attention
                 drop = (cfg.attention_dropout

@@ -56,21 +56,31 @@ def _pick_block(seq: int, want: int) -> int:
     return max(b, 1)
 
 
-def _mask_block(iq, ik, bq, bk, sq, sk, causal, window, q_seg, k_seg):
+def _mask_block(iq, ik, bq, bk, sq, sk, causal, window, q_seg, k_seg,
+                q_pos=None, k_pos=None):
     """fp32 additive mask (bq, bk) for the (iq, ik) block pair.
 
     ``q_seg``/``k_seg`` are column (bq, 1) / row (1, bk) int32 blocks
     (the kernel segment layouts); the XLA path masks segments itself.
+    ``q_pos``/``k_pos`` (same layouts) carry global token positions for
+    ring/blockwise chunks, replacing the static causal/window geometry.
     """
-    row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-    col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    if q_pos is not None:
+        # dynamic GLOBAL positions (ring/blockwise chunks): causal and
+        # window tests compare position values, not block indices
+        row, col = q_pos, k_pos           # (bq, 1) / (1, bk)
+        off = 0
+    else:
+        row = iq * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+        col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+        off = sk - sq
     neg = jnp.zeros((bq, bk), jnp.float32)
     if causal:
         # query i attends to keys j <= i + (sk - sq) (supports sk >= sq)
-        neg = jnp.where(col > row + (sk - sq), NEG_INF, neg)
+        neg = jnp.where(col > row + off, NEG_INF, neg)
     if window is not None:
         # sliding window: the last `window` keys up to the diagonal
-        neg = jnp.where(col <= row + (sk - sq) - window, NEG_INF, neg)
+        neg = jnp.where(col <= row + off - window, NEG_INF, neg)
     if q_seg is not None:
         neg = jnp.where(q_seg != k_seg, NEG_INF, neg)
     return neg
@@ -113,6 +123,20 @@ def _block_live(iq, ik, bq, bk, sq, sk, causal, window):
     return run
 
 
+def _block_live_dynamic(qp_ref, kp_ref, causal, window):
+    """Position-based analog of `_block_live`: bounds of the loaded
+    position blocks decide whether any (q, k) pair can be unmasked —
+    ring attention's causal-future chunks skip their matmuls just like
+    the static path skips upper-triangle blocks."""
+    run = True
+    if causal:
+        run = jnp.max(qp_ref[...]) >= jnp.min(kp_ref[...])
+    if window is not None:
+        run = jnp.logical_and(
+            run, jnp.max(kp_ref[...]) > jnp.min(qp_ref[...]) - window)
+    return run
+
+
 def _band_k_lo(iq, bq, bk, off, window):
     """First k-block index intersecting q-block ``iq``'s sliding window."""
     return jnp.maximum(0, (iq * bq + off - (window - 1)) // bk)
@@ -129,12 +153,13 @@ def _band_steps(span_block, other_block, window):
     return (span_block + window - 1 + other_block - 1) // other_block + 1
 
 
-def _band(window, span_block, other_block, n_other):
+def _band(window, span_block, other_block, n_other, dynamic=False):
     """Host-side band setup for one inner grid dim: (banded, n_steps).
 
     Shared by the fwd/dq/dkv pallas builders so the grid sizing logic
-    exists once."""
-    if window is None:
+    exists once. ``dynamic`` (positions-based masking) disables static
+    banding — block geometry is meaningless under dynamic positions."""
+    if window is None or dynamic:
         return False, n_other
     steps = _band_steps(span_block, other_block, window)
     return steps < n_other, min(steps, n_other)
@@ -155,6 +180,7 @@ def _band_pos(lo, j, n):
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref, seed_ref,
+                qp_ref, kp_ref,
                 o_ref, lse_ref, acc_sc, m_sc, l_sc,
                 *, scale, causal, window, rate, nk, n_inner, banded,
                 bq, bk, sq, sk):
@@ -175,9 +201,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref, seed_ref,
         m_sc[...] = jnp.full_like(m_sc, NEG_INF)
         l_sc[...] = jnp.zeros_like(l_sc)
 
-    # whole blocks above the diagonal / below the window are skipped
-    run = jnp.logical_and(
-        _block_live(iq, ik, bq, bk, sq, sk, causal, window), in_range)
+    # whole blocks above the diagonal / below the window are skipped;
+    # with dynamic positions the static block geometry is meaningless,
+    # so every in-range block runs and masking is purely additive
+    live = (_block_live_dynamic(qp_ref, kp_ref, causal, window)
+            if qp_ref is not None
+            else _block_live(iq, ik, bq, bk, sq, sk, causal, window))
+    run = jnp.logical_and(live, in_range)
 
     @pl.when(run)
     def _step():
@@ -195,8 +225,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref, seed_ref,
             s = s + bias_ref[0].astype(jnp.float32)
         q_seg = qs_ref[0] if qs_ref is not None else None
         k_seg = ks_ref[0] if ks_ref is not None else None
-        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, window,
-                            q_seg, k_seg)
+        s = s + _mask_block(
+            iq, ik, bq, bk, sq, sk, causal, window, q_seg, k_seg,
+            q_pos=qp_ref[...] if qp_ref is not None else None,
+            k_pos=kp_ref[...] if kp_ref is not None else None)
 
         m_prev = m_sc[:, :1]                       # (bq, 1)
         l_prev = l_sc[:, :1]
@@ -230,12 +262,16 @@ def _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref, seed_ref,
         valid = m > NEG_INF * 0.5
         safe = jnp.where(l > 0.0, l, 1.0)
         o_ref[0] = jnp.where(valid, acc_sc[...] / safe, 0.0).astype(o_ref.dtype)
-        # lse block is (1, bq, 1): a column vector per q block
-        lse_ref[0] = jnp.where(valid, m + jnp.log(safe), 0.0)
+        # lse block is (1, bq, 1): a column vector per q block. Fully
+        # masked rows emit NEG_INF — zero mass under logaddexp merging
+        # (ring attention combines chunk (out, lse) pairs); the backward
+        # kernels clamp it so p = exp(s - lse) still underflows to 0.
+        lse_ref[0] = jnp.where(valid, m + jnp.log(safe), NEG_INF)
 
 
 def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale, causal,
-                      window, rate, bq, bk, interpret):
+                      window, rate, bq, bk, interpret,
+                      q_pos=None, k_pos=None):
     b, h, sq, d = q.shape
     hk = k.shape[1]
     group = h // hk          # GQA: q heads per shared kv head
@@ -245,7 +281,7 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale, causal,
     nq, nk = sq // bq, sk // bk
     # banded sliding window: the inner grid dim covers only the k blocks
     # a q block's window can touch, so DMA traffic is O(S*w) not O(S^2)
-    banded, n_inner = _band(window, bq, bk, nk)
+    banded, n_inner = _band(window, bq, bk, nk, dynamic=q_pos is not None)
 
     def ik_of(iq, j):
         if not banded:
@@ -301,6 +337,16 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale, causal,
     else:
         in_specs.append(None)
         args.append(None)
+    if q_pos is not None:
+        # global positions: q as an (sq, 1) column, k as a (1, sk) row
+        in_specs.append(pl.BlockSpec((bq, 1), lambda bh, iq, j: (iq, 0)))
+        in_specs.append(
+            pl.BlockSpec((1, bk), lambda bh, iq, j: (0, ik_of(iq, j))))
+        args += [jnp.asarray(q_pos, jnp.int32).reshape(sq, 1),
+                 jnp.asarray(k_pos, jnp.int32).reshape(1, sk)]
+    else:
+        in_specs += [None, None]
+        args += [None, None]
 
     live_specs = [s for s in in_specs if s is not None]
     live_args = [a for a in args if a is not None]
@@ -314,8 +360,11 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale, causal,
         qs_ref = next(it) if q_seg is not None else None
         ks_ref = next(it) if q_seg is not None else None
         seed_ref = next(it) if rate > 0.0 else None
+        qp_ref = next(it) if q_pos is not None else None
+        kp_ref = next(it) if q_pos is not None else None
         o_ref, lse_ref, acc_sc, m_sc, l_sc = refs[len(live_specs):]
         _fwd_kernel(q_ref, k_ref, v_ref, bias_ref, qs_ref, ks_ref, seed_ref,
+                    qp_ref, kp_ref,
                     o_ref, lse_ref, acc_sc, m_sc, l_sc,
                     scale=scale, causal=causal, window=window, rate=rate,
                     nk=nk, n_inner=n_inner, banded=banded,
@@ -351,7 +400,8 @@ def _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale, causal,
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                   bias_ref, qs_ref, ks_ref, seed_ref, dq_ref, dq_sc,
+                   bias_ref, qs_ref, ks_ref, seed_ref, glse_ref,
+                   qp_ref, kp_ref, dq_ref, dq_sc,
                    *, scale, causal, window, rate, nk, n_inner, banded,
                    bq, bk, sq, sk):
     j = pl.program_id(2)
@@ -367,8 +417,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
     def _init():
         dq_sc[...] = jnp.zeros_like(dq_sc)
 
-    run = jnp.logical_and(
-        _block_live(iq, ik, bq, bk, sq, sk, causal, window), in_range)
+    live = (_block_live_dynamic(qp_ref, kp_ref, causal, window)
+            if qp_ref is not None
+            else _block_live(iq, ik, bq, bk, sq, sk, causal, window))
+    run = jnp.logical_and(live, in_range)
 
     @pl.when(run)
     def _step():
@@ -376,7 +428,9 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]                           # (bq, 1) column block
+        # clamp: fully-masked rows carry lse = NEG_INF (merge-friendly);
+        # exp(s - NEG_INF) would explode, exp(s - NEG_INF/2) underflows
+        lse = jnp.maximum(lse_ref[0], NEG_INF * 0.5)   # (bq, 1) column
         delta = dl_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -384,8 +438,10 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
             s = s + bias_ref[0].astype(jnp.float32)
         q_seg = qs_ref[0] if qs_ref is not None else None
         k_seg = ks_ref[0] if ks_ref is not None else None
-        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, window,
-                            q_seg, k_seg)
+        s = s + _mask_block(
+            iq, ik, bq, bk, sq, sk, causal, window, q_seg, k_seg,
+            q_pos=qp_ref[...] if qp_ref is not None else None,
+            k_pos=kp_ref[...] if kp_ref is not None else None)
         p = jnp.exp(s - lse)
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -397,7 +453,11 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
             col = ik * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
             keep = _dropout_keep(seed_ref[0], bh, row, col, rate)
             dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
-        ds = (p * (dp - delta)).astype(k.dtype)
+        ds = p * (dp - delta)
+        if glse_ref is not None:
+            # lse is also an output: dlse_i/ds_ij = p_ij (undropped)
+            ds = ds + p * glse_ref[0]
+        ds = ds.astype(k.dtype)
         dq_sc[...] = dq_sc[...] + jax.lax.dot_general(
             ds, k, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -408,8 +468,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
-                    bias_ref, qs_ref, ks_ref, seed_ref,
-                    dk_ref, dv_ref, dk_sc, dv_sc,
+                    bias_ref, qs_ref, ks_ref, seed_ref, glse_ref,
+                    qp_ref, kp_ref, dk_ref, dv_ref, dk_sc, dv_sc,
                     *, scale, causal, window, rate, nq, nq_inner, banded,
                     h, hk, bq, bk, sq, sk):
     # inner grid dim sweeps (q-head of the GQA group) x (q block):
@@ -431,8 +491,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         dk_sc[...] = jnp.zeros_like(dk_sc)
         dv_sc[...] = jnp.zeros_like(dv_sc)
 
-    run = jnp.logical_and(
-        _block_live(iq, ik, bq, bk, sq, sk, causal, window), in_range)
+    live = (_block_live_dynamic(qp_ref, kp_ref, causal, window)
+            if qp_ref is not None
+            else _block_live(iq, ik, bq, bk, sq, sk, causal, window))
+    run = jnp.logical_and(live, in_range)
 
     @pl.when(run)
     def _step():
@@ -440,7 +502,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         k = k_ref[0]
         v = v_ref[0]
         do = do_ref[0]
-        lse = lse_ref[0]                           # (bq, 1) column block
+        lse = jnp.maximum(lse_ref[0], NEG_INF * 0.5)   # (bq, 1) column
         delta = dl_ref[0]
         s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32) * scale
@@ -448,8 +510,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
             s = s + bias_ref[0].astype(jnp.float32)
         q_seg = qs_ref[0] if qs_ref is not None else None
         k_seg = ks_ref[0] if ks_ref is not None else None
-        s = s + _mask_block(iq, ik, bq, bk, sq, sk, causal, window,
-                            q_seg, k_seg)
+        s = s + _mask_block(
+            iq, ik, bq, bk, sq, sk, causal, window, q_seg, k_seg,
+            q_pos=qp_ref[...] if qp_ref is not None else None,
+            k_pos=kp_ref[...] if kp_ref is not None else None)
         p = jnp.exp(s - lse)                       # (bq, bk)
         p_v = p                                    # what multiplied V
         dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -466,7 +530,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
         dv_sc[...] = dv_sc[...] + jax.lax.dot_general(
             p_v.astype(do.dtype), do, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)    # (bk, d)
-        ds = (p * (dp - delta)).astype(q.dtype)
+        ds = p * (dp - delta)
+        if glse_ref is not None:
+            ds = ds + p * glse_ref[0]
+        ds = ds.astype(q.dtype)
         dk_sc[...] = dk_sc[...] + jax.lax.dot_general(
             ds, q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32) * scale
@@ -478,7 +545,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, dl_ref,
 
 
 def _flash_bwd_pallas(res, g, delta, seed, scale, causal, window, rate,
-                      bq, bk, interpret):
+                      bq, bk, interpret, glse=None,
+                      q_pos=None, k_pos=None):
     q, k, v, bias, q_seg, k_seg, out, lse = res
     b, h, sq, d = q.shape
     hk = k.shape[1]
@@ -537,11 +605,22 @@ def _flash_bwd_pallas(res, g, delta, seed, scale, causal, window, rate,
         if rate > 0.0:
             specs.append(pl.BlockSpec(memory_space=pltpu.SMEM))
             arr.append(jnp.asarray(seed, jnp.uint32).reshape(1))
+        if glse is not None:
+            specs.append(pl.BlockSpec((1, bq, 1), qi))
+            arr.append(glse.astype(jnp.float32).reshape(b * h, sq, 1))
+        if q_pos is not None:
+            specs.append(pl.BlockSpec(
+                (bq, 1), lambda *g_: (iq_of(*g_), 0)))
+            specs.append(pl.BlockSpec(
+                (1, bk), lambda *g_: (0, ik_of(*g_))))
+            arr += [jnp.asarray(q_pos, jnp.int32).reshape(sq, 1),
+                    jnp.asarray(k_pos, jnp.int32).reshape(1, sk)]
         return specs, arr
 
     # banded sliding window (see _flash_fwd_pallas): inner dims walk only
     # the band's blocks, clamped + masked at the edges
-    dq_banded, nk_inner = _band(window, bq, bk, nk)
+    dq_banded, nk_inner = _band(window, bq, bk, nk,
+                                dynamic=q_pos is not None)
 
     def dq_ik_of(iq, j):
         if not dq_banded:
@@ -565,9 +644,12 @@ def _flash_bwd_pallas(res, g, delta, seed, scale, causal, window, rate,
         qs_ref = next(it) if q_seg is not None else None
         ks_ref = next(it) if q_seg is not None else None
         seed_ref = next(it) if rate > 0.0 else None
+        glse_ref = next(it) if glse is not None else None
+        qp_ref = next(it) if q_pos is not None else None
+        kp_ref = next(it) if q_pos is not None else None
         dq_ref, dq_sc = refs[n:]
-        _bwd_dq_kernel(*base, bias_ref, qs_ref, ks_ref, seed_ref,
-                       dq_ref, dq_sc,
+        _bwd_dq_kernel(*base, bias_ref, qs_ref, ks_ref, seed_ref, glse_ref,
+                       qp_ref, kp_ref, dq_ref, dq_sc,
                        scale=scale, causal=causal, window=window,
                        rate=rate, nk=nk, n_inner=nk_inner,
                        banded=dq_banded, bq=bq, bk=bk, sq=sq, sk=sk)
@@ -589,7 +671,8 @@ def _flash_bwd_pallas(res, g, delta, seed, scale, causal, window, rate,
     # in the band); dk/dv accumulate in VMEM so GQA needs no
     # materialized repeat and backward peak memory is independent of
     # h/hk.
-    dkv_banded, nq_inner = _band(window, bk, bq, nq)
+    dkv_banded, nq_inner = _band(window, bk, bq, nq,
+                                 dynamic=q_pos is not None)
 
     def dkv_iq_of(ik, j):
         if not dkv_banded:
@@ -614,9 +697,12 @@ def _flash_bwd_pallas(res, g, delta, seed, scale, causal, window, rate,
         qs_ref = next(it) if q_seg is not None else None
         ks_ref = next(it) if q_seg is not None else None
         seed_ref = next(it) if rate > 0.0 else None
+        glse_ref = next(it) if glse is not None else None
+        qp_ref = next(it) if q_pos is not None else None
+        kp_ref = next(it) if q_pos is not None else None
         dk_ref, dv_ref, dk_sc, dv_sc = refs[n:]
-        _bwd_dkv_kernel(*base, bias_ref, qs_ref, ks_ref, seed_ref,
-                        dk_ref, dv_ref, dk_sc, dv_sc,
+        _bwd_dkv_kernel(*base, bias_ref, qs_ref, ks_ref, seed_ref, glse_ref,
+                        qp_ref, kp_ref, dk_ref, dv_ref, dk_sc, dv_sc,
                         scale=scale, causal=causal, window=window,
                         rate=rate, nq=nq, nq_inner=nq_inner,
                         banded=dkv_banded, h=h, hk=hk,
@@ -654,7 +740,8 @@ def _flash_bwd_pallas(res, g, delta, seed, scale, causal, window, rate,
 
 
 def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
-                   window=None, dropout_rate=0.0, dropout_seed=None):
+                   window=None, dropout_rate=0.0, dropout_seed=None,
+                   return_lse=False, q_pos=None, k_pos=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
     if k.shape[1] != h:                 # GQA: repeat shared kv heads
@@ -666,8 +753,12 @@ def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
         s = s + bias.astype(jnp.float32)
     if causal or window is not None:
         # one (sq, sk) block = the full matrix; same mask code as the kernel
-        s = s + _mask_block(0, 0, sq, sk, sq, sk, causal, window, None,
-                            None)[None, None]
+        s = s + _mask_block(
+            0, 0, sq, sk, sq, sk, causal, window, None, None,
+            q_pos=(jnp.asarray(q_pos, jnp.int32).reshape(sq, 1)
+                   if q_pos is not None else None),
+            k_pos=(jnp.asarray(k_pos, jnp.int32).reshape(1, sk)
+                   if k_pos is not None else None))[None, None]
     if q_seg is not None:
         seg = q_seg[:, None, :, None] != k_seg[:, None, None, :]
         s = jnp.where(seg, NEG_INF, s)
@@ -686,6 +777,11 @@ def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
         keep = _dropout_keep(dropout_seed, bh, row, col, dropout_rate)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
     out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    if return_lse:
+        valid = m[..., 0] > NEG_INF * 0.5
+        lse = jnp.where(valid, m[..., 0] + jnp.log(
+            jnp.where(l[..., 0] > 0.0, l[..., 0], 1.0)), NEG_INF)
+        return out.astype(q.dtype), lse
     return out.astype(q.dtype)
 
 
@@ -720,7 +816,7 @@ def _flash_bwd_rule(scale, causal, window, rate, bq, bk, interpret, res, g):
 
 
 def _finish_bwd(res, g, delta, dq, dk, dv, seed, scale, causal, window,
-                rate):
+                rate, glse=None, q_pos=None, k_pos=None, with_pos=False):
     """Shared tail of the backward rule: bias cotangent by recompute
     plus the integer (segment-id / seed) cotangents."""
     q, k, v, bias, q_seg, k_seg, out, lse = res
@@ -744,12 +840,17 @@ def _finish_bwd(res, g, delta, dq, dk, dv, seed, scale, causal, window,
                 preferred_element_type=jnp.float32)
             s = s + bias[ib % b_b, ih % h_b].astype(jnp.float32)
             if causal or window is not None:
-                s = s + _mask_block(0, 0, sq, sk, sq, sk, causal, window,
-                                    None, None)
+                s = s + _mask_block(
+                    0, 0, sq, sk, sq, sk, causal, window, None, None,
+                    q_pos=(q_pos.reshape(sq, 1)
+                           if q_pos is not None else None),
+                    k_pos=(k_pos.reshape(1, sk)
+                           if k_pos is not None else None))
             if q_seg is not None:
                 seg = q_seg[ib][:, None] != k_seg[ib][None, :]
                 s = jnp.where(seg, NEG_INF, s)
-            p = jnp.exp(s - lse[ib, ih][:, None])
+            p = jnp.exp(
+                s - jnp.maximum(lse[ib, ih][:, None], NEG_INF * 0.5))
             dp = jax.lax.dot_general(
                 g[ib, ih].astype(jnp.float32),
                 v[ib, ih // group].astype(jnp.float32),
@@ -761,6 +862,8 @@ def _finish_bwd(res, g, delta, dq, dk, dv, seed, scale, causal, window,
                 keep = _dropout_keep(seed, bh, row, col, rate)
                 dp = jnp.where(keep, dp / (1.0 - rate), 0.0)
             ds = p * (dp - delta[ib, ih][:, None])
+            if glse is not None:
+                ds = ds + p * glse[ib, ih][:, None]
             if sq_b == 1:
                 ds = jnp.sum(ds, axis=0, keepdims=True)
             if sk_b == 1:
@@ -776,10 +879,52 @@ def _finish_bwd(res, g, delta, dq, dk, dv, seed, scale, causal, window,
         return (None if a is None
                 else np.zeros(a.shape, dtype=jax.dtypes.float0))
 
-    return (dq, dk, dv, dbias, int_ct(q_seg), int_ct(k_seg), int_ct(seed))
+    cts = (dq, dk, dv, dbias, int_ct(q_seg), int_ct(k_seg), int_ct(seed))
+    if with_pos or q_pos is not None or k_pos is not None:
+        cts = cts + (int_ct(q_pos), int_ct(k_pos))
+    return cts
 
 
 _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(9, 10, 11, 12, 13, 14, 15))
+def _flash_with_lse(q, k, v, bias, q_seg, k_seg, seed, q_pos, k_pos,
+                    scale, causal, window, rate, bq, bk, interpret):
+    """Like ``_flash`` but also returns the per-row logsumexp (fp32,
+    (b, h, sq); NEG_INF on fully-masked rows) as a differentiable
+    output — the merge signal for ring/blockwise attention. Accepts
+    dynamic global positions for chunked causal masking."""
+    return _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale,
+                             causal, window, rate, bq, bk, interpret,
+                             q_pos=q_pos, k_pos=k_pos)
+
+
+def _flash_lse_fwd_rule(q, k, v, bias, q_seg, k_seg, seed, q_pos, k_pos,
+                        scale, causal, window, rate, bq, bk, interpret):
+    out, lse = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale,
+                                 causal, window, rate, bq, bk, interpret,
+                                 q_pos=q_pos, k_pos=k_pos)
+    return (out, lse), (q, k, v, bias, q_seg, k_seg, seed, q_pos, k_pos,
+                        out, lse)
+
+
+def _flash_lse_bwd_rule(scale, causal, window, rate, bq, bk, interpret,
+                        res, gs):
+    g, glse = gs
+    q, k, v, bias, q_seg, k_seg, seed, q_pos, k_pos, out, lse = res
+    core = (q, k, v, bias, q_seg, k_seg, out, lse)
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
+    dq, dk, dv = _flash_bwd_pallas(core, g, delta, seed, scale, causal,
+                                   window, rate, bq, bk, interpret,
+                                   glse=glse, q_pos=q_pos, k_pos=k_pos)
+    return _finish_bwd(core, g, delta, dq, dk, dv, seed, scale, causal,
+                       window, rate, glse=glse, q_pos=q_pos, k_pos=k_pos,
+                       with_pos=True)
+
+
+_flash_with_lse.defvjp(_flash_lse_fwd_rule, _flash_lse_bwd_rule)
 
 
 def flash_attention(
@@ -798,7 +943,10 @@ def flash_attention(
     block_q: int = 1024,
     block_k: int = 1024,
     impl: Optional[str] = None,
-) -> jax.Array:
+    return_lse: bool = False,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
+):
     """Memory-efficient attention over (batch, heads, seq, head_dim).
 
     ``segment_ids`` (batch, seq_q) int32 enables packed-varlen batches —
@@ -811,6 +959,11 @@ def flash_attention(
     ``w`` keys up to the diagonal. The kernel grids are banded: the
     inner dimension walks only the k (resp. q) blocks each band
     touches, so both FLOPs and DMA traffic scale O(S·w), not O(S²).
+
+    ``return_lse=True`` additionally returns the per-row logsumexp
+    (fp32, (batch, heads, seq_q); NEG_INF on fully-masked rows) as a
+    differentiable output — chunk results merge exactly via
+    ``logaddexp`` (the ring/blockwise-attention combine).
 
     ``dropout_rate`` applies dropout to the attention probabilities
     inside the kernel (the reference's fused softmax+dropout, ref
@@ -832,6 +985,11 @@ def flash_attention(
         raise ValueError(
             f"kv heads ({k.shape[1]}/{v.shape[1]}) must be equal and "
             f"divide q heads ({q.shape[1]})")
+    if (q_positions is None) != (kv_positions is None):
+        raise ValueError("q_positions and kv_positions must be given together")
+    if q_positions is not None and not causal:
+        raise ValueError("positions only affect causal/window masking; "
+                         "pass causal=True")
     if window_size is not None:
         if not causal:
             raise ValueError("window_size requires causal=True")
@@ -863,7 +1021,15 @@ def flash_attention(
     if impl == "xla":
         return _attention_xla(q, k, v, bias, segment_ids, kv_segment_ids,
                               softmax_scale, causal, window_size,
-                              dropout_rate, seed)
+                              dropout_rate, seed, return_lse=return_lse,
+                              q_pos=q_positions, k_pos=kv_positions)
+    if return_lse or q_positions is not None:
+        out = _flash_with_lse(
+            q, k, v, bias, segment_ids, kv_segment_ids, seed,
+            q_positions, kv_positions,
+            softmax_scale, causal, window_size, float(dropout_rate),
+            block_q, block_k, interpret_flag(impl))
+        return out if return_lse else out[0]
     return _flash(q, k, v, bias, segment_ids, kv_segment_ids, seed,
                   softmax_scale, causal, window_size, float(dropout_rate),
                   block_q, block_k, interpret_flag(impl))

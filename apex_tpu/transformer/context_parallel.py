@@ -78,30 +78,23 @@ def zigzag_indices(seq_len: int, cp_size: int) -> Tuple[np.ndarray, np.ndarray]:
 # --------------------------------------------------------------------------
 
 
-def _chunk_attn(q, k_c, v_c, qpos, kpos, scale, causal):
-    """One ring step: scores of local Q against a visiting KV chunk,
-    returning (m, l, o_unnorm) partials in fp32 for online merging.
+def _chunk_attn(q, k_c, v_c, qpos, kpos, scale, causal, impl=None):
+    """One ring step: local Q against a visiting KV chunk through the
+    flash kernel, returning (out fp32, lse) partials.
 
-    A fully-masked row (a chunk entirely in this query's causal future)
-    yields m = NEG_INF; the caller's merge then weights it by
-    exp(NEG_INF - m_new) == 0 once any unmasked chunk has been seen, so
-    its garbage l/o never survive — causal self-attention always sees
-    its own diagonal chunk unmasked.
+    Chunk pairs merge exactly via logaddexp (``_merge``): a fully-masked
+    row (a chunk entirely in this query's causal future) carries
+    lse = NEG_INF — zero mass — so its zero output never survives.
+    Causality comes from *global* positions (``q_positions`` /
+    ``kv_positions`` on the kernel), which is what makes zig-zag
+    balancing a pure input permutation.
     """
-    s = jnp.einsum(
-        "bhqd,bhkd->bhqk", q, k_c, preferred_element_type=jnp.float32
-    ) * scale
-    if causal:
-        mask = qpos[:, None] >= kpos[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    m = jnp.max(s, axis=-1)
-    p = jnp.exp(s - m[..., None])
-    l = jnp.sum(p, axis=-1)
-    o = jnp.einsum(
-        "bhqk,bhkd->bhqd", p.astype(v_c.dtype), v_c,
-        preferred_element_type=jnp.float32,
-    )
-    return m, l, o
+    out, lse = flash_attention(
+        q, k_c, v_c, causal=causal,
+        q_positions=qpos if causal else None,
+        kv_positions=kpos if causal else None,
+        softmax_scale=scale, return_lse=True, impl=impl)
+    return out.astype(jnp.float32), lse
 
 
 def ring_attention(
@@ -115,6 +108,7 @@ def ring_attention(
     q_positions: Optional[jax.Array] = None,
     kv_positions: Optional[jax.Array] = None,
     skip_granularity: int = 1,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """Exact ring attention over the ``axis_name`` device ring.
 
@@ -153,26 +147,25 @@ def ring_attention(
             f"({k.shape[2]}) shard lengths")
 
     def _merge(a, p):
-        m_a, l_a, o_a = a
-        m_p, l_p, o_p = p
-        m_new = jnp.maximum(m_a, m_p)
-        c_a = jnp.exp(m_a - m_new)
-        c_p = jnp.exp(m_p - m_new)
-        return (m_new, l_a * c_a + l_p * c_p,
-                o_a * c_a[..., None] + o_p * c_p[..., None])
+        o_a, l_a = a
+        o_p, l_p = p
+        l_new = jnp.logaddexp(l_a, l_p)
+        return (o_a * jnp.exp(l_a - l_new)[..., None]
+                + o_p * jnp.exp(l_p - l_new)[..., None], l_new)
 
     def compute(k_c, v_c, kpos):
-        """(m, l, o) partials of local Q against one visiting KV shard.
+        """(out, lse) partials of local Q against one visiting KV shard.
 
         Under causal masking the shard is processed in ``ng`` x ``ng``
         (q-block, kv-block) sub-tiles; a tile wholly in the q-block's
-        causal future is skipped via ``lax.cond`` so no score matmul is
+        causal future is skipped via ``lax.cond`` so no kernel launch is
         issued for it (the predicate is per-device and collective-free,
         so divergent branches across the ring are fine)."""
         if not causal:
-            return _chunk_attn(q, k_c, v_c, q_positions, kpos, scale, False)
+            return _chunk_attn(q, k_c, v_c, q_positions, kpos, scale,
+                               False, impl)
         qs, ks = s_local // ng, k_c.shape[2] // ng
-        m_rows, l_rows, o_rows = [], [], []
+        o_rows, l_rows = [], []
         for qb in range(ng):
             qsl = slice(qb * qs, (qb + 1) * qs)
             q_b, qpos_b = q[:, :, qsl], q_positions[qsl]
@@ -183,20 +176,17 @@ def ring_attention(
                 k_b, v_b, kpos_b = k_c[:, :, ksl], v_c[:, :, ksl], kpos[ksl]
                 part = lax.cond(
                     jnp.min(kpos_b) > q_max_b,
-                    lambda: (jnp.full((b, h, qs), NEG_INF, jnp.float32),
-                             jnp.zeros((b, h, qs), jnp.float32),
-                             jnp.zeros((b, h, qs, d), jnp.float32)),
+                    lambda: (jnp.zeros((b, h, qs, d), jnp.float32),
+                             jnp.full((b, h, qs), NEG_INF, jnp.float32)),
                     lambda k_b=k_b, v_b=v_b, kpos_b=kpos_b, q_b=q_b,
                     qpos_b=qpos_b: _chunk_attn(
-                        q_b, k_b, v_b, qpos_b, kpos_b, scale, True),
+                        q_b, k_b, v_b, qpos_b, kpos_b, scale, True, impl),
                 )
                 acc = part if acc is None else _merge(acc, part)
-            m_rows.append(acc[0])
+            o_rows.append(acc[0])
             l_rows.append(acc[1])
-            o_rows.append(acc[2])
-        return (jnp.concatenate(m_rows, axis=2),
-                jnp.concatenate(l_rows, axis=2),
-                jnp.concatenate(o_rows, axis=2))
+        return (jnp.concatenate(o_rows, axis=2),
+                jnp.concatenate(l_rows, axis=2))
 
     # chunk 0 is the local KV shard — computed before any rotation, so
     # the ring does exactly cp-1 ppermutes (none wasted).
@@ -212,10 +202,7 @@ def ring_attention(
 
     (acc, _, _, _), _ = lax.scan(
         step, (acc, k, v, kv_positions), None, length=cp - 1)
-    m, l, o = acc
-    # guard fully-masked rows (l == 0) — only possible with non-causal
-    # external masks; causal self-attention always sees the diagonal.
-    out = o / jnp.maximum(l, 1e-30)[..., None]
+    out, _lse = acc       # chunks arrive normalized; nothing to divide
     return out.astype(q.dtype)
 
 
@@ -230,6 +217,7 @@ def ring_attention_sharded(
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     zigzag: bool = False,
+    impl: Optional[str] = None,
 ) -> jax.Array:
     """shard_map convenience wrapper: global (b, h, S, d) in/out, sequence
     sharded over ``axis_name`` (and batch over ``batch_axis`` if given).
@@ -265,7 +253,7 @@ def ring_attention_sharded(
             ql, kl, vl, axis_name=axis_name, causal=causal,
             softmax_scale=softmax_scale,
             q_positions=posl, kv_positions=posl,
-            skip_granularity=2 if zigzag else 1,
+            skip_granularity=2 if zigzag else 1, impl=impl,
         )
 
     out = run(q, k, v, pos)

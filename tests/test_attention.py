@@ -483,3 +483,141 @@ class TestDropout:
         for a, b_ in zip(g, g_ref):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
                                        rtol=2e-4, atol=2e-4)
+
+
+class TestReturnLse:
+    """lse as a differentiable second output — the merge signal for
+    ring/blockwise attention (chunk pairs combine via logaddexp)."""
+
+    def test_lse_matches_xla(self, rng, impl):
+        b, h, s, d = 2, 4, 128, 64
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * .3)
+                   for _ in range(3))
+        o_k, lse_k = flash_attention(q, k, v, causal=True, impl=impl,
+                                     return_lse=True, block_q=64, block_k=64)
+        o_x, lse_x = flash_attention(q, k, v, causal=True, impl="xla",
+                                     return_lse=True)
+        np.testing.assert_allclose(np.asarray(o_k), np.asarray(o_x),
+                                   rtol=2e-4, atol=2e-4)
+        np.testing.assert_allclose(np.asarray(lse_k), np.asarray(lse_x),
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_lse_grads_match_xla(self, rng, impl):
+        """A loss using BOTH outputs exercises the extended VJP
+        (ds += p * g_lse)."""
+        b, h, s, d = 2, 2, 64, 32
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * .3)
+                   for _ in range(3))
+
+        def loss(q, k, v, im):
+            o, lse = flash_attention(q, k, v, causal=True, impl=im,
+                                     return_lse=True, block_q=32,
+                                     block_k=32)
+            return jnp.sum(o.astype(jnp.float32) ** 2) + jnp.sum(
+                jnp.sin(lse))
+
+        g = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, impl)
+        g_ref = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, "xla")
+        for a, b_ in zip(g, g_ref):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                       rtol=2e-4, atol=2e-4)
+
+    def test_chunked_merge_equals_full(self, rng, impl):
+        """Split KV into chunks, attend per chunk with return_lse, merge
+        with logaddexp: must equal full attention — the ring-attention
+        combine identity."""
+        b, h, s, d = 1, 2, 128, 32
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * .3)
+                   for _ in range(3))
+        full = flash_attention(q, k, v, impl=impl, block_q=32, block_k=32)
+        halves = [(flash_attention(q, k[:, :, i:i + 64], v[:, :, i:i + 64],
+                                   impl=impl, return_lse=True,
+                                   block_q=32, block_k=32))
+                  for i in (0, 64)]
+        (o1, l1), (o2, l2) = halves
+        lse = jnp.logaddexp(l1, l2)
+        merged = (o1.astype(jnp.float32) * jnp.exp(l1 - lse)[..., None]
+                  + o2.astype(jnp.float32) * jnp.exp(l2 - lse)[..., None])
+        np.testing.assert_allclose(np.asarray(merged), np.asarray(full),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_masked_rows_lse_neg_inf(self, rng, impl):
+        """Fully-masked rows carry lse=NEG_INF — zero mass under the
+        merge — and grads stay finite."""
+        from apex_tpu.ops.attention import NEG_INF
+
+        b, h, s, d = 1, 2, 64, 32
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * .3)
+                   for _ in range(3))
+        seg = jnp.zeros((b, s), jnp.int32).at[:, :32].set(1)
+        kseg = jnp.ones((b, s), jnp.int32) * 2    # no kv matches any q
+        o, lse = flash_attention(q, k, v, segment_ids=seg,
+                                 kv_segment_ids=kseg, impl=impl,
+                                 return_lse=True, block_q=32, block_k=32)
+        assert np.all(np.asarray(lse) <= NEG_INF * 0.5)
+        assert np.all(np.asarray(o) == 0.0)
+        g = jax.grad(lambda q: jnp.sum(flash_attention(
+            q, k, v, segment_ids=seg, kv_segment_ids=kseg, impl=impl,
+            return_lse=True, block_q=32, block_k=32)[0] ** 2))(q)
+        assert np.isfinite(np.asarray(g)).all()
+
+
+class TestPositions:
+    """Dynamic global positions for chunked causal masking — the mask
+    basis for ring/blockwise attention chunks."""
+
+    def test_positions_equal_static_causal(self, rng, impl):
+        b, h, s, d = 1, 2, 64, 32
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * .3)
+                   for _ in range(3))
+        pos = jnp.arange(s, dtype=jnp.int32)
+        o_pos = flash_attention(q, k, v, causal=True, q_positions=pos,
+                                kv_positions=pos, impl=impl,
+                                block_q=32, block_k=32)
+        o_stat = flash_attention(q, k, v, causal=True, impl=impl,
+                                 block_q=32, block_k=32)
+        np.testing.assert_allclose(np.asarray(o_pos), np.asarray(o_stat),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_chunked_causal_merge(self, rng, impl):
+        """KV chunks attended with global positions + lse merge must
+        equal full causal attention — including grads through the
+        positions-masked backward."""
+        b, h, s, d = 1, 2, 128, 32
+        q, k, v = (jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * .3)
+                   for _ in range(3))
+        pos = jnp.arange(s, dtype=jnp.int32)
+
+        def merged(q, k, v, im):
+            outs = []
+            for i in (0, 64):
+                o, l = flash_attention(
+                    q, k[:, :, i:i + 64], v[:, :, i:i + 64], causal=True,
+                    q_positions=pos, kv_positions=pos[i:i + 64],
+                    return_lse=True, impl=im, block_q=32, block_k=32)
+                outs.append((o.astype(jnp.float32), l))
+            (o1, l1), (o2, l2) = outs
+            lse = jnp.logaddexp(l1, l2)
+            return (o1 * jnp.exp(l1 - lse)[..., None]
+                    + o2 * jnp.exp(l2 - lse)[..., None])
+
+        full = flash_attention(q, k, v, causal=True, impl=impl,
+                               block_q=32, block_k=32)
+        np.testing.assert_allclose(
+            np.asarray(merged(q, k, v, impl)), np.asarray(full),
+            rtol=2e-4, atol=2e-4)
+
+        g = jax.grad(lambda q: jnp.sum(merged(q, k, v, impl) ** 2))(q)
+        g_ref = jax.grad(lambda q: jnp.sum(flash_attention(
+            q, k, v, causal=True, impl=impl, block_q=32, block_k=32
+        ).astype(jnp.float32) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_positions_validation(self, rng):
+        q = jnp.zeros((1, 2, 16, 8))
+        pos = jnp.arange(16, dtype=jnp.int32)
+        with pytest.raises(ValueError, match="together"):
+            flash_attention(q, q, q, causal=True, q_positions=pos)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, q, q, q_positions=pos, kv_positions=pos)
