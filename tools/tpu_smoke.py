@@ -228,6 +228,39 @@ def run(perf=False, kimpl="pallas"):
           lambda q_, k_, vv, impl: ops.flash_attention(
               q_, k_, vv, causal=True, impl=impl),
           q, kg, vg, grad_wrt=(0, 1, 2), tol=2e-2)
+    check("flash_attention dropout (fwd+bwd)",
+          lambda q_, k_, vv, impl: ops.flash_attention(
+              q_, k_, vv, causal=True, dropout_rate=0.1,
+              dropout_rng=jax.random.PRNGKey(0), impl=impl),
+          q, k, v_, grad_wrt=(0, 1, 2), tol=2e-2)
+    check("flash_attention return_lse (fwd+bwd)",
+          lambda q_, k_, vv, impl: ops.flash_attention(
+              q_, k_, vv, causal=True, return_lse=True, impl=impl),
+          q, k, v_, grad_wrt=(0, 1, 2), tol=2e-2)
+    pos = jnp.arange(1024, dtype=jnp.int32)
+    check("flash_attention positions causal",
+          lambda q_, k_, vv, impl: ops.flash_attention(
+              q_, k_, vv, causal=True, q_positions=pos, kv_positions=pos,
+              impl=impl),
+          q, k, v_, grad_wrt=(0, 1, 2), tol=2e-2)
+
+    # ---- ring attention chunk math (single-chunk degenerate ring:
+    # flash with positions + lse-merge identity) --------------------
+    def chunk_merge(q_, k_, vv, impl):
+        o1, l1 = ops.flash_attention(
+            q_, k_[:, :, :512], vv[:, :, :512], causal=True,
+            q_positions=pos, kv_positions=pos[:512],
+            return_lse=True, impl=impl)
+        o2, l2 = ops.flash_attention(
+            q_, k_[:, :, 512:], vv[:, :, 512:], causal=True,
+            q_positions=pos, kv_positions=pos[512:],
+            return_lse=True, impl=impl)
+        lse = jnp.logaddexp(l1, l2)
+        return (o1.astype(jnp.float32) * jnp.exp(l1 - lse)[..., None]
+                + o2.astype(jnp.float32) * jnp.exp(l2 - lse)[..., None])
+
+    check("flash chunked lse-merge == full", chunk_merge, q, k, v_,
+          tol=2e-2)
 
     n_fail = sum(1 for _, ok, *_ in results if not ok)
     print(f"\n{len(results) - n_fail}/{len(results)} ops pass on "
