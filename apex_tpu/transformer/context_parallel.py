@@ -276,8 +276,8 @@ def ulysses_attention(
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     impl: Optional[str] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ) -> jax.Array:
     """DeepSpeed-Ulysses-style attention: all_to_all seq->heads, local
     full-sequence flash attention, all_to_all heads->seq.
@@ -319,8 +319,8 @@ def ulysses_attention_sharded(
     causal: bool = False,
     softmax_scale: Optional[float] = None,
     impl: Optional[str] = None,
-    block_q: int = 128,
-    block_k: int = 128,
+    block_q: int = 1024,
+    block_k: int = 1024,
 ) -> jax.Array:
     """shard_map wrapper for :func:`ulysses_attention` (global arrays in/out)."""
     spec_x = P(batch_axis, None, axis_name, None)
