@@ -188,9 +188,12 @@ def make_gpt_pretrain_step(
         if _inside_axis(TENSOR_AXIS):
             losses = vocab_parallel_cross_entropy(logits, labels_sb)
         else:
-            lse = jax.scipy.special.logsumexp(logits, axis=-1)
-            tgt = jnp.take_along_axis(logits, labels_sb[..., None], -1)[..., 0]
-            losses = lse - tgt
+            # fused xentropy: saves only the logsumexp residual instead
+            # of re-deriving softmax grads through the XLA lse graph
+            # (ref apex.contrib.xentropy memory story)
+            from apex_tpu.ops import softmax_cross_entropy_loss
+
+            losses = softmax_cross_entropy_loss(logits, labels_sb)
         return jnp.mean(losses)
 
     def local_loss(params, tokens, labels):

@@ -24,13 +24,22 @@ from jax.experimental.pallas import tpu as pltpu
 from apex_tpu._backend import interpret_flag, resolve_impl
 
 
-def _row_tile(rows: int, cols: int, budget=2 * 1024 * 1024) -> int:
-    tile = max(8, min(128, budget // max(cols * 4, 1)))
-    while rows % tile:
-        tile //= 2
-        if tile < 1:
-            return 1
-    return max(tile, 1)
+def _row_tile(rows: int, cols: int, budget=2 * 1024 * 1024):
+    """Largest legal row tile, or None when no Mosaic-legal tile fits.
+
+    Legal = divides ``rows`` AND (multiple of 8 OR equal to ``rows``)
+    — the last-two-dims tiling rule — AND the (tile, cols) fp32 block
+    fits the VMEM budget. Callers fall back to the XLA path on None
+    (huge vocabularies, ragged row counts)."""
+    want = min(128, budget // max(cols * 4, 1))
+    if rows <= want:
+        return rows          # single block == full dim, always legal
+    tile = (want // 8) * 8   # tiles must be sublane-aligned
+    while tile >= 8:
+        if rows % tile == 0:
+            return tile
+        tile -= 8
+    return None
 
 
 def _fwd_kernel(x_ref, y_ref, loss_ref, lse_ref, *, smoothing):
@@ -63,7 +72,8 @@ def _bwd_kernel(x_ref, y_ref, lse_ref, g_ref, dx_ref, *, smoothing):
 
 def _fwd_impl(logits2, labels2, smoothing, impl):
     rows, cols = logits2.shape
-    if impl == "xla":
+    tile = None if impl == "xla" else _row_tile(rows, cols)
+    if tile is None:
         x = logits2.astype(jnp.float32)
         lse = jax.scipy.special.logsumexp(x, axis=-1, keepdims=True)
         x_t = jnp.take_along_axis(x, labels2, axis=-1)
@@ -71,7 +81,6 @@ def _fwd_impl(logits2, labels2, smoothing, impl):
         if smoothing > 0.0:
             loss = loss - smoothing * jnp.mean(x, axis=-1, keepdims=True)
         return loss, lse
-    tile = _row_tile(rows, cols)
     loss, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, smoothing=smoothing),
         grid=(rows // tile,),
@@ -94,13 +103,13 @@ def _fwd_impl(logits2, labels2, smoothing, impl):
 
 def _bwd_impl(logits2, labels2, lse, g2, smoothing, impl):
     rows, cols = logits2.shape
-    if impl == "xla":
+    tile = None if impl == "xla" else _row_tile(rows, cols)
+    if tile is None:
         x = logits2.astype(jnp.float32)
         p = jnp.exp(x - lse)
         onehot = jax.nn.one_hot(labels2[:, 0], cols, dtype=jnp.float32)
         dx = g2 * (p - (1.0 - smoothing) * onehot - smoothing / cols)
         return dx.astype(logits2.dtype)
-    tile = _row_tile(rows, cols)
     dx = pl.pallas_call(
         functools.partial(_bwd_kernel, smoothing=smoothing),
         grid=(rows // tile,),

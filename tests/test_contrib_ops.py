@@ -105,6 +105,27 @@ class TestFocalLoss:
         assert float(jnp.abs(g[3]).sum()) > 0  # y=-1 (background) row
 
 
+class TestXentropyTiling:
+    """Mosaic-legality guard: ragged row counts and huge vocabularies
+    must fall back to the XLA path instead of emitting illegal
+    (tile, cols) blocks (tile not a multiple of 8 / VMEM-busting)."""
+
+    @pytest.mark.parametrize("rows,cols", [(1001, 512), (16, 300_000),
+                                           (12, 512)])
+    def test_awkward_shapes_match_xla(self, rng, impl, rows, cols):
+        from apex_tpu.ops import softmax_cross_entropy_loss
+
+        logits = jnp.asarray(rng.randn(rows, cols).astype(np.float32))
+        labels = jnp.asarray(rng.randint(0, cols, (rows,)), jnp.int32)
+        got = softmax_cross_entropy_loss(logits, labels, impl=impl)
+        want = softmax_cross_entropy_loss(logits, labels, impl="xla")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+        g = jax.grad(lambda x: jnp.sum(softmax_cross_entropy_loss(
+            x, labels, impl=impl)))(logits)
+        assert np.isfinite(np.asarray(g)).all()
+
+
 class TestXentropy:
     def test_padding_idx_zeroed(self, rng):
         logits = jnp.asarray(rng.randn(6, 10), jnp.float32)
