@@ -1,0 +1,33 @@
+"""Shared Mosaic-legal row-tile selection for row-wise kernels.
+
+One source of truth for the tiling rule every row-tiled kernel
+(softmax family, xentropy, layer/rms norm) must satisfy on TPU: the
+last-two block dims must be divisible by (8, 128) or equal the array
+dims (empirically pinned by tools/mosaic_probe.py). A returned tile
+divides ``rows``, is a multiple of 8 (or equals ``rows``), and keeps
+the (tile, cols) fp32 block inside the VMEM ``budget``; ``None`` means
+no legal tile exists — callers fall back to their XLA paths (ragged
+row counts, huge trailing dims, empty inputs).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def row_tile(rows: int, cols: int, cap: int = 256,
+             budget: int = 2 * 1024 * 1024) -> Optional[int]:
+    if rows <= 0:
+        return None
+    want = min(cap, budget // max(cols * 4, 1))
+    if rows <= want:
+        return rows          # single block == full dim, always legal
+    tile = (want // 8) * 8   # tiles must be sublane-aligned
+    while tile >= 8:
+        if rows % tile == 0:
+            return tile
+        tile -= 8
+    return None
+
+
+__all__ = ["row_tile"]
