@@ -26,19 +26,15 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu._backend import interpret_flag, resolve_impl
+from apex_tpu.ops._tiling import row_tile
 
-_DEF_ROWS = 256
+_DEF_ROWS = 256   # row-tile cap; tools/tpu_tune.py sweeps this
 
 
-def _row_tile(n_rows: int, hidden: int) -> int:
-    # keep ~ <=4MB fp32 per input tile in VMEM
-    budget = 4 * 1024 * 1024 // max(hidden * 4, 1)
-    tile = max(8, min(_DEF_ROWS, budget))
-    while n_rows % tile:
-        tile //= 2
-        if tile < 1:
-            return 1
-    return max(tile, 1)
+def _row_tile(n_rows: int, hidden: int):
+    # keep ~ <=4MB fp32 per input tile in VMEM; None -> XLA fallback
+    return row_tile(n_rows, hidden, cap=_DEF_ROWS,
+                    budget=4 * 1024 * 1024)
 
 
 # ---------------------------------------------------------------------------
@@ -208,10 +204,9 @@ def _norm(x2, w, b, eps, rms, impl):
 
 
 def _tileable(x2):
-    # Mosaic needs the row-tile divisible by 8 (sublane) unless it covers
-    # all rows; ragged/small row counts take the XLA path instead.
-    rows = x2.shape[0]
-    return rows % 8 == 0 or rows == _row_tile(rows, x2.shape[1])
+    # shared Mosaic-legality rule: a None tile (ragged/empty rows, huge
+    # hidden) routes to the XLA path
+    return _row_tile(x2.shape[0], x2.shape[1]) is not None
 
 
 def _norm_fwd_impl(x2, w, b, eps, rms, impl):

@@ -23,17 +23,13 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu._backend import interpret_flag, resolve_impl
+from apex_tpu.ops._tiling import row_tile
 
 MASK_FILL = -10000.0  # reference fill for masked logits
 
 
-def _row_tile(rows: int, cols: int, budget=2 * 1024 * 1024) -> int:
-    tile = max(8, min(256, budget // max(cols * 4, 1)))
-    while rows % tile:
-        tile //= 2
-        if tile < 1:
-            return 1
-    return max(tile, 1)
+def _row_tile(rows: int, cols: int):
+    return row_tile(rows, cols, cap=256)
 
 
 # -- forward kernels -------------------------------------------------------
@@ -79,12 +75,11 @@ def _bwd_kernel(y_ref, g_ref, dx_ref, *, scale):
     dx_ref[...] = (scale * y * (g - dot)).astype(dx_ref.dtype)
 
 
-def _bwd_pallas(y, g, scale, impl):
+def _bwd_pallas(y, g, scale, impl, tile):
     shape = y.shape
     y2 = y.reshape(-1, shape[-1])
     g2 = g.reshape(-1, shape[-1])
     rows, cols = y2.shape
-    tile = _row_tile(rows, cols)
     dx = pl.pallas_call(
         functools.partial(_bwd_kernel, scale=scale),
         grid=(rows // tile,),
@@ -100,12 +95,14 @@ def _bwd_pallas(y, g, scale, impl):
 
 
 def _bwd_any(y, g, scale, impl):
-    if impl == "xla":
+    tile = (None if impl == "xla"
+            else _row_tile(y[..., 0].size, y.shape[-1]))
+    if tile is None:
         yf = y.astype(jnp.float32)
         gf = g.astype(jnp.float32)
         dot = jnp.sum(yf * gf, axis=-1, keepdims=True)
         return (scale * yf * (gf - dot)).astype(y.dtype)
-    return _bwd_pallas(y, g, scale, impl)
+    return _bwd_pallas(y, g, scale, impl, tile)
 
 
 # -- scaled softmax --------------------------------------------------------
@@ -116,12 +113,12 @@ def scaled_softmax(x, scale: float = 1.0, impl: Optional[str] = None):
     """softmax(scale*x) over the last dim, any leading dims
     (ref: csrc/megatron/scaled_softmax_cuda.cu ScaledSoftmax)."""
     impl = resolve_impl(impl)
-    if impl == "xla":
-        return _softmax_rows(x, scale).astype(x.dtype)
     shape = x.shape
+    rows, cols = x[..., 0].size, shape[-1]
+    tile = None if impl == "xla" else _row_tile(rows, cols)
+    if tile is None:
+        return _softmax_rows(x, scale).astype(x.dtype)
     x2 = x.reshape(-1, shape[-1])
-    rows, cols = x2.shape
-    tile = _row_tile(rows, cols)
     y = pl.pallas_call(
         functools.partial(_scaled_kernel, scale=scale),
         grid=(rows // tile,),
@@ -157,12 +154,12 @@ def scaled_upper_triang_masked_softmax(x, scale: float = 1.0,
     impl = resolve_impl(impl)
     assert x.ndim == 3, "expected (attn_batches, sq, sk)"
     a, sq, sk = x.shape
-    if impl == "xla":
+    tile = None if impl == "xla" else _row_tile(sq, sk)
+    if tile is None:
         row = jax.lax.broadcasted_iota(jnp.int32, (1, sq, sk), 1)
         col = jax.lax.broadcasted_iota(jnp.int32, (1, sq, sk), 2)
         neg = jnp.where(col > row, jnp.float32(-1e30), 0.0)
         return _softmax_rows(x, scale, neg).astype(x.dtype)
-    tile = _row_tile(sq, sk)
     y = pl.pallas_call(
         functools.partial(_causal_kernel, scale=scale, tile=tile),
         grid=(a, sq // tile),
@@ -204,13 +201,13 @@ def scaled_masked_softmax(x, mask, scale: float = 1.0,
     impl = resolve_impl(impl)
     assert x.ndim == 4 and mask.ndim == 4
     b, h, sq, sk = x.shape
-    if impl == "xla":
+    tile = None if impl == "xla" else _row_tile(sq, sk)
+    if tile is None:
         extra = jnp.where(mask, jnp.float32(MASK_FILL), 0.0)
         return _softmax_rows(x, scale, extra).astype(x.dtype)
     mb = mask.shape[0]
     x3 = x.reshape(b * h, sq, sk)
     m3 = jnp.broadcast_to(mask, (mb, 1, sq, sk)).reshape(mb, sq, sk)
-    tile = _row_tile(sq, sk)
 
     def mask_index(i, j):
         return (jax.lax.rem(i // h, mb), j, 0)

@@ -22,24 +22,11 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from apex_tpu._backend import interpret_flag, resolve_impl
+from apex_tpu.ops._tiling import row_tile
 
 
-def _row_tile(rows: int, cols: int, budget=2 * 1024 * 1024):
-    """Largest legal row tile, or None when no Mosaic-legal tile fits.
-
-    Legal = divides ``rows`` AND (multiple of 8 OR equal to ``rows``)
-    — the last-two-dims tiling rule — AND the (tile, cols) fp32 block
-    fits the VMEM budget. Callers fall back to the XLA path on None
-    (huge vocabularies, ragged row counts)."""
-    want = min(128, budget // max(cols * 4, 1))
-    if rows <= want:
-        return rows          # single block == full dim, always legal
-    tile = (want // 8) * 8   # tiles must be sublane-aligned
-    while tile >= 8:
-        if rows % tile == 0:
-            return tile
-        tile -= 8
-    return None
+def _row_tile(rows: int, cols: int):
+    return row_tile(rows, cols, cap=128)
 
 
 def _fwd_kernel(x_ref, y_ref, loss_ref, lse_ref, *, smoothing):

@@ -446,3 +446,30 @@ class TestFastLayerNormShim:
             x.var(-1, keepdims=True) + 1e-5)
         np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
                                    rtol=1e-4, atol=1e-4)
+
+
+class TestSoftmaxTiling:
+    """Mosaic-legality guard (same class as the xentropy fix): ragged
+    row counts and huge trailing dims must fall back to XLA instead of
+    emitting sub-8 row tiles."""
+
+    @pytest.mark.parametrize("shape", [(7, 12, 512), (2, 3, 1001, 260)])
+    def test_awkward_shapes_match_xla(self, rng, impl, shape):
+        from apex_tpu.ops import (
+            scaled_softmax,
+            scaled_upper_triang_masked_softmax,
+        )
+
+        x = jnp.asarray(rng.randn(*shape).astype(np.float32))
+        got = scaled_softmax(x, 0.7, impl=impl)
+        want = scaled_softmax(x, 0.7, impl="xla")
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-6)
+        g = jax.grad(lambda x: jnp.sum(
+            scaled_softmax(x, 0.7, impl=impl) ** 2))(x)
+        assert np.isfinite(np.asarray(g)).all()
+        if len(shape) == 3:
+            got = scaled_upper_triang_masked_softmax(x, 0.7, impl=impl)
+            want = scaled_upper_triang_masked_softmax(x, 0.7, impl="xla")
+            np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                       rtol=1e-5, atol=1e-6)
