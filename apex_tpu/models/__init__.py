@@ -1,0 +1,11 @@
+"""Model zoo — the standalone test/benchmark fixtures as real models
+(ref: apex/transformer/testing/standalone_{gpt,bert}.py and the
+1574-LoC transformer LM fixture; resnet mirrors examples/imagenet).
+
+Submodules import lazily: each model family pulls heavy deps
+(flax transformer stack, parallel layers) only when used.
+"""
+
+from apex_tpu.models import bert, gpt, pretrain, resnet, t5  # noqa: F401
+
+__all__ = ["bert", "gpt", "pretrain", "resnet", "t5"]
