@@ -2,8 +2,9 @@
 (ref: apex/transformer/testing/standalone_{gpt,bert}.py and the
 1574-LoC transformer LM fixture; resnet mirrors examples/imagenet).
 
-Submodules import lazily: each model family pulls heavy deps
-(flax transformer stack, parallel layers) only when used.
+Importing the package loads every family (the surface lock and
+packaging both want the full tree importable); reach for a submodule
+directly if import cost matters.
 """
 
 from apex_tpu.models import bert, gpt, pretrain, resnet, t5  # noqa: F401

@@ -47,9 +47,19 @@ class BertConfig:
     param_dtype: Any = jnp.float32
     sequence_parallel: bool = False
     softmax_impl: Optional[str] = None
+    # "softmax": fused scaled-masked softmax over materialized scores
+    # (the reference fixture's path); "flash": the Pallas flash kernel
+    # with the padding mask as segment ids and fused in-kernel dropout
+    attention_backend: str = "softmax"
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
     layernorm_epsilon: float = 1e-5
+
+    def __post_init__(self):
+        if self.attention_backend not in ("softmax", "flash"):
+            raise ValueError(
+                f"attention_backend must be 'softmax' or 'flash', got "
+                f"{self.attention_backend!r}")
 
     @property
     def ffn(self) -> int:
@@ -96,6 +106,32 @@ class BertParallelAttention(nn.Module):
         s, b = qkv.shape[0], qkv.shape[1]
         qkv = qkv.reshape(s, b, heads_local, 3 * head_dim)
         q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        if cfg.attention_backend == "flash":
+            # `mask` is the raw (b, s) keep-mask: as segment ids, real
+            # tokens (1) attend real tokens and pads attend pads —
+            # identical to the outer-product padding mask on every real
+            # row (pad rows are garbage under both conventions and are
+            # excluded from the loss). Dropout runs inside the kernel.
+            from apex_tpu.ops.attention import flash_attention
+
+            qb, kb, vb = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+            drop = (cfg.attention_dropout
+                    if cfg.attention_dropout > 0.0 and not deterministic
+                    else 0.0)
+            ctx = flash_attention(
+                qb, kb, vb, segment_ids=mask.astype(jnp.int32),
+                dropout_rate=drop,
+                dropout_rng=(self.make_rng("dropout") if drop > 0.0
+                             else None),
+                impl=cfg.softmax_impl)
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(
+                s, b, heads_local * head_dim)
+            return RowParallelLinear(
+                output_size=h, input_is_parallel=True,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
+            )(ctx)
 
         def to_bhsd(t):
             return t.transpose(1, 2, 0, 3).reshape(b * heads_local, s, head_dim)
@@ -225,7 +261,11 @@ class BertModel(nn.Module):
                  deterministic=True):
         cfg = self.config
         b, s = tokens.shape
-        ext_mask = bert_extended_attention_mask(attention_mask)
+        # the flash backend consumes the raw (b, s) keep-mask (segment
+        # ids); the softmax backend the outer-product boolean mask
+        ext_mask = (attention_mask
+                    if cfg.attention_backend == "flash"
+                    else bert_extended_attention_mask(attention_mask))
 
         emb = VocabParallelEmbedding(
             num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,

@@ -186,3 +186,54 @@ class TestTensorParallel:
             ),
             g_tp, g_dense,
         )
+
+
+class TestBertFlashBackend:
+    """BERT on the Pallas flash path (VERDICT #5 acceptance: the BERT
+    fixture with attention_dropout runs the kernel, not an XLA
+    fallback)."""
+
+    def _toks(self, rng, cfg, b=2, s=64):
+        toks = jnp.asarray(rng.randint(0, cfg.vocab_size, (b, s)), jnp.int32)
+        mask = jnp.ones((b, s), jnp.int32).at[:, s - 9:].set(0)  # padding
+        return toks, mask
+
+    def test_flash_matches_softmax_on_real_rows(self, rng):
+        base = dict(vocab_size=512, max_seq_len=64, hidden_size=64,
+                    num_layers=2, num_heads=4, dtype=jnp.float32,
+                    softmax_impl="interpret", add_binary_head=False)
+        toks, mask = self._toks(rng, BertConfig(**base))
+        outs = {}
+        for backend in ("softmax", "flash"):
+            cfg = BertConfig(attention_backend=backend, **base)
+            model = BertModel(cfg)
+            params = model.init(jax.random.PRNGKey(0), toks, mask)
+            lm, _ = model.apply(params, toks, mask)
+            outs[backend] = np.asarray(lm)
+        # compare only real (unpadded) rows — pad rows are garbage under
+        # both masking conventions
+        real = np.asarray(mask[0]).astype(bool)
+        np.testing.assert_allclose(outs["flash"][real], outs["softmax"][real],
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_flash_dropout_trains(self, rng):
+        cfg = BertConfig(vocab_size=512, max_seq_len=64, hidden_size=64,
+                         num_layers=2, num_heads=4, dtype=jnp.float32,
+                         attention_backend="flash", attention_dropout=0.1,
+                         softmax_impl="interpret", add_binary_head=False)
+        toks, mask = self._toks(rng, cfg)
+        model = BertModel(cfg)
+        params = model.init(jax.random.PRNGKey(0), toks, mask)
+
+        def loss_fn(p, key):
+            lm, _ = model.apply(p, toks, mask, deterministic=False,
+                                rngs={"dropout": key})
+            return jnp.mean(lm.astype(jnp.float32) ** 2)
+
+        l1 = loss_fn(params, jax.random.PRNGKey(1))
+        l2 = loss_fn(params, jax.random.PRNGKey(2))
+        assert np.isfinite(float(l1)) and np.isfinite(float(l2))
+        assert float(l1) != float(l2)          # dropout is live
+        g = jax.grad(loss_fn)(params, jax.random.PRNGKey(3))
+        assert all(np.isfinite(np.asarray(x)).all()
+                   for x in jax.tree.leaves(g))
