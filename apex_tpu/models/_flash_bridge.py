@@ -1,0 +1,27 @@
+"""Shared (s, b, heads, head_dim) <-> kernel-layout bridge.
+
+Every model family stores activations in Megatron's sbh convention;
+the flash kernel wants (b, heads, s, d). One helper owns the transpose
+pair so a kernel-interface change lands once, not per model.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from apex_tpu.ops.attention import flash_attention
+
+
+def flash_sbhd(q: jax.Array, k: jax.Array, v: jax.Array, **kwargs):
+    """q (sq, b, h, d), k/v (sk, b, hk, d) -> (sq, b, h*d).
+
+    kwargs pass straight to :func:`flash_attention` (causal, segment
+    ids, dropout, window, positions, impl, ...).
+    """
+    sq, b = q.shape[0], q.shape[1]
+    qb, kb, vb = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
+    out = flash_attention(qb, kb, vb, **kwargs)
+    return out.transpose(2, 0, 1, 3).reshape(sq, b, -1)
+
+
+__all__ = ["flash_sbhd"]

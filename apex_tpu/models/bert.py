@@ -113,46 +113,41 @@ class BertParallelAttention(nn.Module):
             # identical to the outer-product padding mask on every real
             # row (pad rows are garbage under both conventions and are
             # excluded from the loss). Dropout runs inside the kernel.
-            from apex_tpu.ops.attention import flash_attention
+            from apex_tpu.models._flash_bridge import flash_sbhd
 
-            qb, kb, vb = (t.transpose(1, 2, 0, 3) for t in (q, k, v))
             drop = (cfg.attention_dropout
                     if cfg.attention_dropout > 0.0 and not deterministic
                     else 0.0)
-            ctx = flash_attention(
-                qb, kb, vb, segment_ids=mask.astype(jnp.int32),
+            ctx = flash_sbhd(
+                q, k, v, segment_ids=mask.astype(jnp.int32),
                 dropout_rate=drop,
                 dropout_rng=(self.make_rng("dropout") if drop > 0.0
                              else None),
                 impl=cfg.softmax_impl)
+        else:
+            def to_bhsd(t):
+                return t.transpose(1, 2, 0, 3).reshape(
+                    b * heads_local, s, head_dim)
+
+            q, k, v = to_bhsd(q), to_bhsd(k), to_bhsd(v)
+            scores = jnp.einsum(
+                "bsd,btd->bst", q, k, preferred_element_type=jnp.float32
+            ) / jnp.sqrt(head_dim).astype(jnp.float32)
+            probs = FusedScaleMaskSoftmax(
+                attn_mask_type=AttnMaskType.padding, impl=cfg.softmax_impl
+            )(scores.reshape(b, heads_local, s, s).astype(cfg.dtype),
+              mask=mask)
+            if cfg.attention_dropout > 0.0 and not deterministic:
+                probs = nn.Dropout(rate=cfg.attention_dropout)(
+                    probs, deterministic=False
+                )
+            ctx = jnp.einsum(
+                "bhst,bhtd->bhsd", probs,
+                v.reshape(b, heads_local, s, head_dim),
+                preferred_element_type=jnp.float32,
+            ).astype(cfg.dtype)
             ctx = ctx.transpose(2, 0, 1, 3).reshape(
                 s, b, heads_local * head_dim)
-            return RowParallelLinear(
-                output_size=h, input_is_parallel=True,
-                sequence_parallel_enabled=cfg.sequence_parallel,
-                param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
-            )(ctx)
-
-        def to_bhsd(t):
-            return t.transpose(1, 2, 0, 3).reshape(b * heads_local, s, head_dim)
-
-        q, k, v = to_bhsd(q), to_bhsd(k), to_bhsd(v)
-        scores = jnp.einsum(
-            "bsd,btd->bst", q, k, preferred_element_type=jnp.float32
-        ) / jnp.sqrt(head_dim).astype(jnp.float32)
-        probs = FusedScaleMaskSoftmax(
-            attn_mask_type=AttnMaskType.padding, impl=cfg.softmax_impl
-        )(scores.reshape(b, heads_local, s, s).astype(cfg.dtype), mask=mask)
-        if cfg.attention_dropout > 0.0 and not deterministic:
-            probs = nn.Dropout(rate=cfg.attention_dropout)(
-                probs, deterministic=False
-            )
-        ctx = jnp.einsum(
-            "bhst,bhtd->bhsd", probs,
-            v.reshape(b, heads_local, s, head_dim),
-            preferred_element_type=jnp.float32,
-        ).astype(cfg.dtype)
-        ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, heads_local * head_dim)
         return RowParallelLinear(
             output_size=h, input_is_parallel=True,
             sequence_parallel_enabled=cfg.sequence_parallel,

@@ -54,6 +54,17 @@ class T5Config:
     dtype: Any = jnp.bfloat16
     param_dtype: Any = jnp.float32
     softmax_impl: Optional[str] = None
+    # "softmax": materialized scores + fused masked softmax (the
+    # reference fixture's path); "flash": the Pallas kernel — encoder
+    # padding as segment ids, decoder causal, cross-attention via
+    # key-side-only segment masking
+    attention_backend: str = "softmax"
+
+    def __post_init__(self):
+        if self.attention_backend not in ("softmax", "flash"):
+            raise ValueError(
+                f"attention_backend must be 'softmax' or 'flash', got "
+                f"{self.attention_backend!r}")
 
     @property
     def ffn(self) -> int:
@@ -128,25 +139,43 @@ class _Attention(nn.Module):
             kv = kv.reshape(sk, b, heads_local, 2 * head_dim)
             k, v = jnp.split(kv, 2, axis=-1)
 
-        def to_bhsd(t, s):
-            return t.transpose(1, 2, 0, 3).reshape(b * heads_local, s,
-                                                   head_dim)
+        if cfg.attention_backend == "flash":
+            # mask here is the RAW (b, s_kv) keep-mask (or None for the
+            # causal decoder): self-attn uses it as segment ids on both
+            # sides; cross-attn masks keys only (kv_segment_ids with
+            # real keys in segment 0 — the kernel's key-side mode)
+            from apex_tpu.models._flash_bridge import flash_sbhd
 
-        q, k, v = to_bhsd(q, sq), to_bhsd(k, sk), to_bhsd(v, sk)
-        scores = jnp.einsum(
-            "bsd,btd->bst", q, k, preferred_element_type=jnp.float32
-        ) / jnp.sqrt(head_dim).astype(jnp.float32)
-        probs = FusedScaleMaskSoftmax(
-            attn_mask_type=self.mask_type, impl=cfg.softmax_impl
-        )(scores.reshape(b, heads_local, sq, sk).astype(cfg.dtype),
-          mask=mask)
-        ctx = jnp.einsum(
-            "bhst,bhtd->bhsd", probs,
-            v.reshape(b, heads_local, sk, head_dim),
-            preferred_element_type=jnp.float32,
-        ).astype(cfg.dtype)
-        ctx = ctx.transpose(2, 0, 1, 3).reshape(sq, b,
-                                                heads_local * head_dim)
+            kwargs = {}
+            causal = self.mask_type == AttnMaskType.causal
+            if not causal and mask is not None:
+                if self.attn_type == AttnType.self_attn:
+                    kwargs["segment_ids"] = mask.astype(jnp.int32)
+                else:
+                    kwargs["kv_segment_ids"] = (
+                        1 - mask.astype(jnp.int32))
+            ctx = flash_sbhd(q, k, v, causal=causal,
+                             impl=cfg.softmax_impl, **kwargs)
+        else:
+            def to_bhsd(t, s):
+                return t.transpose(1, 2, 0, 3).reshape(
+                    b * heads_local, s, head_dim)
+
+            q, k, v = to_bhsd(q, sq), to_bhsd(k, sk), to_bhsd(v, sk)
+            scores = jnp.einsum(
+                "bsd,btd->bst", q, k, preferred_element_type=jnp.float32
+            ) / jnp.sqrt(head_dim).astype(jnp.float32)
+            probs = FusedScaleMaskSoftmax(
+                attn_mask_type=self.mask_type, impl=cfg.softmax_impl
+            )(scores.reshape(b, heads_local, sq, sk).astype(cfg.dtype),
+              mask=mask)
+            ctx = jnp.einsum(
+                "bhst,bhtd->bhsd", probs,
+                v.reshape(b, heads_local, sk, head_dim),
+                preferred_element_type=jnp.float32,
+            ).astype(cfg.dtype)
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(
+                sq, b, heads_local * head_dim)
         return RowParallelLinear(
             output_size=h, input_is_parallel=True,
             param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
@@ -231,10 +260,15 @@ class T5Model(nn.Module):
             (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype,
         )
 
-        # (b, 1, sq, sk) True = masked
-        m = enc_mask.astype(jnp.float32)
-        enc_attn_mask = (m[:, None, :] * m[:, :, None] < 0.5)[:, None]
-        cross_mask = (m[:, None, :] < 0.5)[:, None].repeat(s_dec, axis=2)
+        if cfg.attention_backend == "flash":
+            # the kernel consumes the raw keep-mask
+            enc_attn_mask = enc_mask
+            cross_mask = enc_mask
+        else:
+            # (b, 1, sq, sk) True = masked
+            m = enc_mask.astype(jnp.float32)
+            enc_attn_mask = (m[:, None, :] * m[:, :, None] < 0.5)[:, None]
+            cross_mask = (m[:, None, :] < 0.5)[:, None].repeat(s_dec, axis=2)
 
         x = emb(enc_tokens) + pos[:s_enc][None].astype(cfg.dtype)
         x = x.transpose(1, 0, 2)

@@ -163,3 +163,33 @@ class TestTensorParallel:
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-5),
             g_tp, g_dense)
+
+
+class TestT5FlashBackend:
+    """T5 on the Pallas kernel: encoder padding as segment ids, causal
+    decoder, key-side-masked cross attention."""
+
+    def test_flash_matches_softmax(self, rng):
+        base = dict(vocab_size=256, max_seq_len=64, hidden_size=64,
+                    num_encoder_layers=2, num_decoder_layers=2,
+                    num_heads=4, dtype=jnp.float32,
+                    softmax_impl="interpret")
+        enc = jnp.asarray(rng.randint(0, 256, (2, 48)), jnp.int32)
+        mask = jnp.ones((2, 48), jnp.int32).at[:, 41:].set(0)
+        dec = jnp.asarray(rng.randint(0, 256, (2, 32)), jnp.int32)
+        outs = {}
+        for backend in ("softmax", "flash"):
+            cfg = T5Config(attention_backend=backend, **base)
+            model = T5Model(cfg)
+            params = model.init(jax.random.PRNGKey(0), enc, mask, dec)
+            outs[backend] = np.asarray(
+                model.apply(params, enc, mask, dec))
+        # decoder logits must agree: encoder pad ROWS differ between
+        # masking conventions but are excluded as cross-attn keys under
+        # both, so nothing downstream sees them
+        np.testing.assert_allclose(outs["flash"], outs["softmax"],
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_backend_validated(self):
+        with pytest.raises(ValueError, match="attention_backend"):
+            T5Config(attention_backend="Flash")
