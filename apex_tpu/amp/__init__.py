@@ -26,9 +26,12 @@ from apex_tpu.amp.functional import (
     register_half_function,
     register_promote_function,
 )
+from apex_tpu.amp.handle import disable_casts, scale_loss
 from apex_tpu.amp.scaler import LossScaler, ScalerState
 
 __all__ = [
+    "scale_loss",
+    "disable_casts",
     "OPT_LEVELS",
     "AmpState",
     "Properties",

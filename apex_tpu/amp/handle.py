@@ -1,0 +1,93 @@
+"""`with amp.scale_loss(...)` — the reference's context-manager surface
+(ref: apex/amp/handle.py:16-158).
+
+The reference yields ``loss.float() * scale`` and, on exit, unscales the
+stashed grads, updates the scale, and patches ``optimizer.step`` to a
+skip on overflow. The functional TPU analog keeps the exact `with` shape
+users port, with the imperative steps becoming fields on the yielded
+handle (everything traces under jit):
+
+    with amp.scale_loss(loss, amp_state, loss_id=0) as scaled:
+        scaled.grads = jax.grad(scaled_loss_fn)(params)   # grads of
+                                                          # scaled.loss
+    # exiting the block unscales + updates the scaler:
+    grads      = scaled.grads        # unscaled grad pytree
+    amp_state  = scaled.amp_state    # scaler advanced (overflow halves)
+    skip       = scaled.skip         # fp32 0/1 — gate the step on it
+
+``skip`` replaces the reference's monkey-patched skip-step
+(handle.py:127-154): pass it to a fused optimizer's ``found_inf`` /
+``skip_if_nonfinite`` input or gate the update with ``lax.cond``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from apex_tpu.amp.frontend import AmpState, make_scaler
+
+
+class _ScaledLossHandle:
+    """Yielded by :func:`scale_loss`. ``loss`` is the scaled loss;
+    assign the grads of that scaled loss to ``.grads`` inside the block
+    and read back the unscaled grads, advanced ``amp_state`` and
+    ``skip`` flag after it."""
+
+    def __init__(self, loss, scaler, amp_state: AmpState, loss_id: int):
+        self._scaler = scaler
+        self._in_state = amp_state
+        self._loss_id = loss_id
+        self.loss = scaler.scale_loss(loss, amp_state.scalers[loss_id])
+        self.grads: Optional[Any] = None
+        self.amp_state: Optional[AmpState] = None
+        self.skip = None
+
+    def _finish(self):
+        state = self._in_state.scalers[self._loss_id]
+        if self.grads is not None:
+            self.grads, found_inf = self._scaler.unscale(self.grads, state)
+        else:
+            found_inf = jnp.zeros((), jnp.float32)
+        new_scaler = self._scaler.update(state, found_inf)
+        scalers = list(self._in_state.scalers)
+        scalers[self._loss_id] = new_scaler
+        self.amp_state = AmpState(properties=self._in_state.properties,
+                                  scalers=tuple(scalers))
+        self.skip = found_inf
+
+
+@contextlib.contextmanager
+def scale_loss(loss, amp_state: AmpState, *, loss_id: int = 0,
+               delay_unscale: bool = False):
+    """Drop-in shape of ``apex.amp.scale_loss`` (ref handle.py:16-158).
+
+    ``delay_unscale=True`` mirrors the reference's grad-accumulation
+    knob (handle.py:62-76): exit leaves ``.grads`` scaled and the scaler
+    state unchanged — unscale once on the final accumulation step.
+    """
+    if loss_id >= len(amp_state.scalers):
+        raise ValueError(
+            f"loss_id {loss_id} out of range for {len(amp_state.scalers)} "
+            f"scalers (pass num_losses to amp.initialize)")
+    scaler = make_scaler(amp_state.properties)
+    handle = _ScaledLossHandle(loss, scaler, amp_state, loss_id)
+    yield handle
+    if delay_unscale:
+        handle.amp_state = amp_state
+        handle.skip = jnp.zeros((), jnp.float32)
+    else:
+        handle._finish()
+
+
+@contextlib.contextmanager
+def disable_casts():
+    """API-parity no-op (ref handle.py:163-167): the reference suspends
+    its function patches inside this block; here dtypes are explicit
+    policies, so there is nothing to suspend."""
+    yield
+
+
+__all__ = ["scale_loss", "disable_casts"]

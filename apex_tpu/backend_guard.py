@@ -23,14 +23,20 @@ This module makes backend acquisition total:
 
 from __future__ import annotations
 
+import contextlib
 import os
 import re
 import subprocess
 import sys
+import time
 from dataclasses import dataclass, field
 
 _PROBE_TIMEOUT_ENV = "APEX_TPU_BACKEND_PROBE_TIMEOUT"
 _DEFAULT_PROBE_TIMEOUT = 120.0
+_RETRY_BUDGET_ENV = "APEX_TPU_BACKEND_RETRY_BUDGET"
+_RETRY_SLEEP = 90.0
+_LOCK_PATH_ENV = "APEX_TPU_SLOT_LOCK"
+_DEFAULT_LOCK_PATH = "/tmp/apex_tpu_tpu_slot.lock"
 
 _PROBE_SRC = (
     "import jax; ds = jax.devices(); "
@@ -152,14 +158,100 @@ def probe_default_backend(timeout: float | None = None) -> dict:
     }
 
 
+@contextlib.contextmanager
+def tpu_slot_lock(timeout: float = 3600.0):
+    """Exclusive cross-process lock around TPU use.
+
+    The tunneled chip in this environment serves ONE client at a time; a
+    second concurrent client makes probes time out and records silently
+    fall back to CPU (round-2 BENCH_r02.json). Every entry point that
+    touches the non-CPU backend (bench modes, smoke/tune tools) takes
+    this flock so runs serialize instead of corrupting each other.
+    Reentrant within a process; a lock held by a dead process is
+    released by the OS automatically.
+    """
+    path = os.environ.get(_LOCK_PATH_ENV, _DEFAULT_LOCK_PATH)
+    if getattr(tpu_slot_lock, "_held", False):
+        yield True
+        return
+    import fcntl
+
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o666)
+        try:
+            os.chmod(path, 0o666)   # umask-proof: any user can lock
+        except OSError:
+            pass                    # another user owns the file; fine
+    except OSError as e:
+        # the lock is advisory — never let acquiring it take down an
+        # entry point whose contract is "always leave a record"
+        print(f"# WARNING: could not open TPU slot lock {path}: {e}; "
+              f"proceeding unserialized", file=sys.stderr)
+        yield False
+        return
+    deadline = time.monotonic() + timeout
+    got = False
+    try:
+        while time.monotonic() < deadline:
+            try:
+                fcntl.flock(fd, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                got = True
+                break
+            except OSError:
+                time.sleep(5.0)
+        if not got:
+            # proceeding unserialized risks exactly the concurrent-client
+            # probe corruption the lock exists to prevent — warn HERE so
+            # every entry point inherits the provenance note
+            print(f"# WARNING: TPU slot lock {path} not acquired within "
+                  f"{timeout:.0f}s; another client may hold the "
+                  f"single-slot tunnel", file=sys.stderr)
+        tpu_slot_lock._held = got
+        yield got
+    finally:
+        tpu_slot_lock._held = False
+        if got:
+            fcntl.flock(fd, fcntl.LOCK_UN)
+        os.close(fd)
+
+
+def chip_peak_tflops(device_kind: str) -> float | None:
+    """Peak dense bf16-matmul TFLOP/s per chip for MFU accounting.
+
+    bf16 only — the dtype every bench mode computes in. Returns None
+    for unknown device kinds so callers emit mfu: null rather than a
+    made-up denominator.
+    """
+    kind = device_kind.lower()
+    table = [
+        ("v6", 918.0),           # Trillium / v6e
+        ("v5p", 459.0),
+        ("v5", 197.0),           # v5 lite / v5e
+        ("v4", 275.0),
+        ("v3", 123.0),
+        ("v2", 45.0),
+    ]
+    for pat, peak in table:
+        if pat in kind:
+            return peak
+    return None
+
+
 def ensure_backend(min_devices: int = 1,
-                   probe_timeout: float | None = None) -> BackendReport:
+                   probe_timeout: float | None = None,
+                   retry_budget: float | None = None) -> BackendReport:
     """Guarantee a usable backend with >= ``min_devices`` devices.
 
     Order of preference: (1) a backend already initialized in-process,
     (2) the default backend if a subprocess probe confirms it healthy
     within the timeout, (3) forced CPU with ``min_devices`` simulated
     devices. Total: always returns, never hangs on a dead tunnel.
+
+    ``retry_budget`` (seconds; env ``APEX_TPU_BACKEND_RETRY_BUDGET``)
+    keeps re-probing a failed default backend — sleep, probe again —
+    until the budget is spent, instead of giving up after one shot.
+    A transiently-busy single-slot tunnel (round-2 failure mode) then
+    costs minutes of waiting, not a silently-CPU benchmark record.
     """
     import jax
     import jax._src.xla_bridge as xb
@@ -183,16 +275,31 @@ def ensure_backend(min_devices: int = 1,
         return BackendReport("cpu", jax.device_count(), fallback=False,
                              note="JAX_PLATFORMS=cpu preset")
 
-    probe = probe_default_backend(probe_timeout)
-    if probe.get("ok") and probe["n_devices"] >= min_devices:
-        # Probe just succeeded seconds ago; in-process init is safe.
-        return BackendReport(
-            jax.default_backend(), jax.device_count(),
-            fallback=False, probe=probe)
+    if retry_budget is None:
+        retry_budget = float(os.environ.get(_RETRY_BUDGET_ENV, 0.0))
+    deadline = time.monotonic() + max(retry_budget, 0.0)
+    attempt = 0
+    while True:
+        attempt += 1
+        probe = probe_default_backend(probe_timeout)
+        if probe.get("ok") and probe["n_devices"] >= min_devices:
+            # Probe just succeeded seconds ago; in-process init is safe.
+            probe["attempts"] = attempt
+            return BackendReport(
+                jax.default_backend(), jax.device_count(),
+                fallback=False, probe=probe)
+        if time.monotonic() >= deadline:
+            break
+        print(f"# backend probe attempt {attempt} failed "
+              f"({probe.get('error', 'too few devices')}); retrying in "
+              f"{_RETRY_SLEEP:.0f}s", file=sys.stderr)
+        time.sleep(min(_RETRY_SLEEP, max(deadline - time.monotonic(), 0.0)))
 
     note = (probe.get("error")
             or (f"default backend has {probe.get('n_devices')} devices, "
                 f"need {min_devices}"))
+    if attempt > 1:
+        note += f" (after {attempt} probes)"
     force_cpu_backend(min_devices)
     return BackendReport(
         "cpu", jax.device_count(), fallback=True, note=note, probe=probe)

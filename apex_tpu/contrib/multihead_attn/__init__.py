@@ -77,10 +77,12 @@ class _MHABase(nn.Module):
         rate = 0.0 if deterministic else self.dropout
         if rate > 0.0:
             rng = self.make_rng("dropout")
+        # dropout stays on the fused kernel: its counter-based in-kernel
+        # mask is identical across impls for a given rng (the reference's
+        # fused softmax+dropout, ref apex/contrib/csrc/multihead_attn/)
         return flash_attention(
             q, k, v, bias=bias, kv_segment_ids=kv_seg, softmax_scale=scale,
-            dropout_rate=rate, dropout_rng=rng,
-            impl=_attn_impl(self.impl) if rate == 0.0 else "xla")
+            dropout_rate=rate, dropout_rng=rng, impl=_attn_impl(self.impl))
 
 
 class SelfMultiheadAttn(_MHABase):

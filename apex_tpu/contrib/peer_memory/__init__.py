@@ -28,10 +28,20 @@ from apex_tpu.contrib.bottleneck import SPATIAL_AXIS, HaloExchangerPpermute
 
 
 class PeerMemoryPool:
-    """ref peer_memory.py:5-46: per-node peer group bookkeeping around a
-    raw IPC allocation. Here only the group math survives; ``static_size``
-    and ``dynamic_size`` are accepted and recorded for compatibility but
-    nothing is allocated (buffers are XLA-managed device memory)."""
+    """Bump allocator with the reference's exact region semantics
+    (ref peer_memory.py:5-100): a *static* region for long-lived halo
+    buffers and a *dynamic* region reset every iteration, 256-byte
+    alignment, hard exhaustion errors, one buffer per peer rank.
+
+    Delta vs the reference, documented: the CUDA version carves views
+    out of one raw IPC allocation so peers can write each other's
+    memory directly; on TPU the backing memory is XLA-managed (ICI
+    neighbor copies via ``ppermute`` need no shared mapping), so
+    ``allocate_peer_tensors`` returns ordinary device buffers while the
+    pool enforces the same capacity/alignment/reset accounting — a port
+    keeps its sizing logic and its exhaustion failures behave
+    identically.
+    """
 
     def __init__(self, static_size: int = 0, dynamic_size: int = 0,
                  peer_ranks: Optional[Sequence[int]] = None,
@@ -40,9 +50,48 @@ class PeerMemoryPool:
         self.static_size = (static_size + alignment - 1) // alignment * alignment
         self.dynamic_size = (dynamic_size + alignment - 1) // alignment * alignment
         self.peer_ranks = None if peer_ranks is None else tuple(peer_ranks)
+        self.static_offset = 0
+        self.dynamic_offset = 0
 
-    def reset(self):  # ref peer_memory.py __init__ offset reset
-        pass
+    def reset(self):
+        """Reclaim the dynamic region (ref peer_memory.py:45-46 — called
+        once per iteration; static allocations persist)."""
+        self.dynamic_offset = 0
+
+    def allocate_peer_tensors(self, shape: Sequence[int], dtype,
+                              channels_last: bool = False,
+                              dynamic: bool = True):
+        """One zero-initialized buffer per peer rank, carved (by
+        accounting) from the static or dynamic region
+        (ref peer_memory.py:48-100).
+
+        Raises ``MemoryError`` when the region is exhausted — the
+        reference's pool-exhausted assertion — so capacity planning
+        ports unchanged. ``channels_last`` is accepted for signature
+        parity (layout is XLA's concern on TPU).
+        """
+        del channels_last
+        import math
+
+        nbytes = math.prod(shape) * jnp.dtype(dtype).itemsize
+        if dynamic:
+            start = ((self.dynamic_offset + self.alignment - 1)
+                     // self.alignment * self.alignment)
+            if start + nbytes > self.dynamic_size:
+                raise MemoryError(
+                    f"Dynamic peer memory pool exhausted: need {nbytes} B "
+                    f"at offset {start}, capacity {self.dynamic_size} B")
+            self.dynamic_offset = start + nbytes
+        else:
+            start = ((self.static_offset + self.alignment - 1)
+                     // self.alignment * self.alignment)
+            if start + nbytes > self.static_size:
+                raise MemoryError(
+                    f"Static peer memory pool exhausted: need {nbytes} B "
+                    f"at offset {start}, capacity {self.static_size} B")
+            self.static_offset = start + nbytes
+        n_peers = len(self.peer_ranks) if self.peer_ranks else 1
+        return [jnp.zeros(tuple(shape), dtype) for _ in range(n_peers)]
 
 
 class PeerHaloExchanger1d:

@@ -182,8 +182,23 @@ def fused_elementwise(
         # alias indices count ALL pallas inputs, scalar-prefetch args first
         n_prefetch = len(prefetch)
         for in_idx, out_idx in aliases.items():
+            if not (0 <= in_idx < len(inputs)
+                    and 0 <= out_idx < num_outputs):
+                raise ValueError(
+                    f"alias {in_idx}->{out_idx} out of range: "
+                    f"{len(inputs)} inputs, {num_outputs} outputs")
             if jnp.dtype(inputs[in_idx].dtype) == jnp.dtype(out_dtypes[out_idx]):
                 io_aliases[n_prefetch + in_idx] = out_idx
+            else:
+                # in-place donation silently NOT applying would double
+                # the op's HBM traffic with no signal — warn once
+                import warnings
+
+                warnings.warn(
+                    f"requested alias input {in_idx} "
+                    f"({inputs[in_idx].dtype}) -> output {out_idx} "
+                    f"({out_dtypes[out_idx]}) skipped: dtype mismatch "
+                    f"prevents in-place buffer reuse", stacklevel=3)
 
     results = pl.pallas_call(
         kernel,

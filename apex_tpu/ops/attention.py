@@ -744,11 +744,20 @@ def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
                    return_lse=False, q_pos=None, k_pos=None):
     b, h, sq, d = q.shape
     sk = k.shape[2]
-    if k.shape[1] != h:                 # GQA: repeat shared kv heads
-        k = jnp.repeat(k, h // k.shape[1], axis=1)
-        v = jnp.repeat(v, h // v.shape[1], axis=1)
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
-                   k.astype(jnp.float32))
+    hk = k.shape[1]
+    if hk != h:
+        # GQA: einsum over a kv-head-group axis — never materializes
+        # repeated K/V (jnp.repeat here is an h/hk x KV HBM spike at
+        # long sk, and this path serves every CPU test and any
+        # Mosaic-fallback production run)
+        group = h // hk
+        s = jnp.einsum(
+            "bkgqd,bkcd->bkgqc",
+            (q.astype(jnp.float32) * scale).reshape(b, hk, group, sq, d),
+            k.astype(jnp.float32)).reshape(b, h, sq, sk)
+    else:
+        s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32) * scale,
+                       k.astype(jnp.float32))
     if bias is not None:
         s = s + bias.astype(jnp.float32)
     if causal or window is not None:
@@ -776,7 +785,13 @@ def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
         col = jax.lax.broadcasted_iota(jnp.int32, (1, 1, sq, sk), 3)
         keep = _dropout_keep(dropout_seed, bh, row, col, dropout_rate)
         p = jnp.where(keep, p / (1.0 - dropout_rate), 0.0)
-    out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    if hk != h:
+        out = jnp.einsum(
+            "bkgqc,bkcd->bkgqd",
+            p.reshape(b, hk, h // hk, sq, sk),
+            v.astype(jnp.float32)).reshape(b, h, sq, d)
+    else:
+        out = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
     if return_lse:
         valid = m[..., 0] > NEG_INF * 0.5
         lse = jnp.where(valid, m[..., 0] + jnp.log(
@@ -790,27 +805,29 @@ def _attention_xla(q, k, v, bias, q_seg, k_seg, scale, causal,
 # --------------------------------------------------------------------------
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12, 13))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(7, 8, 9, 10, 11, 12, 13, 14, 15))
 def _flash(q, k, v, bias, q_seg, k_seg, seed, scale, causal, window, rate,
-           bq, bk, interpret):
+           bq, bk, bbq, bbk, interpret):
     out, _ = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale,
                                causal, window, rate, bq, bk, interpret)
     return out
 
 
 def _flash_fwd_rule(q, k, v, bias, q_seg, k_seg, seed, scale, causal,
-                    window, rate, bq, bk, interpret):
+                    window, rate, bq, bk, bbq, bbk, interpret):
     out, lse = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale,
                                  causal, window, rate, bq, bk, interpret)
     return out, (q, k, v, bias, q_seg, k_seg, seed, out, lse)
 
 
-def _flash_bwd_rule(scale, causal, window, rate, bq, bk, interpret, res, g):
+def _flash_bwd_rule(scale, causal, window, rate, bq, bk, bbq, bbk,
+                    interpret, res, g):
     q, k, v, bias, q_seg, k_seg, seed, out, lse = res
     core = (q, k, v, bias, q_seg, k_seg, out, lse)
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
     dq, dk, dv = _flash_bwd_pallas(core, g, delta, seed, scale, causal,
-                                   window, rate, bq, bk, interpret)
+                                   window, rate, bbq, bbk, interpret)
     return _finish_bwd(core, g, delta, dq, dk, dv, seed, scale, causal,
                        window, rate)
 
@@ -889,9 +906,10 @@ _flash.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(9, 10, 11, 12, 13, 14, 15))
+                   nondiff_argnums=(9, 10, 11, 12, 13, 14, 15, 16, 17))
 def _flash_with_lse(q, k, v, bias, q_seg, k_seg, seed, q_pos, k_pos,
-                    scale, causal, window, rate, bq, bk, interpret):
+                    scale, causal, window, rate, bq, bk, bbq, bbk,
+                    interpret):
     """Like ``_flash`` but also returns the per-row logsumexp (fp32,
     (b, h, sq); NEG_INF on fully-masked rows) as a differentiable
     output — the merge signal for ring/blockwise attention. Accepts
@@ -902,7 +920,8 @@ def _flash_with_lse(q, k, v, bias, q_seg, k_seg, seed, q_pos, k_pos,
 
 
 def _flash_lse_fwd_rule(q, k, v, bias, q_seg, k_seg, seed, q_pos, k_pos,
-                        scale, causal, window, rate, bq, bk, interpret):
+                        scale, causal, window, rate, bq, bk, bbq, bbk,
+                        interpret):
     out, lse = _flash_fwd_pallas(q, k, v, bias, q_seg, k_seg, seed, scale,
                                  causal, window, rate, bq, bk, interpret,
                                  q_pos=q_pos, k_pos=k_pos)
@@ -910,14 +929,14 @@ def _flash_lse_fwd_rule(q, k, v, bias, q_seg, k_seg, seed, q_pos, k_pos,
                         out, lse)
 
 
-def _flash_lse_bwd_rule(scale, causal, window, rate, bq, bk, interpret,
-                        res, gs):
+def _flash_lse_bwd_rule(scale, causal, window, rate, bq, bk, bbq, bbk,
+                        interpret, res, gs):
     g, glse = gs
     q, k, v, bias, q_seg, k_seg, seed, q_pos, k_pos, out, lse = res
     core = (q, k, v, bias, q_seg, k_seg, out, lse)
     delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), axis=-1)
     dq, dk, dv = _flash_bwd_pallas(core, g, delta, seed, scale, causal,
-                                   window, rate, bq, bk, interpret,
+                                   window, rate, bbq, bbk, interpret,
                                    glse=glse, q_pos=q_pos, k_pos=k_pos)
     return _finish_bwd(core, g, delta, dq, dk, dv, seed, scale, causal,
                        window, rate, glse=glse, q_pos=q_pos, k_pos=k_pos,
@@ -942,6 +961,8 @@ def flash_attention(
     dropout_rng: Optional[jax.Array] = None,
     block_q: int = 1024,
     block_k: int = 1024,
+    bwd_block_q: Optional[int] = None,
+    bwd_block_k: Optional[int] = None,
     impl: Optional[str] = None,
     return_lse: bool = False,
     q_positions: Optional[jax.Array] = None,
@@ -990,6 +1011,15 @@ def flash_attention(
     if q_positions is not None and not causal:
         raise ValueError("positions only affect causal/window masking; "
                          "pass causal=True")
+    if q_positions is not None and dropout_rate > 0.0:
+        # the dropout counter hashes block-LOCAL row/col indices, so a
+        # chunked (ring/blockwise) call would sample a different mask
+        # than the equivalent unchunked call — silently breaking the
+        # chunk-merge == full identity that positions exist to provide
+        raise ValueError(
+            "dropout_rate > 0 with q_positions/kv_positions is not "
+            "supported: the dropout mask is keyed on local indices and "
+            "would not match across chunked and unchunked calls")
     if window_size is not None:
         if not causal:
             raise ValueError("window_size requires causal=True")
@@ -1018,6 +1048,10 @@ def flash_attention(
             kd = jnp.asarray(dropout_rng)
         kd = kd.astype(jnp.uint32).ravel()
         seed = kd[0] if kd.size == 1 else kd[0] ^ kd[1]
+    # backward blocks default to the forward's; tuned separately on-chip
+    # (the dq/dkv kernels have different reuse patterns than the fwd)
+    bbq = bwd_block_q if bwd_block_q is not None else block_q
+    bbk = bwd_block_k if bwd_block_k is not None else block_k
     if impl == "xla":
         return _attention_xla(q, k, v, bias, segment_ids, kv_segment_ids,
                               softmax_scale, causal, window_size,
@@ -1028,11 +1062,11 @@ def flash_attention(
             q, k, v, bias, segment_ids, kv_segment_ids, seed,
             q_positions, kv_positions,
             softmax_scale, causal, window_size, float(dropout_rate),
-            block_q, block_k, interpret_flag(impl))
+            block_q, block_k, bbq, bbk, interpret_flag(impl))
         return out if return_lse else out[0]
     return _flash(q, k, v, bias, segment_ids, kv_segment_ids, seed,
                   softmax_scale, causal, window_size, float(dropout_rate),
-                  block_q, block_k, interpret_flag(impl))
+                  block_q, block_k, bbq, bbk, interpret_flag(impl))
 
 
 __all__ = ["flash_attention"]

@@ -23,8 +23,13 @@ two standard sequence-scaling schemes over the mesh's "context" axis:
 Both are called *inside* ``shard_map`` on local shards laid out
 (batch, heads, seq_local, head_dim); ``*_sharded`` convenience wrappers
 apply the shard_map for the common mesh layout. Both are reverse-mode
-differentiable (scan + ppermute/all_to_all transpose rules give the
-textbook re-ringing backward).
+differentiable. Ring attention carries a **recompute backward**
+(custom VJP): the forward saves only the local shards plus (out, lse) —
+O(s_local) per device — and the backward re-rotates KV around the ring,
+recomputing each chunk's gradient contribution against the *global*
+(lse, delta) statistics. Differentiating through the forward scan
+instead would stack per-step KV/out residuals into O(S) per device,
+erasing exactly the memory advantage ring attention exists for.
 """
 
 from __future__ import annotations
@@ -97,61 +102,23 @@ def _chunk_attn(q, k_c, v_c, qpos, kpos, scale, causal, impl=None):
     return out.astype(jnp.float32), lse
 
 
-def ring_attention(
-    q: jax.Array,
-    k: jax.Array,
-    v: jax.Array,
-    *,
-    axis_name: str = CONTEXT_AXIS,
-    causal: bool = False,
-    softmax_scale: Optional[float] = None,
-    q_positions: Optional[jax.Array] = None,
-    kv_positions: Optional[jax.Array] = None,
-    skip_granularity: int = 1,
-    impl: Optional[str] = None,
-) -> jax.Array:
-    """Exact ring attention over the ``axis_name`` device ring.
+def _merge(a, p):
+    o_a, l_a = a
+    o_p, l_p = p
+    l_new = jnp.logaddexp(l_a, l_p)
+    return (o_a * jnp.exp(l_a - l_new)[..., None]
+            + o_p * jnp.exp(l_p - l_new)[..., None], l_new)
 
-    Call inside ``shard_map``; ``q``/``k``/``v`` are the local sequence
-    shards, (batch, heads, s_local, head_dim). ``q_positions`` /
-    ``kv_positions`` are the *global* token positions of the local shard
-    (s_local,) — defaults assume contiguous block sharding; pass the
-    zig-zag positions when the inputs were permuted with
-    :func:`zigzag_indices`. KV (and its positions) rotate ring-wise via
-    ``ppermute``; the online-softmax carry merges chunks exactly as the
-    Pallas flash kernel does across KV blocks, so the result matches
-    single-device attention to fp32 accumulation order.
 
-    ``skip_granularity`` splits Q and KV into that many contiguous
-    sub-blocks and, under causal masking, skips the score matmul for any
-    (q-block, kv-block) pair wholly in the causal future via ``lax.cond``
-    (TPU executes only the taken branch, so skipped pairs are ~free).
-    With contiguous sharding 1 suffices (whole visiting chunks skip);
-    with zig-zag each shard is two chunks, so pass 2 — that is what
-    recovers the ~2x causal FLOP saving that zig-zag balancing is for.
-    """
+def _ring_forward(q, k, v, q_positions, kv_positions, axis_name, causal,
+                  scale, ng, impl):
+    """The ring sweep: returns fp32 (out, lse) of the local Q shard
+    against the full sequence. KV (and positions) rotate via ppermute;
+    the online-softmax carry merges chunks exactly as the Pallas flash
+    kernel does across KV blocks."""
     cp = lax.axis_size(axis_name)
-    idx = lax.axis_index(axis_name)
     b, h, s_local, d = q.shape
-    scale = softmax_scale if softmax_scale is not None else d ** -0.5
-    if q_positions is None:
-        q_positions = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
-    if kv_positions is None:
-        kv_positions = idx * k.shape[2] + jnp.arange(k.shape[2], dtype=jnp.int32)
-
     perm = [(i, (i + 1) % cp) for i in range(cp)]
-    ng = skip_granularity
-    if ng < 1 or s_local % ng or k.shape[2] % ng:
-        raise ValueError(
-            f"skip_granularity {ng} must divide q ({s_local}) and kv "
-            f"({k.shape[2]}) shard lengths")
-
-    def _merge(a, p):
-        o_a, l_a = a
-        o_p, l_p = p
-        l_new = jnp.logaddexp(l_a, l_p)
-        return (o_a * jnp.exp(l_a - l_new)[..., None]
-                + o_p * jnp.exp(l_p - l_new)[..., None], l_new)
 
     def compute(k_c, v_c, kpos):
         """(out, lse) partials of local Q against one visiting KV shard.
@@ -202,8 +169,225 @@ def ring_attention(
 
     (acc, _, _, _), _ = lax.scan(
         step, (acc, k, v, kv_positions), None, length=cp - 1)
-    out, _lse = acc       # chunks arrive normalized; nothing to divide
+    return acc            # chunks arrive normalized; nothing to divide
+
+
+def _chunk_grads(q, k_c, v_c, qpos, kpos, g, lse, delta, scale, causal,
+                 impl):
+    """Gradient contribution of one visiting KV chunk, evaluated against
+    the *global* softmax statistics.
+
+    With P = exp(S - lse_global) restricted to this chunk and
+    delta = rowsum(out_global * g), the per-chunk flash backward yields
+    exactly this chunk's share of (dq, dk_c, dv_c): summed over chunks,
+    rowsum(P) = 1 restores the full softmax backward. This is the
+    identity that lets the ring backward recompute instead of saving
+    per-step residuals."""
+    if impl is None:
+        from apex_tpu._backend import default_impl
+        impl = default_impl()
+    if impl != "xla":
+        from apex_tpu.ops.attention import (_flash_bwd_pallas,
+                                            interpret_flag)
+        core = (q, k_c, v_c, None, None, None, None, lse)
+        return _flash_bwd_pallas(
+            core, g, delta, None, scale, causal, None, 0.0, 1024, 1024,
+            interpret_flag(impl),
+            q_pos=qpos if causal else None,
+            k_pos=kpos if causal else None)
+
+    b, h, sq, d = q.shape
+    hk = k_c.shape[1]
+    group = h // hk
+    s = jnp.einsum("bkgqd,bkcd->bkgqc",
+                   (q.astype(jnp.float32) * scale).reshape(
+                       b, hk, group, sq, d),
+                   k_c.astype(jnp.float32))
+    if causal:
+        masked = kpos[None, :] > qpos[:, None]
+        s = jnp.where(masked[None, None, None], NEG_INF, s)
+    # rows whose global lse is NEG_INF (fully masked everywhere) get 0
+    p = jnp.exp(s - jnp.maximum(lse, NEG_INF * 0.5).reshape(
+        b, hk, group, sq, 1))
+    if causal:
+        p = jnp.where(masked[None, None, None], 0.0, p)
+    gf = g.astype(jnp.float32).reshape(b, hk, group, sq, d)
+    dv_c = jnp.einsum("bkgqc,bkgqd->bkcd", p, gf)
+    dp = jnp.einsum("bkgqd,bkcd->bkgqc", gf, v_c.astype(jnp.float32))
+    ds = p * (dp - delta.reshape(b, hk, group, sq, 1))
+    dq = (jnp.einsum("bkgqc,bkcd->bkgqd", ds, k_c.astype(jnp.float32))
+          * scale).reshape(b, h, sq, d)
+    dk_c = jnp.einsum("bkgqc,bkgqd->bkcd", ds,
+                      (q.astype(jnp.float32) * scale).reshape(
+                          b, hk, group, sq, d))
+    return dq.astype(q.dtype), dk_c.astype(k_c.dtype), dv_c.astype(v_c.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
+def _ring_core(q, k, v, qpos, kpos, axis_name, causal, scale, ng, impl):
+    out, _ = _ring_forward(q, k, v, qpos, kpos, axis_name, causal, scale,
+                           ng, impl)
     return out.astype(q.dtype)
+
+
+def _ring_fwd_rule(q, k, v, qpos, kpos, axis_name, causal, scale, ng, impl):
+    out, lse = _ring_forward(q, k, v, qpos, kpos, axis_name, causal,
+                             scale, ng, impl)
+    out = out.astype(q.dtype)
+    # O(s_local) residuals: local shards + (out, lse). Nothing scales
+    # with the ring size — the backward re-rotates KV instead.
+    return out, (q, k, v, qpos, kpos, out, lse)
+
+
+def _ring_bwd_rule(axis_name, causal, scale, ng, impl, res, g):
+    q, k, v, qpos, kpos, out, lse = res
+    cp = lax.axis_size(axis_name)
+    b, h, s_local, d = q.shape
+    perm = [(i, (i + 1) % cp) for i in range(cp)]
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32),
+                    axis=-1)
+
+    def chunk_bwd(k_c, v_c, kpos_c):
+        """(dq_part, dk_c, dv_c) of local Q vs one visiting shard, with
+        the same ng x ng causal-future tile skip as the forward — the
+        backward is ~2.5x the forward's FLOPs, so keeping the zig-zag
+        skip here is most of the schedule's causal saving."""
+        if not causal:
+            dq_p, dkc_p, dvc_p = _chunk_grads(
+                q, k_c, v_c, qpos, kpos_c, g, lse, delta, scale, False,
+                impl)
+            return (dq_p.astype(jnp.float32), dkc_p.astype(jnp.float32),
+                    dvc_p.astype(jnp.float32))
+        qs, ks = s_local // ng, k_c.shape[2] // ng
+        dq_rows = []
+        dk_cols = [None] * ng
+        dv_cols = [None] * ng
+        for qb in range(ng):
+            qsl = slice(qb * qs, (qb + 1) * qs)
+            q_b, g_b = q[:, :, qsl], g[:, :, qsl]
+            lse_b, delta_b = lse[:, :, qsl], delta[:, :, qsl]
+            qpos_b = qpos[qsl]
+            q_max_b = jnp.max(qpos_b)
+            dq_acc = jnp.zeros((b, h, qs, d), jnp.float32)
+            for kb in range(ng):
+                ksl = slice(kb * ks, (kb + 1) * ks)
+                k_b, v_b, kpos_b = (k_c[:, :, ksl], v_c[:, :, ksl],
+                                    kpos_c[ksl])
+
+                def run(k_b=k_b, v_b=v_b, kpos_b=kpos_b, q_b=q_b,
+                        g_b=g_b, lse_b=lse_b, delta_b=delta_b,
+                        qpos_b=qpos_b):
+                    dq_p, dk_p, dv_p = _chunk_grads(
+                        q_b, k_b, v_b, qpos_b, kpos_b, g_b, lse_b,
+                        delta_b, scale, True, impl)
+                    return (dq_p.astype(jnp.float32),
+                            dk_p.astype(jnp.float32),
+                            dv_p.astype(jnp.float32))
+
+                def skip(k_b=k_b, v_b=v_b):
+                    return (jnp.zeros((b, h, qs, d), jnp.float32),
+                            jnp.zeros(k_b.shape, jnp.float32),
+                            jnp.zeros(v_b.shape, jnp.float32))
+
+                dq_p, dk_p, dv_p = lax.cond(
+                    jnp.min(kpos_b) > q_max_b, skip, run)
+                dq_acc = dq_acc + dq_p
+                dk_cols[kb] = dk_p if dk_cols[kb] is None else dk_cols[kb] + dk_p
+                dv_cols[kb] = dv_p if dv_cols[kb] is None else dv_cols[kb] + dv_p
+            dq_rows.append(dq_acc)
+        return (jnp.concatenate(dq_rows, axis=2),
+                jnp.concatenate(dk_cols, axis=2),
+                jnp.concatenate(dv_cols, axis=2))
+
+    def step(carry, _):
+        dq, k_c, v_c, kpos_c, dk_c, dv_c = carry
+        dq_p, dkc_p, dvc_p = chunk_bwd(k_c, v_c, kpos_c)
+        dq = dq + dq_p
+        dk_c = dk_c + dkc_p
+        dv_c = dv_c + dvc_p
+        # rotate the chunk together with its accumulated gradients; after
+        # cp steps both are back on the chunk's home device
+        k_c = lax.ppermute(k_c, axis_name, perm)
+        v_c = lax.ppermute(v_c, axis_name, perm)
+        kpos_c = lax.ppermute(kpos_c, axis_name, perm)
+        dk_c = lax.ppermute(dk_c, axis_name, perm)
+        dv_c = lax.ppermute(dv_c, axis_name, perm)
+        return (dq, k_c, v_c, kpos_c, dk_c, dv_c), None
+
+    init = (jnp.zeros(q.shape, jnp.float32), k, v, kpos,
+            jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32))
+    (dq, _, _, _, dk, dv), _ = lax.scan(step, init, None, length=cp)
+
+    def int_ct(a):
+        import numpy as _np
+        return _np.zeros(a.shape, dtype=jax.dtypes.float0)
+
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            int_ct(qpos), int_ct(kpos))
+
+
+_ring_core.defvjp(_ring_fwd_rule, _ring_bwd_rule)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis_name: str = CONTEXT_AXIS,
+    causal: bool = False,
+    softmax_scale: Optional[float] = None,
+    q_positions: Optional[jax.Array] = None,
+    kv_positions: Optional[jax.Array] = None,
+    skip_granularity: int = 1,
+    impl: Optional[str] = None,
+) -> jax.Array:
+    """Exact ring attention over the ``axis_name`` device ring.
+
+    Call inside ``shard_map``; ``q``/``k``/``v`` are the local sequence
+    shards, (batch, heads, s_local, head_dim). ``q_positions`` /
+    ``kv_positions`` are the *global* token positions of the local shard
+    (s_local,) — defaults assume contiguous block sharding; pass the
+    zig-zag positions when the inputs were permuted with
+    :func:`zigzag_indices`. KV (and its positions) rotate ring-wise via
+    ``ppermute``; the online-softmax carry merges chunks exactly as the
+    Pallas flash kernel does across KV blocks, so the result matches
+    single-device attention to fp32 accumulation order.
+
+    ``skip_granularity`` splits Q and KV into that many contiguous
+    sub-blocks and, under causal masking, skips the score matmul for any
+    (q-block, kv-block) pair wholly in the causal future via ``lax.cond``
+    (TPU executes only the taken branch, so skipped pairs are ~free).
+    With contiguous sharding 1 suffices (whole visiting chunks skip);
+    with zig-zag each shard is two chunks, so pass 2 — that is what
+    recovers the ~2x causal FLOP saving that zig-zag balancing is for.
+
+    Reverse-mode differentiation uses a **recompute backward**: forward
+    residuals are O(s_local) (local shards + out + lse) and the backward
+    re-rotates KV around the ring, evaluating each chunk's flash
+    backward against the global (lse, delta) — the standard ring
+    attention backward, vs. AD-through-the-scan which would stack
+    O(ring) KV/out residuals per device.
+    """
+    cp = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    b, h, s_local, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    if q_positions is None:
+        q_positions = idx * s_local + jnp.arange(s_local, dtype=jnp.int32)
+    if kv_positions is None:
+        kv_positions = idx * k.shape[2] + jnp.arange(k.shape[2], dtype=jnp.int32)
+
+    ng = skip_granularity
+    if ng < 1 or s_local % ng or k.shape[2] % ng:
+        raise ValueError(
+            f"skip_granularity {ng} must divide q ({s_local}) and kv "
+            f"({k.shape[2]}) shard lengths")
+    del cp
+    return _ring_core(q, k, v,
+                      jnp.asarray(q_positions, jnp.int32),
+                      jnp.asarray(kv_positions, jnp.int32),
+                      axis_name, causal, scale, ng, impl)
 
 
 def ring_attention_sharded(

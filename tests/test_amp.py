@@ -182,6 +182,74 @@ class TestSkipStepIntegration:
         assert float(sst.loss_scale) == 2.0 ** 15
 
 
+class TestScaleLossContextManager:
+    """The reference's `with amp.scale_loss(...)` surface
+    (ref apex/amp/handle.py:16-158) as a functional handle."""
+
+    def _amp_state(self, **kw):
+        _, state = amp.initialize(make_params(), opt_level="O2", **kw)
+        return state
+
+    def test_scales_and_unscales(self):
+        state = self._amp_state()
+        loss = jnp.float32(2.0)
+        grads_scaled = {"w": jnp.full((4,), 3.0 * 65536.0)}
+        with amp.scale_loss(loss, state) as scaled:
+            np.testing.assert_allclose(float(scaled.loss), 2.0 * 65536.0)
+            scaled.grads = grads_scaled
+        np.testing.assert_allclose(np.asarray(scaled.grads["w"]), 3.0,
+                                   rtol=1e-6)
+        assert float(scaled.skip) == 0.0
+        # one clean step counted toward the growth window
+        assert int(scaled.amp_state.scalers[0].unskipped) == 1
+
+    def test_overflow_halves_scale_and_sets_skip(self):
+        state = self._amp_state()
+        with amp.scale_loss(jnp.float32(1.0), state) as scaled:
+            scaled.grads = {"w": jnp.asarray([jnp.inf, 1.0])}
+        assert float(scaled.skip) == 1.0
+        assert float(scaled.amp_state.scalers[0].loss_scale) == 65536.0 / 2
+
+    def test_delay_unscale_leaves_state(self):
+        state = self._amp_state()
+        with amp.scale_loss(jnp.float32(1.0), state,
+                            delay_unscale=True) as scaled:
+            scaled.grads = {"w": jnp.full((2,), 65536.0)}
+        # grads still scaled, scaler untouched (accumulation step)
+        np.testing.assert_allclose(np.asarray(scaled.grads["w"]), 65536.0)
+        assert scaled.amp_state is state
+
+    def test_multiple_losses(self):
+        state = self._amp_state(num_losses=2)
+        with amp.scale_loss(jnp.float32(1.0), state, loss_id=1) as scaled:
+            scaled.grads = {"w": jnp.asarray([jnp.nan])}
+        assert float(scaled.amp_state.scalers[1].loss_scale) == 65536.0 / 2
+        assert float(scaled.amp_state.scalers[0].loss_scale) == 65536.0
+        with pytest.raises(ValueError, match="loss_id"):
+            with amp.scale_loss(jnp.float32(1.0), state, loss_id=2):
+                pass
+
+    def test_traces_under_jit(self):
+        state = self._amp_state()
+
+        @jax.jit
+        def step(state, x):
+            def loss_fn(w):
+                with amp.scale_loss(jnp.sum(w * x), state) as scaled:
+                    pass
+                return scaled.loss
+
+            w = jnp.ones((4,), jnp.float32)
+            with amp.scale_loss(jnp.sum(w * x), state) as scaled:
+                scaled.grads = jax.grad(loss_fn)(w)
+            return scaled.grads, scaled.amp_state, scaled.skip
+
+        grads, new_state, skip = step(state, jnp.arange(4.0))
+        np.testing.assert_allclose(np.asarray(grads), np.arange(4.0),
+                                   rtol=1e-6)
+        assert float(skip) == 0.0
+
+
 class TestFunctionCasts:
     def test_half_and_float_function(self):
         @amp.half_function

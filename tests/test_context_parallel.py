@@ -64,6 +64,83 @@ def test_ring_grads_match(rng, cp_mesh):
                                    rtol=2e-4, atol=2e-4)
 
 
+def test_ring_backward_saves_no_per_step_residuals(rng, cp_mesh):
+    """The recompute backward must keep residuals O(s_local): the grad
+    jaxpr may not contain any scan-stacked per-ring-step buffer (leading
+    dim cp or cp-1 over a (b, h, s_local, d)-shaped chunk) — that is
+    the O(S)-per-device AD-through-the-scan failure mode (round-2
+    VERDICT weak#5)."""
+    b, h, s, d = 2, 2, 64, 8
+    cp = 4
+    q, k, v = _qkv(rng, b=b, h=h, s=s, d=d)
+
+    def loss(q, k, v):
+        o = ring_attention_sharded(q, k, v, cp_mesh, causal=True)
+        return jnp.sum(o * o)
+
+    stacked = {(n, b, h, s // cp, d) for n in (cp, cp - 1)}
+    jaxpr = jax.make_jaxpr(jax.grad(loss, argnums=(0, 1, 2)))(q, k, v)
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            for var in eqn.outvars:
+                shape = tuple(getattr(var.aval, "shape", ()))
+                assert shape not in stacked, (
+                    f"{eqn.primitive} stacks per-ring-step residuals "
+                    f"{shape}")
+            for sub in eqn.params.values():
+                if hasattr(sub, "jaxpr"):
+                    walk(sub.jaxpr)
+                if isinstance(sub, (list, tuple)):
+                    for s_ in sub:
+                        if hasattr(s_, "jaxpr"):
+                            walk(s_.jaxpr)
+
+    walk(jaxpr.jaxpr)
+
+
+def test_ring_gqa_grads_match(rng, cp_mesh):
+    """GQA through the ring: shared kv heads, recompute backward."""
+    b, hq, hk, s, d = 2, 4, 2, 32, 8
+    q = jnp.asarray(rng.randn(b, hq, s, d), np.float32)
+    k = jnp.asarray(rng.randn(b, hk, s, d), np.float32)
+    v = jnp.asarray(rng.randn(b, hk, s, d), np.float32)
+
+    def loss_ring(q, k, v):
+        o = ring_attention_sharded(q, k, v, cp_mesh, causal=True)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = flash_attention(q, k, v, causal=True, impl="xla")
+        return jnp.sum(o * o)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_ring_zigzag_grads_match(rng, cp_mesh):
+    """Zig-zag layout + recompute backward: grads must match dense."""
+    q, k, v = _qkv(rng, b=2, h=2, s=32, d=8)
+
+    def loss_ring(q, k, v):
+        o = ring_attention_sharded(q, k, v, cp_mesh, causal=True,
+                                   zigzag=True)
+        return jnp.sum(o * o)
+
+    def loss_ref(q, k, v):
+        o = flash_attention(q, k, v, causal=True, impl="xla")
+        return jnp.sum(o * o)
+
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_ref = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_ref):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_ring_bf16(rng, cp_mesh):
     q, k, v = _qkv(rng, dtype=jnp.bfloat16)
     ref = flash_attention(q, k, v, causal=True, impl="xla")

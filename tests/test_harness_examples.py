@@ -102,6 +102,47 @@ class TestArguments:
         with pytest.raises(ValueError):
             parse_args(args=["--fp16", "--bf16"])
 
+    def test_reference_flag_surface(self):
+        """The flag groups the reference fixtures drive (ref
+        arguments.py): kv-channels derivation, virtual-pp from
+        layers-per-virtual-stage, recompute knobs, precision extras."""
+        ns = parse_args(args=[
+            "--num-layers", "8", "--hidden-size", "128",
+            "--num-attention-heads", "8",
+            "--pipeline-model-parallel-size", "2",
+            "--num-layers-per-virtual-pipeline-stage", "2",
+            "--adam-beta2", "0.95", "--init-method-std", "0.01",
+            "--lr-decay-style", "cosine", "--lr-warmup-iters", "5",
+            "--attention-softmax-in-fp32",
+            "--accumulate-allreduce-grads-in-fp32",
+            "--recompute-granularity", "full",
+            "--make-vocab-size-divisible-by", "64",
+            "--eval-iters", "7", "--mask-prob", "0.2",
+            "--bert-no-binary-head",
+        ])
+        assert ns.kv_channels == 16
+        assert ns.virtual_pipeline_model_parallel_size == 2
+        assert ns.adam_beta2 == 0.95
+        assert ns.attention_softmax_in_fp32
+        assert ns.checkpoint_activations       # implied by recompute
+        assert not ns.bert_binary_head
+        assert ns.eval_iters == 7 and ns.mask_prob == 0.2
+
+    def test_validation_errors(self):
+        with pytest.raises(ValueError, match="not divisible"):
+            parse_args(args=["--num-layers", "5",
+                             "--pipeline-model-parallel-size", "2"])
+        with pytest.raises(ValueError, match="max-position"):
+            parse_args(args=["--seq-length", "64",
+                             "--max-position-embeddings", "32"])
+        with pytest.raises(ValueError, match="divisible by"):
+            parse_args(args=["--micro-batch-size", "3",
+                             "--global-batch-size", "8"])
+        with pytest.raises(ValueError, match="tensor parallelism"):
+            parse_args(args=["--distribute-saved-activations"])
+        with pytest.raises(ValueError, match="fp16"):
+            parse_args(args=["--fp16-lm-cross-entropy"])
+
     def test_global_vars_lifecycle(self):
         global_vars.destroy_global_vars()
         with pytest.raises(RuntimeError):

@@ -151,6 +151,102 @@ class TestCrossProduct:
             trajectories[("O4", None)], trajectories[("O5", None)],
             rtol=5e-2, atol=5e-4)
 
+    def _train_dp(self, opt_level, loss_scale, n_dev=8):
+        """The same workload dp-sharded over the simulated mesh: batch
+        split over the data axis, grads psum-averaged (the apex-DDP
+        gradient_average semantics), optimizer step replicated
+        (ref: tests/L1/cross_product_distributed/ repeats the grid
+        under DDP)."""
+        import functools
+
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        rng = np.random.RandomState(0)
+        x, y = _data(rng)
+        params0 = _init_params(rng)
+        mesh = Mesh(np.asarray(jax.devices()[:n_dev]), ("data",))
+
+        opt = FusedSGD(lr=LR, momentum=0.9, impl="xla")
+        cast_params, opt_state, amp_state = amp.initialize(
+            params0, optimizers=opt, opt_level=opt_level,
+            loss_scale=loss_scale)
+        props = amp_state.properties
+        scaler = make_scaler(props)
+        sst = amp_state.scalers[0]
+
+        @jax.jit
+        @functools.partial(
+            shard_map, mesh=mesh,
+            in_specs=(P(), jax.tree.map(lambda _: P(), opt_state),
+                      jax.tree.map(lambda _: P(), sst),
+                      P("data"), P("data")),
+            out_specs=(P(), P(), jax.tree.map(lambda _: P(), opt_state),
+                       jax.tree.map(lambda _: P(), sst)),
+            check_vma=False,
+        )
+        def step(model_params, opt_state, sst, xs, ys):
+            def loss_fn(p):
+                pred = _forward(p, xs, props.compute_dtype)
+                return jnp.mean((pred - ys) ** 2)
+
+            local_loss = loss_fn(model_params)
+            grads = jax.grad(
+                lambda p: scaler.scale_loss(loss_fn(p), sst))(model_params)
+            # DDP: average grads (and the reported loss) over the
+            # data axis — every rank then steps identically
+            grads = jax.tree.map(
+                lambda g: jax.lax.pmean(g, "data"), grads)
+            loss = jax.lax.pmean(local_loss, "data")
+            new_params, opt_state = opt.step(
+                opt_state, grads, grad_scale=sst.loss_scale,
+                skip_if_nonfinite=True)
+            sst2 = scaler.update(sst, opt_state.found_inf)
+            if props.cast_model_type is not None:
+                new_params = jax.tree.map(
+                    lambda p, m: p.astype(m.dtype), new_params,
+                    model_params)
+            return loss, new_params, opt_state, sst2
+
+        losses = []
+        model_params = cast_params
+        for _ in range(STEPS):
+            loss, model_params, opt_state, sst = step(
+                model_params, opt_state, sst, x, y)
+            losses.append(float(loss))
+        return np.asarray(losses)
+
+    @pytest.mark.parametrize("cfg", [("O0", None), ("O2", 128.0),
+                                     ("O5", None)])
+    def test_dp_sharded_matches_single_device(self, trajectories, cfg):
+        """dp-sharded run reproduces the single-device trajectory: the
+        psum-mean of per-shard grads equals the full-batch grad, so the
+        whole training curve must agree to fp tolerance (the
+        cross_product_distributed acceptance). Dynamic-scale configs
+        are excluded from the elementwise check: per-shard fp16 grads
+        are scaled BEFORE the allreduce (reference DDP semantics), so
+        the overflow-skip schedule can differ by a step or two — see
+        test_dp_dynamic_scale_converges."""
+        tr_dp = self._train_dp(*cfg)
+        # half-precision configs round each shard's grads before the
+        # pmean, so mean-of-shard-means wobbles in the last bf16/fp16
+        # digit vs the full-batch mean
+        rtol = 2e-3 if cfg[0] == "O0" else 6e-3
+        np.testing.assert_allclose(
+            tr_dp, trajectories[cfg], rtol=rtol, atol=1e-5,
+            err_msg=f"{cfg} dp trajectory diverged from single-device")
+
+    def test_dp_dynamic_scale_converges(self, trajectories):
+        """O2 + dynamic scale under dp: early steps may skip while the
+        scale backs off (per-shard scaled fp16 grads overflow sooner
+        than the full batch's), but the run must land on the same
+        solution — final loss matches the single-device run."""
+        tr_dp = self._train_dp("O2", None)
+        assert np.isfinite(tr_dp).all()
+        ref = trajectories[("O2", None)]
+        np.testing.assert_allclose(tr_dp[-1], ref[-1], rtol=0.05,
+                                   atol=1e-3)
+
     def test_dynamic_scaler_stayed_sane(self):
         """A dynamic-scale run's scaler must not collapse (no spurious
         overflow spiral) on a well-conditioned problem."""

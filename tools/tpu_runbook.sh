@@ -1,0 +1,40 @@
+#!/bin/bash
+# Serial TPU measurement sequence for the single-slot tunnel.
+# Run when the chip answers (tools/../tpu probe or the watcher says so);
+# every stage is strictly sequential — two TPU clients deadlock the
+# tunnel (docs/HARDWARE_NOTES.md). Logs land in $LOGDIR.
+set -u
+cd "$(dirname "$0")/.."
+LOGDIR=${LOGDIR:-/tmp/tpu_runbook_$(date +%H%M)}
+mkdir -p "$LOGDIR"
+echo "logs -> $LOGDIR"
+
+run() {  # run <name> <timeout-s> <cmd...>
+  local name=$1 to=$2; shift 2
+  echo "=== $name ($(date +%H:%M:%S)) ==="
+  timeout "$to" "$@" >"$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  tail -3 "$LOGDIR/$name.log"
+  echo "--- $name rc=$rc"
+}
+
+# kernel parity + Mosaic lowering across the whole op zoo first: if
+# this fails nothing else is trustworthy
+run smoke 1800 python tools/tpu_smoke.py
+
+# bench modes, headline first (the driver-scored artifact)
+export APEX_TPU_BENCH_PROBE_BUDGET=240
+run bench_headline 2400 python bench.py
+run bench_attn     1800 python bench.py attn
+run bench_bert     2400 python bench.py bert
+run bench_gpt      2400 python bench.py gpt
+run bench_resnet   2400 python bench.py resnet
+run bench_moe      1800 python bench.py moe
+
+# tuning sweeps (feed winners back into kernel defaults)
+run tune_attnbwd 2400 python tools/tpu_tune.py attnbwd
+run tune_attn    2400 python tools/tpu_tune.py attn
+run tune_opt     1800 python tools/tpu_tune.py opt
+run tune_ln      1200 python tools/tpu_tune.py ln
+
+echo "ALL DONE ($(date +%H:%M:%S)); logs in $LOGDIR"

@@ -276,4 +276,9 @@ if __name__ == "__main__":
                     help="kernel impl to compare against the XLA path "
                          "(interpret = CPU logic check)")
     args = ap.parse_args()
-    sys.exit(run(perf=args.perf, kimpl=args.impl))
+    from apex_tpu.backend_guard import tpu_slot_lock
+
+    # the tunnel serves ONE client; serialize against bench/tune runs
+    # (the lock warns on stderr itself if it can't be acquired)
+    with tpu_slot_lock():
+        sys.exit(run(perf=args.perf, kimpl=args.impl))

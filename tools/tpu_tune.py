@@ -69,6 +69,51 @@ def tune_attn():
             print(f"  xla reference   FAIL {str(e)[:60]}")
 
 
+def tune_attn_bwd():
+    """Sweep the BACKWARD dq/dkv blocks independently of the forward's
+    (fixed at the round-2 winner 1024x1024): the dq and dkv kernels have
+    different reuse patterns than the fwd, so their best block shape can
+    differ. Winner feeds flash_attention's bwd_block_q/bwd_block_k
+    defaults."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.ops.attention import flash_attention
+
+    rng = np.random.RandomState(0)
+    for (b, h, s, d), dt in [((4, 16, 2048, 128), jnp.bfloat16),
+                             ((2, 16, 4096, 128), jnp.bfloat16)]:
+        q, k, v = (jnp.asarray(
+            rng.randn(b, h, s, d).astype(np.float32) * 0.1, dt)
+            for _ in range(3))
+        print(f"flash BWD blocks (fwd fixed 1024x1024) "
+              f"bhsd={(b, h, s, d)} {dt.__name__}")
+        base = None
+        for bbq, bbk in [(256, 256), (512, 512), (512, 1024), (1024, 512),
+                         (1024, 1024), (2048, 1024), (1024, 2048),
+                         (2048, 2048), (256, 1024), (1024, 256)]:
+            if bbq > s or bbk > s:
+                continue
+
+            def fwd_bwd(q, k, v, bbq=bbq, bbk=bbk):
+                def loss(q, k, v):
+                    o = flash_attention(q, k, v, causal=True,
+                                        impl="pallas",
+                                        block_q=1024, block_k=1024,
+                                        bwd_block_q=bbq, bwd_block_k=bbk)
+                    return jnp.sum(o.astype(jnp.float32) ** 2)
+                l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
+                return (l, *g)
+
+            try:
+                t = _time(fwd_bwd, q, k, v, iters=3, chain=10)
+                base = base or t
+                print(f"  bbq={bbq:5d} bbk={bbk:5d}  {t*1e3:8.3f} ms "
+                      f"({base/t:4.2f}x)")
+            except Exception as e:  # noqa: BLE001
+                print(f"  bbq={bbq:5d} bbk={bbk:5d}  FAIL {str(e)[:60]}")
+
+
 def tune_ln():
     import jax
     import jax.numpy as jnp
@@ -176,13 +221,18 @@ def tune_opt():
     print(f"  xla reference     {t*1e3:8.3f} ms ({7*n*4/t/1e9:6.1f} GB/s)")
 
 
-ALL = {"attn": tune_attn, "ln": tune_ln, "softmax": tune_softmax,
-       "opt": tune_opt}
+ALL = {"attn": tune_attn, "attnbwd": tune_attn_bwd, "ln": tune_ln,
+       "softmax": tune_softmax, "opt": tune_opt}
 
 if __name__ == "__main__":
     import jax
 
-    print("backend:", jax.default_backend())
-    which = sys.argv[1:] or list(ALL)
-    for name in which:
-        ALL[name]()
+    from apex_tpu.backend_guard import tpu_slot_lock
+
+    # the tunnel serves ONE client; serialize against bench/smoke runs
+    # (the lock warns on stderr itself if it can't be acquired)
+    with tpu_slot_lock():
+        print("backend:", jax.default_backend())
+        which = sys.argv[1:] or list(ALL)
+        for name in which:
+            ALL[name]()
