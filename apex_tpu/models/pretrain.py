@@ -27,6 +27,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax, shard_map
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
@@ -40,6 +41,7 @@ from apex_tpu.transformer.parallel_state import (
     TENSOR_AXIS,
 )
 from apex_tpu.transformer.pipeline_parallel.schedules import (
+    forward_backward_pipelining_with_interleaving,
     last_stage_value,
     spmd_pipeline,
 )
@@ -125,6 +127,27 @@ def _opt_state_specs(optimizer: FlatFusedOptimizer, local_params: Any) -> Any:
     )
 
 
+def interleaved_layer_permutation(num_layers: int, pp: int,
+                                  vpp: int) -> np.ndarray:
+    """Stacked-layer-dim permutation for the interleaved schedule.
+
+    Virtual stage j holds layers [j*L/(pp*vpp), (j+1)*L/(pp*vpp)); rank s
+    hosts virtual stages {c*pp + s}. Sharding the stacked (L, ...) layer
+    tree over the pipe axis hands rank s a CONTIGUOUS block, so the
+    stack must be pre-permuted so that block is exactly rank s's chunks
+    in chunk order — the functional analog of the reference's
+    model-chunk list construction (ref schedules/common.py:30-151 with
+    virtual_pipeline_model_parallel_size).
+    """
+    per_vstage = num_layers // (pp * vpp)
+    order = []
+    for s in range(pp):
+        for c in range(vpp):
+            v = c * pp + s
+            order.extend(range(v * per_vstage, (v + 1) * per_vstage))
+    return np.asarray(order)
+
+
 def make_gpt_pretrain_step(
     cfg: GPTConfig,
     mesh: Mesh,
@@ -132,6 +155,7 @@ def make_gpt_pretrain_step(
     *,
     num_microbatches: int = 1,
     remat: bool = True,
+    num_model_chunks: int = 1,
 ):
     """Build the jitted full-parallel train step.
 
@@ -139,6 +163,15 @@ def make_gpt_pretrain_step(
       init_opt_fn(params_global) -> opt_state (sharded)
       step_fn(params, opt_state, tokens, labels) -> (params, opt_state, loss)
     tokens/labels: (global_batch, seq) int32.
+
+    ``num_model_chunks > 1`` selects the interleaved (virtual-pipeline)
+    schedule. The CALLER owns the layer layout: a stacked layer tree in
+    global order (e.g. a ported checkpoint) must be permuted with
+    :func:`interleaved_layer_permutation` before use so each rank's
+    contiguous pipe shard holds its vpp chunks in chunk order —
+    ``init_gpt_pretrain_params`` does NOT permute (fresh i.i.d. init
+    needs no permutation; ordering only matters for pre-trained
+    weights). The returned specs are unchanged either way.
     """
     layer = GPTLayer(cfg)
     emb_mod = VocabParallelEmbedding(
@@ -147,8 +180,10 @@ def make_gpt_pretrain_step(
     )
     norm_mod = FusedLayerNorm(cfg.hidden_size)
     pp = mesh.shape[PIPELINE_AXIS]
-    if cfg.num_layers % pp:
-        raise ValueError("num_layers must be divisible by pipeline size")
+    vpp = num_model_chunks
+    if cfg.num_layers % (pp * vpp):
+        raise ValueError(
+            "num_layers must be divisible by pipeline size x model chunks")
 
     def pre_fn(params, mb_tokens):
         x = emb_mod.apply({"params": params["embedding"]}, mb_tokens)
@@ -167,6 +202,21 @@ def make_gpt_pretrain_step(
             return layer.apply({"params": lp}, h), None
 
         y, _ = lax.scan(body, x, params["layers"])
+        return y
+
+    def stage_fn_chunk(params, x, chunk_id):
+        # vpp: this rank's local (L/pp)-layer stack is its vpp chunks in
+        # chunk order (interleaved_layer_permutation layout); scan the
+        # chunk_id-th slice
+        per = cfg.num_layers // (pp * vpp)
+        chunk_layers = jax.tree.map(
+            lambda l: lax.dynamic_slice_in_dim(l, chunk_id * per, per, 0),
+            params["layers"])
+
+        def body(h, lp):
+            return layer.apply({"params": lp}, h), None
+
+        y, _ = lax.scan(body, x, chunk_layers)
         return y
 
     def loss_fn_mb(params, y, mb_labels):
@@ -212,8 +262,26 @@ def make_gpt_pretrain_step(
         )
         return loss_sum / m
 
+    def local_loss_vpp(params, tokens, labels):
+        """Interleaved (virtual-pipeline) loss+grads via the staggered
+        tick-scan schedule; loss head takes params so the tied-embedding
+        projection's grads flow."""
+        loss, grads = forward_backward_pipelining_with_interleaving(
+            stage_fn_chunk,
+            lambda p, y, b: loss_fn_mb(p, y, b["labels"]),
+            lambda p, b: pre_fn(p, b["tokens"]),
+            params, {"tokens": tokens, "labels": labels},
+            num_microbatches=num_microbatches, num_model_chunks=vpp,
+            remat=remat, loss_takes_params=True,
+        )
+        return loss, grads
+
     def step(params, opt_state, tokens, labels):
-        loss, grads = jax.value_and_grad(local_loss)(params, tokens, labels)
+        if vpp > 1:
+            loss, grads = local_loss_vpp(params, tokens, labels)
+        else:
+            loss, grads = jax.value_and_grad(local_loss)(
+                params, tokens, labels)
         for name in ("embedding", "position_embedding", "final_norm"):
             grads[name] = jax.tree.map(
                 lambda g: lax.psum(g, PIPELINE_AXIS), grads[name]

@@ -110,6 +110,14 @@ def _merge(a, p):
             + o_p * jnp.exp(l_p - l_new)[..., None], l_new)
 
 
+def _skip_future_tile(kpos_b, q_max_b, run, zero):
+    """The ring's causal tile skip, shared by forward and backward: a
+    (q-block, kv-block) pair wholly in the q-block's causal future is
+    skipped via ``lax.cond`` (per-device predicate, collective-free, so
+    divergent branches across the ring are fine)."""
+    return lax.cond(jnp.min(kpos_b) > q_max_b, zero, run)
+
+
 def _ring_forward(q, k, v, q_positions, kv_positions, axis_name, causal,
                   scale, ng, impl):
     """The ring sweep: returns fp32 (out, lse) of the local Q shard
@@ -141,13 +149,14 @@ def _ring_forward(q, k, v, q_positions, kv_positions, axis_name, causal,
             for kb in range(ng):
                 ksl = slice(kb * ks, (kb + 1) * ks)
                 k_b, v_b, kpos_b = k_c[:, :, ksl], v_c[:, :, ksl], kpos[ksl]
-                part = lax.cond(
-                    jnp.min(kpos_b) > q_max_b,
-                    lambda: (jnp.zeros((b, h, qs, d), jnp.float32),
-                             jnp.full((b, h, qs), NEG_INF, jnp.float32)),
-                    lambda k_b=k_b, v_b=v_b, kpos_b=kpos_b, q_b=q_b,
+                part = _skip_future_tile(
+                    kpos_b, q_max_b,
+                    run=lambda k_b=k_b, v_b=v_b, kpos_b=kpos_b, q_b=q_b,
                     qpos_b=qpos_b: _chunk_attn(
                         q_b, k_b, v_b, qpos_b, kpos_b, scale, True, impl),
+                    zero=lambda: (jnp.zeros((b, h, qs, d), jnp.float32),
+                                  jnp.full((b, h, qs), NEG_INF,
+                                           jnp.float32)),
                 )
                 acc = part if acc is None else _merge(acc, part)
             o_rows.append(acc[0])
@@ -173,7 +182,7 @@ def _ring_forward(q, k, v, q_positions, kv_positions, axis_name, causal,
 
 
 def _chunk_grads(q, k_c, v_c, qpos, kpos, g, lse, delta, scale, causal,
-                 impl):
+                 impl, bq=1024, bk=1024):
     """Gradient contribution of one visiting KV chunk, evaluated against
     the *global* softmax statistics.
 
@@ -182,7 +191,13 @@ def _chunk_grads(q, k_c, v_c, qpos, kpos, g, lse, delta, scale, causal,
     exactly this chunk's share of (dq, dk_c, dv_c): summed over chunks,
     rowsum(P) = 1 restores the full softmax backward. This is the
     identity that lets the ring backward recompute instead of saving
-    per-step residuals."""
+    per-step residuals.
+
+    The XLA path returns fp32 so per-chunk contributions accumulate
+    without intermediate rounding; the kernel path rounds once per
+    chunk to the input dtype (the kernels' output dtype) — one extra
+    rounding per ring step vs single-device flash.
+    """
     if impl is None:
         from apex_tpu._backend import default_impl
         impl = default_impl()
@@ -191,7 +206,7 @@ def _chunk_grads(q, k_c, v_c, qpos, kpos, g, lse, delta, scale, causal,
                                             interpret_flag)
         core = (q, k_c, v_c, None, None, None, None, lse)
         return _flash_bwd_pallas(
-            core, g, delta, None, scale, causal, None, 0.0, 1024, 1024,
+            core, g, delta, None, scale, causal, None, 0.0, bq, bk,
             interpret_flag(impl),
             q_pos=qpos if causal else None,
             k_pos=kpos if causal else None)
@@ -220,17 +235,19 @@ def _chunk_grads(q, k_c, v_c, qpos, kpos, g, lse, delta, scale, causal,
     dk_c = jnp.einsum("bkgqc,bkgqd->bkcd", ds,
                       (q.astype(jnp.float32) * scale).reshape(
                           b, hk, group, sq, d))
-    return dq.astype(q.dtype), dk_c.astype(k_c.dtype), dv_c.astype(v_c.dtype)
+    return dq, dk_c, dv_c     # fp32: callers accumulate across chunks
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9))
-def _ring_core(q, k, v, qpos, kpos, axis_name, causal, scale, ng, impl):
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10, 11))
+def _ring_core(q, k, v, qpos, kpos, axis_name, causal, scale, ng, impl,
+               bwd_bq, bwd_bk):
     out, _ = _ring_forward(q, k, v, qpos, kpos, axis_name, causal, scale,
                            ng, impl)
     return out.astype(q.dtype)
 
 
-def _ring_fwd_rule(q, k, v, qpos, kpos, axis_name, causal, scale, ng, impl):
+def _ring_fwd_rule(q, k, v, qpos, kpos, axis_name, causal, scale, ng,
+                   impl, bwd_bq, bwd_bk):
     out, lse = _ring_forward(q, k, v, qpos, kpos, axis_name, causal,
                              scale, ng, impl)
     out = out.astype(q.dtype)
@@ -239,7 +256,8 @@ def _ring_fwd_rule(q, k, v, qpos, kpos, axis_name, causal, scale, ng, impl):
     return out, (q, k, v, qpos, kpos, out, lse)
 
 
-def _ring_bwd_rule(axis_name, causal, scale, ng, impl, res, g):
+def _ring_bwd_rule(axis_name, causal, scale, ng, impl, bwd_bq, bwd_bk,
+                   res, g):
     q, k, v, qpos, kpos, out, lse = res
     cp = lax.axis_size(axis_name)
     b, h, s_local, d = q.shape
@@ -255,7 +273,7 @@ def _ring_bwd_rule(axis_name, causal, scale, ng, impl, res, g):
         if not causal:
             dq_p, dkc_p, dvc_p = _chunk_grads(
                 q, k_c, v_c, qpos, kpos_c, g, lse, delta, scale, False,
-                impl)
+                impl, bwd_bq, bwd_bk)
             return (dq_p.astype(jnp.float32), dkc_p.astype(jnp.float32),
                     dvc_p.astype(jnp.float32))
         qs, ks = s_local // ng, k_c.shape[2] // ng
@@ -279,7 +297,7 @@ def _ring_bwd_rule(axis_name, causal, scale, ng, impl, res, g):
                         qpos_b=qpos_b):
                     dq_p, dk_p, dv_p = _chunk_grads(
                         q_b, k_b, v_b, qpos_b, kpos_b, g_b, lse_b,
-                        delta_b, scale, True, impl)
+                        delta_b, scale, True, impl, bwd_bq, bwd_bk)
                     return (dq_p.astype(jnp.float32),
                             dk_p.astype(jnp.float32),
                             dv_p.astype(jnp.float32))
@@ -289,8 +307,8 @@ def _ring_bwd_rule(axis_name, causal, scale, ng, impl, res, g):
                             jnp.zeros(k_b.shape, jnp.float32),
                             jnp.zeros(v_b.shape, jnp.float32))
 
-                dq_p, dk_p, dv_p = lax.cond(
-                    jnp.min(kpos_b) > q_max_b, skip, run)
+                dq_p, dk_p, dv_p = _skip_future_tile(
+                    kpos_b, q_max_b, run=run, zero=skip)
                 dq_acc = dq_acc + dq_p
                 dk_cols[kb] = dk_p if dk_cols[kb] is None else dk_cols[kb] + dk_p
                 dv_cols[kb] = dv_p if dv_cols[kb] is None else dv_cols[kb] + dv_p
@@ -341,6 +359,8 @@ def ring_attention(
     kv_positions: Optional[jax.Array] = None,
     skip_granularity: int = 1,
     impl: Optional[str] = None,
+    bwd_block_q: int = 1024,
+    bwd_block_k: int = 1024,
 ) -> jax.Array:
     """Exact ring attention over the ``axis_name`` device ring.
 
@@ -387,7 +407,8 @@ def ring_attention(
     return _ring_core(q, k, v,
                       jnp.asarray(q_positions, jnp.int32),
                       jnp.asarray(kv_positions, jnp.int32),
-                      axis_name, causal, scale, ng, impl)
+                      axis_name, causal, scale, ng, impl,
+                      bwd_block_q, bwd_block_k)
 
 
 def ring_attention_sharded(

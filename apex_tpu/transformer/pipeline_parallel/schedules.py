@@ -327,6 +327,7 @@ def forward_backward_pipelining_with_interleaving(
     forward_only: bool = False,
     remat: bool = True,
     chunk_ticks: Optional[int] = None,
+    loss_takes_params: bool = False,
 ):
     """Interleaved (virtual pipeline) schedule
     (ref fwd_bwd_pipelining_with_interleaving.py:26): each rank hosts
@@ -354,6 +355,12 @@ def forward_backward_pipelining_with_interleaving(
       is O(ticks/chunk + chunk) single-microbatch buffers, never the
       (M, ...) boundary stack (round-2 VERDICT weak#4). Requires
       ``num_microbatches % S == 0`` (the reference requires the same).
+
+    ``loss_takes_params=True`` calls ``loss_fn(params, y, mb)`` so a
+    loss head that reads params (e.g. a tied-embedding vocab
+    projection) contributes its param gradients — a closure over outer
+    params would silently be a constant under the internal
+    ``value_and_grad``.
     """
     mb = _split_microbatches(batch, num_microbatches)
     m = num_microbatches
@@ -423,12 +430,14 @@ def forward_backward_pipelining_with_interleaving(
                 sel == vpp - 1)
             # loss_fn (vocab projection + CE for an LM) likewise runs
             # only on exit ticks of the last chunk on the last rank
+            def run_loss():
+                lb = index_mb(mb, jnp.clip(m_idx, 0, m - 1))
+                out = (loss_fn(params, y, lb) if loss_takes_params
+                       else loss_fn(y, lb))
+                return jnp.asarray(out, jnp.float32)
+
             acc = acc + lax.cond(
-                active,
-                lambda: jnp.asarray(
-                    loss_fn(y, index_mb(mb, jnp.clip(m_idx, 0, m - 1))),
-                    jnp.float32),
-                lambda: jnp.float32(0.0))
+                active, run_loss, lambda: jnp.float32(0.0))
             return (lax.ppermute(y, axis_name, perm), acc), None
 
         _, loss_sum = _chunked_scan(

@@ -237,6 +237,17 @@ class TestExamples:
         loss2 = ex.main(argv[:1] + ["6"] + argv[2:])
         assert np.isfinite(loss2)
 
+    @pytest.mark.parametrize("attn", ["ring", "ulysses"])
+    def test_long_context(self, attn):
+        """Beyond-reference long-context example: sequence sharded over
+        the cp axis, exact causal attention via ring/Ulysses."""
+        ex = _load_example("examples/long_context/train_long_context.py",
+                           f"ex_long_context_{attn}")
+        loss = ex.main(["--seq", "128", "--cp", "4", "--steps", "60",
+                        "--hidden", "32", "--vocab", "32",
+                        "--lr", "5e-3", "--attn", attn])
+        assert np.isfinite(loss) and loss < 2.9   # from ~3.47 at init
+
     def test_dcgan(self):
         ex = _load_example("examples/dcgan/main_amp.py", "ex_dcgan")
         lD, lG = ex.main(["--steps", "4", "--batch-size", "8",

@@ -262,6 +262,33 @@ def run(perf=False, kimpl="pallas"):
     check("flash chunked lse-merge == full", chunk_merge, q, k, v_,
           tol=2e-2)
 
+    # separately-tuned backward blocks (new bwd_block_q/bwd_block_k
+    # threading) must lower through Mosaic and match the XLA grads
+    check("flash_attention bwd blocks 512x512",
+          lambda q_, k_, vv, impl: ops.flash_attention(
+              q_, k_, vv, causal=True, bwd_block_q=512, bwd_block_k=512,
+              impl=impl),
+          q, k, v_, grad_wrt=(0, 1, 2), tol=2e-2)
+
+    # ring-attention recompute backward's per-chunk kernel path:
+    # _flash_bwd_pallas evaluated against GLOBAL (lse, delta) statistics
+    # must reproduce the XLA chunk-grads (context_parallel._chunk_grads)
+    from apex_tpu.transformer.context_parallel import _chunk_grads
+
+    def ring_chunk_grads(q_, k_, vv, impl):
+        half = k_.shape[2] // 2
+        out, lse = ops.flash_attention(
+            q_, k_, vv, causal=True, return_lse=True, impl="xla")
+        g = out.astype(jnp.float32) * 2.0     # d(sum out^2)/d out
+        delta = jnp.sum(out.astype(jnp.float32) * g, axis=-1)
+        return _chunk_grads(
+            q_, k_[:, :, :half], vv[:, :, :half],
+            pos, pos[:half], g, lse, delta, q_.shape[-1] ** -0.5, True,
+            impl)
+
+    check("ring chunk-grads (global lse) kernel", ring_chunk_grads,
+          q, k, v_, tol=2e-2)
+
     n_fail = sum(1 for _, ok, *_ in results if not ok)
     print(f"\n{len(results) - n_fail}/{len(results)} ops pass on "
           f"{jax.default_backend()}")
