@@ -55,6 +55,7 @@ def fused_elementwise(
     impl: Optional[str] = None,
     tile_rows: Optional[int] = None,
     aliases: Optional[dict] = None,
+    sumsq_subtiles: Sequence = (),
 ):
     """Run ``fn`` element-wise over 1-D buffers in one fused kernel.
 
@@ -71,6 +72,18 @@ def fused_elementwise(
     so this is always safe; in a jitted train step whose optimizer state
     flows through, it eliminates the fresh allocation per updated buffer.
 
+    ``sumsq_subtiles`` — entries ``("in", i)`` or ``("out", j)`` — emits,
+    for each named buffer, per-(PER_TENSOR_TILE_ROWS*LANES)-subtile
+    per-lane partial sums of squares from INSIDE the same kernel pass
+    (shape (num_tiles, tile_rows//PER_TENSOR_TILE_ROWS, LANES), fp32),
+    appended to the returned outputs. The tail pad beyond ``n`` is
+    masked out of the partials (``fn``'s image of the zero padding
+    never contaminates them), so summing all partials gives the exact
+    global sum-of-squares on every impl. Since FlatSpace aligns every
+    leaf to the subtile size, a segment-sum of these partials yields
+    exact per-tensor norms without re-reading the buffer — the fusion
+    LAMB uses to fold its ||p||/||update|| passes into stage 1.
+
     Returns ``(outputs, found_inf)`` where ``found_inf`` is a float32
     scalar in {0, 1} covering the ``check_finite`` input indices.
     """
@@ -84,13 +97,22 @@ def fused_elementwise(
     if tile_rows is None:
         tile_rows = PER_TENSOR_TILE_ROWS if tile_ids is not None else DEFAULT_TILE_ROWS
     tile = tile_rows * LANES
+    for kind, idx in sumsq_subtiles:
+        if kind not in ("in", "out") or not (
+                0 <= idx < (len(inputs) if kind == "in" else num_outputs)):
+            raise ValueError(f"bad sumsq_subtiles entry {(kind, idx)}")
+    if sumsq_subtiles and tile_rows % PER_TENSOR_TILE_ROWS:
+        raise ValueError(
+            f"sumsq_subtiles needs tile_rows divisible by "
+            f"{PER_TENSOR_TILE_ROWS}, got {tile_rows}")
+    sub = tile_rows // PER_TENSOR_TILE_ROWS
 
     scalars = [jnp.asarray(s, jnp.float32) for s in scalars]
 
     if impl == "xla":
         return _fused_elementwise_xla(
             fn, inputs, scalars, num_outputs, out_dtypes, check_finite,
-            tile_ids, per_tensor, tile,
+            tile_ids, per_tensor, tile, sumsq_subtiles,
         )
 
     padded_n = ((n + tile - 1) // tile) * tile
@@ -117,7 +139,8 @@ def fused_elementwise(
         pt_refs = refs[k : k + n_pt]; k += n_pt
         in_refs = refs[k : k + n_in]; k += n_in
         out_refs = refs[k : k + num_outputs]; k += num_outputs
-        found_ref = refs[k]
+        found_ref = refs[k]; k += 1
+        sq_refs = refs[k : k + len(sumsq_subtiles)]
 
         i = pl.program_id(0)
 
@@ -143,6 +166,25 @@ def fused_elementwise(
         outs = fn(ins, svals, tvals)
         for r, o in zip(out_refs, outs):
             r[...] = o.astype(r.dtype)
+        if sumsq_subtiles:
+            # mask the tail pad so partials never include fn's image of
+            # the zero padding (fn(0) may be nonzero) — keeps pallas and
+            # XLA paths bit-consistent for any buffer length
+            ridx = jax.lax.broadcasted_iota(
+                jnp.int32, (tile_rows, LANES), 0)
+            lidx = jax.lax.broadcasted_iota(
+                jnp.int32, (tile_rows, LANES), 1)
+            valid = (i * tile + ridx * LANES + lidx) < n
+        for r, (kind, idx) in zip(sq_refs, sumsq_subtiles):
+            src = (ins[idx] if kind == "in" else outs[idx]).astype(
+                jnp.float32)
+            src = jnp.where(valid, src, 0.0)
+            # per-(PER_TENSOR_TILE_ROWS-row) subtile, per-lane partial
+            # sums: the row-group reduction runs in-kernel; lane sums
+            # and the per-leaf segment-sum are tiny XLA finishing work
+            r[0] = jnp.sum(
+                (src * src).reshape(sub, PER_TENSOR_TILE_ROWS, LANES),
+                axis=1)
 
     # index maps receive (grid idx, *prefetch refs) under PrefetchScalarGridSpec
     grid_spec = pltpu.PrefetchScalarGridSpec(
@@ -162,6 +204,11 @@ def fused_elementwise(
                 for _ in range(num_outputs)
             ]
             + [pl.BlockSpec((1, 1), lambda i, *_: (0, 0), memory_space=pltpu.SMEM)]
+            + [
+                pl.BlockSpec((1, sub, LANES), lambda i, *_: (i, 0, 0),
+                             memory_space=pltpu.VMEM)
+                for _ in sumsq_subtiles
+            ]
         ),
     )
 
@@ -173,9 +220,13 @@ def fused_elementwise(
         prefetch.append(jnp.asarray(tile_ids))
     prefetch.extend(jnp.asarray(p, jnp.float32) for p in per_tensor)
 
-    out_shapes = [
-        jax.ShapeDtypeStruct((padded_n // LANES, LANES), dt) for dt in out_dtypes
-    ] + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+    out_shapes = (
+        [jax.ShapeDtypeStruct((padded_n // LANES, LANES), dt)
+         for dt in out_dtypes]
+        + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
+        + [jax.ShapeDtypeStruct((num_tiles, sub, LANES), jnp.float32)
+           for _ in sumsq_subtiles]
+    )
 
     io_aliases = {}
     if aliases:
@@ -210,12 +261,13 @@ def fused_elementwise(
 
     outs = [r.reshape(padded_n)[:n] for r in results[:num_outputs]]
     found = results[num_outputs][0, 0]
+    outs.extend(results[num_outputs + 1:])      # sumsq partials, if any
     return outs, found
 
 
 def _fused_elementwise_xla(
     fn, inputs, scalars, num_outputs, out_dtypes, check_finite,
-    tile_ids, per_tensor, tile,
+    tile_ids, per_tensor, tile, sumsq_subtiles=(),
 ):
     """Pure-XLA reference path (CPU tests, simulated meshes)."""
     n = inputs[0].shape[0]
@@ -232,11 +284,22 @@ def _fused_elementwise_xla(
         found = jnp.maximum(
             found, jnp.where(jnp.all(jnp.isfinite(bufs[idx])), 0.0, 1.0)
         )
-    outs = fn(bufs, scalars, tvals)
+    raw_outs = fn(bufs, scalars, tvals)
     outs = [
         o.reshape(-1)[:n].astype(dt) if tile_ids is not None else o.astype(dt)
-        for o, dt in zip(outs, out_dtypes)
+        for o, dt in zip(raw_outs, out_dtypes)
     ]
+    if sumsq_subtiles:
+        # mirror the kernel's (num_tiles, sub, LANES) partial layout
+        num_tiles = -(-n // tile)
+        padded_n = num_tiles * tile
+        sub = tile // (PER_TENSOR_TILE_ROWS * LANES)
+        for kind, idx in sumsq_subtiles:
+            src = inputs[idx] if kind == "in" else raw_outs[idx].reshape(-1)[:n]
+            x = _pad_to(src.astype(jnp.float32), padded_n)
+            outs.append(jnp.sum(
+                x.reshape(num_tiles, sub, PER_TENSOR_TILE_ROWS, LANES) ** 2,
+                axis=2))
     return outs, found
 
 

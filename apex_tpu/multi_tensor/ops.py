@@ -71,6 +71,18 @@ def multi_tensor_axpby(x, y, a, b, *, arg_to_check=-1, out_dtype=None, impl=None
     return out, found
 
 
+def _norms_from_subtile_partials(partials, space: FlatSpace) -> jax.Array:
+    """(num_leaves,) L2 norms from the engine's (num_tiles, sub, LANES)
+    per-subtile sumsq partials: subtiles are leaf-aligned (FlatSpace
+    aligns every leaf to the subtile size), so a lane-sum + segment-sum
+    finishes the reduction without touching the big buffer again."""
+    per_subtile = jnp.sum(partials, axis=-1).reshape(-1)
+    ids = jnp.asarray(space.tile_leaf_ids(_PT_TILE))
+    sumsq = jax.ops.segment_sum(per_subtile[:ids.shape[0]], ids,
+                                num_segments=space.num_leaves)
+    return jnp.sqrt(sumsq)
+
+
 def per_tensor_l2norm(buf, space: FlatSpace, *, impl=None) -> jax.Array:
     """(num_leaves,) L2 norms of each tensor in the flat buffer.
 
@@ -225,6 +237,7 @@ def fused_lamb_compute_update_term(
     p, m, v, g, *,
     beta1, beta2, beta3, eps, weight_decay, bias_correction1,
     bias_correction2, adam_w_mode, inv_scale, impl=None,
+    with_norm_partials=False,
 ):
     """LAMB stage 1: Adam-style update term + moment updates on any flat
     fp32 buffer (full or ZeRO shard).
@@ -235,7 +248,14 @@ def fused_lamb_compute_update_term(
     (distributed_lamb_cuda.multi_tensor_lamb_compute_update_term,
     apex/contrib/optimizers/distributed_fused_lamb.py:105).
 
-    Returns ((update, m', v'), found_inf).
+    ``with_norm_partials=True`` additionally emits per-subtile partial
+    sums of squares of ``p`` and of the update term from the SAME kernel
+    pass — the ||p|| / ||update|| the trust ratio needs, without the two
+    full re-read passes separate per_tensor_l2norm calls would cost
+    (~15% of the step's HBM traffic at BERT-large scale).
+
+    Returns ((update, m', v'), found_inf), with
+    (..., p_sumsq_partials, u_sumsq_partials) appended when requested.
     """
     mode = 1.0 if adam_w_mode else 0.0
 
@@ -257,6 +277,8 @@ def fused_lamb_compute_update_term(
         num_outputs=3, out_dtypes=[jnp.float32, m.dtype, v.dtype],
         check_finite=(3,), impl=impl,
         aliases={3: 0, 1: 1, 2: 2},   # g's buffer becomes the update term
+        sumsq_subtiles=((("in", 0), ("out", 0))
+                        if with_norm_partials else ()),
     )
 
 
@@ -294,26 +316,30 @@ def fused_lamb_update(
     bc1 = jnp.where(bias_correction, 1.0 - jnp.power(b1, step), 1.0)
     bc2 = jnp.where(bias_correction, 1.0 - jnp.power(b2, step), 1.0)
 
-    if global_grad_norm is None:
-        global_grad_norm, _ = multi_tensor_l2norm(g, impl=impl)
-    global_grad_norm = global_grad_norm / jnp.asarray(grad_scale, jnp.float32)
-    # clipped_global_grad_norm (ref csrc/multi_tensor_lamb.cu:354-360)
+    # clipped_global_grad_norm (ref csrc/multi_tensor_lamb.cu:354-360).
+    # The global norm is a full extra read of g — only pay for it when
+    # clipping actually consumes it (max_grad_norm <= 0 means clip = 1,
+    # making the norm dead computation)
     if max_grad_norm and max_grad_norm > 0:
+        if global_grad_norm is None:
+            global_grad_norm, _ = multi_tensor_l2norm(g, impl=impl)
+        global_grad_norm = (global_grad_norm
+                            / jnp.asarray(grad_scale, jnp.float32))
         clip = jnp.maximum(global_grad_norm / max_grad_norm, 1.0)
     else:
         clip = jnp.float32(1.0)
     inv_scale = clip * jnp.asarray(grad_scale, jnp.float32)
 
-    (u, m2, v2), found = fused_lamb_compute_update_term(
+    (u, m2, v2, p_part, u_part), found = fused_lamb_compute_update_term(
         p, m, v, g,
         beta1=b1, beta2=b2, beta3=beta3, eps=eps,
         weight_decay=weight_decay, bias_correction1=bc1,
         bias_correction2=bc2, adam_w_mode=adam_w_mode,
-        inv_scale=inv_scale, impl=impl,
+        inv_scale=inv_scale, impl=impl, with_norm_partials=True,
     )
 
-    w_norm = per_tensor_l2norm(p, space, impl=impl)
-    u_norm = per_tensor_l2norm(u, space, impl=impl)
+    w_norm = _norms_from_subtile_partials(p_part, space)
+    u_norm = _norms_from_subtile_partials(u_part, space)
     ratio = lamb_trust_ratio(w_norm, u_norm, weight_decay=weight_decay,
                              use_nvlamb=use_nvlamb)
 

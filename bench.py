@@ -740,6 +740,15 @@ if __name__ == "__main__":
 
         modes = {"moe": bench_moe, "gpt": bench_gpt, "attn": bench_attn,
                  "resnet": bench_resnet, "bert": bench_bert}
+
+        def run_all():
+            # one process for every mode: pays interpreter + backend
+            # startup once (CI smoke uses this)
+            main()
+            for fn in modes.values():
+                fn()
+
+        modes["all"] = run_all
         try:
             modes.get(mode, main)()
         except BaseException as e:  # noqa: BLE001 — always leave a record

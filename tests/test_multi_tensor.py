@@ -138,6 +138,66 @@ class TestScaleAxpbyL2norm:
             float(norm), float(np.linalg.norm(np.asarray(buf))), rtol=1e-5
         )
 
+    def test_sumsq_subtiles_fused_into_update(self, rng, impl):
+        """The engine's in-pass per-subtile sumsq partials (the fusion
+        that folds LAMB's ||p||/||update|| passes into stage 1) must
+        reproduce per_tensor_l2norm exactly, for both an input and an
+        output buffer, at the DEFAULT (non-per-tensor) tile size."""
+        from apex_tpu.multi_tensor.engine import fused_elementwise
+        from apex_tpu.multi_tensor.ops import _norms_from_subtile_partials
+
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        buf = space.pack(tree)
+        other = space.pack(jax.tree.map(
+            lambda v: jnp.asarray(np.asarray(
+                np.random.RandomState(1).standard_normal(v.shape),
+                np.float32)),
+            tree))
+
+        def fn(ins, s, t):
+            a, b = [x.astype(jnp.float32) for x in ins]
+            return [a * 2.0 + b]
+
+        (out, a_part, o_part), _ = fused_elementwise(
+            fn, [buf, other], num_outputs=1, out_dtypes=[jnp.float32],
+            impl=impl, sumsq_subtiles=(("in", 0), ("out", 0)))
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(buf) * 2.0 + np.asarray(other),
+            rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(_norms_from_subtile_partials(a_part, space)),
+            np.asarray(per_tensor_l2norm(buf, space, impl="xla")),
+            rtol=1e-5)
+        np.testing.assert_allclose(
+            np.asarray(_norms_from_subtile_partials(o_part, space)),
+            np.asarray(per_tensor_l2norm(out, space, impl="xla")),
+            rtol=1e-5)
+
+        with pytest.raises(ValueError, match="sumsq_subtiles"):
+            fused_elementwise(fn, [buf, other], num_outputs=1,
+                              out_dtypes=[jnp.float32], impl=impl,
+                              sumsq_subtiles=(("out", 3),))
+
+    def test_sumsq_subtiles_pad_clean(self, rng, impl):
+        """fn's image of the zero tail-pad (fn(0) != 0 here) must never
+        leak into the partials: summing ALL partials equals the exact
+        global sum of squares of the real output, on every impl."""
+        from apex_tpu.multi_tensor.engine import fused_elementwise
+
+        n = 70000    # not a multiple of the 65536-element default tile
+        x = jnp.asarray(np.asarray(rng.standard_normal(n), np.float32))
+
+        def fn(ins, s, t):
+            return [ins[0].astype(jnp.float32) + 1.0]   # fn(0) = 1
+
+        (out, part), _ = fused_elementwise(
+            fn, [x], num_outputs=1, out_dtypes=[jnp.float32], impl=impl,
+            sumsq_subtiles=(("out", 0),))
+        got = float(jnp.sum(part))
+        want = float(jnp.sum(out.astype(jnp.float32) ** 2))
+        np.testing.assert_allclose(got, want, rtol=1e-6)
+
 
 def _np_adam(p, m, v, g, lr, b1, b2, eps, step, wd, adam_w):
     if not adam_w:

@@ -25,19 +25,26 @@ def clean():
 
 
 class TestPretrainStep:
-    @pytest.mark.parametrize("tp,pp,sp", [(2, 2, True), (2, 2, False),
-                                          (4, 2, True), (1, 4, False)])
-    def test_step_runs_and_loss_decreases(self, rng, tp, pp, sp):
+    @pytest.mark.parametrize("tp,pp,sp,vpp", [
+        (2, 2, True, 1), (2, 2, False, 1), (4, 2, True, 1),
+        (1, 4, False, 1),
+        # interleaved schedule composed with TP(+SP): the vpp tick scan
+        # must interoperate with the TP collectives inside each chunk
+        (2, 2, True, 2), (2, 2, False, 2),
+    ])
+    def test_step_runs_and_loss_decreases(self, rng, tp, pp, sp, vpp):
         mesh = ps.initialize_model_parallel(tp, pp)
         dp = 8 // (tp * pp)
+        layers = max(pp * vpp, 2)
         cfg = GPTConfig(
             vocab_size=128, max_seq_len=32, hidden_size=64,
-            num_layers=max(pp, 2) if pp <= 2 else pp, num_heads=4,
+            num_layers=layers, num_heads=4,
             dtype=jnp.float32, sequence_parallel=sp,
         )
         params = init_gpt_pretrain_params(cfg, jax.random.PRNGKey(0))
         opt = FusedAdam(lr=2e-3, impl="xla")
-        build = make_gpt_pretrain_step(cfg, mesh, opt, num_microbatches=2)
+        build = make_gpt_pretrain_step(cfg, mesh, opt, num_microbatches=2,
+                                       num_model_chunks=vpp)
         init_opt, step_fn, _ = build(params)
         opt_state = init_opt(params)
         toks = jnp.asarray(rng.randint(0, 128, (4 * dp, 33)), jnp.int32)
