@@ -743,12 +743,13 @@ if __name__ == "__main__":
 
         modes = {"moe": bench_moe, "gpt": bench_gpt, "attn": bench_attn,
                  "resnet": bench_resnet, "bert": bench_bert}
+        base_modes = tuple(modes.values())
 
         def run_all():
             # one process for every mode: pays interpreter + backend
             # startup once (CI smoke uses this)
             main()
-            for fn in modes.values():
+            for fn in base_modes:
                 fn()
 
         modes["all"] = run_all

@@ -1,0 +1,114 @@
+"""backend_guard: the defensive bring-up layer every driver entry point
+and bench run depends on (probe-with-timeout, retry budget, CPU
+fallback, single-slot lock, MFU peak table)."""
+
+import multiprocessing
+import os
+import time
+
+import pytest
+
+import apex_tpu.backend_guard as bg
+
+
+class TestChipPeaks:
+    @pytest.mark.parametrize("kind,peak", [
+        ("TPU v5p", 459.0),
+        ("TPU v5 lite", 197.0),
+        ("TPU v5e", 197.0),
+        ("TPU v4", 275.0),
+        ("TPU v6 lite", 918.0),
+        ("TPU v3", 123.0),
+    ])
+    def test_known_chips(self, kind, peak):
+        assert bg.chip_peak_tflops(kind) == peak
+
+    def test_unknown_is_none_not_a_guess(self):
+        # mfu must be null for unknown chips, never a made-up denominator
+        assert bg.chip_peak_tflops("cpu") is None
+        assert bg.chip_peak_tflops("TPU v99") is None
+
+
+class TestSlotLock:
+    def test_acquire_and_reenter(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_SLOT_LOCK", str(tmp_path / "l"))
+        with bg.tpu_slot_lock(timeout=5) as got:
+            assert got
+            # reentrant within the process: no deadlock, reports held
+            with bg.tpu_slot_lock(timeout=5) as got2:
+                assert got2
+
+    def test_contention_times_out_not_hangs(self, tmp_path, monkeypatch):
+        path = str(tmp_path / "l")
+        monkeypatch.setenv("APEX_TPU_SLOT_LOCK", path)
+
+        def hold(path, ev):
+            import fcntl
+            fd = os.open(path, os.O_CREAT | os.O_RDWR)
+            fcntl.flock(fd, fcntl.LOCK_EX)
+            ev.set()
+            time.sleep(30)
+
+        ev = multiprocessing.Event()
+        proc = multiprocessing.Process(target=hold, args=(path, ev),
+                                       daemon=True)
+        proc.start()
+        assert ev.wait(10)
+        t0 = time.monotonic()
+        try:
+            with bg.tpu_slot_lock(timeout=1) as got:
+                assert not got          # fails OPEN (advisory), not hang
+            assert time.monotonic() - t0 < 15
+        finally:
+            proc.terminate()
+            proc.join()
+        # lock released by the dead process: next acquisition succeeds
+        with bg.tpu_slot_lock(timeout=10) as got:
+            assert got
+
+    def test_unopenable_path_fails_open(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_SLOT_LOCK",
+                           "/nonexistent-dir-xyz/lock")
+        with bg.tpu_slot_lock(timeout=1) as got:
+            assert not got              # warns + proceeds, never raises
+
+
+class TestEnsureBackend:
+    def test_initialized_backend_short_circuits(self):
+        # the test process already runs the simulated CPU mesh
+        report = bg.ensure_backend(min_devices=1)
+        assert not report.fallback
+        assert report.n_devices >= 1
+        assert "backend" in report.as_detail()
+
+    def test_retry_budget_retries_probe(self, monkeypatch):
+        import jax._src.xla_bridge as xb
+
+        calls = []
+
+        def fake_probe(timeout=None):
+            calls.append(1)
+            return {"ok": False, "error": "tunnel down"}
+
+        monkeypatch.setattr(bg, "probe_default_backend", fake_probe)
+        monkeypatch.setattr(bg, "_RETRY_SLEEP", 0.05)
+        monkeypatch.setattr(xb, "backends_are_initialized", lambda: False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        report = bg.ensure_backend(min_devices=1, retry_budget=0.2)
+        assert report.fallback
+        assert len(calls) >= 2          # retried, not one-shot
+        assert "after" in report.note   # attempt count recorded
+
+    def test_zero_budget_single_probe(self, monkeypatch):
+        import jax._src.xla_bridge as xb
+
+        calls = []
+        monkeypatch.setattr(
+            bg, "probe_default_backend",
+            lambda timeout=None: (calls.append(1)
+                                  or {"ok": False, "error": "down"}))
+        monkeypatch.setattr(xb, "backends_are_initialized", lambda: False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        report = bg.ensure_backend(min_devices=1, retry_budget=0.0)
+        assert report.fallback and len(calls) == 1
+        assert report.as_detail()["backend_fallback"] == "down"
