@@ -747,10 +747,29 @@ if __name__ == "__main__":
 
         def run_all():
             # one process for every mode: pays interpreter + backend
-            # startup once (CI smoke uses this)
-            main()
-            for fn in base_modes:
-                fn()
+            # startup once (CI smoke uses this). Per-mode failures emit
+            # their own error record and the sweep continues — one bad
+            # mode must not suppress the others' records.
+            failures = 0
+            for fn in (main,) + base_modes:
+                try:
+                    fn()
+                except BaseException as e:  # noqa: BLE001
+                    if isinstance(e, KeyboardInterrupt):
+                        raise
+                    failures += 1
+                    print(json.dumps({
+                        "metric": f"bench_{fn.__name__}_error",
+                        "value": None,
+                        "unit": "error (no measurement)",
+                        "vs_baseline": None,
+                        "detail": {
+                            "error": f"{type(e).__name__}: {str(e)[:300]}",
+                            **backend_detail(),
+                        },
+                    }))
+            if failures:
+                raise SystemExit(failures)
 
         modes["all"] = run_all
         try:

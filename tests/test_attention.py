@@ -455,6 +455,28 @@ class TestGQA:
                     f"array {shape}")
 
 
+def test_window_with_distinct_bwd_blocks(rng):
+    """Sliding-window attention with backward blocks different from the
+    forward's: the banded-grid math must derive from the backward's own
+    block sizes, not the forward's."""
+    b, h, s, d = 1, 2, 128, 16
+    q = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * 0.3)
+    k = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * 0.3)
+    v = jnp.asarray(rng.randn(b, h, s, d).astype(np.float32) * 0.3)
+
+    def loss(q, k, v, im):
+        o = flash_attention(q, k, v, causal=True, window_size=48,
+                            block_q=64, block_k=64,
+                            bwd_block_q=32, bwd_block_k=32, impl=im)
+        return jnp.sum(o ** 2)
+
+    g_kern = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, "interpret")
+    g_xla = jax.grad(loss, argnums=(0, 1, 2))(q, k, v, "xla")
+    for a, b_ in zip(g_kern, g_xla):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_fp32_backward_tight_tolerance(rng):
     """The backward casts dS/P to the INPUT dtype before its matmuls
     (bf16 MXU fast path); with fp32 inputs that cast is the identity,
