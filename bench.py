@@ -743,15 +743,18 @@ if __name__ == "__main__":
 
         modes = {"moe": bench_moe, "gpt": bench_gpt, "attn": bench_attn,
                  "resnet": bench_resnet, "bert": bench_bert}
-        base_modes = tuple(modes.values())
 
         def run_all():
             # one process for every mode: pays interpreter + backend
             # startup once (CI smoke uses this). Per-mode failures emit
-            # their own error record and the sweep continues — one bad
-            # mode must not suppress the others' records.
+            # their own error record — named exactly as the direct-mode
+            # invocation would name it — and the sweep continues; the
+            # failure count is RETURNED (not raised) so the outer
+            # always-leave-a-record handler never double-reports it.
             failures = 0
-            for fn in (main,) + base_modes:
+            for name, fn in [("headline", main)] + list(modes.items()):
+                if fn is run_all:
+                    continue
                 try:
                     fn()
                 except BaseException as e:  # noqa: BLE001
@@ -759,7 +762,7 @@ if __name__ == "__main__":
                         raise
                     failures += 1
                     print(json.dumps({
-                        "metric": f"bench_{fn.__name__}_error",
+                        "metric": f"bench_{name}_error",
                         "value": None,
                         "unit": "error (no measurement)",
                         "vs_baseline": None,
@@ -768,12 +771,12 @@ if __name__ == "__main__":
                             **backend_detail(),
                         },
                     }))
-            if failures:
-                raise SystemExit(failures)
+            return failures
 
         modes["all"] = run_all
+        rc = 0
         try:
-            modes.get(mode, main)()
+            rc = modes.get(mode, main)()
         except BaseException as e:  # noqa: BLE001 — always leave a record
             if isinstance(e, KeyboardInterrupt):
                 raise
@@ -788,3 +791,5 @@ if __name__ == "__main__":
                 },
             }))
             sys.exit(1)
+        if rc:                  # run_all returns its per-mode failure count
+            sys.exit(int(rc))

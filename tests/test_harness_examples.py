@@ -248,6 +248,26 @@ class TestExamples:
                         "--lr", "5e-3", "--attn", attn])
         assert np.isfinite(loss) and loss < 2.9   # from ~3.47 at init
 
+    def test_multihead_attn_perf_example(self):
+        """ref apex/contrib/examples/multihead_attn: the standalone
+        func/perf sweep, flag surface included."""
+        ex = _load_example(
+            "examples/multihead_attn/perf_test_multihead_attn.py",
+            "ex_mha_perf")
+        rows = ex.main(["--seq-length", "32", "--num-seqs-start", "4",
+                        "--num-seqs-stop", "8", "--num-seqs-inc", "4",
+                        "--trials", "2", "--warmup-trials", "1",
+                        "--layers", "2", "--hidden-dim", "64",
+                        "--heads", "4"])
+        assert len(rows) == 2 and all(t > 0 for _, t in rows)
+        rows = ex.main(["--seq-length", "32", "--num-seqs-start", "4",
+                        "--num-seqs-stop", "4", "--num-seqs-inc", "4",
+                        "--trials", "2", "--warmup-trials", "1",
+                        "--layers", "1", "--hidden-dim", "64",
+                        "--heads", "4", "--encdec-attn", "--ref",
+                        "--fwd", "--norm-add", "--biases"])
+        assert len(rows) == 1
+
     def test_dcgan(self):
         ex = _load_example("examples/dcgan/main_amp.py", "ex_dcgan")
         lD, lG = ex.main(["--steps", "4", "--batch-size", "8",
