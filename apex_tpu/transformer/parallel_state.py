@@ -91,7 +91,40 @@ def initialize_model_parallel(
     # chunks) also rides ICI-adjacent devices (the reference has no CP;
     # this axis is the TPU-native long-context extension, SURVEY.md §5
     # "Long-context").
-    arr = np.asarray(devs).reshape(dp, ep, pp, cp, tp)
+    #
+    # Device assignment is TOPOLOGY-AWARE when jax can see one: on a
+    # multi-host deployment the data axis spans DCN (hosts) while
+    # tp/cp/pp stay on a slice's ICI — the mesh-layout discipline the
+    # reference approximates by making TP ranks node-consecutive
+    # (parallel_state.py:196-221) and that multi-host NCCL gets from
+    # rank placement. Explicit ``devices`` bypasses this (caller owns
+    # the order); any mesh_utils failure falls back to the plain
+    # reshape (CPU simulated meshes have no topology to exploit).
+    shape = (dp, ep, pp, cp, tp)
+    arr = None
+    if devices is None:
+        n_slices = getattr(jax, "process_count", lambda: 1)()
+        try:
+            from jax.experimental import mesh_utils
+
+            if n_slices > 1 and dp % n_slices == 0:
+                try:
+                    arr = mesh_utils.create_hybrid_device_mesh(
+                        (dp // n_slices, ep, pp, cp, tp),
+                        (n_slices, 1, 1, 1, 1),
+                        devices=devs, allow_split_physical_axes=True)
+                except Exception:  # noqa: BLE001
+                    # hybrid shape unsatisfiable (e.g. model axes larger
+                    # than a slice) — single-level assignment still
+                    # recovers intra-slice ICI adjacency
+                    arr = None
+            if arr is None:
+                arr = mesh_utils.create_device_mesh(
+                    shape, devices=devs, allow_split_physical_axes=True)
+        except Exception:  # noqa: BLE001 — fall back to linear order
+            arr = None
+    if arr is None:
+        arr = np.asarray(devs).reshape(shape)
     _MESH = Mesh(
         arr, (DATA_AXIS, EXPERT_AXIS, PIPELINE_AXIS, CONTEXT_AXIS, TENSOR_AXIS)
     )

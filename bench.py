@@ -743,6 +743,7 @@ if __name__ == "__main__":
 
         modes = {"moe": bench_moe, "gpt": bench_gpt, "attn": bench_attn,
                  "resnet": bench_resnet, "bert": bench_bert}
+        sweep = [("headline", main)] + list(modes.items())
 
         def run_all():
             # one process for every mode: pays interpreter + backend
@@ -752,9 +753,7 @@ if __name__ == "__main__":
             # failure count is RETURNED (not raised) so the outer
             # always-leave-a-record handler never double-reports it.
             failures = 0
-            for name, fn in [("headline", main)] + list(modes.items()):
-                if fn is run_all:
-                    continue
+            for name, fn in sweep:
                 try:
                     fn()
                 except BaseException as e:  # noqa: BLE001

@@ -12,7 +12,6 @@ timing protocol (queue all trials inside one jitted loop, fence once).
 """
 
 import argparse
-import functools
 import sys
 import time
 

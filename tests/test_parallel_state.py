@@ -51,6 +51,21 @@ class TestInitializeModelParallel:
         row = ids[0, 0, 0, 0, :]
         np.testing.assert_array_equal(row, np.arange(row[0], row[0] + 4))
 
+    def test_mesh_covers_all_devices_once(self):
+        """Topology-aware assignment may permute devices but must remain
+        a bijection onto the device set."""
+        mesh = ps.initialize_model_parallel(2, 2, context_parallel_size=2)
+        ids = sorted(d.id for d in np.asarray(mesh.devices).ravel())
+        assert ids == sorted(d.id for d in jax.devices())
+
+    def test_explicit_devices_bypass_topology(self):
+        """Caller-supplied devices keep the caller's exact order (the
+        topology-aware path only applies to the default device set)."""
+        devs = list(jax.devices())[::-1]        # deliberately reversed
+        mesh = ps.initialize_model_parallel(2, 1, devices=devs)
+        got = [d.id for d in np.asarray(mesh.devices).ravel()]
+        assert got == [d.id for d in devs]
+
     def test_virtual_pp(self):
         ps.initialize_model_parallel(
             1, 4, virtual_pipeline_model_parallel_size=2
