@@ -312,7 +312,7 @@ def fused_sumsq_partials(
     buf: jax.Array,
     *,
     impl: Optional[str] = None,
-    tile_rows: int = PER_TENSOR_TILE_ROWS,
+    tile_rows: Optional[int] = None,
 ) -> jax.Array:
     """Per-tile partial sums of squares over a flat buffer.
 
@@ -320,8 +320,17 @@ def fused_sumsq_partials(
     ref: csrc/multi_tensor_l2norm_kernel.cu (per-chunk partials + cleanup):
     the kernel emits one fp32 partial per tile; the tiny finishing
     reduction (global sum or per-tensor segment-sum) runs in XLA.
+
+    Default tile is the big (512-row) sweep — right for GLOBAL norms
+    (no alignment constraint; a 2048-element tile would cost a 32x
+    larger grid). Per-tensor callers pass PER_TENSOR_TILE_ROWS so tiles
+    never straddle a leaf.
     """
     impl = resolve_impl(impl)
+    if tile_rows is None:
+        # read at call time so runtime tuning of DEFAULT_TILE_ROWS
+        # (tools/tpu_tune.py monkeypatch pattern) applies here too
+        tile_rows = DEFAULT_TILE_ROWS
     tile = tile_rows * LANES
     n = buf.shape[0]
     padded_n = ((n + tile - 1) // tile) * tile

@@ -103,19 +103,30 @@ def initialize_model_parallel(
     shape = (dp, ep, pp, cp, tp)
     arr = None
     if devices is None:
-        n_slices = getattr(jax, "process_count", lambda: 1)()
+        # DCN granules: TPU pods group devices by slice_index (a slice
+        # may hold several hosts — process_count is NOT the slice
+        # count); non-TPU multi-host backends have no slice_index and
+        # granulate by process instead.
+        slice_ids = {getattr(d, "slice_index", None) for d in devs}
+        if None in slice_ids:
+            n_granules = getattr(jax, "process_count", lambda: 1)()
+            granule_kw = {"process_is_granule": True}
+        else:
+            n_granules = len(slice_ids)
+            granule_kw = {}
         try:
             from jax.experimental import mesh_utils
 
-            if n_slices > 1 and dp % n_slices == 0:
+            if n_granules > 1 and dp % n_granules == 0:
                 try:
                     arr = mesh_utils.create_hybrid_device_mesh(
-                        (dp // n_slices, ep, pp, cp, tp),
-                        (n_slices, 1, 1, 1, 1),
-                        devices=devs, allow_split_physical_axes=True)
+                        (dp // n_granules, ep, pp, cp, tp),
+                        (n_granules, 1, 1, 1, 1),
+                        devices=devs, allow_split_physical_axes=True,
+                        **granule_kw)
                 except Exception:  # noqa: BLE001
                     # hybrid shape unsatisfiable (e.g. model axes larger
-                    # than a slice) — single-level assignment still
+                    # than a granule) — single-level assignment still
                     # recovers intra-slice ICI adjacency
                     arr = None
             if arr is None:
