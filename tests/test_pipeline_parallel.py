@@ -240,6 +240,42 @@ class TestSchedules:
             np.asarray(grads), np.asarray(ref_grads), rtol=1e-3, atol=1e-4
         )
 
+    def test_interleaved_forward_only(self, pp_mesh, rng):
+        """forward_only=True returns (loss, None) and the loss equals
+        the grad-producing run's."""
+        width, m, vpp = 8, 4, 2
+        ws = jnp.asarray(rng.randn(PP, vpp, width, width) * 0.2, jnp.float32)
+        batch = jnp.asarray(rng.randn(m * 2, width), jnp.float32)
+
+        def stage_fn(params, h, chunk_id):
+            return jnp.tanh(h @ params[0, chunk_id])
+
+        def loss_fn(y, mb):
+            return jnp.mean(y ** 2)
+
+        grads_seen = []
+
+        def call(p, b, forward_only):
+            loss, grads = forward_backward_pipelining_with_interleaving(
+                stage_fn, loss_fn, None, p, b,
+                num_microbatches=m, num_model_chunks=vpp,
+                forward_only=forward_only)
+            grads_seen.append(grads)
+            return loss
+
+        def run(forward_only):
+            fn = shard_map(
+                lambda p, b: call(p, b, forward_only),
+                mesh=pp_mesh,
+                in_specs=(P("pipe", None, None, None), P()),
+                out_specs=P(), check_vma=False,
+            )
+            return float(np.ravel(jax.jit(fn)(ws, batch))[0])
+
+        loss_fwd_only = run(True)
+        assert grads_seen[0] is None        # forward_only returns no grads
+        np.testing.assert_allclose(loss_fwd_only, run(False), rtol=1e-6)
+
     def test_get_forward_backward_func(self):
         assert get_forward_backward_func(None, 1) is forward_backward_no_pipelining
         assert (
