@@ -499,8 +499,8 @@ def ulysses_attention(
     if k.shape[1] % cp:
         raise ValueError(
             f"kv heads ({k.shape[1]}) must be divisible by cp={cp} for "
-            f"the all_to_all head resharding (GQA with fewer kv heads "
-            f"than cp needs ring attention instead)")
+            f"the all_to_all head resharding (kv head counts not "
+            f"divisible by cp need ring attention instead)")
 
     def to_seq(x):  # (b, h, s/cp, d) -> (b, h/cp, S, d)
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,

@@ -178,6 +178,28 @@ def tune_softmax():
             print(f"  {impl:8s}  FAIL {str(e)[:60]}")
 
 
+def _sweep_tile_rows(label, step_fn, args, n, accesses_per_elem):
+    """Sweep engine.DEFAULT_TILE_ROWS for one fused-update step.
+
+    ``accesses_per_elem`` = fp32 reads+writes per element (drives the
+    achieved-GB/s column; keep it in sync with the op's actual traffic).
+    """
+    from apex_tpu.multi_tensor import engine
+
+    print(f"{label} n={n}")
+    orig = engine.DEFAULT_TILE_ROWS
+    for tile_rows in (128, 256, 512, 1024, 2048):
+        engine.DEFAULT_TILE_ROWS = tile_rows
+        try:
+            t = _time(step_fn, *args, iters=3, chain=5)
+            gbps = accesses_per_elem * n * 4 / t / 1e9
+            print(f"  tile_rows={tile_rows:5d}  {t*1e3:8.3f} ms "
+                  f"({gbps:6.1f} GB/s)")
+        except Exception as e:  # noqa: BLE001
+            print(f"  tile_rows={tile_rows:5d}  FAIL {str(e)[:60]}")
+    engine.DEFAULT_TILE_ROWS = orig
+
+
 def tune_opt():
     import jax
     import jax.numpy as jnp
@@ -191,34 +213,37 @@ def tune_opt():
     m = jnp.zeros_like(p)
     v = jnp.zeros_like(p)
 
-    print(f"fused adam update n={n}")
-    from apex_tpu.multi_tensor import engine
-    orig = engine.DEFAULT_TILE_ROWS
-    for tile_rows in (128, 256, 512, 1024, 2048):
-        engine.DEFAULT_TILE_ROWS = tile_rows
-
-        def step(p, m, v, g):
-            p2, m2, v2, f = mt.fused_adam_update(
-                p, m, v, g, lr=1e-3, step=2, weight_decay=0.01,
-                impl="pallas")
-            return (p2, m2, v2)
-
-        try:
-            t = _time(step, p, m, v, g, iters=3, chain=5)
-            gbps = 7 * n * 4 / t / 1e9   # 4 reads + 3 writes
-            print(f"  tile_rows={tile_rows:5d}  {t*1e3:8.3f} ms "
-                  f"({gbps:6.1f} GB/s)")
-        except Exception as e:  # noqa: BLE001
-            print(f"  tile_rows={tile_rows:5d}  FAIL {str(e)[:60]}")
-    engine.DEFAULT_TILE_ROWS = orig
-
-    def xla_step(p, m, v, g):
+    def adam_step(p, m, v, g, impl="pallas"):
         p2, m2, v2, f = mt.fused_adam_update(
-            p, m, v, g, lr=1e-3, step=2, weight_decay=0.01, impl="xla")
+            p, m, v, g, lr=1e-3, step=2, weight_decay=0.01, impl=impl)
         return (p2, m2, v2)
 
-    t = _time(xla_step, p, m, v, g, iters=3, chain=5)
+    # adam: reads p/m/v/g + writes p/m/v = 7 accesses per element
+    _sweep_tile_rows("fused adam update", adam_step, (p, m, v, g), n, 7)
+    t = _time(lambda *a: adam_step(*a, impl="xla"), p, m, v, g,
+              iters=3, chain=5)
     print(f"  xla reference     {t*1e3:8.3f} ms ({7*n*4/t/1e9:6.1f} GB/s)")
+
+    # LAMB with the stage-1-fused per-tensor norm partials: sweep the
+    # stage-1 tile (read via DEFAULT_TILE_ROWS at call time). Layout
+    # only needs shapes/dtypes — no device zeros materialized.
+    tree = {f"p{i}": jax.ShapeDtypeStruct((4096, 1024), jnp.float32)
+            for i in range(16)}
+    space = mt.FlatSpace.create(tree)
+    pL = jnp.asarray(rng.randn(space.total).astype(np.float32))
+    gL = jnp.asarray(rng.randn(space.total).astype(np.float32) * 1e-3)
+    mL = jnp.zeros_like(pL)
+    vL = jnp.zeros_like(pL)
+
+    def lamb_step(p, m_, v_, g_):
+        p2, m2, v2, f = mt.fused_lamb_update(
+            p, m_, v_, g_, space, lr=1e-3, step=2, weight_decay=0.01,
+            impl="pallas")
+        return (p2, m2, v2)
+
+    # stage 1: 4 reads + 3 writes; stage 2: 2 reads + 1 write = 10
+    _sweep_tile_rows("fused lamb update (stage-1-fused norms)",
+                     lamb_step, (pL, mL, vL, gL), space.total, 10)
 
 
 ALL = {"attn": tune_attn, "attnbwd": tune_attn_bwd, "ln": tune_ln,
