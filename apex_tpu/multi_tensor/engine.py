@@ -31,8 +31,11 @@ LANES = 128
 # 512 rows x 128 lanes = 65536 elements per tile, matching the reference's
 # large multi-tensor chunk size (ref: apex/multi_tensor_apply/__init__.py:4).
 DEFAULT_TILE_ROWS = 512
-# Per-tensor ops use the alignment-sized tile so a tile never straddles
-# a leaf (see FlatSpace.tile_leaf_ids).
+# The per-tensor SUBTILE quantum: tile_ids carry one leaf id per
+# (PER_TENSOR_TILE_ROWS * LANES) elements — the FlatSpace alignment —
+# so ids never straddle a leaf regardless of the sweep tile size
+# (see FlatSpace.tile_leaf_ids; kernels gather `tile_rows/16` ids per
+# big tile).
 PER_TENSOR_TILE_ROWS = 16
 
 
@@ -63,6 +66,16 @@ def fused_elementwise(
     ``ins`` are same-shape blocks, ``scalars`` are 0-d values and
     ``tensor_scalars`` are values broadcastable against the blocks
     (per-tensor values resolved through ``tile_ids``).
+
+    ``tile_ids`` is SUBTILE-granular: one leaf id per
+    ``PER_TENSOR_TILE_ROWS * LANES`` elements (the FlatSpace alignment
+    quantum) — i.e. exactly ``FlatSpace.tile_leaf_ids(2048)``. Sweeps
+    still run at ``tile_rows`` (default DEFAULT_TILE_ROWS): the kernel
+    gathers the tile's ``tile_rows/16`` ids and broadcasts each
+    subtile's value over its rows, so per-tensor ops get big-tile grids
+    (32x fewer steps than one-id-per-tile tiling) without a tile ever
+    straddling a leaf. Pass ``tile_rows=PER_TENSOR_TILE_ROWS`` to
+    force the one-id-per-tile layout.
 
     ``aliases`` maps input position (into ``inputs``) -> output position:
     the output may reuse the input's buffer (the TPU analog of the
@@ -95,15 +108,16 @@ def fused_elementwise(
         out_dtypes = [inputs[0].dtype] * num_outputs
 
     if tile_rows is None:
-        tile_rows = PER_TENSOR_TILE_ROWS if tile_ids is not None else DEFAULT_TILE_ROWS
+        tile_rows = DEFAULT_TILE_ROWS
     tile = tile_rows * LANES
     for kind, idx in sumsq_subtiles:
         if kind not in ("in", "out") or not (
                 0 <= idx < (len(inputs) if kind == "in" else num_outputs)):
             raise ValueError(f"bad sumsq_subtiles entry {(kind, idx)}")
-    if sumsq_subtiles and tile_rows % PER_TENSOR_TILE_ROWS:
+    if (sumsq_subtiles or tile_ids is not None) \
+            and tile_rows % PER_TENSOR_TILE_ROWS:
         raise ValueError(
-            f"sumsq_subtiles needs tile_rows divisible by "
+            f"sumsq_subtiles/tile_ids need tile_rows divisible by "
             f"{PER_TENSOR_TILE_ROWS}, got {tile_rows}")
     sub = tile_rows // PER_TENSOR_TILE_ROWS
 
@@ -119,10 +133,15 @@ def fused_elementwise(
     bufs = [_pad_to(b, padded_n) for b in inputs]
     num_tiles = padded_n // tile
     if tile_ids is not None:
+        # SUBTILE-granular leaf map: one id per PER_TENSOR_TILE_ROWS*LANES
+        # elements (the FlatSpace alignment quantum), so per-tensor ops
+        # can sweep at the big tile size — the kernel gathers `sub` ids
+        # per tile instead of shrinking the grid 32x to one-id-per-tile
         tile_ids = np.asarray(tile_ids, np.int32)
-        if tile_ids.shape[0] * tile != padded_n:
+        want = num_tiles * sub
+        if tile_ids.shape[0] != want:
             # pad map for the trailing partial tile (maps to last leaf)
-            extra = padded_n // tile - tile_ids.shape[0]
+            extra = want - tile_ids.shape[0]
             tile_ids = np.concatenate([tile_ids, np.full(extra, tile_ids[-1] if len(tile_ids) else 0, np.int32)])
 
     n_in = len(bufs)
@@ -150,8 +169,21 @@ def fused_elementwise(
 
         svals = [scalar_ref[j] for j in range(len(scalars))]
         if has_ids:
-            tid = ids_ref[i]
-            tvals = [r[tid] for r in pt_refs]
+            if sub == 1:
+                tid = ids_ref[i]
+                tvals = [r[tid] for r in pt_refs]
+            else:
+                # gather the tile's `sub` leaf ids (unrolled SMEM reads)
+                # and broadcast each subtile's value over its rows —
+                # per-tensor semantics at the big-tile grid size
+                tids = [ids_ref[i * sub + j] for j in range(sub)]
+                tvals = []
+                for r in pt_refs:
+                    vals = jnp.stack([r[t] for t in tids])      # (sub,)
+                    tvals.append(jnp.broadcast_to(
+                        vals.reshape(sub, 1, 1),
+                        (sub, PER_TENSOR_TILE_ROWS, 1),
+                    ).reshape(tile_rows, 1))
         else:
             tvals = [r[0] for r in pt_refs]
 
@@ -271,11 +303,18 @@ def _fused_elementwise_xla(
 ):
     """Pure-XLA reference path (CPU tests, simulated meshes)."""
     n = inputs[0].shape[0]
+    sub_elems = PER_TENSOR_TILE_ROWS * LANES
     if tile_ids is not None:
-        padded_n = tile_ids.shape[0] * tile
-        bufs = [_pad_to(b, padded_n).reshape(-1, tile) for b in inputs]
+        # tile_ids are SUBTILE-granular (one per alignment quantum);
+        # XLA has no grid to amortize, so blocks reshape at subtile
+        # granularity and values broadcast as (n_subtiles, 1) — never
+        # materialized per element
+        padded_n = tile_ids.shape[0] * sub_elems
+        bufs = [_pad_to(b, padded_n).reshape(-1, sub_elems)
+                for b in inputs]
         ids = jnp.asarray(tile_ids)
-        tvals = [jnp.asarray(p, jnp.float32)[ids][:, None] for p in per_tensor]
+        tvals = [jnp.asarray(p, jnp.float32)[ids][:, None]
+                 for p in per_tensor]
     else:
         bufs = list(inputs)
         tvals = [jnp.asarray(p, jnp.float32) for p in per_tensor]
