@@ -75,3 +75,51 @@ probe("2d (8,128) int-ish of (64,256)", (64, 256), (8, 128),
 probe("2d (1,1) of (1,1)", (1, 1), (1, 1), lambda: (0, 0), ())
 # (bq,128) scratch-like full-dim equality: (16,128) of (16,128)
 probe("2d (16,128) of (16,128)", (16, 128), (16, 128), lambda: (0, 0), ())
+
+
+def probe_subtile_gather():
+    """The engine's big-tile per-tensor pattern (multi_tensor/engine.py):
+    gather `sub` leaf ids from a scalar-prefetch SMEM array, stack the
+    per-leaf values, broadcast each over its subtile's rows. Probing it
+    in isolation triages a Mosaic rejection without compiling the whole
+    LAMB kernel."""
+    tile_rows, lanes, sub = 512, 128, 32
+    n_tiles = 2
+    ids = jnp.asarray(np.arange(n_tiles * sub) % 5, jnp.int32)
+    vals = jnp.arange(5, dtype=jnp.float32) + 1.0
+    x = jnp.ones((n_tiles * tile_rows, lanes), jnp.float32)
+
+    def kernel(ids_ref, vals_ref, x_ref, o_ref):
+        i = pl.program_id(0)
+        tids = [ids_ref[i * sub + j] for j in range(sub)]
+        v = jnp.stack([vals_ref[t] for t in tids])
+        v = jnp.broadcast_to(
+            v.reshape(sub, 1, 1), (sub, tile_rows // sub, 1)
+        ).reshape(tile_rows, 1)
+        o_ref[...] = x_ref[...] * v
+
+    try:
+        out = pl.pallas_call(
+            kernel,
+            grid_spec=pltpu.PrefetchScalarGridSpec(
+                num_scalar_prefetch=2,
+                grid=(n_tiles,),
+                in_specs=[pl.BlockSpec((tile_rows, lanes),
+                                       lambda i, *_: (i, 0))],
+                out_specs=pl.BlockSpec((tile_rows, lanes),
+                                       lambda i, *_: (i, 0)),
+            ),
+            out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        )(ids, vals, x)
+        want = np.repeat(
+            np.asarray(vals)[np.asarray(ids)], tile_rows // sub
+        )[:, None] * np.ones((1, lanes), np.float32)
+        ok = bool(jnp.allclose(out, want))
+        print(f"  [{'PASS' if ok else 'WRONG'}] subtile gather "
+              f"(stack of {sub} SMEM scalar reads + broadcast)")
+    except Exception as e:  # noqa: BLE001
+        msg = str(e).split("\n")[0][:110]
+        print(f"  [FAIL] subtile gather: {msg}")
+
+
+probe_subtile_gather()
