@@ -179,6 +179,33 @@ class TestScaleAxpbyL2norm:
                               out_dtypes=[jnp.float32], impl=impl,
                               sumsq_subtiles=(("out", 3),))
 
+    @pytest.mark.parametrize("tile_rows", [16, 128, 512])
+    def test_per_tensor_values_any_tile_size(self, rng, impl, tile_rows):
+        """Subtile-granular tile_ids give identical per-tensor semantics
+        at every sweep tile size: sub=1 (the documented Mosaic
+        mitigation path), sub=8, and the default sub=32."""
+        from apex_tpu.multi_tensor.engine import fused_elementwise
+        from apex_tpu.multi_tensor.ops import _PT_TILE
+
+        tree = make_tree(rng)
+        space = FlatSpace.create(tree)
+        buf = space.pack(tree)
+        per_leaf = jnp.arange(space.num_leaves, dtype=jnp.float32) + 2.0
+
+        def fn(ins, s, t):
+            (x,) = [i.astype(jnp.float32) for i in ins]
+            (r,) = t
+            return [x * r]
+
+        (out,), _ = fused_elementwise(
+            fn, [buf], per_tensor=[per_leaf],
+            tile_ids=space.tile_leaf_ids(_PT_TILE),
+            num_outputs=1, out_dtypes=[jnp.float32], impl=impl,
+            tile_rows=tile_rows)
+        want = buf * space.elementwise_leaf_values(per_leaf)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(want),
+                                   rtol=1e-6)
+
     def test_sumsq_subtiles_pad_clean(self, rng, impl):
         """fn's image of the zero tail-pad (fn(0) != 0 here) must never
         leak into the partials: summing ALL partials equals the exact
