@@ -34,8 +34,8 @@ DEFAULT_TILE_ROWS = 512
 # The per-tensor SUBTILE quantum: tile_ids carry one leaf id per
 # (PER_TENSOR_TILE_ROWS * LANES) elements — the FlatSpace alignment —
 # so ids never straddle a leaf regardless of the sweep tile size
-# (see FlatSpace.tile_leaf_ids; kernels gather `tile_rows/16` ids per
-# big tile).
+# (see FlatSpace.tile_leaf_ids; ids resolve to per-row values in XLA
+# outside the kernel).
 PER_TENSOR_TILE_ROWS = 16
 
 
@@ -70,12 +70,15 @@ def fused_elementwise(
     ``tile_ids`` is SUBTILE-granular: one leaf id per
     ``PER_TENSOR_TILE_ROWS * LANES`` elements (the FlatSpace alignment
     quantum) — i.e. exactly ``FlatSpace.tile_leaf_ids(2048)``. Sweeps
-    still run at ``tile_rows`` (default DEFAULT_TILE_ROWS): the kernel
-    gathers the tile's ``tile_rows/16`` ids and broadcasts each
-    subtile's value over its rows, so per-tensor ops get big-tile grids
-    (32x fewer steps than one-id-per-tile tiling) without a tile ever
-    straddling a leaf. Pass ``tile_rows=PER_TENSOR_TILE_ROWS`` to
-    force the one-id-per-tile layout.
+    still run at ``tile_rows`` (default DEFAULT_TILE_ROWS): the
+    id->value resolution happens OUTSIDE the kernel (a tiny XLA gather
+    producing one fp32 per buffer row, ~n/128 elements), and the kernel
+    reads the per-row values as a (tile_rows, 1) VMEM block alongside
+    the data tile. Per-tensor ops thus keep big-tile grids (32x fewer
+    steps than one-id-per-tile tiling) without the kernel ever doing a
+    dynamic SMEM gather — stacked dynamic scalar reads are exactly the
+    construct Mosaic's compiler rejects at sub>1 (measured on-chip,
+    docs/HARDWARE_NOTES.md round 3).
 
     ``aliases`` maps input position (into ``inputs``) -> output position:
     the output may reuse the input's buffer (the TPU analog of the
@@ -132,31 +135,45 @@ def fused_elementwise(
     padded_n = ((n + tile - 1) // tile) * tile
     bufs = [_pad_to(b, padded_n) for b in inputs]
     num_tiles = padded_n // tile
+    pt_rows = []
     if tile_ids is not None:
         # SUBTILE-granular leaf map: one id per PER_TENSOR_TILE_ROWS*LANES
-        # elements (the FlatSpace alignment quantum), so per-tensor ops
-        # can sweep at the big tile size — the kernel gathers `sub` ids
-        # per tile instead of shrinking the grid 32x to one-id-per-tile
+        # elements (the FlatSpace alignment quantum). Resolve ids to
+        # values OUTSIDE the kernel: a (num_rows, 1) fp32 array of each
+        # row's per-tensor value (rows never straddle a leaf because
+        # FlatSpace aligns leaves to the subtile quantum). The kernel
+        # then reads a (tile_rows, 1) VMEM block per tile — no dynamic
+        # SMEM gather, which Mosaic's compiler crashes on at sub>1.
+        # Cost: one extra fp32 per 128 data elements of read traffic.
         tile_ids = np.asarray(tile_ids, np.int32)
         want = num_tiles * sub
         if tile_ids.shape[0] != want:
             # pad map for the trailing partial tile (maps to last leaf)
             extra = want - tile_ids.shape[0]
             tile_ids = np.concatenate([tile_ids, np.full(extra, tile_ids[-1] if len(tile_ids) else 0, np.int32)])
+        ids = jnp.asarray(tile_ids)
+        pt_rows = [
+            jnp.repeat(jnp.asarray(p, jnp.float32)[ids],
+                       PER_TENSOR_TILE_ROWS).reshape(-1, 1)
+            for p in per_tensor
+        ]
 
     n_in = len(bufs)
     n_pt = len(per_tensor)
     has_ids = tile_ids is not None
 
     def kernel(*refs):
-        # prefetch refs: scalars_ref, [ids_ref], per_tensor refs...
+        # ref order: scalars prefetch, [pt prefetch when no ids],
+        # data inputs, [per-row pt values when ids], outputs...
         k = 0
         scalar_ref = refs[k]; k += 1
-        ids_ref = None
-        if has_ids:
-            ids_ref = refs[k]; k += 1
-        pt_refs = refs[k : k + n_pt]; k += n_pt
+        pt_sc_refs = ()
+        if not has_ids:
+            pt_sc_refs = refs[k : k + n_pt]; k += n_pt
         in_refs = refs[k : k + n_in]; k += n_in
+        ptv_refs = ()
+        if has_ids:
+            ptv_refs = refs[k : k + n_pt]; k += n_pt
         out_refs = refs[k : k + num_outputs]; k += num_outputs
         found_ref = refs[k]; k += 1
         sq_refs = refs[k : k + len(sumsq_subtiles)]
@@ -169,23 +186,11 @@ def fused_elementwise(
 
         svals = [scalar_ref[j] for j in range(len(scalars))]
         if has_ids:
-            if sub == 1:
-                tid = ids_ref[i]
-                tvals = [r[tid] for r in pt_refs]
-            else:
-                # gather the tile's `sub` leaf ids (unrolled SMEM reads)
-                # and broadcast each subtile's value over its rows —
-                # per-tensor semantics at the big-tile grid size
-                tids = [ids_ref[i * sub + j] for j in range(sub)]
-                tvals = []
-                for r in pt_refs:
-                    vals = jnp.stack([r[t] for t in tids])      # (sub,)
-                    tvals.append(jnp.broadcast_to(
-                        vals.reshape(sub, 1, 1),
-                        (sub, PER_TENSOR_TILE_ROWS, 1),
-                    ).reshape(tile_rows, 1))
+            # (tile_rows, 1) per-row values, pre-resolved outside the
+            # kernel; broadcasts against the (tile_rows, LANES) blocks
+            tvals = [r[...] for r in ptv_refs]
         else:
-            tvals = [r[0] for r in pt_refs]
+            tvals = [r[0] for r in pt_sc_refs]
 
         ins = [r[...] for r in in_refs]
         if check_finite:
@@ -220,13 +225,18 @@ def fused_elementwise(
 
     # index maps receive (grid idx, *prefetch refs) under PrefetchScalarGridSpec
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1 + (1 if has_ids else 0) + n_pt,
+        num_scalar_prefetch=1 + (0 if has_ids else n_pt),
         grid=(num_tiles,),
         in_specs=[
             pl.BlockSpec(
                 (tile_rows, LANES), lambda i, *_: (i, 0), memory_space=pltpu.VMEM
             )
             for _ in range(n_in)
+        ] + [
+            pl.BlockSpec(
+                (tile_rows, 1), lambda i, *_: (i, 0), memory_space=pltpu.VMEM
+            )
+            for _ in pt_rows
         ],
         out_specs=(
             [
@@ -248,9 +258,8 @@ def fused_elementwise(
         jnp.stack(scalars) if scalars else jnp.zeros((1,), jnp.float32)
     )
     prefetch = [scalar_arg]
-    if has_ids:
-        prefetch.append(jnp.asarray(tile_ids))
-    prefetch.extend(jnp.asarray(p, jnp.float32) for p in per_tensor)
+    if not has_ids:
+        prefetch.extend(jnp.asarray(p, jnp.float32) for p in per_tensor)
 
     out_shapes = (
         [jax.ShapeDtypeStruct((padded_n // LANES, LANES), dt)
@@ -289,7 +298,8 @@ def fused_elementwise(
         out_shape=out_shapes,
         input_output_aliases=io_aliases,
         interpret=interpret_flag(impl),
-    )(*prefetch, *[b.reshape(padded_n // LANES, LANES) for b in bufs])
+    )(*prefetch, *[b.reshape(padded_n // LANES, LANES) for b in bufs],
+      *pt_rows)
 
     outs = [r.reshape(padded_n)[:n] for r in results[:num_outputs]]
     found = results[num_outputs][0, 0]

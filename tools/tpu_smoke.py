@@ -54,7 +54,7 @@ def _time(fn, *args, iters=30, warmup=2, chain=20):
     return (time.perf_counter() - t0) / (iters * chain)
 
 
-def run(perf=False, kimpl="pallas"):
+def run(perf=False, kimpl="pallas", only=None):
     import jax
     import jax.numpy as jnp
 
@@ -65,6 +65,8 @@ def run(perf=False, kimpl="pallas"):
         """Compare impl='pallas' vs impl='xla' outputs (and grads)."""
         import functools
 
+        if only and only not in name:
+            return
         try:
             f_p = jax.jit(functools.partial(fn, impl=kimpl))
             f_x = jax.jit(functools.partial(fn, impl="xla"))
@@ -148,6 +150,11 @@ def run(perf=False, kimpl="pallas"):
               p, m_, jnp.zeros((space.num_leaves,), jnp.float32), g, space,
               lr=1e-3, beta1=0.95, beta2=0.98, eps=1e-8, step=1,
               weight_decay=0.01, impl=impl),
+          buf, gbuf, m, tol=1e-4)
+    check("fused_lars_update",
+          lambda p, g, m_, impl: mt.fused_lars_update(
+              p, m_, g, space, lr=1e-2, momentum=0.9, weight_decay=1e-4,
+              trust_coefficient=0.02, impl=impl),
           buf, gbuf, m, tol=1e-4)
 
     # ---- layer norm / rms norm ---------------------------------------
@@ -302,10 +309,13 @@ if __name__ == "__main__":
                     choices=("pallas", "interpret"),
                     help="kernel impl to compare against the XLA path "
                          "(interpret = CPU logic check)")
+    ap.add_argument("--only", default=None,
+                    help="substring filter: run only configs whose name "
+                         "contains this (targeted hardware re-checks)")
     args = ap.parse_args()
     from apex_tpu.backend_guard import tpu_slot_lock
 
     # the tunnel serves ONE client; serialize against bench/tune runs
     # (the lock warns on stderr itself if it can't be acquired)
     with tpu_slot_lock():
-        sys.exit(run(perf=args.perf, kimpl=args.impl))
+        sys.exit(run(perf=args.perf, kimpl=args.impl, only=args.only))

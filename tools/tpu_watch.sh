@@ -1,0 +1,26 @@
+#!/bin/bash
+# Tunnel watcher: probe the single-slot TPU tunnel until it answers,
+# then run the full measurement runbook ONCE and exit.
+#
+# The tunnel wedges for long stretches after killed/OOM'd clients
+# (docs/HARDWARE_NOTES.md "Known tunnel behaviors"); this keeps a
+# session's hardware queue alive without a human re-trying. Each probe
+# is a 120 s-timeout subprocess (apex_tpu.backend_guard), so a wedged
+# tunnel can never hang the watcher itself.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${INTERVAL:-480}
+while true; do
+  if timeout 150 python -c "
+from apex_tpu.backend_guard import probe_default_backend as p
+import sys
+r = p()
+print(r, flush=True)
+sys.exit(0 if r.get('ok') and r.get('platform') == 'tpu' else 1)
+"; then
+    echo "tunnel up $(date -u +%H:%M:%S); launching runbook"
+    LOGDIR=${LOGDIR:-/tmp/tpu_runbook_auto} exec bash tools/tpu_runbook.sh
+  fi
+  echo "tunnel down $(date -u +%H:%M:%S); retry in ${INTERVAL}s"
+  sleep "$INTERVAL"
+done
