@@ -45,6 +45,26 @@ def _pad_to(buf: jax.Array, n: int) -> jax.Array:
     return jnp.pad(buf, (0, n - buf.shape[0]))
 
 
+def _sr_cast_emulated(x: jax.Array, seed, salt: int) -> jax.Array:
+    """fp32 -> bf16 stochastic round for the xla/interpret paths.
+
+    Emulates ``pltpu.stochastic_round`` (which only lowers on real TPU):
+    add uniform random low bits below the bf16 mantissa boundary, then
+    truncate. E[result] == x exactly; non-finite values pass through a
+    nearest cast (adding bits to an inf/nan pattern could change its
+    class).
+    """
+    xf = x.astype(jnp.float32)
+    key = jax.random.fold_in(
+        jax.random.PRNGKey(jnp.asarray(seed, jnp.uint32)), salt)
+    bits = jax.random.bits(key, xf.shape, jnp.uint32)
+    xi = jax.lax.bitcast_convert_type(xf, jnp.uint32)
+    trunc = jax.lax.bitcast_convert_type(
+        (xi + (bits & jnp.uint32(0xFFFF))) & jnp.uint32(0xFFFF0000),
+        jnp.float32)
+    return jnp.where(jnp.isfinite(xf), trunc, xf).astype(jnp.bfloat16)
+
+
 def fused_elementwise(
     fn: Callable,
     inputs: Sequence[jax.Array],
@@ -59,6 +79,8 @@ def fused_elementwise(
     tile_rows: Optional[int] = None,
     aliases: Optional[dict] = None,
     sumsq_subtiles: Sequence = (),
+    sr_outputs: Sequence[int] = (),
+    sr_seed=None,
 ):
     """Run ``fn`` element-wise over 1-D buffers in one fused kernel.
 
@@ -100,6 +122,19 @@ def fused_elementwise(
     exact per-tensor norms without re-reading the buffer — the fusion
     LAMB uses to fold its ||p||/||update|| passes into stage 1.
 
+    ``sr_outputs`` lists output indices to write with **stochastic
+    rounding** to bfloat16 (their ``out_dtypes`` entry must be bf16,
+    and ``sr_seed`` — an int32 scalar, traced OK — must be given). This
+    is the TPU-native replacement for the reference's fp32 master-copy
+    discipline (ref: csrc/multi_tensor_lamb_mp.cu mixed param/state
+    dtypes): E[rounded] equals the fp32 value, so sub-ulp updates
+    accumulate in expectation instead of being lost to nearest
+    rounding, letting params (and optimizer state) live in bf16 with
+    no master at half the HBM traffic. On compiled TPU the rounding
+    runs in-kernel via ``pltpu.stochastic_round`` seeded per
+    (sr_seed, tile); the xla/interpret paths emulate it with
+    ``jax.random`` bits (statistically identical, different stream).
+
     Returns ``(outputs, found_inf)`` where ``found_inf`` is a float32
     scalar in {0, 1} covering the ``check_finite`` input indices.
     """
@@ -124,12 +159,25 @@ def fused_elementwise(
             f"{PER_TENSOR_TILE_ROWS}, got {tile_rows}")
     sub = tile_rows // PER_TENSOR_TILE_ROWS
 
+    sr_outputs = tuple(sr_outputs)
+    if sr_outputs:
+        if sr_seed is None:
+            raise ValueError("sr_outputs requires sr_seed")
+        for j in sr_outputs:
+            if not 0 <= j < num_outputs:
+                raise ValueError(f"sr output {j} out of range")
+            if jnp.dtype(out_dtypes[j]) != jnp.bfloat16:
+                raise ValueError(
+                    f"stochastic rounding targets bfloat16 outputs; "
+                    f"output {j} is {out_dtypes[j]}")
+
     scalars = [jnp.asarray(s, jnp.float32) for s in scalars]
 
     if impl == "xla":
         return _fused_elementwise_xla(
             fn, inputs, scalars, num_outputs, out_dtypes, check_finite,
             tile_ids, per_tensor, tile, sumsq_subtiles,
+            sr_outputs, sr_seed,
         )
 
     padded_n = ((n + tile - 1) // tile) * tile
@@ -161,15 +209,28 @@ def fused_elementwise(
     n_in = len(bufs)
     n_pt = len(per_tensor)
     has_ids = tile_ids is not None
+    is_interp = bool(interpret_flag(impl))
+    # in-kernel SR lowers only through Mosaic (prng_seed has no CPU
+    # rule); interpret mode writes fp32 and SR-casts after the call
+    sr_in_kernel = bool(sr_outputs) and not is_interp
+    sr_post = set(sr_outputs) if (sr_outputs and is_interp) else set()
+    kernel_out_dtypes = [
+        jnp.float32 if j in sr_post else dt
+        for j, dt in enumerate(out_dtypes)
+    ]
 
     def kernel(*refs):
         # ref order: scalars prefetch, [pt prefetch when no ids],
-        # data inputs, [per-row pt values when ids], outputs...
+        # [sr seed prefetch], data inputs, [per-row pt values when
+        # ids], outputs...
         k = 0
         scalar_ref = refs[k]; k += 1
         pt_sc_refs = ()
         if not has_ids:
             pt_sc_refs = refs[k : k + n_pt]; k += n_pt
+        sr_ref = None
+        if sr_in_kernel:
+            sr_ref = refs[k]; k += 1
         in_refs = refs[k : k + n_in]; k += n_in
         ptv_refs = ()
         if has_ids:
@@ -201,8 +262,18 @@ def fused_elementwise(
                 found_ref[0, 0], jnp.where(ok, 0.0, 1.0).astype(jnp.float32)
             )
         outs = fn(ins, svals, tvals)
-        for r, o in zip(out_refs, outs):
-            r[...] = o.astype(r.dtype)
+        if sr_in_kernel:
+            # one per-tile stream: (sr_seed, tile index); successive
+            # random_bits calls for multiple SR outputs continue it
+            pltpu.prng_seed(sr_ref[0], i)
+        for j, (r, o) in enumerate(zip(out_refs, outs)):
+            if sr_in_kernel and j in sr_outputs:
+                bits = jax.lax.bitcast_convert_type(
+                    pltpu.prng_random_bits(o.shape), jnp.uint32)
+                r[...] = pltpu.stochastic_round(
+                    o.astype(jnp.float32), bits, target_dtype=r.dtype)
+            else:
+                r[...] = o.astype(r.dtype)
         if sumsq_subtiles:
             # mask the tail pad so partials never include fn's image of
             # the zero padding (fn(0) may be nonzero) — keeps pallas and
@@ -225,7 +296,8 @@ def fused_elementwise(
 
     # index maps receive (grid idx, *prefetch refs) under PrefetchScalarGridSpec
     grid_spec = pltpu.PrefetchScalarGridSpec(
-        num_scalar_prefetch=1 + (0 if has_ids else n_pt),
+        num_scalar_prefetch=(1 + (0 if has_ids else n_pt)
+                             + (1 if sr_in_kernel else 0)),
         grid=(num_tiles,),
         in_specs=[
             pl.BlockSpec(
@@ -260,10 +332,12 @@ def fused_elementwise(
     prefetch = [scalar_arg]
     if not has_ids:
         prefetch.extend(jnp.asarray(p, jnp.float32) for p in per_tensor)
+    if sr_in_kernel:
+        prefetch.append(jnp.asarray(sr_seed, jnp.int32).reshape(1))
 
     out_shapes = (
         [jax.ShapeDtypeStruct((padded_n // LANES, LANES), dt)
-         for dt in out_dtypes]
+         for dt in kernel_out_dtypes]
         + [jax.ShapeDtypeStruct((1, 1), jnp.float32)]
         + [jax.ShapeDtypeStruct((num_tiles, sub, LANES), jnp.float32)
            for _ in sumsq_subtiles]
@@ -279,6 +353,11 @@ def fused_elementwise(
                 raise ValueError(
                     f"alias {in_idx}->{out_idx} out of range: "
                     f"{len(inputs)} inputs, {num_outputs} outputs")
+            if out_idx in sr_post:
+                # interpret-mode SR writes fp32 storage then casts
+                # outside; the in-place reuse intentionally doesn't
+                # apply (CPU-only path, no warning needed)
+                continue
             if jnp.dtype(inputs[in_idx].dtype) == jnp.dtype(out_dtypes[out_idx]):
                 io_aliases[n_prefetch + in_idx] = out_idx
             else:
@@ -302,6 +381,11 @@ def fused_elementwise(
       *pt_rows)
 
     outs = [r.reshape(padded_n)[:n] for r in results[:num_outputs]]
+    if sr_post:
+        outs = [
+            _sr_cast_emulated(o, sr_seed, j) if j in sr_post else o
+            for j, o in enumerate(outs)
+        ]
     found = results[num_outputs][0, 0]
     outs.extend(results[num_outputs + 1:])      # sumsq partials, if any
     return outs, found
@@ -310,6 +394,7 @@ def fused_elementwise(
 def _fused_elementwise_xla(
     fn, inputs, scalars, num_outputs, out_dtypes, check_finite,
     tile_ids, per_tensor, tile, sumsq_subtiles=(),
+    sr_outputs=(), sr_seed=None,
 ):
     """Pure-XLA reference path (CPU tests, simulated meshes)."""
     n = inputs[0].shape[0]
@@ -334,10 +419,15 @@ def _fused_elementwise_xla(
             found, jnp.where(jnp.all(jnp.isfinite(bufs[idx])), 0.0, 1.0)
         )
     raw_outs = fn(bufs, scalars, tvals)
-    outs = [
-        o.reshape(-1)[:n].astype(dt) if tile_ids is not None else o.astype(dt)
-        for o, dt in zip(raw_outs, out_dtypes)
-    ]
+    sr = set(sr_outputs)
+
+    def final_cast(j, o, dt):
+        if tile_ids is not None:
+            o = o.reshape(-1)[:n]
+        return _sr_cast_emulated(o, sr_seed, j) if j in sr else o.astype(dt)
+
+    outs = [final_cast(j, o, dt)
+            for j, (o, dt) in enumerate(zip(raw_outs, out_dtypes))]
     if sumsq_subtiles:
         # mirror the kernel's (num_tiles, sub, LANES) partial layout
         num_tiles = -(-n // tile)

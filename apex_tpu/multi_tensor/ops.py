@@ -116,7 +116,7 @@ def fused_adam_update(
     p, m, v, g, *,
     lr, beta1=0.9, beta2=0.999, eps=1e-8, step=1,
     adam_w_mode=True, bias_correction=True, weight_decay=0.0,
-    grad_scale=1.0, impl=None,
+    grad_scale=1.0, impl=None, sr_seed=None,
 ):
     """One fused Adam/AdamW step over flat fp32 buffers.
 
@@ -154,6 +154,7 @@ def fused_adam_update(
         num_outputs=3, out_dtypes=[p.dtype, m.dtype, v.dtype],
         check_finite=(3,), impl=impl,
         aliases={0: 0, 1: 1, 2: 2},   # in-place p/m/v (ref in-place semantics)
+        sr_outputs=(0,) if sr_seed is not None else (), sr_seed=sr_seed,
     )
     return p2, m2, v2, found
 
@@ -164,7 +165,7 @@ def fused_adam_update(
 
 
 def fused_adagrad_update(p, h, g, *, lr, eps=1e-10, weight_decay=0.0,
-                         grad_scale=1.0, impl=None):
+                         grad_scale=1.0, impl=None, sr_seed=None):
     """h += g^2 ; p -= lr * g / (sqrt(h) + eps), L2-mode weight decay
     (ADAGRAD_MODE_0, ref csrc/multi_tensor_adagrad.cu:23-60)."""
 
@@ -181,6 +182,7 @@ def fused_adagrad_update(p, h, g, *, lr, eps=1e-10, weight_decay=0.0,
         num_outputs=2, out_dtypes=[p.dtype, h.dtype],
         check_finite=(2,), impl=impl,
         aliases={0: 0, 1: 1},
+        sr_outputs=(0,) if sr_seed is not None else (), sr_seed=sr_seed,
     )
     return p2, h2, found
 
@@ -194,6 +196,7 @@ def fused_sgd_update(
     p, mom, g, *,
     lr, momentum=0.0, dampening=0.0, nesterov=False, weight_decay=0.0,
     wd_after_momentum=False, scale=1.0, first_run=False, impl=None,
+    sr_seed=None,
 ):
     """One fused SGD step (momentum/nesterov/wd ordering per the reference).
 
@@ -223,6 +226,7 @@ def fused_sgd_update(
         num_outputs=2, out_dtypes=[p.dtype, mom.dtype],
         check_finite=(2,), impl=impl,
         aliases={0: 0, 1: 1},
+        sr_outputs=(0,) if sr_seed is not None else (), sr_seed=sr_seed,
     )
     return p2, mom2, found
 
@@ -296,7 +300,7 @@ def fused_lamb_update(
     lr, beta1=0.9, beta2=0.999, eps=1e-6, step=1,
     weight_decay=0.0, bias_correction=True, grad_averaging=True,
     max_grad_norm=0.0, adam_w_mode=True, use_nvlamb=False,
-    global_grad_norm=None, grad_scale=1.0, impl=None,
+    global_grad_norm=None, grad_scale=1.0, impl=None, sr_seed=None,
 ):
     """One fused LAMB step over flat fp32 buffers.
 
@@ -355,6 +359,7 @@ def fused_lamb_update(
         tile_ids=space.tile_leaf_ids(_PT_TILE),
         num_outputs=1, out_dtypes=[p.dtype], impl=impl,
         aliases={0: 0},
+        sr_outputs=(0,) if sr_seed is not None else (), sr_seed=sr_seed,
     )
     return p2, m2, v2, found
 
@@ -368,7 +373,7 @@ def fused_novograd_update(
     p, m, v_per_tensor, g, space: FlatSpace, *,
     lr, beta1=0.95, beta2=0.98, eps=1e-8, step=1,
     weight_decay=0.0, grad_averaging=True, bias_correction=False,
-    impl=None,
+    impl=None, sr_seed=None,
 ):
     """NovoGrad: second moment is a per-tensor *scalar* ||g||^2 EMA
     (ref csrc/multi_tensor_novograd.cu norm-per-tensor design).
@@ -406,6 +411,7 @@ def fused_novograd_update(
         num_outputs=2, out_dtypes=[p.dtype, m.dtype],
         check_finite=(2,), impl=impl,
         aliases={0: 0, 1: 1},
+        sr_outputs=(0,) if sr_seed is not None else (), sr_seed=sr_seed,
     )
     return p2, m2, v2, found
 
@@ -418,7 +424,7 @@ def fused_novograd_update(
 def fused_lars_update(
     p, mom, g, space: FlatSpace, *,
     lr, momentum=0.9, weight_decay=0.0, trust_coefficient=0.02,
-    eps=1e-8, clip=True, first_run=False, impl=None,
+    eps=1e-8, clip=True, first_run=False, impl=None, sr_seed=None,
 ):
     """LARS/LARC: per-tensor adaptive lr = eta*||p||/(||g|| + wd*||p|| + eps),
     optionally clipped at 1 (LARC clip-mode, ref apex/parallel/LARC.py:91-99),
@@ -446,5 +452,6 @@ def fused_lars_update(
         num_outputs=2, out_dtypes=[p.dtype, mom.dtype],
         check_finite=(2,), impl=impl,
         aliases={0: 0, 1: 1},
+        sr_outputs=(0,) if sr_seed is not None else (), sr_seed=sr_seed,
     )
     return p2, mom2, found

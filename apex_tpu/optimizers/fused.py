@@ -48,6 +48,13 @@ class FlatOptState(NamedTuple):
     found_inf: jax.Array      # f32 {0,1} from the last step attempt
 
 
+def _mv_slots(master: jax.Array) -> Dict[str, jax.Array]:
+    """fp32 m/v slot pair — fp32 even under a bf16 SR master: the EMAs
+    are where bf16 quantization bias hurts most."""
+    return {"m": jnp.zeros(master.shape, jnp.float32),
+            "v": jnp.zeros(master.shape, jnp.float32)}
+
+
 def _resolve_lr(lr: Schedule, count: jax.Array) -> jax.Array:
     if callable(lr):
         return jnp.asarray(lr(count), jnp.float32)
@@ -55,11 +62,41 @@ def _resolve_lr(lr: Schedule, count: jax.Array) -> jax.Array:
 
 
 class FlatFusedOptimizer:
-    """Base: pack grads once, run one fused kernel, unpack params."""
+    """Base: pack grads once, run one fused kernel, unpack params.
 
-    def __init__(self, lr: Schedule, impl: Optional[str] = None):
+    ``master_dtype=jnp.bfloat16`` with ``stochastic_rounding=True``
+    drops the fp32 master entirely: params live in bf16 and every
+    update is written with stochastic rounding (E[stored] == exact
+    fp32 result), so sub-ulp updates accumulate in expectation instead
+    of vanishing to nearest-rounding. This is the TPU-native
+    master-free mixed-precision mode the reference approximates with
+    mixed param/state dtypes in csrc/multi_tensor_lamb_mp.cu — it
+    halves the optimizer's param HBM traffic and state memory vs the
+    fp32-master discipline. Optimizer slot buffers stay fp32.
+    """
+
+    def __init__(self, lr: Schedule, impl: Optional[str] = None, *,
+                 master_dtype=jnp.float32, stochastic_rounding=False):
         self.lr = lr
         self.impl = impl
+        self.master_dtype = jnp.dtype(master_dtype)
+        self.stochastic_rounding = bool(stochastic_rounding)
+        if self.stochastic_rounding and self.master_dtype != jnp.bfloat16:
+            raise ValueError(
+                "stochastic_rounding requires master_dtype=bfloat16 "
+                f"(got {self.master_dtype})")
+        if (self.master_dtype != jnp.float32
+                and not self.stochastic_rounding):
+            raise ValueError(
+                "a reduced-precision master without stochastic rounding "
+                "loses sub-ulp updates to nearest rounding; pass "
+                "stochastic_rounding=True (or keep master_dtype=float32)")
+
+    def _sr_seed(self, state: "FlatOptState"):
+        """Per-step SR seed (None when SR is off): the unskipped-step
+        counter, so every step rounds with a fresh deterministic
+        stream and checkpoint-resume reproduces the same stream."""
+        return state.count if self.stochastic_rounding else None
 
     # -- subclass hooks ----------------------------------------------------
 
@@ -74,8 +111,23 @@ class FlatFusedOptimizer:
     # -- public API --------------------------------------------------------
 
     def init(self, params: Any) -> FlatOptState:
+        if self.master_dtype != jnp.float32:
+            # a reduced master stores EVERY leaf at master_dtype; packing
+            # a wider leaf would silently quantize it at init (e.g. fp32
+            # layernorm scales losing 16 mantissa bits). Require the
+            # caller to cast explicitly so the loss is a decision.
+            wider = {
+                str(l.dtype) for l in jax.tree.leaves(params)
+                if jnp.dtype(l.dtype) != self.master_dtype
+            }
+            if wider:
+                raise ValueError(
+                    f"master_dtype={self.master_dtype} requires all param "
+                    f"leaves in that dtype; found {sorted(wider)} — cast "
+                    "the tree explicitly (mixed per-leaf masters are not "
+                    "supported)")
         space = FlatSpace.create(params)
-        master = space.pack(params, dtype=jnp.float32)
+        master = space.pack(params, dtype=self.master_dtype)
         return FlatOptState(
             space=space,
             master=master,
@@ -150,8 +202,10 @@ class FusedAdam(FlatFusedOptimizer):
     """Adam/AdamW in one fused kernel (ref: apex/optimizers/fused_adam.py)."""
 
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
-                 eps=1e-8, adam_w_mode=True, weight_decay=0.0, impl=None):
-        super().__init__(lr, impl)
+                 eps=1e-8, adam_w_mode=True, weight_decay=0.0, impl=None,
+                 master_dtype=jnp.float32, stochastic_rounding=False):
+        super().__init__(lr, impl, master_dtype=master_dtype,
+                         stochastic_rounding=stochastic_rounding)
         self.bias_correction = bias_correction
         self.betas = betas
         self.eps = eps
@@ -159,7 +213,7 @@ class FusedAdam(FlatFusedOptimizer):
         self.weight_decay = weight_decay
 
     def _init_slots(self, space, master):
-        return {"m": jnp.zeros_like(master), "v": jnp.zeros_like(master)}
+        return _mv_slots(master)
 
     def _update(self, state, g, lr, grad_scale):
         p2, m2, v2, found = fused_adam_update(
@@ -168,7 +222,7 @@ class FusedAdam(FlatFusedOptimizer):
             step=state.count + 1, adam_w_mode=self.adam_w_mode,
             bias_correction=self.bias_correction,
             weight_decay=self.weight_decay, grad_scale=grad_scale,
-            impl=self.impl,
+            impl=self.impl, sr_seed=self._sr_seed(state),
         )
         return p2, {"m": m2, "v": v2}, found
 
@@ -180,8 +234,10 @@ class FusedLAMB(FlatFusedOptimizer):
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-6, weight_decay=0.01, grad_averaging=True,
                  adam_w_mode=True, max_grad_norm=1.0, use_nvlamb=False,
-                 impl=None):
-        super().__init__(lr, impl)
+                 impl=None, master_dtype=jnp.float32,
+                 stochastic_rounding=False):
+        super().__init__(lr, impl, master_dtype=master_dtype,
+                         stochastic_rounding=stochastic_rounding)
         self.bias_correction = bias_correction
         self.betas = betas
         self.eps = eps
@@ -192,7 +248,7 @@ class FusedLAMB(FlatFusedOptimizer):
         self.use_nvlamb = use_nvlamb
 
     def _init_slots(self, space, master):
-        return {"m": jnp.zeros_like(master), "v": jnp.zeros_like(master)}
+        return _mv_slots(master)
 
     def _update(self, state, g, lr, grad_scale):
         p2, m2, v2, found = fused_lamb_update(
@@ -202,7 +258,8 @@ class FusedLAMB(FlatFusedOptimizer):
             bias_correction=self.bias_correction,
             grad_averaging=self.grad_averaging,
             max_grad_norm=self.max_grad_norm, adam_w_mode=self.adam_w_mode,
-            use_nvlamb=self.use_nvlamb, grad_scale=grad_scale, impl=self.impl,
+            use_nvlamb=self.use_nvlamb, grad_scale=grad_scale,
+            impl=self.impl, sr_seed=self._sr_seed(state),
         )
         return p2, {"m": m2, "v": v2}, found
 
@@ -212,8 +269,10 @@ class FusedSGD(FlatFusedOptimizer):
     (ref: apex/optimizers/fused_sgd.py, csrc/multi_tensor_sgd_kernel.cu)."""
 
     def __init__(self, lr, momentum=0.0, dampening=0.0, weight_decay=0.0,
-                 nesterov=False, wd_after_momentum=False, impl=None):
-        super().__init__(lr, impl)
+                 nesterov=False, wd_after_momentum=False, impl=None,
+                 master_dtype=jnp.float32, stochastic_rounding=False):
+        super().__init__(lr, impl, master_dtype=master_dtype,
+                         stochastic_rounding=stochastic_rounding)
         self.momentum = momentum
         self.dampening = dampening
         self.weight_decay = weight_decay
@@ -221,7 +280,7 @@ class FusedSGD(FlatFusedOptimizer):
         self.wd_after_momentum = wd_after_momentum
 
     def _init_slots(self, space, master):
-        return {"momentum": jnp.zeros_like(master),
+        return {"momentum": jnp.zeros(master.shape, jnp.float32),
                 "initialized": jnp.zeros((), jnp.float32)}
 
     def _update(self, state, g, lr, grad_scale):
@@ -235,6 +294,7 @@ class FusedSGD(FlatFusedOptimizer):
             wd_after_momentum=self.wd_after_momentum,
             scale=1.0 / jnp.asarray(grad_scale, jnp.float32),
             first_run=state.slots["initialized"] == 0, impl=self.impl,
+            sr_seed=self._sr_seed(state),
         )
         return p2, {"momentum": mom2, "initialized": jnp.ones((), jnp.float32)}, found
 
@@ -245,8 +305,10 @@ class FusedNovoGrad(FlatFusedOptimizer):
 
     def __init__(self, lr=1e-3, betas=(0.95, 0.98), eps=1e-8,
                  weight_decay=0.0, grad_averaging=True, bias_correction=False,
-                 impl=None):
-        super().__init__(lr, impl)
+                 impl=None, master_dtype=jnp.float32,
+                 stochastic_rounding=False):
+        super().__init__(lr, impl, master_dtype=master_dtype,
+                         stochastic_rounding=stochastic_rounding)
         self.betas = betas
         self.eps = eps
         self.weight_decay = weight_decay
@@ -254,7 +316,7 @@ class FusedNovoGrad(FlatFusedOptimizer):
         self.bias_correction = bias_correction
 
     def _init_slots(self, space, master):
-        return {"m": jnp.zeros_like(master),
+        return {"m": jnp.zeros(master.shape, jnp.float32),
                 "v": jnp.zeros((space.num_leaves,), jnp.float32)}
 
     def _update(self, state, g, lr, grad_scale):
@@ -266,6 +328,7 @@ class FusedNovoGrad(FlatFusedOptimizer):
             step=state.count + 1, weight_decay=self.weight_decay,
             grad_averaging=self.grad_averaging,
             bias_correction=self.bias_correction, impl=self.impl,
+            sr_seed=self._sr_seed(state),
         )
         return p2, {"m": m2, "v": v2}, found
 
@@ -273,19 +336,21 @@ class FusedNovoGrad(FlatFusedOptimizer):
 class FusedAdagrad(FlatFusedOptimizer):
     """Adagrad in one fused kernel (ref: apex/optimizers/fused_adagrad.py)."""
 
-    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0, impl=None):
-        super().__init__(lr, impl)
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0, impl=None,
+                 master_dtype=jnp.float32, stochastic_rounding=False):
+        super().__init__(lr, impl, master_dtype=master_dtype,
+                         stochastic_rounding=stochastic_rounding)
         self.eps = eps
         self.weight_decay = weight_decay
 
     def _init_slots(self, space, master):
-        return {"h": jnp.zeros_like(master)}
+        return {"h": jnp.zeros(master.shape, jnp.float32)}
 
     def _update(self, state, g, lr, grad_scale):
         p2, h2, found = fused_adagrad_update(
             state.master, state.slots["h"], g, lr=lr, eps=self.eps,
             weight_decay=self.weight_decay, grad_scale=grad_scale,
-            impl=self.impl,
+            impl=self.impl, sr_seed=self._sr_seed(state),
         )
         return p2, {"h": h2}, found
 
@@ -295,8 +360,10 @@ class FusedLARS(FlatFusedOptimizer):
     (ref: csrc/multi_tensor_lars.cu; LARC semantics apex/parallel/LARC.py)."""
 
     def __init__(self, lr, momentum=0.9, weight_decay=0.0,
-                 trust_coefficient=0.02, eps=1e-8, clip=True, impl=None):
-        super().__init__(lr, impl)
+                 trust_coefficient=0.02, eps=1e-8, clip=True, impl=None,
+                 master_dtype=jnp.float32, stochastic_rounding=False):
+        super().__init__(lr, impl, master_dtype=master_dtype,
+                         stochastic_rounding=stochastic_rounding)
         self.momentum = momentum
         self.weight_decay = weight_decay
         self.trust_coefficient = trust_coefficient
@@ -304,7 +371,7 @@ class FusedLARS(FlatFusedOptimizer):
         self.clip = clip
 
     def _init_slots(self, space, master):
-        return {"momentum": jnp.zeros_like(master),
+        return {"momentum": jnp.zeros(master.shape, jnp.float32),
                 "initialized": jnp.zeros((), jnp.float32)}
 
     def _update(self, state, g, lr, grad_scale):
@@ -314,7 +381,7 @@ class FusedLARS(FlatFusedOptimizer):
             momentum=self.momentum, weight_decay=self.weight_decay,
             trust_coefficient=self.trust_coefficient, eps=self.eps,
             clip=self.clip, first_run=state.slots["initialized"] == 0,
-            impl=self.impl,
+            impl=self.impl, sr_seed=self._sr_seed(state),
         )
         return p2, {"momentum": mom2, "initialized": jnp.ones((), jnp.float32)}, found
 

@@ -277,3 +277,94 @@ class TestFusedMixedPrecisionLamb:
         pa, _ = a.step(a.init(params), grads)
         pb, _ = b.step(b.init(params), grads)
         np.testing.assert_array_equal(np.asarray(pa["w"]), np.asarray(pb["w"]))
+
+
+class TestStochasticRoundingMaster:
+    """Master-free bf16 training (master_dtype=bf16 + SR): the
+    TPU-native replacement for the fp32-master discipline."""
+
+    def test_bf16_master_state_memory(self, rng):
+        opt = FusedAdam(lr=1e-3, master_dtype=jnp.bfloat16,
+                        stochastic_rounding=True, impl="xla")
+        params = make_params(rng, jnp.bfloat16)
+        state = opt.init(params)
+        assert state.master.dtype == jnp.bfloat16
+        # slot EMAs stay fp32 (bf16 quantization bias hits m/v hardest)
+        assert state.slots["m"].dtype == jnp.float32
+        assert state.slots["v"].dtype == jnp.float32
+
+    def test_requires_bf16_and_sr_together(self):
+        with pytest.raises(ValueError, match="bfloat16"):
+            FusedAdam(master_dtype=jnp.float16, stochastic_rounding=True)
+        with pytest.raises(ValueError, match="stochastic_rounding"):
+            FusedAdam(master_dtype=jnp.bfloat16)
+
+    def test_rejects_wider_leaves(self, rng):
+        """A reduced master must not silently quantize fp32 leaves
+        (e.g. layernorm scales) at init — explicit cast required."""
+        opt = FusedAdam(master_dtype=jnp.bfloat16,
+                        stochastic_rounding=True, impl="xla")
+        params = {"w": jnp.asarray(rng.randn(64), jnp.bfloat16),
+                  "ln": jnp.asarray(rng.randn(8), jnp.float32)}
+        with pytest.raises(ValueError, match="float32"):
+            opt.init(params)
+
+    @pytest.mark.parametrize(
+        "opt_cls", [FusedAdam, FusedLAMB, FusedSGD, FusedNovoGrad])
+    def test_trains_close_to_fp32(self, rng, impl, opt_cls):
+        """bf16+SR reaches a loss in the same regime as the fp32-master
+        run on a small regression (the reference-style convergence
+        check, ref tests/L0/run_optimizers/test_fused_optimizer.py)."""
+        W = jnp.asarray(rng.randn(16, 16) * 0.7, jnp.float32)
+        X = jnp.asarray(rng.randn(512, 16), jnp.float32)
+        Y = jnp.tanh(X @ W)
+
+        def loss_fn(pt):
+            h = jnp.tanh(X @ pt["w1"].astype(jnp.float32))
+            return jnp.mean((h @ pt["w2"].astype(jnp.float32) - Y) ** 2)
+
+        def train(dtype, **kw):
+            params = {
+                "w1": jnp.asarray(rng.randn(16, 32) * 0.3, dtype),
+                "w2": jnp.asarray(rng.randn(32, 16) * 0.3, dtype),
+            }
+            kwargs = dict(lr=0.03) if opt_cls is not FusedSGD else dict(lr=0.3)
+            opt = opt_cls(**kwargs, impl=impl, **kw)
+            state = opt.init(params)
+
+            @jax.jit
+            def step(pp, st):
+                l, gr = jax.value_and_grad(loss_fn)(pp)
+                pp2, st2 = opt.step(st, gr)
+                return pp2, st2, l
+
+            for _ in range(80):
+                params, state, l = step(params, state)
+            return float(l)
+
+        rng_state = rng.get_state()
+        l_fp32 = train(jnp.float32)
+        rng.set_state(rng_state)            # identical init
+        l_sr = train(jnp.bfloat16, master_dtype=jnp.bfloat16,
+                     stochastic_rounding=True)
+        assert l_sr < max(3.0 * l_fp32, 5e-3), (l_sr, l_fp32)
+
+    def test_sr_seed_advances_with_count(self, rng):
+        """Two consecutive steps must use different SR streams (seeded
+        by the unskipped-step counter), and resume from a checkpointed
+        state must reproduce the same stream."""
+        opt = FusedSGD(lr=1.0, master_dtype=jnp.bfloat16,
+                       stochastic_rounding=True, impl="xla")
+        params = {"w": jnp.full((4096,), 1.0, jnp.bfloat16)}
+        g = {"w": jnp.full((4096,), 2.0 ** -9, jnp.float32)}
+        s0 = opt.init(params)
+        p1, s1 = opt.step(s0, g)
+        p2, s2 = opt.step(s1, g)
+        # different steps -> different rounding pattern
+        a1 = np.asarray(p1["w"], np.float32)
+        d2 = np.asarray(p2["w"], np.float32) - a1
+        assert (np.unique(a1).size > 1) and (np.unique(d2).size > 1)
+        # replay step 2 from the same state: bitwise identical
+        p2r, _ = opt.step(s1, g)
+        np.testing.assert_array_equal(np.asarray(p2["w"]),
+                                      np.asarray(p2r["w"]))

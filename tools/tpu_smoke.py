@@ -157,6 +157,31 @@ def run(perf=False, kimpl="pallas", only=None):
               trust_coefficient=0.02, impl=impl),
           buf, gbuf, m, tol=1e-4)
 
+    # stochastic rounding: the in-kernel pltpu.prng path has NO CPU
+    # lowering, so this statistics check (not parity — streams differ
+    # from the xla emulation by design) is its only validation surface
+    name = "stochastic_round bf16 (in-kernel prng)"
+    if not (only and only not in name):
+        try:
+            nsr = 1 << 14
+            psr = jnp.full((nsr,), 1.0, jnp.bfloat16)
+            gsr = jnp.full((nsr,), 2.0 ** -9, jnp.float32)
+            p2sr, _, _ = jax.jit(
+                lambda p_, g_: mt.fused_sgd_update(
+                    p_, jnp.zeros((nsr,), jnp.float32), g_, lr=1.0,
+                    impl=kimpl, sr_seed=7))(psr, gsr)
+            vals = np.asarray(jax.device_get(p2sr), np.float32)
+            frac_hi = float((vals == 1.0).mean())
+            mean_err = abs(float(vals.mean()) - (1.0 - 2.0 ** -9))
+            ok = abs(frac_hi - 0.5) < 0.05 and mean_err < 2e-4
+            results.append((name, ok, mean_err, None, None))
+            print(f"  [{'PASS' if ok else 'FAIL'}] {name:42s} "
+                  f"mean_err {mean_err:.2e} frac_hi {frac_hi:.3f}")
+        except Exception as e:  # noqa: BLE001 — report, keep sweeping
+            results.append((name, False, float("inf"), None, None))
+            msg = str(e).split("\n")[0][:140]
+            print(f"  [FAIL] {name:42s} {type(e).__name__}: {msg}")
+
     # ---- layer norm / rms norm ---------------------------------------
     from apex_tpu import ops
 
