@@ -50,8 +50,14 @@ from apex_tpu.multi_tensor import (
     FlatSpace,
     fused_adam_update,
     fused_lamb_compute_update_term,
+    stochastic_round_cast,
     fused_sumsq_partials,
     lamb_trust_ratio,
+)
+from apex_tpu.optimizers.fused import (
+    _mv_slots,
+    check_leaf_dtypes,
+    validate_master_dtype,
 )
 from apex_tpu.multi_tensor.engine import LANES
 from apex_tpu.multi_tensor.flat_buffer import _round_up
@@ -69,7 +75,7 @@ class DistFlatOptState(NamedTuple):
     """
 
     space: FlatSpace          # static layout node (full, unsharded)
-    master: jax.Array         # (shard,) fp32 master params
+    master: jax.Array         # (shard,) master params (master_dtype)
     leaf_ids: jax.Array       # (shard,) int32 element -> leaf map
     slots: Dict[str, jax.Array]
     count: jax.Array          # int32 successful-step counter
@@ -109,6 +115,8 @@ class _DistributedFlatOptimizer:
         param_sync_dtype: Optional[Any] = None,
         average_grad_sync: bool = True,
         impl: Optional[str] = None,
+        master_dtype=jnp.float32,
+        stochastic_rounding: bool = False,
     ):
         self.lr = lr
         self.shard_axis = shard_axis
@@ -116,6 +124,22 @@ class _DistributedFlatOptimizer:
         self.param_sync_dtype = param_sync_dtype
         self.average_grad_sync = average_grad_sync
         self.impl = impl
+        # master-free bf16 shards (same contract as FlatFusedOptimizer):
+        # sharded master + all-gathered params live in bf16, every shard
+        # update is written with stochastic rounding. The all-gather then
+        # moves half the bytes — the bf16 analog of the reference's
+        # e5m2-compressed allgather (distributed_fused_lamb.py:91).
+        self.stochastic_rounding = bool(stochastic_rounding)
+        self.master_dtype = validate_master_dtype(
+            master_dtype, self.stochastic_rounding)
+
+    def _sr_seed(self, state: "DistFlatOptState"):
+        """Per-(step, shard) SR seed, or None when SR is off: shards
+        round different slices, so give each its own stream."""
+        if not self.stochastic_rounding:
+            return None
+        world = lax.axis_size(self.shard_axis)
+        return state.count * world + lax.axis_index(self.shard_axis)
 
     # -- shard layout ------------------------------------------------------
 
@@ -154,9 +178,11 @@ class _DistributedFlatOptimizer:
         """Build this device's state shard. Must run under ``shard_map``
         with ``shard_axis`` live; ``params`` replicated (or at least
         identical) across that axis."""
+        check_leaf_dtypes(params, self.master_dtype)
         space = FlatSpace.create(params)
         _, padded_total, shard = self._shard_layout(space)
-        master = self._my_slice(self._pack_padded(space, params), shard)
+        master = self._my_slice(
+            self._pack_padded(space, params, dtype=self.master_dtype), shard)
         ids = self._my_slice(jnp.asarray(_full_leaf_ids(space, padded_total)), shard)
         return DistFlatOptState(
             space=space,
@@ -168,10 +194,11 @@ class _DistributedFlatOptimizer:
             l2_grad_norm=jnp.zeros((), jnp.float32),
         )
 
-    def _pack_padded(self, space: FlatSpace, tree: Any) -> jax.Array:
+    def _pack_padded(self, space: FlatSpace, tree: Any,
+                     dtype=jnp.float32) -> jax.Array:
         """Flatten a pytree into the shard-divisible padded flat buffer."""
         _, padded_total, _ = self._shard_layout(space)
-        buf = space.pack(tree, dtype=jnp.float32)
+        buf = space.pack(tree, dtype=dtype)
         if padded_total != space.total:
             buf = jnp.pad(buf, (0, padded_total - space.total))
         return buf
@@ -298,11 +325,14 @@ class DistributedFusedAdam(_DistributedFlatOptimizer):
     def __init__(self, lr=1e-3, *, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-8, adam_w_mode=True, weight_decay=0.0,
                  shard_axis: str = DATA_AXIS, grad_sync_dtype=None,
-                 param_sync_dtype=None, average_grad_sync=True, impl=None):
+                 param_sync_dtype=None, average_grad_sync=True, impl=None,
+                 master_dtype=jnp.float32, stochastic_rounding=False):
         super().__init__(
             lr, shard_axis=shard_axis, grad_sync_dtype=grad_sync_dtype,
             param_sync_dtype=param_sync_dtype,
             average_grad_sync=average_grad_sync, impl=impl,
+            master_dtype=master_dtype,
+            stochastic_rounding=stochastic_rounding,
         )
         self.bias_correction = bias_correction
         self.betas = betas
@@ -311,7 +341,7 @@ class DistributedFusedAdam(_DistributedFlatOptimizer):
         self.weight_decay = weight_decay
 
     def _init_slots(self, master, space):
-        return {"m": jnp.zeros_like(master), "v": jnp.zeros_like(master)}
+        return _mv_slots(master)
 
     def _update_shard(self, state, g, lr, grad_scale, aux):
         p2, m2, v2, found = fused_adam_update(
@@ -320,7 +350,7 @@ class DistributedFusedAdam(_DistributedFlatOptimizer):
             step=state.count + 1, adam_w_mode=self.adam_w_mode,
             bias_correction=self.bias_correction,
             weight_decay=self.weight_decay, grad_scale=grad_scale,
-            impl=self.impl,
+            impl=self.impl, sr_seed=self._sr_seed(state),
         )
         return p2, {"m": m2, "v": v2}, found
 
@@ -347,13 +377,16 @@ class DistributedFusedLAMB(_DistributedFlatOptimizer):
                  adam_w_mode=True, max_grad_norm=1.0, use_nvlamb=False,
                  clip_after_ar=True, e5m2_allgather=False,
                  shard_axis: str = DATA_AXIS, grad_sync_dtype=None,
-                 param_sync_dtype=None, average_grad_sync=True, impl=None):
+                 param_sync_dtype=None, average_grad_sync=True, impl=None,
+                 master_dtype=jnp.float32, stochastic_rounding=False):
         if e5m2_allgather and param_sync_dtype is None:
             param_sync_dtype = jnp.float8_e5m2
         super().__init__(
             lr, shard_axis=shard_axis, grad_sync_dtype=grad_sync_dtype,
             param_sync_dtype=param_sync_dtype,
             average_grad_sync=average_grad_sync, impl=impl,
+            master_dtype=master_dtype,
+            stochastic_rounding=stochastic_rounding,
         )
         self.bias_correction = bias_correction
         self.betas = betas
@@ -366,7 +399,7 @@ class DistributedFusedLAMB(_DistributedFlatOptimizer):
         self.clip_after_ar = clip_after_ar
 
     def _init_slots(self, master, space):
-        return {"m": jnp.zeros_like(master), "v": jnp.zeros_like(master)}
+        return _mv_slots(master)
 
     def _pre_sync(self, state, grads, grads_pre_synced):
         # clip_after_ar=False needs the pre-sync local grads: the clip
@@ -420,7 +453,13 @@ class DistributedFusedLAMB(_DistributedFlatOptimizer):
         # (ref multi_tensor_lamb_update_weights,
         # distributed_fused_lamb.py:106) — XLA fuses this chain.
         r_elem = jnp.take(ratio, state.leaf_ids)
-        p2 = (state.master.astype(jnp.float32) - lr * r_elem * u).astype(
-            state.master.dtype
-        )
+        p2f = state.master.astype(jnp.float32) - lr * r_elem * u
+        sr_seed = self._sr_seed(state)
+        if sr_seed is not None:
+            # stage 2 here is plain XLA (not the engine), so the
+            # XLA-lowerable SR cast applies the same E[stored]==fp32
+            # contract as the in-kernel primitive
+            p2 = stochastic_round_cast(p2f, sr_seed)
+        else:
+            p2 = p2f.astype(state.master.dtype)
         return p2, {"m": m2, "v": v2}, found

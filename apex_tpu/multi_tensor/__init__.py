@@ -15,6 +15,7 @@ from apex_tpu.multi_tensor.flat_buffer import DEFAULT_ALIGN, FlatSpace, pack_lik
 from apex_tpu.multi_tensor.engine import (
     fused_elementwise,
     fused_sumsq_partials,
+    stochastic_round_cast,
 )
 from apex_tpu.multi_tensor.ops import (
     fused_adagrad_update,
@@ -37,6 +38,7 @@ __all__ = [
     "pack_like",
     "fused_elementwise",
     "fused_sumsq_partials",
+    "stochastic_round_cast",
     "multi_tensor_scale",
     "multi_tensor_axpby",
     "multi_tensor_l2norm",

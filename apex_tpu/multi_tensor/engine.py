@@ -45,14 +45,16 @@ def _pad_to(buf: jax.Array, n: int) -> jax.Array:
     return jnp.pad(buf, (0, n - buf.shape[0]))
 
 
-def _sr_cast_emulated(x: jax.Array, seed, salt: int) -> jax.Array:
-    """fp32 -> bf16 stochastic round for the xla/interpret paths.
+def stochastic_round_cast(x: jax.Array, seed, salt: int = 0) -> jax.Array:
+    """fp32 -> bf16 stochastic round in plain XLA ops.
 
-    Emulates ``pltpu.stochastic_round`` (which only lowers on real TPU):
-    add uniform random low bits below the bf16 mantissa boundary, then
-    truncate. E[result] == x exactly; non-finite values pass through a
-    nearest cast (adding bits to an inf/nan pattern could change its
-    class).
+    Equivalent in distribution to ``pltpu.stochastic_round`` (which only
+    lowers through Mosaic): add uniform random low bits below the bf16
+    mantissa boundary, then truncate. E[result] == x exactly; non-finite
+    values pass through a nearest cast (adding bits to an inf/nan
+    pattern could change its class). Used by the engine's xla/interpret
+    paths and by sharded optimizers whose update tail is plain XLA;
+    compiled Pallas kernels use the in-kernel primitive instead.
     """
     xf = x.astype(jnp.float32)
     key = jax.random.fold_in(
@@ -383,7 +385,7 @@ def fused_elementwise(
     outs = [r.reshape(padded_n)[:n] for r in results[:num_outputs]]
     if sr_post:
         outs = [
-            _sr_cast_emulated(o, sr_seed, j) if j in sr_post else o
+            stochastic_round_cast(o, sr_seed, j) if j in sr_post else o
             for j, o in enumerate(outs)
         ]
     found = results[num_outputs][0, 0]
@@ -424,7 +426,7 @@ def _fused_elementwise_xla(
     def final_cast(j, o, dt):
         if tile_ids is not None:
             o = o.reshape(-1)[:n]
-        return _sr_cast_emulated(o, sr_seed, j) if j in sr else o.astype(dt)
+        return stochastic_round_cast(o, sr_seed, j) if j in sr else o.astype(dt)
 
     outs = [final_cast(j, o, dt)
             for j, (o, dt) in enumerate(zip(raw_outs, out_dtypes))]

@@ -55,6 +55,40 @@ def _mv_slots(master: jax.Array) -> Dict[str, jax.Array]:
             "v": jnp.zeros(master.shape, jnp.float32)}
 
 
+def validate_master_dtype(master_dtype, stochastic_rounding: bool):
+    """Shared master-dtype policy for flat and sharded optimizers:
+    reduced masters only with stochastic rounding, and only bf16."""
+    master_dtype = jnp.dtype(master_dtype)
+    if stochastic_rounding and master_dtype != jnp.bfloat16:
+        raise ValueError(
+            "stochastic_rounding requires master_dtype=bfloat16 "
+            f"(got {master_dtype})")
+    if master_dtype != jnp.float32 and not stochastic_rounding:
+        raise ValueError(
+            "a reduced-precision master without stochastic rounding "
+            "loses sub-ulp updates to nearest rounding; pass "
+            "stochastic_rounding=True (or keep master_dtype=float32)")
+    return master_dtype
+
+
+def check_leaf_dtypes(params: Any, master_dtype) -> None:
+    """A reduced master stores EVERY leaf at master_dtype; packing a
+    wider leaf would silently quantize it at init (e.g. fp32 layernorm
+    scales losing 16 mantissa bits). Require an explicit cast so the
+    loss is a decision."""
+    if jnp.dtype(master_dtype) == jnp.float32:
+        return
+    wider = {
+        str(l.dtype) for l in jax.tree.leaves(params)
+        if jnp.dtype(l.dtype) != jnp.dtype(master_dtype)
+    }
+    if wider:
+        raise ValueError(
+            f"master_dtype={jnp.dtype(master_dtype)} requires all param "
+            f"leaves in that dtype; found {sorted(wider)} — cast the "
+            "tree explicitly (mixed per-leaf masters are not supported)")
+
+
 def _resolve_lr(lr: Schedule, count: jax.Array) -> jax.Array:
     if callable(lr):
         return jnp.asarray(lr(count), jnp.float32)
@@ -79,18 +113,9 @@ class FlatFusedOptimizer:
                  master_dtype=jnp.float32, stochastic_rounding=False):
         self.lr = lr
         self.impl = impl
-        self.master_dtype = jnp.dtype(master_dtype)
         self.stochastic_rounding = bool(stochastic_rounding)
-        if self.stochastic_rounding and self.master_dtype != jnp.bfloat16:
-            raise ValueError(
-                "stochastic_rounding requires master_dtype=bfloat16 "
-                f"(got {self.master_dtype})")
-        if (self.master_dtype != jnp.float32
-                and not self.stochastic_rounding):
-            raise ValueError(
-                "a reduced-precision master without stochastic rounding "
-                "loses sub-ulp updates to nearest rounding; pass "
-                "stochastic_rounding=True (or keep master_dtype=float32)")
+        self.master_dtype = validate_master_dtype(
+            master_dtype, self.stochastic_rounding)
 
     def _sr_seed(self, state: "FlatOptState"):
         """Per-step SR seed (None when SR is off): the unskipped-step
@@ -111,21 +136,7 @@ class FlatFusedOptimizer:
     # -- public API --------------------------------------------------------
 
     def init(self, params: Any) -> FlatOptState:
-        if self.master_dtype != jnp.float32:
-            # a reduced master stores EVERY leaf at master_dtype; packing
-            # a wider leaf would silently quantize it at init (e.g. fp32
-            # layernorm scales losing 16 mantissa bits). Require the
-            # caller to cast explicitly so the loss is a decision.
-            wider = {
-                str(l.dtype) for l in jax.tree.leaves(params)
-                if jnp.dtype(l.dtype) != self.master_dtype
-            }
-            if wider:
-                raise ValueError(
-                    f"master_dtype={self.master_dtype} requires all param "
-                    f"leaves in that dtype; found {sorted(wider)} — cast "
-                    "the tree explicitly (mixed per-leaf masters are not "
-                    "supported)")
+        check_leaf_dtypes(params, self.master_dtype)
         space = FlatSpace.create(params)
         master = space.pack(params, dtype=self.master_dtype)
         return FlatOptState(

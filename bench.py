@@ -678,6 +678,38 @@ def main():
     del fstate, out
     if not fused_times:
         raise SystemExit("fused LAMB failed under every impl")
+
+    # master-free bf16 + stochastic rounding variant (same workload,
+    # better operating point: ~half the param-side HBM traffic). Not
+    # the headline ratio — optax's lamb is fp32 and this isn't an
+    # apples comparison — but recorded so the chip artifact shows the
+    # SR mode's step time next to the fp32-master number.
+    t_sr = None
+    try:
+        params_bf16 = jax.tree.map(
+            lambda l: l.astype(jnp.bfloat16), params)
+        sr_opt = FusedLAMB(lr=lr, weight_decay=wd, max_grad_norm=0.0,
+                           use_nvlamb=True,
+                           master_dtype=jnp.bfloat16,
+                           stochastic_rounding=True)
+        sr_state = sr_opt.init(params_bf16)
+
+        @functools.partial(jax.jit, donate_argnums=(0,))
+        def sr_k_steps(state, grads):
+            def body(_, carry):
+                state, probe = carry
+                new_params, state = sr_opt.step(state, grads)
+                return state, probe + probe_all(new_params)
+
+            return jax.lax.fori_loop(
+                0, K, body, (state, jnp.float32(0.0)))
+
+        t_sr_total, sr_out = time_fn_threaded(sr_k_steps, sr_state, grads)
+        t_sr = t_sr_total / K
+        del sr_state, sr_out, params_bf16
+    except Exception as e:  # noqa: BLE001 — detail-only record
+        print(f"# sr-bf16 fused lamb failed: {type(e).__name__}: "
+              f"{str(e).split(chr(10))[0][:120]}", file=sys.stderr)
     default_impl = resolve_impl(None)
     impl_used = (default_impl if default_impl in fused_times
                  else min(fused_times, key=fused_times.get))
@@ -697,6 +729,8 @@ def main():
         "impl": impl_used,
         "fused_ms_by_impl": {k: round(v * 1e3, 3)
                              for k, v in fused_times.items()},
+        **({"t_fused_sr_bf16_ms": round(t_sr * 1e3, 3)}
+           if t_sr is not None else {}),
         "approx_hbm_gb_per_sec": round(approx_bytes / t_fused / 1e9, 1),
         **backend_detail(),
     }
