@@ -56,6 +56,37 @@ class TestPretrainStep:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]
 
+    def test_sr_bf16_master_free(self, rng):
+        """The full parallel pretrain stack composes with the
+        master-free bf16 stochastic-rounding optimizer mode: params and
+        optimizer master live in bf16 end to end, loss still drops."""
+        mesh = ps.initialize_model_parallel(2, 2)
+        cfg = GPTConfig(
+            vocab_size=128, max_seq_len=32, hidden_size=64,
+            num_layers=2, num_heads=4,
+            dtype=jnp.bfloat16, sequence_parallel=True,
+        )
+        params = init_gpt_pretrain_params(cfg, jax.random.PRNGKey(0))
+        params = jax.tree.map(lambda l: l.astype(jnp.bfloat16), params)
+        opt = FusedAdam(lr=2e-3, impl="xla", master_dtype=jnp.bfloat16,
+                        stochastic_rounding=True)
+        build = make_gpt_pretrain_step(cfg, mesh, opt, num_microbatches=2)
+        init_opt, step_fn, _ = build(params)
+        opt_state = init_opt(params)
+        assert jax.tree.leaves(opt_state)[0].dtype in (jnp.bfloat16,
+                                                       jnp.int32,
+                                                       jnp.float32)
+        toks = jnp.asarray(rng.randint(0, 128, (8, 33)), jnp.int32)
+        x, y = toks[:, :-1], toks[:, 1:]
+        losses = []
+        for _ in range(6):
+            params, opt_state, loss = step_fn(params, opt_state, x, y)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        assert all(l.dtype == jnp.bfloat16
+                   for l in jax.tree.leaves(params))
+
     def test_matches_single_device(self, rng):
         """Parallel pretrain loss == dense sequential model loss."""
         mesh = ps.initialize_model_parallel(2, 2)
