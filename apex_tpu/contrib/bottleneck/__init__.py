@@ -21,6 +21,7 @@ is XLA's default behavior.
 
 from __future__ import annotations
 
+import functools
 from typing import Any
 
 import flax.linen as nn
@@ -107,13 +108,48 @@ def halo_pad_1d(x: jax.Array, halo: int, exchanger=None) -> jax.Array:
 # --------------------------------------------------------------------------
 
 
-def conv2d_nhwc(x, w, stride: int = 1, padding="SAME"):
-    """NHWC conv, HWIO weights, fp32 accumulation."""
+def _conv2d_nhwc_impl(x, w, stride, padding):
     return lax.conv_general_dilated(
         x, w, window_strides=(stride, stride), padding=padding,
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
         preferred_element_type=jnp.float32,
     ).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2, 3))
+def _conv2d_nhwc_vjp(x, w, stride, padding):
+    return _conv2d_nhwc_impl(x, w, stride, padding)
+
+
+def _conv2d_nhwc_fwd(x, w, stride, padding):
+    return _conv2d_nhwc_impl(x, w, stride, padding), (x, w)
+
+
+def _conv2d_nhwc_bwd(stride, padding, res, g):
+    x, w = res
+    _, vjp = jax.vjp(
+        lambda x_, w_: lax.conv_general_dilated(
+            x_, w_, window_strides=(stride, stride), padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC")), x, w)
+    dx, dw = vjp(g.astype(x.dtype))
+    return dx, dw.astype(w.dtype)
+
+
+_conv2d_nhwc_vjp.defvjp(_conv2d_nhwc_fwd, _conv2d_nhwc_bwd)
+
+
+def conv2d_nhwc(x, w, stride: int = 1, padding="SAME"):
+    """NHWC conv, HWIO weights, fp32 accumulation.
+
+    Custom VJP because ``preferred_element_type=float32`` makes the
+    built-in conv transpose unbuildable under mixed precision: the
+    fp32 cotangent meets bf16 operands and ``lax.conv_general_dilated``
+    rejects the dtype mix. The backward casts the cotangent to the
+    input dtype and differentiates a same-dtype conv — on TPU the MXU
+    accumulates bf16 convs in fp32 either way, so no accuracy is
+    given up.
+    """
+    return _conv2d_nhwc_vjp(x, w, stride, padding)
 
 
 def spatial_conv2d(x, w, *, stride: int = 1, exchanger=None) -> jax.Array:

@@ -1,0 +1,58 @@
+"""Chip health gauge: raw streaming bandwidth probe.
+
+The axon tunnel degrades after OOM'd/killed clients — everything still
+*runs*, just 5-10x slower (observed 574 -> 99 GB/s raw copy within an
+hour, docs/HARDWARE_NOTES.md round-3 log), which silently poisons every
+measurement taken in the window. Gate hardware measurement queues on
+this: exit 0 iff the chip streams above ``--min-gbps``.
+
+    python tools/tpu_health.py             # probe, print JSON, gate at 300
+    python tools/tpu_health.py --min-gbps 400
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from tpu_longctx import _time_adaptive  # noqa: E402
+
+
+def probe_gbps(n=1 << 26):
+    """Streaming GB/s of an out-of-place scale over a 256 MB buffer."""
+    import jax.numpy as jnp
+
+    buf = jnp.asarray(np.random.RandomState(0).randn(n).astype(np.float32))
+    t = _time_adaptive(lambda b: (b * 1.0000001,), buf, target_s=1.0,
+                       feed=lambda out, carry: out)
+    return 2 * n * 4 / t / 1e9
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--min-gbps", type=float, default=300.0)
+    args = ap.parse_args()
+
+    from apex_tpu.backend_guard import tpu_slot_lock
+
+    with tpu_slot_lock():
+        import jax
+
+        backend = str(jax.default_backend())
+        gbps = probe_gbps()
+        healthy = backend == "tpu" and gbps >= args.min_gbps
+        print(json.dumps({
+            "backend": backend,
+            "raw_copy_gb_per_sec": round(gbps, 1),
+            "healthy": bool(healthy),
+            "min_gbps": args.min_gbps,
+        }))
+        sys.exit(0 if healthy else 1)
+
+
+if __name__ == "__main__":
+    main()

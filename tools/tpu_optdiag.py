@@ -1,0 +1,205 @@
+"""Fused-optimizer step breakdown on the real chip.
+
+The round-3 headline artifact showed FusedLAMB at 4.3x optax (84 ms vs
+19 ms at 335M params, ~160 GB/s effective) — far from the <=1.1x
+north-star. This tool decomposes the step so the fix lands where the
+time actually goes. Measurement phases are ordered to stage memory on a
+16 GB chip (each drops its buffers before the next allocates) and each
+is fault-isolated so one failure never loses the rest:
+
+  1. chip identity + raw HBM streaming bandwidth (natural-feed copy)
+  2. optax.lamb on the param tree, state threaded (the baseline)
+  3. the FULL FusedLAMB.step as the bench runs it (pack + kernel +
+     unpack + per-leaf probe), both impls
+  4. kernel-only fused_lamb/adam on pre-flat buffers, both impls
+     (full minus kernel = the plumbing the flat design pays)
+
+    python tools/tpu_optdiag.py            # BERT-large-class shapes
+    python tools/tpu_optdiag.py --small    # ~40M quick pass
+
+One JSON line per measurement; all timing via the feed-threaded chained
+loop (tunnel round-trips never inside the sample; every measurement
+has a REAL iteration-to-iteration data dependence, see tpu_smoke._time).
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+from tpu_smoke import opt_feed  # noqa: E402
+from tpu_longctx import _time_adaptive  # noqa: E402
+
+
+def rec(**kw):
+    print(json.dumps(kw), flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--small", action="store_true")
+    args = ap.parse_args()
+
+    from apex_tpu.backend_guard import tpu_slot_lock
+
+    with tpu_slot_lock():
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        import apex_tpu.multi_tensor as mt
+        from apex_tpu.optimizers import FusedLAMB
+        from bench import bert_large_shapes
+
+        d = jax.devices()[0]
+        rec(what="device", kind=str(d.device_kind),
+            platform=str(d.platform),
+            backend=str(jax.default_backend()))
+
+        rng = np.random.RandomState(0)
+        # interpret-mode pallas at these sizes is not a measurement;
+        # on CPU only the xla impl is timed (the chip times both)
+        impls = (("xla",) if jax.default_backend() == "cpu"
+                 else ("pallas", "xla"))
+
+        # 1. raw streaming bandwidth: out-of-place scale of a 1 GiB
+        # buffer, output fed back as next input (zero harness traffic)
+        try:
+            n_raw = 1 << 28   # 268M fp32 = 1 GiB
+            buf = jnp.asarray(rng.randn(n_raw).astype(np.float32))
+            t = _time_adaptive(lambda b: (b * 1.0000001,), buf,
+                               feed=lambda out, carry: out)
+            rec(what="raw_copy_scale", gib=1.0, ms=round(t * 1e3, 3),
+                gb_per_sec=round(2 * n_raw * 4 / t / 1e9, 1))
+            del buf
+        except Exception as e:  # noqa: BLE001
+            rec(what="raw_copy_scale",
+                error=f"{type(e).__name__}: {str(e)[:120]}")
+
+        shapes = (bert_large_shapes(hidden=512, layers=8)
+                  if args.small else bert_large_shapes())
+        params = {
+            f"p{i}": jnp.asarray(rng.randn(*s).astype(np.float32) * 0.02)
+            for i, s in enumerate(shapes)
+        }
+        grads = {
+            k: jnp.asarray(rng.randn(*v.shape).astype(np.float32) * 1e-3)
+            for k, v in params.items()
+        }
+        space = mt.FlatSpace.create(params)
+        n = int(space.total)
+        gb = n * 4 / 1e9
+        rec(what="workload", n_params=n, n_tensors=len(shapes),
+            fp32_gb=round(gb, 3))
+
+        # 2. optax.lamb on the tree, state threaded (the baseline,
+        # measured with the same chained discipline as everything else)
+        try:
+            tx = optax.lamb(1e-3, weight_decay=0.01)
+            ostate = tx.init(params)
+            ps_leaves, ps_def = jax.tree.flatten((params, ostate))
+            n_ps = len(ps_leaves)
+            g_leaves, g_def = jax.tree.flatten(grads)
+
+            def optax_step(*leaves):
+                p, s = jax.tree.unflatten(ps_def, leaves[:n_ps])
+                g = jax.tree.unflatten(g_def, leaves[n_ps:])
+                upd, s2 = tx.update(g, s, p)
+                p2 = optax.apply_updates(p, upd)
+                probe = sum(jnp.sum(l) for l in jax.tree.leaves(p2))
+                return (*jax.tree.leaves((p2, s2)), probe)
+
+            t = _time_adaptive(
+                optax_step, *ps_leaves, *g_leaves,
+                feed=lambda out, carry: (*out[:n_ps], *carry[n_ps:]))
+            rec(what="optax_lamb_tree", ms=round(t * 1e3, 3),
+                gb_per_sec=round(10 * gb / t, 1))
+            del ostate, ps_leaves
+        except Exception as e:  # noqa: BLE001
+            rec(what="optax_lamb_tree",
+                error=f"{type(e).__name__}: {str(e)[:120]}")
+
+        # 3. the FULL FusedLAMB.step exactly as bench.py's headline runs
+        # it: pack(grad tree) + kernel + unpack + per-leaf probe fold.
+        # Each impl's 3-buffer state (4 GB at BERT-large scale) is
+        # dropped before the next allocates — two live states OOM the
+        # 16 GB chip and a chip-side OOM degrades the tunnel for
+        # everyone after (docs/HARDWARE_NOTES.md).
+        for impl in impls:
+            state0 = None
+            try:
+                opt = FusedLAMB(lr=1e-3, weight_decay=0.01,
+                                max_grad_norm=0.0, use_nvlamb=True,
+                                impl=impl)
+                state0 = opt.init(params)
+
+                def full_step(master, m_, v_, count, *gleaves,
+                              opt=opt, state0=state0):
+                    gtree = dict(zip(sorted(grads), gleaves))
+                    st = state0._replace(
+                        master=master,
+                        slots={"m": m_, "v": v_}, count=count)
+                    new_params, st2 = opt.step(st, gtree)
+                    probe = sum(jnp.sum(l)
+                                for l in jax.tree.leaves(new_params))
+                    return (st2.master, st2.slots["m"], st2.slots["v"],
+                            st2.count, probe)
+
+                t = _time_adaptive(
+                    full_step, state0.master, state0.slots["m"],
+                    state0.slots["v"], state0.count,
+                    *[grads[k] for k in sorted(grads)],
+                    feed=lambda out, carry: (*out[:4], *carry[4:]))
+                rec(what="full_step_pack_kernel_unpack", impl=impl,
+                    ms=round(t * 1e3, 3))
+            except Exception as e:  # noqa: BLE001
+                rec(what="full_step_pack_kernel_unpack", impl=impl,
+                    error=f"{type(e).__name__}: {str(e)[:120]}")
+            finally:
+                del state0
+
+        # 4. kernel-only updates on pre-flat buffers; the param/grad
+        # trees are dropped first so the chained loop has headroom for
+        # its in-flight outputs (carry + new state + update term)
+        try:
+            flat_g = space.pack(grads, dtype=jnp.float32)
+            flat_p = space.pack(params, dtype=jnp.float32)
+            m = jnp.zeros_like(flat_p)
+            v = jnp.zeros_like(flat_p)
+            del params, grads
+        except Exception as e:  # noqa: BLE001
+            rec(what="kernel_only_setup",
+                error=f"{type(e).__name__}: {str(e)[:120]}")
+            return
+
+        for name, fn in (
+            ("lamb", lambda p_, m_, v_, g_, impl: mt.fused_lamb_update(
+                p_, m_, v_, g_, space, lr=1e-3, step=2, weight_decay=0.01,
+                use_nvlamb=True, max_grad_norm=0.0, impl=impl)[:3]),
+            ("adam", lambda p_, m_, v_, g_, impl: mt.fused_adam_update(
+                p_, m_, v_, g_, lr=1e-3, step=2, weight_decay=0.01,
+                impl=impl)[:3]),
+        ):
+            # traffic: lamb r(p,m,v,g)+w(u,m,v) stage1, r(p,u)+w(p)
+            # stage2 = 10x n*4; adam r(p,m,v,g)+w(p,m,v) = 7x
+            acc = 10 if name == "lamb" else 7
+            for impl in impls:
+                try:
+                    t = _time_adaptive(
+                        lambda p_, m_, v_, g_, fn=fn, impl=impl:
+                        fn(p_, m_, v_, g_, impl), flat_p, m, v, flat_g,
+                        feed=opt_feed)
+                    rec(what=f"fused_{name}_update_flat", impl=impl,
+                        ms=round(t * 1e3, 3),
+                        gb_per_sec=round(acc * gb / t, 1))
+                except Exception as e:  # noqa: BLE001
+                    rec(what=f"fused_{name}_update_flat", impl=impl,
+                        error=f"{type(e).__name__}: {str(e)[:120]}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+#!/bin/bash
+# Round-3 remaining hardware measurements, health-gated.
+#
+# Probes chip health (tools/tpu_health.py: raw streaming >= 300 GB/s)
+# every INTERVAL seconds; when healthy, runs the queue ONCE, serially,
+# re-checking health between stages — a stage that OOMs degrades the
+# tunnel for every stage after it (docs/HARDWARE_NOTES.md), so the gate
+# keeps poisoned numbers out of the logs.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${INTERVAL:-480}
+LOGDIR=${LOGDIR:-/tmp/tpu_queue_r3}
+mkdir -p "$LOGDIR"
+echo "logs -> $LOGDIR"
+
+healthy() { timeout 240 python tools/tpu_health.py >>"$LOGDIR/health.log" 2>&1; }
+
+run() {  # run <name> <timeout-s> <cmd...>
+  local name=$1 to=$2; shift 2
+  until healthy; do
+    echo "chip unhealthy before $name $(date -u +%H:%M:%S); retry in ${INTERVAL}s"
+    sleep "$INTERVAL"
+  done
+  echo "=== $name ($(date -u +%H:%M:%S)) ==="
+  timeout "$to" "$@" >"$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  tail -3 "$LOGDIR/$name.log"
+  echo "--- $name rc=$rc"
+}
+
+run optdiag   1800 python tools/tpu_optdiag.py --small
+run longctx   1800 python tools/tpu_longctx.py
+run bench_bert 2400 python bench.py bert
+run bench_gpt  2400 python bench.py gpt
+run bench_resnet 2400 python bench.py resnet
+
+echo "QUEUE DONE ($(date -u +%H:%M:%S)); logs in $LOGDIR"

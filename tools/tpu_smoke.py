@@ -21,27 +21,70 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 
-def _time(fn, *args, iters=30, warmup=2, chain=20):
+def _time(fn, *args, iters=30, warmup=2, chain=20, feed=None):
     """Per-call device time of ``fn``: ``chain`` iterations run inside
-    ONE jitted fori_loop (an optimization_barrier ties each iteration's
-    inputs to the previous outputs, so XLA can neither CSE nor overlap
-    them), amortizing host dispatch — which costs ~ms through the axon
-    tunnel and would otherwise dominate every sub-ms kernel. The outer
-    loop then queues all calls and syncs once (block_until_ready alone
-    is async through the tunnel; device_get of a scalar is the fence).
+    ONE jitted fori_loop, amortizing host dispatch — which costs ~ms
+    through the axon tunnel and would otherwise dominate every sub-ms
+    kernel. The outer loop then queues all calls and syncs once
+    (block_until_ready alone is async through the tunnel; device_get of
+    a scalar is the fence).
+
+    ``feed(out, args) -> next_args`` threads each iteration's outputs
+    into the next iteration's inputs. THIS IS LOAD-BEARING: without a
+    real data dependence XLA hoists the loop-invariant ``fn(*args)``
+    out of the fori_loop and the "chain" measures ONE call (verified
+    empirically — an optimization_barrier on a discarded output does
+    NOT stop it; a 1024x1024 matmul "sped up" 50x at chain=50). When
+    no natural feed exists, every output leaf is folded into a probe
+    scalar that scales the first input — a multiply by a runtime value
+    the compiler cannot fold away.
     """
     import jax
     import jax.numpy as jnp
 
     def chained(*a):
-        def body(_, carry):
+        def body(_, c):
+            carry, probe = c
             out = fn(*carry)
-            # tie the carry to `out` so iteration i+1 depends on i
-            carry2, _ = jax.lax.optimization_barrier((carry, out))
-            return carry2
+            if feed is not None:
+                nxt = feed(out, carry)
+                # leaves the feed threads forward stay live through the
+                # loop carry; only the DEAD leaves (e.g. the loss in a
+                # (loss, *grads) tuple) need folding into the probe —
+                # summing live ones would add full-array reductions to
+                # every timed iteration
+                live = {id(l) for l in jax.tree.leaves(nxt)}
+                dead = [l for l in jax.tree.leaves(out)
+                        if id(l) not in live]
+            else:
+                # no natural output->input feed: every output leaf is
+                # dead, and EVERY input must be made iteration-variant
+                # (scaling only one would let XLA hoist sub-computations
+                # that read the others) — scale by a runtime-dependent
+                # 1.0 (isnan of a runtime value can't be constant-
+                # folded). This costs a read+write of the inputs plus
+                # the probe reductions per iteration; prefer a real
+                # `feed` for bandwidth-sensitive measurements.
+                dead = list(jax.tree.leaves(out))
+                one = jnp.where(jnp.isnan(probe), probe, 1.0)
+                nxt = jax.tree.map(
+                    lambda l: (l * one.astype(l.dtype))
+                    if hasattr(l, "dtype")
+                    and jnp.issubdtype(l.dtype, jnp.floating) else l,
+                    carry)
+            probe = probe + sum(
+                jnp.sum(l).astype(jnp.float32) for l in dead)
+            return (tuple(nxt), probe)
 
-        final = jax.lax.fori_loop(0, chain, body, a)
-        return jnp.sum(jax.tree.leaves(final)[0].ravel()[:1])
+        final, probe = jax.lax.fori_loop(0, chain, body,
+                                         (a, jnp.float32(0.0)))
+        # tap one element of each final carry leaf: the chain's last
+        # outputs are consumed, so no iteration can be pruned, while the
+        # host transfer stays scalar. (Element-0 slices can't reach back
+        # through the loop: carries are full arrays every iteration.)
+        return probe + sum(
+            l.ravel()[0].astype(jnp.float32)
+            for l in jax.tree.leaves(final))
 
     f = jax.jit(chained)
     for _ in range(warmup):
@@ -52,6 +95,18 @@ def _time(fn, *args, iters=30, warmup=2, chain=20):
         out = f(*args)
     jax.device_get(out)
     return (time.perf_counter() - t0) / (iters * chain)
+
+
+def grad_feed(out, carry):
+    """Natural feed for ``(loss, *grads)`` outputs: grads become the
+    next iteration's inputs (shapes/dtypes match their primals)."""
+    return out[1:]
+
+
+def opt_feed(out, carry):
+    """Natural feed for optimizer steps ``(p,m,v,g) -> (p2,m2,v2)``:
+    thread the state, reuse the grad."""
+    return (*out, carry[3])
 
 
 def run(perf=False, kimpl="pallas", only=None):
@@ -112,6 +167,13 @@ def run(perf=False, kimpl="pallas", only=None):
             print(f"  [FAIL] {name:42s} {type(e).__name__}: {msg}")
 
     print(f"backend: {jax.default_backend()}  devices: {len(jax.devices())}")
+    if perf:
+        print("# perf note: timings use _time's no-feed fallback, which "
+              "adds fixed per-iteration probe traffic (one input "
+              "read+write + output reductions). Common-mode for both "
+              "impls, so the (Nx) column UNDERSTATES bandwidth-bound "
+              "kernel speedups; tools/tpu_tune.py carries the "
+              "feed-threaded numbers that count.")
 
     # ---- multi_tensor engine ops over a flat buffer -------------------
     from apex_tpu import multi_tensor as mt

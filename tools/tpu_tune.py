@@ -17,7 +17,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import numpy as np
 
 from tpu_smoke import _time  # noqa: E402  (chained timer)
-
+from tpu_smoke import grad_feed as _grad_feed  # noqa: E402
+from tpu_smoke import opt_feed as _opt_feed  # noqa: E402
 
 def tune_attn():
     import jax
@@ -48,7 +49,8 @@ def tune_attn():
                 return (l, *g)
 
             try:
-                t = _time(fwd_bwd, q, k, v, iters=3, chain=10)
+                t = _time(fwd_bwd, q, k, v, iters=3, chain=10,
+                          feed=_grad_feed)
                 base = base or t
                 print(f"  bq={bq:5d} bk={bk:5d}  {t*1e3:8.3f} ms "
                       f"({base/t:4.2f}x)")
@@ -63,7 +65,7 @@ def tune_attn():
             return (l, *g)
 
         try:
-            t = _time(xla_fb, q, k, v, iters=3, chain=10)
+            t = _time(xla_fb, q, k, v, iters=3, chain=10, feed=_grad_feed)
             print(f"  xla reference   {t*1e3:8.3f} ms")
         except Exception as e:  # noqa: BLE001
             print(f"  xla reference   FAIL {str(e)[:60]}")
@@ -106,7 +108,8 @@ def tune_attn_bwd():
                 return (l, *g)
 
             try:
-                t = _time(fwd_bwd, q, k, v, iters=3, chain=10)
+                t = _time(fwd_bwd, q, k, v, iters=3, chain=10,
+                          feed=_grad_feed)
                 base = base or t
                 print(f"  bbq={bbq:5d} bbk={bbk:5d}  {t*1e3:8.3f} ms "
                       f"({base/t:4.2f}x)")
@@ -142,13 +145,13 @@ def tune_ln():
         ln_mod._DEF_ROWS = tile_rows
         try:
             t = _time(lambda x, w, b: fwd_bwd(x, w, b, "pallas"),
-                      x, w, b, iters=3, chain=20)
+                      x, w, b, iters=3, chain=20, feed=_grad_feed)
             print(f"  tile_rows={tile_rows:5d}  {t*1e3:8.3f} ms")
         except Exception as e:  # noqa: BLE001
             print(f"  tile_rows={tile_rows:5d}  FAIL {str(e)[:60]}")
     ln_mod._DEF_ROWS = orig
     t = _time(lambda x, w, b: fwd_bwd(x, w, b, "xla"), x, w, b,
-              iters=3, chain=20)
+              iters=3, chain=20, feed=_grad_feed)
     print(f"  xla reference     {t*1e3:8.3f} ms")
 
 
@@ -172,7 +175,8 @@ def tune_softmax():
     print("causal softmax fwd+bwd (32,1024,1024) bf16")
     for impl in ("pallas", "xla"):
         try:
-            t = _time(lambda x: fwd_bwd(x, impl), x, iters=3, chain=20)
+            t = _time(lambda x: fwd_bwd(x, impl), x, iters=3, chain=20,
+                      feed=_grad_feed)
             print(f"  {impl:8s}  {t*1e3:8.3f} ms")
         except Exception as e:  # noqa: BLE001
             print(f"  {impl:8s}  FAIL {str(e)[:60]}")
@@ -191,7 +195,7 @@ def _sweep_tile_rows(label, step_fn, args, n, accesses_per_elem):
     for tile_rows in (128, 256, 512, 1024, 2048):
         engine.DEFAULT_TILE_ROWS = tile_rows
         try:
-            t = _time(step_fn, *args, iters=3, chain=5)
+            t = _time(step_fn, *args, iters=3, chain=5, feed=_opt_feed)
             gbps = accesses_per_elem * n * 4 / t / 1e9
             print(f"  tile_rows={tile_rows:5d}  {t*1e3:8.3f} ms "
                   f"({gbps:6.1f} GB/s)")
@@ -221,7 +225,7 @@ def tune_opt():
     # adam: reads p/m/v/g + writes p/m/v = 7 accesses per element
     _sweep_tile_rows("fused adam update", adam_step, (p, m, v, g), n, 7)
     t = _time(lambda *a: adam_step(*a, impl="xla"), p, m, v, g,
-              iters=3, chain=5)
+              iters=3, chain=5, feed=_opt_feed)
     print(f"  xla reference     {t*1e3:8.3f} ms ({7*n*4/t/1e9:6.1f} GB/s)")
 
     # LAMB with the stage-1-fused per-tensor norm partials: sweep the
