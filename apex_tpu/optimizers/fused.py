@@ -162,8 +162,45 @@ class FlatFusedOptimizer:
         is inf/nan (loss-scaler integration); the step counter then only
         counts *unskipped* steps, matching the reference scaler's
         ``unskipped`` bookkeeping (apex/amp/scaler.py:206-226).
+
+        Packing the grad tree costs a full extra read+write of the
+        gradients every step; a flat-native training loop avoids it by
+        differentiating straight into the flat space and calling
+        :meth:`step_flat`::
+
+            grads_flat = jax.grad(
+                lambda master: loss_fn(state.space.unpack(master))
+            )(state.master)
+            new_params, state = opt.step_flat(state, grads_flat)
+            # the updated FLAT buffer for the next iteration is
+            # state.master; new_params is the unpacked tree
         """
         g = state.space.pack(grads, dtype=jnp.float32)
+        return self.step_flat(state, g, lr=lr, grad_scale=grad_scale,
+                              skip_if_nonfinite=skip_if_nonfinite)
+
+    def step_flat(
+        self,
+        state: FlatOptState,
+        flat_grads: jax.Array,
+        *,
+        lr: Optional[Schedule] = None,
+        grad_scale=1.0,
+        skip_if_nonfinite: bool = False,
+    ) -> Tuple[Any, FlatOptState]:
+        """:meth:`step` for gradients already in the flat space — the
+        layout ``jax.grad`` produces when the loss closes over
+        ``space.unpack(master)`` (unpack's transpose scatters grads
+        back into one flat buffer). Skips the per-leaf pack entirely;
+        the packed-layout analog of the reference feeding its flat DDP
+        bucket straight into ``multi_tensor_*``
+        (ref: apex/contrib/optimizers/distributed_fused_lamb.py flat
+        grad blocks)."""
+        g = flat_grads
+        if g.shape != state.master.shape:
+            raise ValueError(
+                f"flat_grads shape {g.shape} != master {state.master.shape}")
+        g = g.astype(jnp.float32)
         lr_val = _resolve_lr(lr if lr is not None else self.lr, state.count)
         new_master, new_slots, found = self._update(state, g, lr_val, grad_scale)
 

@@ -368,3 +368,65 @@ class TestStochasticRoundingMaster:
         p2r, _ = opt.step(s1, g)
         np.testing.assert_array_equal(np.asarray(p2["w"]),
                                       np.asarray(p2r["w"]))
+
+
+class TestStepFlat:
+    """step_flat consumes grads already in the flat space — bitwise the
+    same update as step(pack(tree)), and the layout jax.grad produces
+    when the loss differentiates through space.unpack(master)."""
+
+    def test_step_flat_matches_step(self):
+        from apex_tpu.optimizers import FusedAdam
+
+        rng = np.random.RandomState(0)
+        params = {"a": jnp.asarray(rng.randn(64, 32).astype(np.float32)),
+                  "b": jnp.asarray(rng.randn(17).astype(np.float32))}
+        grads = jax.tree.map(
+            lambda p: jnp.asarray(
+                rng.randn(*p.shape).astype(np.float32) * 1e-2), params)
+        opt = FusedAdam(lr=1e-3, weight_decay=0.01)
+        s0 = opt.init(params)
+
+        p_tree, s_tree = opt.step(s0, grads)
+        flat = s0.space.pack(grads, dtype=jnp.float32)
+        p_flat, s_flat = opt.step_flat(opt.init(params), flat)
+        np.testing.assert_array_equal(np.asarray(s_tree.master),
+                                      np.asarray(s_flat.master))
+        for a, b in zip(jax.tree.leaves(p_tree), jax.tree.leaves(p_flat)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_grad_through_unpack_is_flat(self):
+        """The flat-native loop: jax.grad w.r.t. the master buffer
+        yields flat grads step_flat accepts, and the resulting training
+        trajectory matches the tree-grad path."""
+        from apex_tpu.optimizers import FusedAdam
+
+        rng = np.random.RandomState(1)
+        params = {"w": jnp.asarray(rng.randn(8, 4).astype(np.float32)),
+                  "b": jnp.asarray(np.zeros(4, np.float32))}
+        x = jnp.asarray(rng.randn(16, 8).astype(np.float32))
+        y = jnp.asarray(rng.randn(16, 4).astype(np.float32))
+
+        def loss_tree(p):
+            return jnp.mean((x @ p["w"] + p["b"] - y) ** 2)
+
+        opt = FusedAdam(lr=1e-2)
+        s_a = opt.init(params)
+        s_b = opt.init(params)
+        for _ in range(3):
+            p_a = s_a.space.unpack(s_a.master)
+            _, s_a = opt.step(s_a, jax.grad(loss_tree)(p_a))
+            gflat = jax.grad(
+                lambda mm: loss_tree(s_b.space.unpack(mm)))(s_b.master)
+            _, s_b = opt.step_flat(s_b, gflat)
+        np.testing.assert_allclose(np.asarray(s_a.master),
+                                   np.asarray(s_b.master), rtol=1e-6)
+
+    def test_step_flat_shape_mismatch(self):
+        from apex_tpu.optimizers import FusedAdam
+
+        params = {"a": jnp.zeros((32,), jnp.float32)}
+        opt = FusedAdam(lr=1e-3)
+        s0 = opt.init(params)
+        with pytest.raises(ValueError, match="flat_grads shape"):
+            opt.step_flat(s0, jnp.zeros((s0.master.shape[0] + 1,)))

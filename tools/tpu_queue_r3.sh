@@ -28,10 +28,8 @@ run() {  # run <name> <timeout-s> <cmd...>
   echo "--- $name rc=$rc"
 }
 
-run optdiag   1800 python tools/tpu_optdiag.py --small
-run longctx   1800 python tools/tpu_longctx.py
-run bench_bert 2400 python bench.py bert
-run bench_gpt  2400 python bench.py gpt
+run bisect    1800 python tools/tpu_bisect.py
+run kprobe    1800 python tools/tpu_kprobe.py
 run bench_resnet 2400 python bench.py resnet
 
 echo "QUEUE DONE ($(date -u +%H:%M:%S)); logs in $LOGDIR"
