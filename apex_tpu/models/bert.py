@@ -54,6 +54,10 @@ class BertConfig:
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
     layernorm_epsilon: float = 1e-5
+    # lax.scan over stacked layer params — one compiled layer body
+    # instead of num_layers inlined copies (see GPTConfig.scan_layers;
+    # 24 unrolled layers crash the Mosaic compile helper on chip).
+    scan_layers: bool = True
 
     def __post_init__(self):
         if self.attention_backend not in ("softmax", "flash"):
@@ -243,6 +247,20 @@ class BertLMHead(nn.Module):
         return logits + vbias.astype(jnp.float32)
 
 
+class _BertScanBlock(nn.Module):
+    """scan body: carry = hidden states; broadcast input = the
+    attention mask. ``deterministic`` stays a static attribute."""
+
+    config: BertConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, ext_mask):
+        y = BertLayer(self.config, name="layer")(
+            x, ext_mask, deterministic=self.deterministic)
+        return y, None
+
+
 class BertModel(nn.Module):
     """Full BERT. Inputs: token ids (b, s), attention keep-mask (b, s),
     optional tokentype ids (b, s). Returns (lm_logits (s, b, vocab[/tp]),
@@ -288,9 +306,19 @@ class BertModel(nn.Module):
             )
             x = scatter_to_sequence_parallel_region(x)
 
-        for i in range(cfg.num_layers):
-            x = BertLayer(cfg, name=f"layer_{i}")(
-                x, ext_mask, deterministic=deterministic)
+        if cfg.scan_layers:
+            scan = nn.scan(
+                _BertScanBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
+                in_axes=nn.broadcast,
+            )
+            x, _ = scan(cfg, deterministic, name="layers")(x, ext_mask)
+        else:
+            for i in range(cfg.num_layers):
+                x = BertLayer(cfg, name=f"layer_{i}")(
+                    x, ext_mask, deterministic=deterministic)
         x = FusedLayerNorm(cfg.hidden_size, eps=cfg.layernorm_epsilon,
                            name="final_norm")(x)
 
@@ -358,15 +386,21 @@ def bert_param_specs(params: Any) -> Any:
         names = [str(getattr(k, "key", k)) for k in path]
         joined = "/".join(names)
         if "embedding" in joined and names[-1] == "embedding":
-            return P(TENSOR_AXIS, None)
-        if ("qkv" in joined or "fc1" in joined) and names[-1] == "kernel":
-            return P(TENSOR_AXIS, None)
-        if ("qkv" in joined or "fc1" in joined) and names[-1] == "bias":
-            return P(TENSOR_AXIS)
-        if ("proj" in joined or "fc2" in joined) and names[-1] == "kernel":
-            return P(None, TENSOR_AXIS)
-        if names[-2:] == ["lm_head", "bias"]:   # the vocab-sharded bias only
-            return P(TENSOR_AXIS)
-        return P()
+            spec = P(TENSOR_AXIS, None)
+        elif ("qkv" in joined or "fc1" in joined) and names[-1] == "kernel":
+            spec = P(TENSOR_AXIS, None)
+        elif ("qkv" in joined or "fc1" in joined) and names[-1] == "bias":
+            spec = P(TENSOR_AXIS)
+        elif ("proj" in joined or "fc2" in joined) and names[-1] == "kernel":
+            spec = P(None, TENSOR_AXIS)
+        elif names[-2:] == ["lm_head", "bias"]:   # the vocab-sharded bias
+            spec = P(TENSOR_AXIS)
+        else:
+            return P()
+        if "layers" in names:
+            # scan_layers stacks layer params with a leading layer
+            # axis; the TP sharding moves one dim to the right
+            spec = P(None, *spec)
+        return spec
 
     return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -65,6 +65,13 @@ class GPTConfig:
     # axis — run the model inside shard_map with tokens sharded along
     # seq and pass global `positions`)
     attention_backend: str = "flash"
+    # lax.scan over stacked layer params (one compiled layer body
+    # instead of num_layers inlined copies). Compile time and program
+    # size become depth-independent — 24 unrolled BERT/GPT-class layers
+    # overwhelm the Mosaic compile pipeline (docs/HARDWARE_NOTES.md
+    # round-3 bench_bert/gpt compile crashes). False restores per-layer
+    # param names ("layer_{i}") for name-addressed checkpoints.
+    scan_layers: bool = True
 
     def __post_init__(self):
         if self.num_kv_heads is not None and self.num_kv_heads < 1:
@@ -259,6 +266,21 @@ class GPTLayer(nn.Module):
         return x + m
 
 
+class _GPTScanBlock(nn.Module):
+    """scan body: carry = hidden states; broadcast inputs = positions.
+    ``deterministic`` is a static module attribute so the dropout
+    branch stays Python-level (no traced bool inside the scan)."""
+
+    config: GPTConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, positions):
+        y = GPTLayer(self.config, name="layer")(
+            x, positions=positions, deterministic=self.deterministic)
+        return y, None
+
+
 class GPTModel(nn.Module):
     """Full GPT LM. Input token ids (b, s); returns vocab-parallel
     logits in (s, b, vocab[/tp]) layout (Megatron sbh convention)."""
@@ -295,9 +317,19 @@ class GPTModel(nn.Module):
             )
             x = scatter_to_sequence_parallel_region(x)
 
-        for i in range(cfg.num_layers):
-            x = GPTLayer(cfg, name=f"layer_{i}")(
-                x, positions=positions, deterministic=deterministic)
+        if cfg.scan_layers:
+            scan = nn.scan(
+                _GPTScanBlock,
+                variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_layers,
+                in_axes=nn.broadcast,
+            )
+            x, _ = scan(cfg, deterministic, name="layers")(x, positions)
+        else:
+            for i in range(cfg.num_layers):
+                x = GPTLayer(cfg, name=f"layer_{i}")(
+                    x, positions=positions, deterministic=deterministic)
         x = FusedLayerNorm(cfg.hidden_size, name="final_norm")(x)
 
         if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
@@ -351,13 +383,19 @@ def gpt_param_specs(params: Any) -> Any:
         names = [str(getattr(k, "key", k)) for k in path]
         joined = "/".join(names)
         if "embedding" in joined and names[-1] == "embedding":
-            return P(TENSOR_AXIS, None)
-        if ("qkv" in joined or "fc1" in joined) and names[-1] == "kernel":
-            return P(TENSOR_AXIS, None)
-        if ("qkv" in joined or "fc1" in joined) and names[-1] == "bias":
-            return P(TENSOR_AXIS)
-        if ("proj" in joined or "fc2" in joined) and names[-1] == "kernel":
-            return P(None, TENSOR_AXIS)
-        return P()
+            spec = P(TENSOR_AXIS, None)
+        elif ("qkv" in joined or "fc1" in joined) and names[-1] == "kernel":
+            spec = P(TENSOR_AXIS, None)
+        elif ("qkv" in joined or "fc1" in joined) and names[-1] == "bias":
+            spec = P(TENSOR_AXIS)
+        elif ("proj" in joined or "fc2" in joined) and names[-1] == "kernel":
+            spec = P(None, TENSOR_AXIS)
+        else:
+            return P()
+        if "layers" in names:
+            # scan_layers stacks layer params with a leading layer
+            # axis; the TP sharding moves one dim to the right
+            spec = P(None, *spec)
+        return spec
 
     return jax.tree_util.tree_map_with_path(spec_for, params)

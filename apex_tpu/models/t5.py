@@ -59,6 +59,10 @@ class T5Config:
     # padding as segment ids, decoder causal, cross-attention via
     # key-side-only segment masking
     attention_backend: str = "softmax"
+    # lax.scan over stacked encoder/decoder layer params (see
+    # GPTConfig.scan_layers — unrolled stacks crash the Mosaic compile
+    # helper and compile slowly everywhere)
+    scan_layers: bool = True
 
     def __post_init__(self):
         if self.attention_backend not in ("softmax", "flash"):
@@ -199,6 +203,28 @@ class _MLP(nn.Module):
         )(y)
 
 
+class _EncScanBlock(nn.Module):
+    """scan body for the encoder stack (see GPTConfig.scan_layers)."""
+
+    config: "T5Config"
+
+    @nn.compact
+    def __call__(self, x, enc_mask):
+        return EncoderLayer(self.config, name="layer")(x, enc_mask), None
+
+
+class _DecScanBlock(nn.Module):
+    """scan body for the decoder stack: broadcast inputs are the
+    encoder output and the cross-attention mask."""
+
+    config: "T5Config"
+
+    @nn.compact
+    def __call__(self, x, enc_out, cross_mask):
+        return DecoderLayer(self.config, name="layer")(
+            x, enc_out, cross_mask), None
+
+
 class EncoderLayer(nn.Module):
     """Pre-LN: bidirectional self-attn + MLP (ref
     ParallelTransformerLayer with LayerType.encoder)."""
@@ -272,15 +298,32 @@ class T5Model(nn.Module):
 
         x = emb(enc_tokens) + pos[:s_enc][None].astype(cfg.dtype)
         x = x.transpose(1, 0, 2)
-        for i in range(cfg.num_encoder_layers):
-            x = EncoderLayer(cfg, name=f"encoder_{i}")(x, enc_attn_mask)
+        if cfg.scan_layers:
+            enc_scan = nn.scan(
+                _EncScanBlock, variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_encoder_layers, in_axes=nn.broadcast,
+            )
+            x, _ = enc_scan(cfg, name="encoder_layers")(x, enc_attn_mask)
+        else:
+            for i in range(cfg.num_encoder_layers):
+                x = EncoderLayer(cfg, name=f"encoder_{i}")(x, enc_attn_mask)
         enc_out = FusedLayerNorm(cfg.hidden_size, name="encoder_norm")(x)
 
         y = emb(dec_tokens) + pos[:s_dec][None].astype(cfg.dtype)
         y = y.transpose(1, 0, 2)
-        for i in range(cfg.num_decoder_layers):
-            y = DecoderLayer(cfg, name=f"decoder_{i}")(
+        if cfg.scan_layers:
+            dec_scan = nn.scan(
+                _DecScanBlock, variable_axes={"params": 0},
+                split_rngs={"params": True, "dropout": True},
+                length=cfg.num_decoder_layers, in_axes=nn.broadcast,
+            )
+            y, _ = dec_scan(cfg, name="decoder_layers")(
                 y, enc_out, cross_mask)
+        else:
+            for i in range(cfg.num_decoder_layers):
+                y = DecoderLayer(cfg, name=f"decoder_{i}")(
+                    y, enc_out, cross_mask)
         y = FusedLayerNorm(cfg.hidden_size, name="decoder_norm")(y)
 
         # tied LM head (ref parallel_lm_logits :1130-1164)
@@ -322,11 +365,16 @@ def t5_param_specs(params: Any) -> Any:
                   for n in ("qkv", "fc1", "q", "kv"))
         row = any(f"/{n}/" in f"/{joined}/" for n in ("proj", "fc2"))
         if col and names[-1] == "kernel":
-            return P(TENSOR_AXIS, None)
-        if col and names[-1] == "bias":
-            return P(TENSOR_AXIS)
-        if row and names[-1] == "kernel":
-            return P(None, TENSOR_AXIS)
-        return P()
+            spec = P(TENSOR_AXIS, None)
+        elif col and names[-1] == "bias":
+            spec = P(TENSOR_AXIS)
+        elif row and names[-1] == "kernel":
+            spec = P(None, TENSOR_AXIS)
+        else:
+            return P()
+        if any(n.endswith("_layers") for n in names):
+            # scan_layers stacks layer params (leading layer axis)
+            spec = P(None, *spec)
+        return spec
 
     return jax.tree_util.tree_map_with_path(spec_for, params)

@@ -103,14 +103,17 @@ class TestGQAWindow:
         model = GPTModel(cfg)
         x, y = synth_batch(rng, 2, 32, cfg.vocab_size)
         params = model.init(jax.random.PRNGKey(0), x)
-        qkv_kernel = params["params"]["layer_0"]["attention"]["qkv"]["kernel"]
+        # scan_layers stacks layer params: leading axis = layer index
+        qkv_kernel = params["params"]["layers"]["layer"][
+            "attention"]["qkv"]["kernel"]
         head_dim = cfg.hidden_size // cfg.num_heads
-        assert qkv_kernel.shape[0] == (cfg.num_heads + 2 * cfg.kv_heads) * head_dim
+        assert qkv_kernel.shape[0] == cfg.num_layers
+        assert qkv_kernel.shape[1] == (cfg.num_heads + 2 * cfg.kv_heads) * head_dim
 
         loss, grads = jax.value_and_grad(
             lambda p: gpt_loss_fn(model.apply(p, x), y))(params)
         assert np.isfinite(float(loss))
-        g = grads["params"]["layer_0"]["attention"]["qkv"]["kernel"]
+        g = grads["params"]["layers"]["layer"]["attention"]["qkv"]["kernel"]
         assert float(jnp.abs(g).sum()) > 0
 
     def test_gqa_kernel_matches_xla_in_model(self, rng):
@@ -239,3 +242,43 @@ class TestTensorParallel:
             ),
             g_tp, g_dense,
         )
+
+
+class TestScanLayersOptOut:
+    """scan_layers=False restores per-layer param names ("layer_{i}")
+    for name-addressed checkpoints, produces the same function, and
+    keeps gpt_param_specs' layer-axis shift from misfiring on the
+    un-stacked names."""
+
+    def test_unrolled_matches_scan(self, rng):
+        base = dict(vocab_size=128, max_seq_len=32, hidden_size=64,
+                    num_layers=2, num_heads=4, dtype=jnp.float32)
+        x, y = synth_batch(rng, 2, 32, 128)
+        scan_model = GPTModel(GPTConfig(**base))
+        loop_model = GPTModel(GPTConfig(scan_layers=False, **base))
+        sp = scan_model.init(jax.random.PRNGKey(0), x)
+        lp = loop_model.init(jax.random.PRNGKey(0), x)
+        assert "layer_0" in lp["params"] and "layers" in sp["params"]
+
+        # copy stacked params into the per-layer tree: same function
+        stacked = sp["params"]["layers"]["layer"]
+        lp2 = dict(lp["params"])
+        for i in range(2):
+            lp2[f"layer_{i}"] = jax.tree.map(lambda s, i=i: s[i], stacked)
+        for k in sp["params"]:
+            if k != "layers":
+                lp2[k] = sp["params"][k]
+        out_scan = scan_model.apply(sp, x)
+        out_loop = loop_model.apply({"params": lp2}, x)
+        np.testing.assert_allclose(np.asarray(out_scan),
+                                   np.asarray(out_loop), rtol=2e-5,
+                                   atol=2e-5)
+
+        # specs: per-layer names must NOT get the leading layer axis
+        specs = gpt_param_specs({"params": lp2})
+        qkv = specs["params"]["layer_0"]["attention"]["qkv"]["kernel"]
+        assert qkv == P("tensor", None)
+        sspecs = gpt_param_specs(sp)
+        sqkv = sspecs["params"]["layers"]["layer"]["attention"]["qkv"][
+            "kernel"]
+        assert sqkv == P(None, "tensor", None)

@@ -7,8 +7,10 @@ Pallas op AT THE EXACT SHAPES the model benches use, one jit at a time,
 so the crashing kernel identifies itself instead of hiding inside a
 4000-op model program.
 
-    python tools/tpu_bisect.py            # all candidates
-    python tools/tpu_bisect.py xentropy   # substring filter
+    python tools/tpu_bisect.py            # all kernel candidates
+    python tools/tpu_bisect.py xentropy   # substring filter (kernels)
+    python tools/tpu_bisect.py bert_full  # exact: whole-model fwd+bwd
+    python tools/tpu_bisect.py gpt_full   # exact: whole-model fwd+bwd
 """
 
 import json
@@ -122,7 +124,9 @@ def main():
         check("bert_scaled_masked_softmax_8x16x512x512", softmax_fwd_bwd,
               scores)
 
-        # the full bert/gpt fwd-bwd jits, for completeness (slow compile)
+        # the full bert/gpt fwd-bwd jits — exact names, not substrings
+        # (slow compiles; request explicitly with `tpu_bisect.py
+        # bert_full` / `gpt_full`)
         if only == "bert_full":
             from apex_tpu.models.bert import (BertConfig, BertModel,
                                               bert_loss_fn)
@@ -146,26 +150,20 @@ def main():
             check("bert_full", lambda p: jax.grad(bert_step)(p), params)
         elif only == "gpt_full":
 
-            if only == "bert_full":
-                check("bert_full", lambda p: jax.grad(bert_step)(p),
-                      params)
-            else:
-                from apex_tpu.models.gpt import (GPTConfig, GPTModel,
-                                                 gpt_loss_fn)
+            from apex_tpu.models.gpt import (GPTConfig, GPTModel,
+                                             gpt_loss_fn)
 
-                gcfg = GPTConfig.gpt2_345m(attention_backend="flash")
-                gmodel = GPTModel(gcfg)
-                toks = jnp.asarray(rng.randint(0, 50000, (4, 1025)),
-                                   jnp.int32)
-                gparams = gmodel.init(jax.random.PRNGKey(0),
-                                      toks[:, :-1])
+            gcfg = GPTConfig.gpt2_345m(attention_backend="flash")
+            gmodel = GPTModel(gcfg)
+            toks = jnp.asarray(rng.randint(0, 50000, (4, 1025)),
+                               jnp.int32)
+            gparams = gmodel.init(jax.random.PRNGKey(0), toks[:, :-1])
 
-                def gpt_step(p):
-                    return gpt_loss_fn(gmodel.apply(p, toks[:, :-1]),
-                                       toks[:, 1:])
+            def gpt_step(p):
+                return gpt_loss_fn(gmodel.apply(p, toks[:, :-1]),
+                                   toks[:, 1:])
 
-                check("gpt_full", lambda p: jax.grad(gpt_step)(p),
-                      gparams)
+            check("gpt_full", lambda p: jax.grad(gpt_step)(p), gparams)
 
 
 if __name__ == "__main__":
