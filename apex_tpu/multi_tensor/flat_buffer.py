@@ -139,3 +139,153 @@ class FlatSpace:
 def pack_like(space: FlatSpace, trees: Sequence[Any], dtype=jnp.float32):
     """Pack several congruent pytrees with one layout."""
     return [space.pack(t, dtype=dtype) for t in trees]
+
+
+# ---------------------------------------------------------------------------
+# Segmented layout (single-pass per-tensor optimizers)
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class SegmentMeta:
+    """Static companion of a segment-aligned :class:`FlatSpace`.
+
+    A *segment* is ``seg_elems`` consecutive buffer elements. The
+    layout guarantees every leaf either (a) lives entirely inside one
+    segment ("small", recorded in the per-subtile ``slot_ids`` map) or
+    (b) starts at a segment boundary and owns a whole number of
+    segments ("large", listed in ``large``). This is what lets a
+    single kernel pass compute per-tensor norms *and* apply them: each
+    small leaf's reduction is segment-local (apex_tpu/multi_tensor/
+    segmented.py), while the few large leaves fall back to the
+    two-stage path over their contiguous slices.
+    """
+
+    seg_elems: int                     # elements per segment
+    n_segments: int                    # total // seg_elems
+    small_segments: tuple[int, ...]    # segment indices the kernel sweeps
+    # (n_small_segments, seg_elems // align) local slot per subtile,
+    # -1 for padding subtiles
+    slot_ids: Any
+    # (n_small_segments, max_slots) global leaf index per slot, -1 pad
+    slot_leaf: Any
+    max_slots: int
+    # (leaf_idx, start_elem, padded_elems) per large leaf
+    large: tuple[tuple[int, int, int], ...]
+
+
+def default_seg_elems(total_estimate: int,
+                      cap: int = 1 << 22,
+                      chunk: int = 512 * 128) -> int:
+    """Segment size matched to the workload: ~1/8 of the buffer
+    (so small models get several segments and tiny CPU tests don't
+    drag a mostly-padding 16 MB segment through interpret mode),
+    clamped to [1 chunk, cap] and rounded to a chunk multiple."""
+    want = max(chunk, min(cap, total_estimate // 8))
+    return ((want + chunk - 1) // chunk) * chunk
+
+
+def segmented_space(
+    tree: Any,
+    seg_elems: Optional[int] = None,
+    max_slots: int = 512,
+    align: int = DEFAULT_ALIGN,
+) -> tuple[FlatSpace, SegmentMeta]:
+    """A :class:`FlatSpace` whose leaf padding is segment-aware, plus
+    the static segment metadata.
+
+    Leaf order is preserved (pack/unpack stay the plain concatenate /
+    slice of FlatSpace); padding grows only where a small leaf would
+    straddle a segment boundary, where a segment would exceed
+    ``max_slots`` leaves, or before/after a large leaf (which must own
+    whole segments). Overhead is bounded by one segment per large leaf
+    plus boundary slack — negligible at real model scales.
+    """
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    if seg_elems is None:
+        est = sum(
+            _round_up(int(np.prod(l.shape)) if l.shape else 1, align)
+            for l in leaves)
+        seg_elems = default_seg_elems(est)
+    if seg_elems % align:
+        raise ValueError(f"seg_elems {seg_elems} must be a multiple of "
+                         f"the alignment {align}")
+    shapes, dtypes, offsets, sizes, padded = [], [], [], [], []
+    # per-small-leaf (segment, start, padded, leaf_idx); large list
+    small_places, large_places = [], []
+    off = 0
+    seg_fill_slots = 0
+    for idx, leaf in enumerate(leaves):
+        size = int(np.prod(leaf.shape)) if leaf.shape else 1
+        base_pad = _round_up(max(size, 1), align)
+        if base_pad > seg_elems:
+            start = _round_up(off, seg_elems)
+            psize = _round_up(base_pad, seg_elems)
+            large_places.append((idx, start, psize))
+            seg_fill_slots = max_slots    # force a fresh segment next
+        else:
+            start = off
+            seg_room = seg_elems - (start % seg_elems)
+            if base_pad > seg_room or seg_fill_slots >= max_slots:
+                start = _round_up(off, seg_elems)
+                seg_fill_slots = 0
+            if start % seg_elems == 0:
+                seg_fill_slots = 0
+            small_places.append((start // seg_elems, start, base_pad, idx))
+            seg_fill_slots += 1
+            psize = base_pad
+        # absorb any gap into the PREVIOUS leaf's padding so FlatSpace
+        # offsets (cumulative padded sizes) stay consistent
+        if offsets and start != off:
+            padded[-1] += start - off
+        elif start != off:
+            raise AssertionError("first leaf cannot need a gap")
+        shapes.append(tuple(leaf.shape))
+        dtypes.append(jnp.dtype(leaf.dtype))
+        offsets.append(start)
+        sizes.append(size)
+        padded.append(psize)
+        off = start + psize
+    total = _round_up(off, seg_elems)
+    if padded:
+        padded[-1] += total - off
+
+    space = FlatSpace(
+        treedef=treedef, shapes=tuple(shapes), dtypes=tuple(dtypes),
+        offsets=tuple(offsets), sizes=tuple(sizes),
+        padded_sizes=tuple(padded), total=total, align=align,
+    )
+
+    n_segments = total // seg_elems
+    sub_per_seg = seg_elems // align
+    large_segs = set()
+    for _, start, psize in large_places:
+        for s in range(start // seg_elems, (start + psize) // seg_elems):
+            large_segs.add(s)
+    small_segments = tuple(
+        s for s in range(n_segments) if s not in large_segs)
+    seg_pos = {s: i for i, s in enumerate(small_segments)}
+    slot_ids = np.full((len(small_segments), sub_per_seg), -1, np.int32)
+    slot_leaf = np.full((len(small_segments), max_slots), -1, np.int32)
+    next_slot = {}
+    for seg, start, psize, idx in small_places:
+        row = seg_pos[seg]
+        slot = next_slot.get(seg, 0)
+        next_slot[seg] = slot + 1
+        if slot >= max_slots:
+            raise AssertionError("layout exceeded max_slots")
+        slot_leaf[row, slot] = idx
+        lo = (start % seg_elems) // align
+        hi = lo + psize // align
+        slot_ids[row, lo:hi] = slot
+    used_slots = max(next_slot.values(), default=1)
+    # trim the slot axis to the real maximum (rounded up for lanes)
+    ms = max(8, int(_round_up(used_slots, 8)))
+    slot_leaf = slot_leaf[:, :ms]
+    meta = SegmentMeta(
+        seg_elems=seg_elems, n_segments=n_segments,
+        small_segments=small_segments, slot_ids=slot_ids,
+        slot_leaf=slot_leaf, max_slots=ms,
+        large=tuple(large_places),
+    )
+    return space, meta

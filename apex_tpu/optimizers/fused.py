@@ -277,13 +277,21 @@ class FusedAdam(FlatFusedOptimizer):
 
 class FusedLAMB(FlatFusedOptimizer):
     """LAMB with global-grad-norm clipping and per-tensor trust ratios
-    (ref: apex/optimizers/fused_lamb.py:96-214)."""
+    (ref: apex/optimizers/fused_lamb.py:96-214).
+
+    ``segmented=True`` (default) lays the flat space out in VMEM-sized
+    segments and runs BOTH LAMB stages in one kernel pass for every
+    leaf that fits a segment — 7 HBM accesses per element instead of
+    the two-stage schedule's ~10 (see multi_tensor/segmented.py). The
+    math is identical; only the schedule (and the flat layout's
+    padding) changes. Set False to force the classic two-stage path.
+    """
 
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-6, weight_decay=0.01, grad_averaging=True,
                  adam_w_mode=True, max_grad_norm=1.0, use_nvlamb=False,
                  impl=None, master_dtype=jnp.float32,
-                 stochastic_rounding=False):
+                 stochastic_rounding=False, segmented=True):
         super().__init__(lr, impl, master_dtype=master_dtype,
                          stochastic_rounding=stochastic_rounding)
         self.bias_correction = bias_correction
@@ -294,13 +302,29 @@ class FusedLAMB(FlatFusedOptimizer):
         self.adam_w_mode = adam_w_mode
         self.max_grad_norm = max_grad_norm
         self.use_nvlamb = use_nvlamb
+        self.segmented = bool(segmented)
+        self._seg_meta = None
+
+    def init(self, params: Any) -> FlatOptState:
+        if not self.segmented:
+            return super().init(params)
+        from apex_tpu.multi_tensor.flat_buffer import segmented_space
+
+        check_leaf_dtypes(params, self.master_dtype)
+        space, self._seg_meta = segmented_space(params)
+        master = space.pack(params, dtype=self.master_dtype)
+        return FlatOptState(
+            space=space, master=master,
+            slots=self._init_slots(space, master),
+            count=jnp.zeros((), jnp.int32),
+            found_inf=jnp.zeros((), jnp.float32),
+        )
 
     def _init_slots(self, space, master):
         return _mv_slots(master)
 
     def _update(self, state, g, lr, grad_scale):
-        p2, m2, v2, found = fused_lamb_update(
-            state.master, state.slots["m"], state.slots["v"], g, state.space,
+        kw = dict(
             lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
             step=state.count + 1, weight_decay=self.weight_decay,
             bias_correction=self.bias_correction,
@@ -309,6 +333,18 @@ class FusedLAMB(FlatFusedOptimizer):
             use_nvlamb=self.use_nvlamb, grad_scale=grad_scale,
             impl=self.impl, sr_seed=self._sr_seed(state),
         )
+        if self.segmented and self._seg_meta is not None:
+            from apex_tpu.multi_tensor.segmented import (
+                fused_lamb_segmented_update,
+            )
+
+            p2, m2, v2, found = fused_lamb_segmented_update(
+                state.master, state.slots["m"], state.slots["v"], g,
+                state.space, self._seg_meta, **kw)
+        else:
+            p2, m2, v2, found = fused_lamb_update(
+                state.master, state.slots["m"], state.slots["v"], g,
+                state.space, **kw)
         return p2, {"m": m2, "v": v2}, found
 
 

@@ -207,6 +207,43 @@ def run(perf=False, kimpl="pallas", only=None):
               p, m_, v_, g, space, lr=1e-3, beta1=0.9, beta2=0.999,
               eps=1e-6, step=1, weight_decay=0.01, impl=impl),
           buf, gbuf, m, v, tol=1e-4)
+    # segment-resident single-pass LAMB vs its two-stage reference on
+    # the SAME segmented layout — the round-3 schedule that brings
+    # LAMB to ~7 HBM accesses/element (multi_tensor/segmented.py).
+    # New Mosaic surface: (seg, phase, chunk) grid with resident
+    # phase-1 blocks, VMEM scratch persisting across grid steps, and
+    # in-kernel one-hot dot_generals.
+    from apex_tpu.multi_tensor.flat_buffer import segmented_space
+    from apex_tpu.multi_tensor.segmented import (
+        CHUNK as SEG_CHUNK,
+        fused_lamb_segmented_update,
+    )
+
+    seg_tree = {
+        "w0": jnp.asarray(rng.randn(600, 700).astype(np.float32)),
+        "b0": jnp.asarray(rng.randn(700).astype(np.float32)),
+        "w1": jnp.asarray(rng.randn(3 * SEG_CHUNK + 777)
+                          .astype(np.float32)),   # large leaf
+        "w2": jnp.asarray(rng.randn(512, 512).astype(np.float32)),
+    }
+    seg_space, seg_meta = segmented_space(seg_tree,
+                                          seg_elems=2 * SEG_CHUNK)
+    seg_pk = lambda t: seg_space.pack(t, dtype=jnp.float32)  # noqa: E731
+    seg_p = seg_pk(seg_tree)
+    seg_g = seg_pk(jax.tree.map(
+        lambda x: jnp.asarray(
+            np.random.RandomState(7).randn(*x.shape).astype(np.float32)
+            * 1e-2), seg_tree))
+    seg_m = jnp.zeros_like(seg_p)
+    seg_v = jnp.zeros_like(seg_p)
+
+    check("fused_lamb_segmented (one-pass)",
+          lambda p, g, m_, v_, impl: fused_lamb_segmented_update(
+              p, m_, v_, g, seg_space, seg_meta, lr=1e-3,
+              weight_decay=0.01, use_nvlamb=True, step=1,
+              max_grad_norm=0.0, impl=impl),
+          seg_p, seg_g, seg_m, seg_v, tol=1e-4)
+
     check("fused_novograd_update",
           lambda p, g, m_, impl: mt.fused_novograd_update(
               p, m_, jnp.zeros((space.num_leaves,), jnp.float32), g, space,
