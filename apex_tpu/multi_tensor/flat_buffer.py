@@ -146,7 +146,8 @@ def pack_like(space: FlatSpace, trees: Sequence[Any], dtype=jnp.float32):
 # ---------------------------------------------------------------------------
 
 
-@dataclasses.dataclass(frozen=True)
+@jax.tree_util.register_static
+@dataclasses.dataclass(frozen=True, eq=False)
 class SegmentMeta:
     """Static companion of a segment-aligned :class:`FlatSpace`.
 
@@ -159,6 +160,11 @@ class SegmentMeta:
     small leaf's reduction is segment-local (apex_tpu/multi_tensor/
     segmented.py), while the few large leaves fall back to the
     two-stage path over their contiguous slices.
+
+    Registered static (like :class:`FlatSpace`) so it can ride inside
+    optimizer state: the meta then travels WITH the space it was built
+    against, and a second ``init()`` over a different tree can never
+    pair an old state with fresh metadata.
     """
 
     seg_elems: int                     # elements per segment
@@ -172,15 +178,64 @@ class SegmentMeta:
     max_slots: int
     # (leaf_idx, start_elem, padded_elems) per large leaf
     large: tuple[tuple[int, int, int], ...]
+    # kernel-schedule knobs resolved at init time (multi_tensor/
+    # segmented.py): whether p stays resident in scratch, and the
+    # update-term stash dtype (by name — dtypes aren't hashable)
+    stash_p: bool = True
+    u_dtype_name: str = "float32"
+
+    # static-pytree contract: hashable + comparable despite the numpy
+    # id-map fields (frozen dataclass __eq__/__hash__ would choke on
+    # them). The key is cached: as a static node inside optimizer state
+    # it gets hashed at EVERY jitted-step cache lookup, and the id maps
+    # are megabytes at large model scales.
+    def _key(self):
+        cached = getattr(self, "_key_cache", None)
+        if cached is None:
+            cached = (
+                self.seg_elems, self.n_segments, self.small_segments,
+                self.max_slots, self.large, self.stash_p,
+                self.u_dtype_name,
+                np.asarray(self.slot_ids).tobytes(),
+                np.asarray(self.slot_leaf).tobytes(),
+            )
+            object.__setattr__(self, "_key_cache", cached)
+        return cached
+
+    def __eq__(self, other):
+        if self is other:
+            return True
+        return (type(other) is SegmentMeta
+                and self._key() == other._key())
+
+    def __hash__(self):
+        cached = getattr(self, "_hash_cache", None)
+        if cached is None:
+            cached = hash(self._key())
+            object.__setattr__(self, "_hash_cache", cached)
+        return cached
+
+
+# Conservative per-core VMEM the segmented kernel may spend on scratch:
+# the guide's planning number is ~16 MB/core total, and the kernel also
+# needs its streamed blocks (double-buffered, ~3.5 MB at the default
+# chunk). Overridable for chips with more VMEM.
+DEFAULT_SEG_VMEM_BUDGET = 10 * 1024 * 1024
 
 
 def default_seg_elems(total_estimate: int,
-                      cap: int = 1 << 22,
-                      chunk: int = 512 * 128) -> int:
+                      cap: Optional[int] = None,
+                      chunk: int = 512 * 128,
+                      scratch_bytes_per_elem: int = 8) -> int:
     """Segment size matched to the workload: ~1/8 of the buffer
     (so small models get several segments and tiny CPU tests don't
-    drag a mostly-padding 16 MB segment through interpret mode),
-    clamped to [1 chunk, cap] and rounded to a chunk multiple."""
+    drag a mostly-padding segment through interpret mode), clamped to
+    [1 chunk, cap] and rounded to a chunk multiple. The default cap is
+    sized so the kernel's VMEM scratch (``scratch_bytes_per_elem`` *
+    seg_elems — 8 for the fp32 u+p stash pair) fits the budget; a
+    too-large segment is not a slowdown but a Mosaic compile failure."""
+    if cap is None:
+        cap = DEFAULT_SEG_VMEM_BUDGET // max(scratch_bytes_per_elem, 1)
     want = max(chunk, min(cap, total_estimate // 8))
     return ((want + chunk - 1) // chunk) * chunk
 

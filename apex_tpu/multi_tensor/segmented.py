@@ -77,10 +77,29 @@ def _small_segment_pass(
     out_dtype,
     sr_seed: Optional[jax.Array],
     interpret: bool = False,
+    stash_p: bool = True,
+    u_dtype=jnp.float32,
 ):
     """The one-pass pallas kernel over the small segments. Regions not
     in meta.small_segments flow through untouched via input/output
-    aliasing. Returns (p2, m2, v2, found)."""
+    aliasing. Returns (p2, m2, v2, found).
+
+    VMEM scratch knobs (the per-core budget is ~16 MB, flat_buffer.
+    DEFAULT_SEG_VMEM_BUDGET):
+
+    - ``stash_p=True`` keeps the phase-0 ``p`` chunks resident
+      (seg_elems fp32 scratch) so phase 1 never touches HBM for them:
+      7 accesses/element. ``False`` drops that buffer and re-streams
+      ``p`` from HBM in phase 1 (the aliased output hasn't been
+      written yet, so the read sees the original values): 8
+      accesses/element, half the scratch — the right trade when it
+      buys segments big enough to keep multi-MB leaves one-pass.
+    - ``u_dtype=bfloat16`` halves the update-term stash. The stashed
+      ``u`` is O(1) by construction (m̂/(√v̂+eps)), so bf16's ~2^-9
+      relative error perturbs ``p2`` by lr*ratio*2^-9*|u| — far below
+      optimizer noise, but outside the two-stage path's bitwise
+      envelope, so it is opt-in, never a silent default.
+    """
     n = p.shape[0]
     C = meta.seg_elems // CHUNK
     if C < 1 or meta.seg_elems % CHUNK:
@@ -100,12 +119,16 @@ def _small_segment_pass(
         if sr:
             (scal_ref, segid_ref, sr_ref, p_ref, m_ref, v_ref, g_ref,
              ids_ref, p2_ref, m2_ref, v2_ref, found_ref,
-             u_buf, p_buf, acc_ref) = args
+             *scratch) = args
         else:
             (scal_ref, segid_ref, p_ref, m_ref, v_ref, g_ref,
              ids_ref, p2_ref, m2_ref, v2_ref, found_ref,
-             u_buf, p_buf, acc_ref) = args
+             *scratch) = args
             sr_ref = None
+        if stash_p:
+            u_buf, p_buf, acc_ref = scratch
+        else:
+            (u_buf, acc_ref), p_buf = scratch, None
         s = pl.program_id(0)
         ph = pl.program_id(1)
         c = pl.program_id(2)
@@ -143,8 +166,9 @@ def _small_segment_pass(
             m2_ref[...] = m2
             v2_ref[...] = v2
             row0 = c * CHUNK_ROWS
-            u_buf[pl.ds(row0, CHUNK_ROWS), :] = u
-            p_buf[pl.ds(row0, CHUNK_ROWS), :] = p_
+            u_buf[pl.ds(row0, CHUNK_ROWS), :] = u.astype(u_buf.dtype)
+            if stash_p:
+                p_buf[pl.ds(row0, CHUNK_ROWS), :] = p_
             oh = slot_one_hot()                      # (sub_chunk, ms)
             pp = jnp.sum(
                 (p_ * p_).reshape(sub_chunk, PER_TENSOR_TILE_ROWS,
@@ -176,8 +200,14 @@ def _small_segment_pass(
                 preferred_element_type=jnp.float32)  # (sub_chunk, 1)
             rr_rows = jnp.repeat(rr, PER_TENSOR_TILE_ROWS, axis=0)
             row0 = c * CHUNK_ROWS
-            u = u_buf[pl.ds(row0, CHUNK_ROWS), :]
-            p_ = p_buf[pl.ds(row0, CHUNK_ROWS), :]
+            u = u_buf[pl.ds(row0, CHUNK_ROWS), :].astype(jnp.float32)
+            if stash_p:
+                p_ = p_buf[pl.ds(row0, CHUNK_ROWS), :]
+            else:
+                # the aliased p2 region for this chunk is still unwritten
+                # (phase 1 writes chunk c at step c), so the streamed
+                # input block holds the original p
+                p_ = p_ref[...].astype(jnp.float32)
             p2 = p_ - lr * rr_rows * u
             if sr:
                 pltpu.prng_seed(sr_ref[0], segid_ref[s] * C + c)
@@ -196,6 +226,10 @@ def _small_segment_pass(
     def data_in(s, ph, c, scal, seg, *_):
         return (seg[s] * C + jnp.where(ph == 0, c, C - 1), 0)
 
+    def p_in(s, ph, c, scal, seg, *_):
+        # without the p stash, phase 1 re-streams each p chunk
+        return (seg[s] * C + c, 0)
+
     def ids_in(s, ph, c, *_):
         return (s * C + c, 0)
 
@@ -210,9 +244,10 @@ def _small_segment_pass(
         num_scalar_prefetch=3 if sr else 2,
         grid=(n_small, 2, C),
         in_specs=[
-            pl.BlockSpec((CHUNK_ROWS, LANES), data_in,
+            pl.BlockSpec((CHUNK_ROWS, LANES),
+                         data_in if (i or stash_p) else p_in,
                          memory_space=pltpu.VMEM)
-            for _ in range(4)
+            for i in range(4)
         ] + [
             pl.BlockSpec((sub_chunk, 1), ids_in,
                          memory_space=pltpu.VMEM)
@@ -227,11 +262,12 @@ def _small_segment_pass(
             pl.BlockSpec((1, 1), lambda *_: (0, 0),
                          memory_space=pltpu.SMEM),
         ],
-        scratch_shapes=[
-            pltpu.VMEM((C * CHUNK_ROWS, LANES), jnp.float32),   # u
-            pltpu.VMEM((C * CHUNK_ROWS, LANES), jnp.float32),   # p
-            pltpu.VMEM((8, ms), jnp.float32),                   # acc
-        ],
+        scratch_shapes=(
+            [pltpu.VMEM((C * CHUNK_ROWS, LANES), jnp.dtype(u_dtype))]
+            + ([pltpu.VMEM((C * CHUNK_ROWS, LANES), jnp.float32)]
+               if stash_p else [])
+            + [pltpu.VMEM((8, ms), jnp.float32)]                # acc
+        ),
     )
 
     prefetch = [scalars, seg_ids]
@@ -265,6 +301,7 @@ def fused_lamb_segmented_update(
     weight_decay=0.0, bias_correction=True, grad_averaging=True,
     max_grad_norm=0.0, adam_w_mode=True, use_nvlamb=False,
     global_grad_norm=None, grad_scale=1.0, impl=None, sr_seed=None,
+    stash_p=None, u_dtype=None,
 ):
     """LAMB step over a segment-aligned flat space: one-pass kernel for
     the small segments + the two-stage path for each large leaf.
@@ -284,6 +321,16 @@ def fused_lamb_segmented_update(
     )
     from apex_tpu.multi_tensor.engine import fused_elementwise
 
+    if meta.n_segments * meta.seg_elems != space.total:
+        raise ValueError(
+            f"SegmentMeta (n_segments={meta.n_segments}, "
+            f"seg_elems={meta.seg_elems}) does not cover the space "
+            f"(total={space.total}) — the meta was built against a "
+            "different layout (e.g. a stale optimizer re-init)")
+    if stash_p is None:
+        stash_p = meta.stash_p
+    if u_dtype is None:
+        u_dtype = jnp.dtype(meta.u_dtype_name)
     impl = resolve_impl(impl)
     # interpret mode runs the REAL kernel schedule (CPU tests pin it
     # against the two-stage reference); in-kernel SR has no interpret
@@ -328,7 +375,8 @@ def fused_lamb_segmented_update(
             p, m, v, g, meta=meta, scalars=scalars,
             use_nvlamb=use_nvlamb,
             wd_is_zero=not (weight_decay > 0.0), out_dtype=p.dtype,
-            sr_seed=sr_seed, interpret=impl == "interpret")
+            sr_seed=sr_seed, interpret=impl == "interpret",
+            stash_p=stash_p, u_dtype=u_dtype)
     else:
         p2, m2, v2 = p, m, v
         found = jnp.float32(0.0)

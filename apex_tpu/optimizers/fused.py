@@ -24,6 +24,7 @@ from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from apex_tpu.multi_tensor import (
     FlatSpace,
@@ -46,6 +47,11 @@ class FlatOptState(NamedTuple):
     slots: Dict[str, jax.Array]
     count: jax.Array          # int32 successful-step counter
     found_inf: jax.Array      # f32 {0,1} from the last step attempt
+    # static SegmentMeta when the space is segment-aligned (FusedLAMB
+    # segmented=True) — carried WITH the space so a later re-init of
+    # the optimizer object can never pair this state with foreign
+    # metadata, else None
+    seg_meta: Any = None
 
 
 def _mv_slots(master: jax.Array) -> Dict[str, jax.Array]:
@@ -217,7 +223,7 @@ class FlatFusedOptimizer:
 
         new_state = FlatOptState(
             space=state.space, master=master2, slots=slots2,
-            count=count2, found_inf=found,
+            count=count2, found_inf=found, seg_meta=state.seg_meta,
         )
         return state.space.unpack(master2), new_state
 
@@ -243,6 +249,7 @@ class FlatFusedOptimizer:
             slots={k: jnp.asarray(v) for k, v in d["slots"].items()},
             count=jnp.asarray(d["count"], jnp.int32),
             found_inf=jnp.asarray(d["found_inf"], jnp.float32),
+            seg_meta=state.seg_meta,
         )
 
 
@@ -281,17 +288,31 @@ class FusedLAMB(FlatFusedOptimizer):
 
     ``segmented=True`` (default) lays the flat space out in VMEM-sized
     segments and runs BOTH LAMB stages in one kernel pass for every
-    leaf that fits a segment — 7 HBM accesses per element instead of
-    the two-stage schedule's ~10 (see multi_tensor/segmented.py). The
-    math is identical; only the schedule (and the flat layout's
-    padding) changes. Set False to force the classic two-stage path.
+    leaf that fits a segment — 7 HBM accesses per element (8 with
+    ``seg_stash_p=False``) instead of the two-stage schedule's ~10
+    (see multi_tensor/segmented.py). The math is identical; only the
+    schedule (and the flat layout's padding) changes. Set False to
+    force the classic two-stage path.
+
+    Segment knobs (None = auto-chosen from the param tree against the
+    VMEM budget, minimizing expected HBM accesses/element):
+
+    - ``seg_elems``: elements per segment (scratch scales with it).
+    - ``seg_stash_p``: keep p resident in scratch (7 accesses) vs
+      re-stream it in phase 1 (8 accesses, half the scratch).
+    - ``seg_u_dtype``: update-term stash dtype. bfloat16 halves the
+      stash so segments can cover multi-MB leaves, at ~2^-9 relative
+      perturbation of the update term — opt-in via
+      ``seg_allow_bf16_u=True`` (never auto-chosen otherwise).
     """
 
     def __init__(self, lr=1e-3, bias_correction=True, betas=(0.9, 0.999),
                  eps=1e-6, weight_decay=0.01, grad_averaging=True,
                  adam_w_mode=True, max_grad_norm=1.0, use_nvlamb=False,
                  impl=None, master_dtype=jnp.float32,
-                 stochastic_rounding=False, segmented=True):
+                 stochastic_rounding=False, segmented=True,
+                 seg_elems=None, seg_stash_p=None, seg_u_dtype=None,
+                 seg_allow_bf16_u=False, seg_vmem_budget=None):
         super().__init__(lr, impl, master_dtype=master_dtype,
                          stochastic_rounding=stochastic_rounding)
         self.bias_correction = bias_correction
@@ -303,21 +324,94 @@ class FusedLAMB(FlatFusedOptimizer):
         self.max_grad_norm = max_grad_norm
         self.use_nvlamb = use_nvlamb
         self.segmented = bool(segmented)
-        self._seg_meta = None
+        self.seg_elems = seg_elems
+        self.seg_stash_p = seg_stash_p
+        self.seg_u_dtype = seg_u_dtype
+        self.seg_allow_bf16_u = bool(seg_allow_bf16_u)
+        self.seg_vmem_budget = seg_vmem_budget
+
+    def _segment_config(self, params):
+        """Resolve (seg_elems, stash_p, u_dtype): explicit knobs win;
+        anything left None is auto-chosen to minimize expected HBM
+        accesses/element over this tree within the VMEM budget."""
+        from apex_tpu.multi_tensor.flat_buffer import (
+            DEFAULT_ALIGN, DEFAULT_SEG_VMEM_BUDGET, _round_up)
+        from apex_tpu.multi_tensor.segmented import CHUNK
+
+        budget = (self.seg_vmem_budget if self.seg_vmem_budget
+                  else DEFAULT_SEG_VMEM_BUDGET)
+        if self.seg_elems is not None and self.seg_elems % CHUNK:
+            raise ValueError(
+                f"seg_elems={self.seg_elems} must be a multiple of the "
+                f"kernel chunk ({CHUNK} elements); round up to "
+                f"{_round_up(self.seg_elems, CHUNK)}")
+        sizes = [
+            _round_up(max(int(np.prod(l.shape)) if l.shape else 1, 1),
+                      DEFAULT_ALIGN)
+            for l in jax.tree.leaves(params)
+        ]
+        total = max(sum(sizes), 1)
+
+        candidates = []      # (stash_p, u_dtype, scratch bytes/elem, cost)
+        for stash in ((self.seg_stash_p,) if self.seg_stash_p is not None
+                      else (True, False)):
+            for u_dt in ((self.seg_u_dtype,)
+                         if self.seg_u_dtype is not None
+                         else ((jnp.float32, jnp.bfloat16)
+                               if self.seg_allow_bf16_u
+                               else (jnp.float32,))):
+                bpe = jnp.dtype(u_dt).itemsize + (4 if stash else 0)
+                candidates.append(
+                    (stash, u_dt, bpe, 7 if stash else 8))
+
+        best = None
+        for stash, u_dt, bpe, cost in candidates:
+            max_seg = (budget // bpe) // CHUNK * CHUNK
+            if self.seg_elems is not None:
+                seg = self.seg_elems
+                over = seg * bpe > budget       # explicit override: keep,
+                # but prefer any candidate whose scratch fits the budget
+            else:
+                seg = min(max_seg, _round_up(total, CHUNK))
+                over = False
+            if seg < CHUNK:
+                continue
+            covered = sum(s for s in sizes if s <= seg)
+            # uncovered (large) leaves take the two-stage ~10-access path
+            expected = (cost * covered + 10 * (total - covered)) / total
+            scratch = seg * bpe
+            key = (over, expected, scratch)
+            if best is None or key < best[0]:
+                best = (key, (seg, stash, u_dt))
+        if best is None:
+            raise ValueError(
+                f"no segment config fits vmem budget {budget} "
+                f"(seg_elems={self.seg_elems})")
+        return best[1]
 
     def init(self, params: Any) -> FlatOptState:
         if not self.segmented:
             return super().init(params)
         from apex_tpu.multi_tensor.flat_buffer import segmented_space
 
+        import dataclasses
+
         check_leaf_dtypes(params, self.master_dtype)
-        space, self._seg_meta = segmented_space(params)
+        seg, stash_p, u_dtype = self._segment_config(params)
+        space, meta = segmented_space(params, seg_elems=seg)
+        # schedule knobs ride inside the static meta so they can never
+        # go stale against this state (ADVICE r3: instance-held meta
+        # broke under a second init())
+        meta = dataclasses.replace(
+            meta, stash_p=bool(stash_p),
+            u_dtype_name=jnp.dtype(u_dtype).name)
         master = space.pack(params, dtype=self.master_dtype)
         return FlatOptState(
             space=space, master=master,
             slots=self._init_slots(space, master),
             count=jnp.zeros((), jnp.int32),
             found_inf=jnp.zeros((), jnp.float32),
+            seg_meta=meta,
         )
 
     def _init_slots(self, space, master):
@@ -333,14 +427,14 @@ class FusedLAMB(FlatFusedOptimizer):
             use_nvlamb=self.use_nvlamb, grad_scale=grad_scale,
             impl=self.impl, sr_seed=self._sr_seed(state),
         )
-        if self.segmented and self._seg_meta is not None:
+        if self.segmented and state.seg_meta is not None:
             from apex_tpu.multi_tensor.segmented import (
                 fused_lamb_segmented_update,
             )
 
             p2, m2, v2, found = fused_lamb_segmented_update(
                 state.master, state.slots["m"], state.slots["v"], g,
-                state.space, self._seg_meta, **kw)
+                state.space, state.seg_meta, **kw)
         else:
             p2, m2, v2, found = fused_lamb_update(
                 state.master, state.slots["m"], state.slots["v"], g,

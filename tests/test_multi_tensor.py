@@ -624,6 +624,106 @@ class TestSegmentedLamb:
             lr=1e-2, max_grad_norm=0.0)
         assert float(found) == 1.0
 
+    @pytest.mark.parametrize("with_large", [False, True])
+    def test_stream_p_matches_two_stage(self, rng, with_large):
+        """stash_p=False re-streams p in phase 1 (half the scratch, 8
+        HBM accesses/elem) — must be bitwise the same math."""
+        from apex_tpu.multi_tensor.flat_buffer import segmented_space
+        from apex_tpu.multi_tensor.segmented import (
+            CHUNK, fused_lamb_segmented_update)
+        from apex_tpu.multi_tensor.ops import fused_lamb_update
+
+        seg = 2 * CHUNK
+        tree = self._tree(rng, with_large, seg)
+        space, meta = segmented_space(tree, seg_elems=seg)
+        pk = lambda t: space.pack(t, dtype=jnp.float32)  # noqa: E731
+        p = pk(tree)
+        g = pk(jax.tree.map(
+            lambda x: jnp.asarray(
+                rng.randn(*x.shape).astype(np.float32) * 1e-2), tree))
+        m = jnp.zeros_like(p)
+        v = jnp.zeros_like(p)
+        kw = dict(lr=1e-2, weight_decay=0.01, use_nvlamb=True, step=1,
+                  max_grad_norm=0.0)
+        got = fused_lamb_segmented_update(
+            p, m, v, g, space, meta, impl="interpret", stash_p=False,
+            **kw)
+        want = fused_lamb_update(p, m, v, g, space, impl="xla", **kw)
+        for name, a, b in zip(("p2", "m2", "v2"), got, want):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-6, rtol=1e-5,
+                err_msg=name)
+
+    def test_bf16_u_stash_close(self, rng):
+        """u_dtype=bfloat16 halves the stash; the update-term is O(1)
+        so the perturbation on p2 is ~lr*2^-9 — loose-tol parity."""
+        from apex_tpu.multi_tensor.flat_buffer import segmented_space
+        from apex_tpu.multi_tensor.segmented import (
+            CHUNK, fused_lamb_segmented_update)
+        from apex_tpu.multi_tensor.ops import fused_lamb_update
+
+        seg = 2 * CHUNK
+        tree = self._tree(rng, False, seg)
+        space, meta = segmented_space(tree, seg_elems=seg)
+        pk = lambda t: space.pack(t, dtype=jnp.float32)  # noqa: E731
+        p = pk(tree)
+        g = pk(jax.tree.map(
+            lambda x: jnp.asarray(
+                rng.randn(*x.shape).astype(np.float32) * 1e-2), tree))
+        m = jnp.zeros_like(p)
+        v = jnp.zeros_like(p)
+        kw = dict(lr=1e-2, weight_decay=0.01, use_nvlamb=True, step=1,
+                  max_grad_norm=0.0)
+        got = fused_lamb_segmented_update(
+            p, m, v, g, space, meta, impl="interpret", stash_p=False,
+            u_dtype=jnp.bfloat16, **kw)
+        want = fused_lamb_update(p, m, v, g, space, impl="xla", **kw)
+        # p2 differs only through the bf16-rounded u: |dp2| <= lr*r*2^-8|u|
+        np.testing.assert_allclose(np.asarray(got[0]), np.asarray(want[0]),
+                                   atol=1e-2 * 2.0 ** -7, rtol=0)
+        # m2/v2 are written in phase 0, before any stash: exact
+        for name, a, b in zip(("m2", "v2"), got[1:], want[1:]):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), atol=5e-6, rtol=1e-5,
+                err_msg=name)
+
+    def test_reinit_does_not_poison_old_state(self, rng):
+        """ADVICE r3: SegmentMeta rides in the STATE — a second init()
+        over a different tree must not change how an earlier state
+        steps."""
+        from apex_tpu.optimizers import FusedLAMB
+
+        params_a = {"w": jnp.asarray(rng.randn(40, 12).astype(np.float32))}
+        params_b = {f"x{i}": jnp.asarray(rng.randn(7 + i).astype(np.float32))
+                    for i in range(5)}
+        g_a = jax.tree.map(
+            lambda l: jnp.asarray(
+                rng.randn(*l.shape).astype(np.float32) * 1e-2), params_a)
+
+        opt = FusedLAMB(lr=1e-2, weight_decay=0.01, use_nvlamb=True,
+                        max_grad_norm=0.0)
+        st_a = opt.init(params_a)
+        want, _ = opt.step(st_a, g_a)
+        _ = opt.init(params_b)          # different tree, fresh layout
+        got, _ = opt.step(st_a, g_a)    # old state must be unaffected
+        for a, b in zip(jax.tree.leaves(want), jax.tree.leaves(got)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_mismatched_meta_raises(self, rng):
+        from apex_tpu.multi_tensor.flat_buffer import segmented_space
+        from apex_tpu.multi_tensor.segmented import (
+            CHUNK, fused_lamb_segmented_update)
+
+        tree = self._tree(rng, False, CHUNK)
+        space, _ = segmented_space(tree, seg_elems=CHUNK)
+        other = {"z": jnp.zeros((5 * CHUNK,), jnp.float32)}
+        _, foreign_meta = segmented_space(other, seg_elems=CHUNK)
+        p = space.pack(tree, dtype=jnp.float32)
+        with pytest.raises(ValueError, match="does not cover"):
+            fused_lamb_segmented_update(
+                p, jnp.zeros_like(p), jnp.zeros_like(p), jnp.zeros_like(p),
+                space, foreign_meta, impl="interpret", lr=1e-2)
+
     def test_optimizer_trajectory_matches(self, rng):
         """FusedLAMB(segmented=True) == FusedLAMB(segmented=False)
         over several steps of a real loop (different flat layouts,
