@@ -1,0 +1,97 @@
+"""Checkpoint migration between scanned and unrolled layer stacks.
+
+The model zoo defaults to ``scan_layers=True`` (one ``nn.scan`` node:
+single trace/compile of the layer body, params stacked with a leading
+layer axis) — the TPU-right representation. Name-addressed checkpoints
+written by the unrolled form (``layer_{i}`` / ``encoder_{i}`` /
+``decoder_{i}``) have a different tree structure; these helpers convert
+either direction so a ``scan_layers`` flip is never a checkpoint
+breakage (ADVICE r3: a default flip is a silent breaking change without
+a migration path).
+
+Works on any params subtree following the zoo's naming convention:
+
+=================  ==========================  =====================
+model              scanned node                unrolled names
+=================  ==========================  =====================
+GPT / BERT         ``layers.layer``            ``layer_{i}``
+T5 encoder         ``encoder_layers.layer``    ``encoder_{i}``
+T5 decoder         ``decoder_layers.layer``    ``decoder_{i}``
+=================  ==========================  =====================
+
+Only the *structure* is converted; values are moved bit-for-bit. (Init
+RNG streams still differ between the two forms, so freshly-initialized
+models differ — migration is for checkpoints, not for matching inits.)
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Mapping
+
+import jax
+import jax.numpy as jnp
+
+# scanned-node name -> unrolled per-layer name pattern
+_SCAN_NODES = {
+    "layers": "layer_{}",
+    "encoder_layers": "encoder_{}",
+    "decoder_layers": "decoder_{}",
+}
+_UNROLLED_RE = re.compile(r"^(layer|encoder|decoder)_(\d+)$")
+_STACK_OF = {"layer": "layers", "encoder": "encoder_layers",
+             "decoder": "decoder_layers"}
+
+
+def unstack_scan_params(tree: Any) -> Any:
+    """Scanned checkpoint -> unrolled layout (``layers.layer`` with a
+    leading layer axis becomes ``layer_0 .. layer_{L-1}``)."""
+    if not isinstance(tree, Mapping):
+        return tree
+    out = {}
+    for key, val in tree.items():
+        if (key in _SCAN_NODES and isinstance(val, Mapping)
+                and set(val) == {"layer"}):
+            body = val["layer"]
+            leaves = jax.tree.leaves(body)
+            if not leaves:
+                out[key] = val
+                continue
+            num_layers = int(leaves[0].shape[0])
+            pat = _SCAN_NODES[key]
+            for i in range(num_layers):
+                out[pat.format(i)] = jax.tree.map(
+                    lambda l, i=i: l[i], body)
+        else:
+            out[key] = unstack_scan_params(val)
+    return out
+
+
+def stack_scan_params(tree: Any) -> Any:
+    """Unrolled checkpoint -> scanned layout (``layer_{i}`` groups are
+    stacked along a new leading axis under ``layers.layer``)."""
+    if not isinstance(tree, Mapping):
+        return tree
+    groups: dict[str, dict[int, Any]] = {}
+    out = {}
+    for key, val in tree.items():
+        m = _UNROLLED_RE.match(key)
+        if m:
+            groups.setdefault(m.group(1), {})[int(m.group(2))] = val
+        else:
+            out[key] = stack_scan_params(val)
+    for kind, by_idx in groups.items():
+        n = len(by_idx)
+        missing = [i for i in range(n) if i not in by_idx]
+        if missing:
+            raise ValueError(
+                f"unrolled {kind}_* params are not contiguous: have "
+                f"{sorted(by_idx)}, missing {missing}")
+        stacked = jax.tree.map(
+            lambda *ls: jnp.stack(ls, axis=0),
+            *[by_idx[i] for i in range(n)])
+        out[_STACK_OF[kind]] = {"layer": stacked}
+    return out
+
+
+__all__ = ["stack_scan_params", "unstack_scan_params"]

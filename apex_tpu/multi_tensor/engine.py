@@ -149,6 +149,12 @@ def fused_elementwise(
 
     if tile_rows is None:
         tile_rows = DEFAULT_TILE_ROWS
+    if impl in ("pallas", "interpret"):
+        # 2048x128 engine tiles CRASH the Mosaic compiler (round-3
+        # chip evidence); refuse before the shape reaches it
+        from apex_tpu.ops.mosaic_limits import check_block
+
+        check_block(tile_rows, LANES, 4, what="engine tile")
     tile = tile_rows * LANES
     for kind, idx in sumsq_subtiles:
         if kind not in ("in", "out") or not (

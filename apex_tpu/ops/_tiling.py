@@ -17,14 +17,23 @@ from typing import Optional
 
 def row_tile(rows: int, cols: int, cap: int = 256,
              budget: int = 2 * 1024 * 1024) -> Optional[int]:
+    from apex_tpu.ops.mosaic_limits import (MAX_BLOCK_BYTES,
+                                            MAX_BLOCK_SUBLANES, block_ok)
+
     if rows <= 0:
         return None
+    # clamp caller-supplied cap/budget to the known Mosaic crash region
+    # (LN tiles >= 256x4096 fp32 crash the compiler — round-3 chip
+    # evidence; a tuner or caller can never push a selector past it)
+    cap = min(cap, MAX_BLOCK_SUBLANES)
+    budget = min(budget, MAX_BLOCK_BYTES - cols * 4)
     want = min(cap, budget // max(cols * 4, 1))
     if rows <= want:
         return rows          # single block == full dim, always legal
     tile = (want // 8) * 8   # tiles must be sublane-aligned
     while tile >= 8:
         if rows % tile == 0:
+            assert block_ok(tile, cols)
             return tile
         tile -= 8
     return None

@@ -1052,6 +1052,16 @@ def flash_attention(
     # (the dq/dkv kernels have different reuse patterns than the fwd)
     bbq = bwd_block_q if bwd_block_q is not None else block_q
     bbk = bwd_block_k if bwd_block_k is not None else block_k
+    if impl != "xla":
+        # blocks of 2048 CRASH the Mosaic compiler (round-3 chip
+        # evidence); refuse before the shape reaches it
+        from apex_tpu.ops.mosaic_limits import check_block
+
+        isz = jnp.dtype(q.dtype).itemsize
+        d_head = q.shape[-1]
+        for nm, blk in (("block_q", block_q), ("block_k", block_k),
+                        ("bwd_block_q", bbq), ("bwd_block_k", bbk)):
+            check_block(blk, d_head, isz, what=f"flash {nm}")
     if impl == "xla":
         return _attention_xla(q, k, v, bias, segment_ids, kv_segment_ids,
                               softmax_scale, causal, window_size,

@@ -1,0 +1,58 @@
+"""Known Mosaic-compiler crash region — encoded, not prose.
+
+Round-3 chip windows established (docs/HARDWARE_NOTES.md, reproducible
+on a healthy chip) that the Mosaic compile helper CRASHES (HTTP 500,
+``tpu_compile_helper exit 1`` — not a clean rejection) on:
+
+- layer-norm row tiles >= 256 x 4096 fp32   -> a >= 4 MB block
+- fused-engine tiles 2048 x 128             -> a >= 2048-sublane block
+- flash-attention blocks of 2048            -> a >= 2048-sublane block
+
+Two independent constraints cover all three: a block's sublane (row)
+dim must stay <= 1024, and a block must stay strictly under 4 MB at
+its compute itemsize. Every tile/block selector and every tuner
+candidate list in this package must consult these — a crash shape
+wedges the tunnel's compile helper for everyone after, so "try it and
+see" is not acceptable on hardware. Probing beyond the region is
+tools/tpu_bisect.py's job, explicitly, never a default path.
+"""
+
+from __future__ import annotations
+
+# strictest observed-crashing sublane count was 2048; cap one power of
+# two below
+MAX_BLOCK_SUBLANES = 1024
+# 256 x 4096 fp32 = 4 MiB crashed; stay strictly below
+MAX_BLOCK_BYTES = 4 * 1024 * 1024
+
+
+def block_ok(rows: int, cols: int, itemsize: int = 4) -> bool:
+    """True iff a (rows, cols) block at ``itemsize`` avoids the known
+    Mosaic crash region."""
+    return (rows <= MAX_BLOCK_SUBLANES
+            and rows * cols * itemsize < MAX_BLOCK_BYTES)
+
+
+def max_rows(cols: int, itemsize: int = 4) -> int:
+    """Largest crash-safe sublane count for a block with ``cols``
+    lanes (multiple of 8, >= 8)."""
+    by_bytes = (MAX_BLOCK_BYTES - 1) // max(cols * itemsize, 1)
+    rows = min(MAX_BLOCK_SUBLANES, by_bytes)
+    return max(8, (rows // 8) * 8)
+
+
+def check_block(rows: int, cols: int, itemsize: int = 4,
+                what: str = "block") -> None:
+    """Raise before a known-crash shape ever reaches the compiler."""
+    if not block_ok(rows, cols, itemsize):
+        raise ValueError(
+            f"{what} ({rows}, {cols}) @ {itemsize}B is inside the known "
+            f"Mosaic compile-crash region (sublanes > "
+            f"{MAX_BLOCK_SUBLANES} or >= {MAX_BLOCK_BYTES} bytes) — "
+            f"largest safe row count for {cols} lanes is "
+            f"{max_rows(cols, itemsize)}. See docs/HARDWARE_NOTES.md "
+            "round 3; probing beyond this is tools/tpu_bisect.py's job.")
+
+
+__all__ = ["MAX_BLOCK_SUBLANES", "MAX_BLOCK_BYTES", "block_ok",
+           "max_rows", "check_block"]

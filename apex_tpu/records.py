@@ -61,7 +61,12 @@ def write_record(kind: str, payload: Dict[str, Any],
             **({"backend": backend} if backend else {}),
             "payload": payload,
         }
-        path = os.path.join(RECORDS_DIR, f"{kind}_{stamp}_{rec['git_sha']}.json")
+        base = f"{kind}_{stamp}_{rec['git_sha']}"
+        path = os.path.join(RECORDS_DIR, f"{base}.json")
+        n = 1
+        while os.path.exists(path):      # same kind+second+sha: uniquify
+            path = os.path.join(RECORDS_DIR, f"{base}.{n}.json")
+            n += 1
         with open(path, "w") as f:
             json.dump(rec, f, indent=1, sort_keys=True)
         return path

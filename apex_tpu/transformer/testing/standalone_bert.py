@@ -1,0 +1,22 @@
+"""Standalone BERT test fixture (ref: apex/transformer/testing/standalone_bert.py:1).
+
+Thin parity wrapper over the real model family in `apex_tpu.models.bert`
+— the reference keeps its BERT fixture under transformer/testing; here
+the model is first-class and this module preserves the import path."""
+
+from apex_tpu.models.bert import (
+    BertConfig,
+    BertLayer,
+    BertLMHead,
+    BertModel,
+    BertParallelAttention,
+    BertPooler,
+    bert_extended_attention_mask,
+    bert_loss_fn,
+    bert_param_specs,
+)
+
+
+def bert_model_provider(config: BertConfig = None, **kw) -> BertModel:
+    """ref run_bert_minimal_test.py bert_model_provider."""
+    return BertModel(config or BertConfig(**kw))

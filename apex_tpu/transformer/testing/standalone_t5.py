@@ -1,0 +1,19 @@
+"""Standalone T5 test fixture (ref: apex/transformer/testing/standalone_transformer_lm.py
+encoder-decoder configuration).
+
+Thin parity wrapper over the real model family in `apex_tpu.models.t5`
+— the reference keeps its enc-dec LM fixture under transformer/testing;
+here the model is first-class and this module preserves the path."""
+
+from apex_tpu.models.t5 import (
+    DecoderLayer,
+    EncoderLayer,
+    T5Config,
+    T5Model,
+    encoder_decoder_stage_layout,
+    t5_loss_fn,
+)
+
+
+def t5_model_provider(config: T5Config = None, **kw) -> T5Model:
+    return T5Model(config or T5Config(**kw))

@@ -46,6 +46,32 @@ def backend_detail():
     return {"backend": jax.default_backend()}
 
 
+def emit(rec, kind):
+    """Print the ONE-line JSON record; persist it to bench_records/ when
+    it was measured on real hardware, and when it was NOT, mark it
+    non-headline and attach the newest persisted TPU record of the same
+    kind (with its timestamp + git SHA) so a tunnel-dead artifact still
+    carries real-chip evidence with provenance (round-1..3 lost every
+    chip-window number this way)."""
+    from apex_tpu.records import latest_record, write_record
+
+    detail = rec.setdefault("detail", {})
+    on_tpu = detail.get("backend") == "tpu"
+    measured = rec.get("value") is not None
+    detail["headline_valid"] = bool(on_tpu and measured)
+    if on_tpu and measured:
+        write_record(kind, rec, backend="tpu")
+    else:
+        if not on_tpu:
+            detail["fallback_note"] = (
+                "measured on a fallback backend — NOT comparable with "
+                "TPU targets or other rounds' TPU records")
+        last = latest_record(kind, require_backend="tpu")
+        if last is not None:
+            detail["last_tpu_record"] = last
+    print(json.dumps(rec))
+
+
 def mfu_detail(model_flops, seconds):
     """Absolute-performance accounting for one timed call: achieved
     TFLOP/s and model FLOPs utilization against the chip's peak
@@ -198,7 +224,7 @@ def bench_moe():
     # (h->ffn, ffn->h) of 2*h*ffn FLOPs each, fwd; bwd = 2x fwd
     flops = 3 * (2 * 2 * n_tok * cfg.top_k * cfg.hidden_size
                  * cfg.ffn_hidden_size)
-    print(json.dumps({
+    emit({
         "metric": "moe_group_gemm_fwdbwd_vs_dense_loop",
         "value": round(n_tok / t_grouped, 1),
         "unit": "tokens/sec (grouped fwd+bwd)",
@@ -210,7 +236,7 @@ def bench_moe():
             **mfu_detail(flops, t_grouped),
             **backend_detail(),
         },
-    }))
+    }, "moe")
 
 
 def bench_attn():
@@ -235,6 +261,7 @@ def bench_attn():
 
     kernel_impl = "interpret" if on_cpu else "pallas"
     times = {}
+    fwd_times = {}
     for impl in (kernel_impl, "xla"):
         def fwd_bwd(q, k, v, impl=impl):
             def loss(q, k, v):
@@ -243,10 +270,15 @@ def bench_attn():
             l, g = jax.value_and_grad(loss, argnums=(0, 1, 2))(q, k, v)
             return l, g
 
-        f = jax.jit(fwd_bwd)
+        def fwd_only(q, k, v, impl=impl):
+            return flash_attention(q, k, v, causal=True, impl=impl)
+
         try:
-            times[impl], _ = time_fn(f, q, k, v, sync=True,
+            times[impl], _ = time_fn(jax.jit(fwd_bwd), q, k, v, sync=True,
                                      iters=2 if on_cpu else None)
+            fwd_times[impl], _ = time_fn(jax.jit(fwd_only), q, k, v,
+                                         sync=True,
+                                         iters=2 if on_cpu else None)
         except Exception as e:  # noqa: BLE001
             msg = str(e).split("\n")[0][:120]
             print(f"# attn impl={impl} failed: {type(e).__name__}: {msg}",
@@ -259,7 +291,15 @@ def bench_attn():
     # s^2-scale matmuls (dS, dP->dV, dQ, dK) = 2.5x the fwd
     fwd_flops = 0.5 * 2 * (2 * b * h * s * s * d)
     flops = fwd_flops * 3.5
-    print(json.dumps({
+    # backward-only accounting (VERDICT r3 #4): the reference's
+    # multihead_attn is backward-heavy; a blended fwd+bwd number can't
+    # support a matching-or-beating claim for the bwd kernels
+    t_fwd = fwd_times.get(kernel_impl)
+    t_bwd = (t_k - t_fwd) if t_fwd is not None else None
+    bwd_mfu = (mfu_detail(2.5 * fwd_flops, t_bwd)
+               if t_bwd is not None and t_bwd > 0 else {})
+    fwd_mfu = mfu_detail(fwd_flops, t_fwd) if t_fwd is not None else {}
+    emit({
         "metric": "flash_attention_fwdbwd_vs_xla",
         "value": round(b * h * s / t_k, 1),
         "unit": "rows/sec (causal fwd+bwd)",
@@ -269,11 +309,50 @@ def bench_attn():
         "detail": {
             "t_flash_ms": round(t_k * 1e3, 3),
             "t_xla_ms": round(t_x * 1e3, 3) if t_x is not None else None,
+            "t_flash_fwd_ms": (round(t_fwd * 1e3, 3)
+                               if t_fwd is not None else None),
+            "t_flash_bwd_ms": (round(t_bwd * 1e3, 3)
+                               if t_bwd is not None else None),
+            "fwd_tflops_per_sec": fwd_mfu.get("tflops_per_sec"),
+            "fwd_mfu": fwd_mfu.get("mfu"),
+            "bwd_tflops_per_sec": bwd_mfu.get("tflops_per_sec"),
+            "bwd_mfu": bwd_mfu.get("mfu"),
             "shape_bhsd": [b, h, s, d], "dtype": str(dt.__name__),
             **mfu_detail(flops, t_k),
             **backend_detail(),
         },
-    }))
+    }, "attn")
+
+
+def force_xla_kernels():
+    """Context manager: package-wide XLA kernel paths (APEX_TPU_IMPL).
+
+    The model benches' Pallas programs have a history of CRASHING the
+    Mosaic compile helper at exact bench shapes (docs/HARDWARE_NOTES.md
+    round 3). When that happens, a labeled XLA-path measurement on the
+    real chip is evidence; an error record is not. The default-impl
+    cache is cleared on entry/exit so the override actually takes.
+    """
+    import contextlib
+    import os
+
+    from apex_tpu import _backend
+
+    @contextlib.contextmanager
+    def cm():
+        prev = os.environ.get("APEX_TPU_IMPL")
+        os.environ["APEX_TPU_IMPL"] = "xla"
+        _backend.default_impl.cache_clear()
+        try:
+            yield
+        finally:
+            if prev is None:
+                os.environ.pop("APEX_TPU_IMPL", None)
+            else:
+                os.environ["APEX_TPU_IMPL"] = prev
+            _backend.default_impl.cache_clear()
+
+    return cm()
 
 
 def bench_gpt():
@@ -303,16 +382,18 @@ def bench_gpt():
     inputs, labels = toks[:, :-1], toks[:, 1:]
 
     times = {}
-    params = state = out = None
-    for backend in ("flash", "softmax"):
+    shared = {"n_params": 0, "cfg": None}
+    fallback_notes = {}
+
+    def measure_backend(backend):
+        import functools
+
         if on_cpu:
             cfg = GPTConfig(attention_backend=backend, **base)
         else:
             cfg = GPTConfig.gpt2_345m(attention_backend=backend, **base)
+        shared["cfg"] = cfg
         model = GPTModel(cfg)
-        # drop the previous backend's params/opt-state/output before this
-        # one allocates (~10 GB at 345M scale — two live copies OOM)
-        params = state = out = None
         params = model.init(jax.random.PRNGKey(0), inputs)
         opt = FusedAdam(lr=1e-4, weight_decay=0.01)
         state = opt.init(params)
@@ -320,8 +401,6 @@ def bench_gpt():
 
         def loss_fn(p, model=model):
             return gpt_loss_fn(model.apply(p, inputs), labels)
-
-        import functools
 
         @functools.partial(jax.jit, donate_argnums=(0,))
         def k_steps(state, opt=opt, loss_fn=loss_fn):
@@ -335,30 +414,61 @@ def bench_gpt():
             return jax.lax.fori_loop(0, k, body, (state, jnp.float32(0.0)))
 
         t, out = time_fn_threaded(k_steps, state, iters=iters)
-        times[backend] = t / k
-        n_params = int(state.space.total) if hasattr(state, "space") else 0
-    params = state = out = None
+        shared["n_params"] = int(state.space.total)
+        del state, out
+        return t / k
 
-    tok_s = batch * seq / times["flash"]
+    for backend in ("flash", "softmax"):
+        # each backend drops its params/opt-state before the next
+        # allocates (~10 GB at 345M scale — two live copies OOM)
+        try:
+            times[backend] = measure_backend(backend)
+        except Exception as e:  # noqa: BLE001
+            msg = f"{type(e).__name__}: {str(e).split(chr(10))[0][:160]}"
+            print(f"# gpt backend={backend} failed: {msg}", file=sys.stderr)
+            if on_cpu:
+                continue
+            # Mosaic-crash fallback: a labeled XLA-kernel-path number on
+            # the real chip beats an error record (the model benches'
+            # Pallas programs crashed the compile helper in round 3)
+            try:
+                with force_xla_kernels():
+                    times[backend] = measure_backend(backend)
+                fallback_notes[backend] = f"xla-kernel fallback ({msg})"
+            except Exception as e2:  # noqa: BLE001
+                print(f"# gpt backend={backend} xla fallback also failed: "
+                      f"{type(e2).__name__}", file=sys.stderr)
+
+    if not times:
+        raise SystemExit("gpt bench: every backend failed")
+    head = "flash" if "flash" in times else next(iter(times))
+    cfg, n_params = shared["cfg"], shared["n_params"]
+    tok_s = batch * seq / times[head]
     # train-step FLOPs: 6*N per token (2N fwd + 4N bwd matmul work) plus
     # the causal-attention s^2 term (fwd 2*b*s^2*d_model per layer,
     # fwd+bwd = 3.5x) the 6N rule does not include
     tokens = batch * seq
     dm, nl = cfg.hidden_size, cfg.num_layers
     flops = 6 * n_params * tokens + 3.5 * nl * (2 * batch * seq * seq * dm)
-    print(json.dumps({
+    emit({
         "metric": "gpt_train_step_tokens_per_sec",
         "value": round(tok_s, 1),
         "unit": "tokens/sec (flash-attention backend, bf16, fused Adam)",
-        "vs_baseline": round(times["softmax"] / times["flash"], 4),
+        "vs_baseline": (round(times["softmax"] / times["flash"], 4)
+                        if "flash" in times and "softmax" in times
+                        else None),
         "detail": {
-            "t_flash_ms": round(times["flash"] * 1e3, 3),
-            "t_softmax_ms": round(times["softmax"] * 1e3, 3),
+            "t_flash_ms": (round(times["flash"] * 1e3, 3)
+                           if "flash" in times else None),
+            "t_softmax_ms": (round(times["softmax"] * 1e3, 3)
+                             if "softmax" in times else None),
             "batch": batch, "seq": seq, "n_params": n_params,
-            **mfu_detail(flops, times["flash"]),
+            **({"kernel_fallbacks": fallback_notes}
+               if fallback_notes else {}),
+            **mfu_detail(flops, times[head]),
             **backend_detail(),
         },
-    }))
+    }, "gpt")
 
 
 def bench_resnet():
@@ -461,7 +571,7 @@ def bench_resnet():
         mfu = dict.fromkeys(
             ("model_flops", "tflops_per_sec", "chip",
              "chip_peak_tflops", "mfu"))
-    print(json.dumps({
+    emit({
         "metric": "resnet50_train_imgs_per_sec",
         "value": round(batch / t_step, 1),
         "unit": "imgs/sec/chip (bf16 + fp32 master, FusedSGD, SyncBN)",
@@ -474,7 +584,7 @@ def bench_resnet():
             **mfu,
             **backend_detail(),
         },
-    }))
+    }, "resnet")
 
 
 def bench_bert():
@@ -510,14 +620,16 @@ def bench_bert():
     nsp = jnp.asarray(rng.randint(0, 2, (batch,)), jnp.int32)
 
     times = {}
-    n_params = 0
-    for backend in ("flash", "softmax"):
+    shared = {"n_params": 0, "cfg": None}
+    fallback_notes = {}
+
+    def measure_backend(backend):
         if on_cpu:
             cfg = BertConfig(attention_backend=backend, **base)
         else:
             cfg = BertConfig.bert_large(attention_backend=backend, **base)
+        shared["cfg"] = cfg
         model = BertModel(cfg)
-        params = state = None
         params = model.init(jax.random.PRNGKey(0), tokens, attn_mask)
         opt = FusedLAMB(lr=1e-4, weight_decay=0.01, max_grad_norm=1.0,
                         use_nvlamb=True)
@@ -539,29 +651,58 @@ def bench_bert():
             return jax.lax.fori_loop(0, k, body, (state, jnp.float32(0.0)))
 
         t, _ = time_fn_threaded(k_steps, state, iters=iters)
-        times[backend] = t / k
-        n_params = int(state.space.total)
-        state = None
+        shared["n_params"] = int(state.space.total)
+        del state
+        return t / k
 
+    for backend in ("flash", "softmax"):
+        try:
+            times[backend] = measure_backend(backend)
+        except Exception as e:  # noqa: BLE001
+            msg = f"{type(e).__name__}: {str(e).split(chr(10))[0][:160]}"
+            print(f"# bert backend={backend} failed: {msg}",
+                  file=sys.stderr)
+            if on_cpu:
+                continue
+            # Mosaic-crash fallback (see bench_gpt): keep a labeled
+            # XLA-kernel-path chip number flowing
+            try:
+                with force_xla_kernels():
+                    times[backend] = measure_backend(backend)
+                fallback_notes[backend] = f"xla-kernel fallback ({msg})"
+            except Exception as e2:  # noqa: BLE001
+                print(f"# bert backend={backend} xla fallback also "
+                      f"failed: {type(e2).__name__}", file=sys.stderr)
+
+    if not times:
+        raise SystemExit("bert bench: every backend failed")
+    head = "flash" if "flash" in times else next(iter(times))
+    cfg, n_params = shared["cfg"], shared["n_params"]
     tokens_per_step = batch * seq
-    t_step = times["flash"]
+    t_step = times[head]
     # 6N per token + the full (non-causal) attention s^2 term
     flops = (6 * n_params * tokens_per_step
              + 3.5 * cfg.num_layers * (4 * batch * seq * seq
                                        * cfg.hidden_size))
-    print(json.dumps({
+    emit({
         "metric": "bert_large_train_step_tokens_per_sec",
         "value": round(tokens_per_step / t_step, 1),
         "unit": "tokens/sec (FusedLAMB + FusedLayerNorm + flash attn)",
-        "vs_baseline": round(times["softmax"] / times["flash"], 4),
+        "vs_baseline": (round(times["softmax"] / times["flash"], 4)
+                        if "flash" in times and "softmax" in times
+                        else None),
         "detail": {
-            "t_flash_ms": round(times["flash"] * 1e3, 3),
-            "t_softmax_ms": round(times["softmax"] * 1e3, 3),
+            "t_flash_ms": (round(times["flash"] * 1e3, 3)
+                           if "flash" in times else None),
+            "t_softmax_ms": (round(times["softmax"] * 1e3, 3)
+                             if "softmax" in times else None),
             "batch": batch, "seq": seq, "n_params": n_params,
+            **({"kernel_fallbacks": fallback_notes}
+               if fallback_notes else {}),
             **mfu_detail(flops, t_step),
             **backend_detail(),
         },
-    }))
+    }, "bert")
 
 
 def main():
@@ -624,12 +765,24 @@ def main():
             0, K, body, (*carry, jnp.float32(0.0)))
         return (params, state), probe
 
+    # Repeats: single measurements cannot attribute a round-over-round
+    # delta to code vs tunnel/host noise (the r2->r3 headline moved with
+    # no way to tell why). Median is the headline; min and the spread
+    # ride in detail.
+    R = 1 if jax.default_backend() == "cpu" else 3
+
+    def measure(fn, carry, *rest):
+        ts = []
+        for _ in range(R):
+            t, carry = time_fn_threaded(fn, carry, *rest)
+            ts.append(t / K)
+        return sorted(ts), carry
+
     # device-side copy survives the donation of `params` into the carry
     # (re-uploading 1.3 GB through a tunneled transport is far slower)
     params_keep = jax.tree.map(jnp.copy, params)
-    t_optax, ocarry = time_fn_threaded(optax_k_steps, (params, opt_state),
-                                       grads)
-    t_optax /= K
+    ts_optax, ocarry = measure(optax_k_steps, (params, opt_state), grads)
+    t_optax = ts_optax[len(ts_optax) // 2]
     # release the baseline's buffers (final carry + Adam moments, ~6.7 GB
     # at BERT-large scale) before the fused states allocate — holding
     # both OOMs 16 GB chips
@@ -647,22 +800,24 @@ def main():
     # is the DEFAULT-resolved impl's time — what a user gets without
     # passing impl= (only if the default impl fails does the record
     # fall back to the surviving one, with a note).
-    from apex_tpu._backend import resolve_impl
-
     fused_times = {}
+    fused_spreads = {}
     fstate = out = None
-    # On an accelerator, time BOTH engine impls explicitly — the round-2
-    # artifact lost the Pallas number because a CPU fallback made the
-    # default resolve to xla and the (None, "xla") pair dedupe to one
-    impls = ((None, "xla") if jax.default_backend() == "cpu"
-             else ("pallas", "xla"))
-    for impl in impls:
-        name = resolve_impl(impl)
-        if name in fused_times:
-            continue    # default already resolves to xla on this backend
+    # On an accelerator, time the segment-resident one-pass schedule
+    # (the DEFAULT: what a user gets), the classic two-stage Pallas
+    # sweep, and the engine's XLA impl — the round-2 artifact lost the
+    # Pallas number because a CPU fallback deduped the impl list, and
+    # the round-3 artifact never timed the segmented kernel at all.
+    if jax.default_backend() == "cpu":
+        configs = [("xla", None, True), ("xla_2stage", None, False)]
+    else:
+        configs = [("segmented", "pallas", True),
+                   ("pallas_2stage", "pallas", False),
+                   ("xla", "xla", False)]
+    for name, impl, seg in configs:
         try:
             fused = FusedLAMB(lr=lr, weight_decay=wd, max_grad_norm=0.0,
-                              use_nvlamb=True, impl=impl)
+                              use_nvlamb=True, impl=impl, segmented=seg)
             fstate = out = None     # drop the previous impl's 3x-params
             fstate = fused.init(params)
             flat_g = fstate.space.pack(grads, dtype=jnp.float32)
@@ -677,8 +832,9 @@ def main():
                 return jax.lax.fori_loop(
                     0, K, body, (state, jnp.float32(0.0)))
 
-            t, out = time_fn_threaded(fused_k_steps, fstate, flat_g)
-            fused_times[name] = t / K
+            ts, out = measure(fused_k_steps, fstate, flat_g)
+            fused_times[name] = ts[len(ts) // 2]
+            fused_spreads[name] = ts
         except Exception as e:  # noqa: BLE001 — keep the record flowing
             msg = str(e).split("\n")[0][:120]
             print(f"# fused impl={name} failed: {type(e).__name__}: {msg}",
@@ -721,41 +877,69 @@ def main():
     except Exception as e:  # noqa: BLE001 — detail-only record
         print(f"# sr-bf16 fused lamb failed: {type(e).__name__}: "
               f"{str(e).split(chr(10))[0][:120]}", file=sys.stderr)
-    default_impl = resolve_impl(None)
-    impl_used = (default_impl if default_impl in fused_times
+    # headline = what a user gets by default: the segmented one-pass
+    # Pallas schedule on an accelerator, the XLA engine on CPU
+    default_name = ("xla" if jax.default_backend() == "cpu"
+                    else "segmented")
+    impl_used = (default_name if default_name in fused_times
                  else min(fused_times, key=fused_times.get))
     t_fused = fused_times[impl_used]
 
     ratio = t_fused / t_optax
     # the LAMB step is HBM-bound, so absolute accounting is bandwidth:
-    # per param ~40 bytes of fp32 traffic (read master+m+v+grad = 16,
-    # write master+m+v+param-out = 16, plus the trust-ratio second pass
-    # re-reading update+param = 8)
-    approx_bytes = 40 * n_params
+    # the segmented one-pass schedule moves 7 fp32 accesses/element
+    # (r p,m,v,g + w p',m',v') = 28 bytes/param of irreducible traffic
+    approx_bytes = 28 * n_params
     detail = {
         "n_params": n_params,
         "n_tensors": len(shapes),
         "t_optax_ms": round(t_optax * 1e3, 3),
         "t_fused_ms": round(t_fused * 1e3, 3),
         "impl": impl_used,
+        "repeats": R,
+        "t_optax_ms_all": [round(t * 1e3, 3) for t in ts_optax],
         "fused_ms_by_impl": {k: round(v * 1e3, 3)
                              for k, v in fused_times.items()},
+        "fused_ms_spread": {k: [round(t * 1e3, 3) for t in v]
+                            for k, v in fused_spreads.items()},
         **({"t_fused_sr_bf16_ms": round(t_sr * 1e3, 3)}
            if t_sr is not None else {}),
-        "approx_hbm_gb_per_sec": round(approx_bytes / t_fused / 1e9, 1),
+        "effective_hbm_gb_per_sec_at_7acc": round(
+            approx_bytes / t_fused / 1e9, 1),
+        "optax_hbm_gb_per_sec_at_7acc": round(
+            approx_bytes / t_optax / 1e9, 1),
         **backend_detail(),
     }
-    if impl_used != default_impl:
+    if jax.default_backend() == "tpu":
+        # chip-health context for the record: regressions are only
+        # attributable when the streaming ceiling rides with the number
+        try:
+            import os as _os
+            sys.path.insert(0, _os.path.join(
+                _os.path.dirname(_os.path.abspath(__file__)), "tools"))
+            from tpu_health import probe_gbps
+            detail["raw_copy_gb_per_sec"] = round(probe_gbps(), 1)
+        except Exception as e:  # noqa: BLE001
+            detail["raw_copy_gb_per_sec"] = None
+            print(f"# health probe failed: {e}", file=sys.stderr)
+    if impl_used != default_name:
         detail["impl_note"] = (
-            f"default impl {default_impl!r} failed; ratio is from "
+            f"default impl {default_name!r} failed; ratio is from "
             f"{impl_used!r}")
-    print(json.dumps({
+    if jax.default_backend() != "tpu":
+        # the optimizer-truth decomposition is the headline's best
+        # chip-side evidence; ride the newest one on fallback records
+        from apex_tpu.records import latest_record
+        od = latest_record("optdiag", require_backend="tpu")
+        if od is not None:
+            detail["last_tpu_optdiag"] = od
+    emit({
         "metric": "fused_lamb_step_time_vs_optax",
         "value": round(ratio, 4),
         "unit": "x (fused/optax, lower is better; target <= 1.1)",
         "vs_baseline": round(ratio, 4),
         "detail": detail,
-    }))
+    }, "headline")
 
 
 if __name__ == "__main__":
@@ -805,7 +989,9 @@ if __name__ == "__main__":
                     if isinstance(e, KeyboardInterrupt):
                         raise
                     failures += 1
-                    print(json.dumps({
+                    # emit (not print): an error record still carries
+                    # the newest persisted TPU evidence for this mode
+                    emit({
                         "metric": f"bench_{name}_error",
                         "value": None,
                         "unit": "error (no measurement)",
@@ -814,7 +1000,7 @@ if __name__ == "__main__":
                             "error": f"{type(e).__name__}: {str(e)[:300]}",
                             **backend_detail(),
                         },
-                    }))
+                    }, name)
             return failures
 
         modes["all"] = run_all
@@ -824,7 +1010,7 @@ if __name__ == "__main__":
         except BaseException as e:  # noqa: BLE001 — always leave a record
             if isinstance(e, KeyboardInterrupt):
                 raise
-            print(json.dumps({
+            emit({
                 "metric": f"bench_{mode or 'headline'}_error",
                 "value": None,
                 "unit": "error (no measurement)",
@@ -833,7 +1019,7 @@ if __name__ == "__main__":
                     "error": f"{type(e).__name__}: {str(e)[:300]}",
                     **backend_detail(),
                 },
-            }))
+            }, mode or "headline")
             sys.exit(1)
         if rc:                  # run_all returns its per-mode failure count
             sys.exit(int(rc))

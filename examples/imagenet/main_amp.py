@@ -166,6 +166,32 @@ def main(argv=None):
             ips = args.batch_size * (i - start_step + 1) / dt
             print(f"step {i:5d}  loss {loss:.4f}  {ips:8.1f} img/s")
 
+    # end-of-run artifact line (ref main_amp.py's epoch summary): one
+    # JSON record with wall-clock throughput, persisted to
+    # bench_records/ when this ran on real hardware so example runs are
+    # load-bearing evidence, not just demos
+    jax.block_until_ready(sloss)
+    total_dt = time.perf_counter() - t0
+    n_run = args.steps - start_step
+    summary = {
+        "example": "imagenet_main_amp",
+        "arch": args.arch,
+        "opt_level": args.opt_level,
+        "steps": n_run,
+        "global_batch": args.batch_size,
+        "imgs_per_sec": round(args.batch_size * n_run / total_dt, 1),
+        "final_loss": round(float(sloss) / float(sstate.loss_scale), 4),
+        "backend": str(jax.default_backend()),
+        "n_devices": len(jax.devices()),
+    }
+    import json as _json
+
+    print(_json.dumps(summary))
+    if summary["backend"] == "tpu":
+        from apex_tpu.records import write_record
+
+        write_record("example_imagenet", summary, backend="tpu")
+
     if args.save:
         save_checkpoint(args.save, params, None, args.steps)
         print(f"saved {args.save}")

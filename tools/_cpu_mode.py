@@ -1,0 +1,39 @@
+"""Force the hardware tools onto the plain CPU backend.
+
+The axon TPU-tunnel plugin hooks jax backend lookup at interpreter
+start; on a dead tunnel any `jax.default_backend()` call sleeps in the
+plugin's retry loop — which, inside a tool that has already taken the
+TPU slot lock, wedges every other client behind a process that will
+never run (observed round 4). `tests/conftest.py` strips the plugin for
+the test suite; this is the same strip as a callable, used by the
+tools' ``--cpu`` flags for CPU logic-validation runs (CI, interpret
+parity) that must never touch the tunnel.
+
+Call BEFORE the first jax import in the process.
+"""
+
+import os
+import sys
+
+
+def force_cpu():
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.setdefault(
+        "XLA_FLAGS",
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8")
+    sys.path = [p for p in sys.path if ".axon_site" not in p]
+    os.environ.pop("PYTHONPATH", None)
+    import jax
+    import jax._src.xla_bridge as _xb
+
+    _xb._backend_factories.pop("axon", None)
+    hook = _xb._get_backend_uncached
+    if getattr(hook, "__name__", "") == "_axon_get_backend_uncached":
+        for cell in hook.__closure__ or ():
+            if callable(cell.cell_contents):
+                _xb._get_backend_uncached = cell.cell_contents
+    jax.config.update("jax_platforms", "cpu")
+
+
+__all__ = ["force_cpu"]

@@ -124,6 +124,52 @@ def main():
         check("bert_scaled_masked_softmax_8x16x512x512", softmax_fwd_bwd,
               scores)
 
+        # ---- segmented one-pass LAMB at headline scale: the small
+        # smoke config compiles tiny segments; the BENCH config runs
+        # ~1.25M-element segments with ~10 MB of VMEM scratch — the
+        # construct class that produced both round-3 Mosaic crashes
+        if not on_cpu:
+            from apex_tpu.multi_tensor.flat_buffer import segmented_space
+            from apex_tpu.multi_tensor.segmented import (
+                fused_lamb_segmented_update,
+            )
+            from apex_tpu.optimizers import FusedLAMB
+            from bench import bert_large_shapes
+
+            import dataclasses as _dc
+
+            for label, okw, shp in (
+                ("seg_lamb_41M_auto", {},
+                 bert_large_shapes(hidden=512, layers=8)),
+                ("seg_lamb_335M_auto", {}, bert_large_shapes()),
+                ("seg_lamb_335M_streamp_bf16u",
+                 {"seg_stash_p": False, "seg_allow_bf16_u": True,
+                  "seg_u_dtype": jnp.bfloat16}, bert_large_shapes()),
+            ):
+                if only and only not in label:
+                    continue
+                tree = {f"p{i}": jax.ShapeDtypeStruct(s, jnp.float32)
+                        for i, s in enumerate(shp)}
+                zeros = jax.tree.map(
+                    lambda sd: jnp.zeros(sd.shape, sd.dtype), tree)
+                opt = FusedLAMB(lr=1e-3, **okw)
+                seg, stash, u_dt = opt._segment_config(zeros)
+                sp, meta = segmented_space(zeros, seg_elems=seg)
+                meta = _dc.replace(meta, stash_p=bool(stash),
+                                   u_dtype_name=jnp.dtype(u_dt).name)
+                pbuf = jnp.zeros((sp.total,), jnp.float32)
+                gbuf = jnp.full((sp.total,), 1e-3, jnp.float32)
+
+                check(label,
+                      lambda p_, g_, sp=sp, meta=meta:
+                      fused_lamb_segmented_update(
+                          p_, jnp.zeros_like(p_), jnp.zeros_like(p_), g_,
+                          sp, meta, lr=1e-3, step=1, weight_decay=0.01,
+                          use_nvlamb=True, max_grad_norm=0.0,
+                          impl="pallas"),
+                      pbuf, gbuf)
+                del pbuf, gbuf, zeros
+
         # the full bert/gpt fwd-bwd jits — exact names, not substrings
         # (slow compiles; request explicitly with `tpu_bisect.py
         # bert_full` / `gpt_full`)

@@ -45,12 +45,17 @@ def main():
         backend = str(jax.default_backend())
         gbps = probe_gbps()
         healthy = backend == "tpu" and gbps >= args.min_gbps
-        print(json.dumps({
+        out = {
             "backend": backend,
             "raw_copy_gb_per_sec": round(gbps, 1),
             "healthy": bool(healthy),
             "min_gbps": args.min_gbps,
-        }))
+        }
+        print(json.dumps(out))
+        if backend == "tpu":
+            from apex_tpu.records import write_record
+
+            write_record("health", out, backend="tpu")
         sys.exit(0 if healthy else 1)
 
 

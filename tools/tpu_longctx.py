@@ -134,6 +134,10 @@ def main():
                     rec["s_ratio"] = round(s / ps, 2)
                 prev[name] = (s, t)
                 print(json.dumps(rec))
+                if backend == "tpu":
+                    from apex_tpu.records import write_record
+
+                    write_record("longctx", rec, backend="tpu")
 
 
 if __name__ == "__main__":

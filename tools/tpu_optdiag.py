@@ -35,7 +35,11 @@ from tpu_smoke import opt_feed  # noqa: E402
 from tpu_longctx import _time_adaptive  # noqa: E402
 
 
+_LINES = []
+
+
 def rec(**kw):
+    _LINES.append(kw)
     print(json.dumps(kw), flush=True)
 
 
@@ -60,17 +64,35 @@ def main():
             platform=str(d.platform),
             backend=str(jax.default_backend()))
 
-        rng = np.random.RandomState(0)
         # interpret-mode pallas at these sizes is not a measurement;
         # on CPU only the xla impl is timed (the chip times both)
         impls = (("xla",) if jax.default_backend() == "cpu"
                  else ("pallas", "xla"))
 
+        def make_trees():
+            # regenerable (same seed) so later phases can rebuild the
+            # trees after dropping them for chip-memory headroom
+            r = np.random.RandomState(0)
+            shapes = (bert_large_shapes(hidden=512, layers=8)
+                      if args.small else bert_large_shapes())
+            ps = {
+                f"p{i}": jnp.asarray(
+                    r.randn(*s).astype(np.float32) * 0.02)
+                for i, s in enumerate(shapes)
+            }
+            gs = {
+                k: jnp.asarray(
+                    r.randn(*v.shape).astype(np.float32) * 1e-3)
+                for k, v in ps.items()
+            }
+            return shapes, ps, gs
+
         # 1. raw streaming bandwidth: out-of-place scale of a 1 GiB
         # buffer, output fed back as next input (zero harness traffic)
         try:
             n_raw = 1 << 28   # 268M fp32 = 1 GiB
-            buf = jnp.asarray(rng.randn(n_raw).astype(np.float32))
+            buf = jnp.asarray(
+                np.random.RandomState(1).randn(n_raw).astype(np.float32))
             t = _time_adaptive(lambda b: (b * 1.0000001,), buf,
                                feed=lambda out, carry: out)
             rec(what="raw_copy_scale", gib=1.0, ms=round(t * 1e3, 3),
@@ -80,16 +102,7 @@ def main():
             rec(what="raw_copy_scale",
                 error=f"{type(e).__name__}: {str(e)[:120]}")
 
-        shapes = (bert_large_shapes(hidden=512, layers=8)
-                  if args.small else bert_large_shapes())
-        params = {
-            f"p{i}": jnp.asarray(rng.randn(*s).astype(np.float32) * 0.02)
-            for i, s in enumerate(shapes)
-        }
-        grads = {
-            k: jnp.asarray(rng.randn(*v.shape).astype(np.float32) * 1e-3)
-            for k, v in params.items()
-        }
+        shapes, params, grads = make_trees()
         space = mt.FlatSpace.create(params)
         n = int(space.total)
         gb = n * 4 / 1e9
@@ -199,6 +212,88 @@ def main():
                 except Exception as e:  # noqa: BLE001
                     rec(what=f"fused_{name}_update_flat", impl=impl,
                         error=f"{type(e).__name__}: {str(e)[:120]}")
+
+        # 5. the segment-resident ONE-PASS LAMB (multi_tensor/
+        # segmented.py) — the round-3 redesign that answers optax's
+        # per-leaf fusion; never measured on chip before round 4. The
+        # plain flat buffers are dropped first and the trees rebuilt
+        # (different layout padding), keeping peak memory at one
+        # workload set.
+        del flat_p, flat_g, m, v
+        from apex_tpu.multi_tensor.segmented import (
+            fused_lamb_segmented_update,
+        )
+
+        for label, kw in (
+            ("stash_p", {}),
+            ("stream_p", {"seg_stash_p": False}),
+            ("stream_p_bf16u", {"seg_stash_p": False,
+                                "seg_allow_bf16_u": True,
+                                "seg_u_dtype": jnp.bfloat16}),
+        ):
+            seg_p = None
+            try:
+                _, params, grads = make_trees()
+                opt = FusedLAMB(lr=1e-3, weight_decay=0.01,
+                                max_grad_norm=0.0, use_nvlamb=True, **kw)
+                seg, stash, u_dt = opt._segment_config(params)
+                from apex_tpu.multi_tensor.flat_buffer import (
+                    segmented_space,
+                )
+
+                seg_space, seg_meta = segmented_space(params,
+                                                      seg_elems=seg)
+                import dataclasses as _dc
+
+                seg_meta = _dc.replace(
+                    seg_meta, stash_p=bool(stash),
+                    u_dtype_name=jnp.dtype(u_dt).name)
+                seg_p = seg_space.pack(params, dtype=jnp.float32)
+                seg_g = seg_space.pack(grads, dtype=jnp.float32)
+                del params, grads
+                sm = jnp.zeros_like(seg_p)
+                sv = jnp.zeros_like(seg_p)
+                seg_gb = int(seg_space.total) * 4 / 1e9
+                covered = 1.0 - sum(
+                    pl for (_, _, pl) in seg_meta.large
+                ) / max(int(seg_space.total), 1)
+                acc = 7 if seg_meta.stash_p else 8
+
+                seg_impl = ("xla" if jax.default_backend() == "cpu"
+                            else "pallas")
+
+                def seg_fn(p_, m_, v_, g_, seg_impl=seg_impl):
+                    return fused_lamb_segmented_update(
+                        p_, m_, v_, g_, seg_space, seg_meta, lr=1e-3,
+                        step=2, weight_decay=0.01, use_nvlamb=True,
+                        max_grad_norm=0.0, impl=seg_impl)[:3]
+
+                t = _time_adaptive(
+                    seg_fn, seg_p, sm, sv, seg_g,
+                    feed=lambda out, carry: (*out, carry[3]))
+                rec(what="fused_lamb_segmented_onepass", config=label,
+                    seg_elems=int(seg_meta.seg_elems),
+                    stash_p=bool(seg_meta.stash_p),
+                    u_dtype=seg_meta.u_dtype_name,
+                    covered_frac=round(covered, 4),
+                    ms=round(t * 1e3, 3),
+                    gb_per_sec_at_small_acc=round(acc * seg_gb / t, 1))
+                del sm, sv, seg_g
+            except Exception as e:  # noqa: BLE001
+                rec(what="fused_lamb_segmented_onepass", config=label,
+                    error=f"{type(e).__name__}: {str(e)[:200]}")
+            finally:
+                del seg_p
+
+        if jax.default_backend() == "tpu":
+            from apex_tpu.records import write_record
+
+            path = write_record(
+                "optdiag",
+                {"small": bool(args.small), "lines": _LINES},
+                backend="tpu")
+            if path:
+                print(f"# record: {path}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,60 @@
+#!/bin/bash
+# Round-4 hardware queue, health-gated — priority order from VERDICT r3:
+# (1) prove the segmented one-pass LAMB through Mosaic and time it,
+# (2) bisect the bench_bert/bench_gpt compile crashers,
+# (3) re-validate tile defaults with the fixed chained timer,
+# (4) fill every BASELINE row with a TPU-backed bench record.
+# Every successful measurement persists to bench_records/ (round-4
+# records infrastructure), so evidence survives a dead tunnel.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${INTERVAL:-480}
+LOGDIR=${LOGDIR:-/tmp/tpu_queue_r4}
+mkdir -p "$LOGDIR"
+echo "logs -> $LOGDIR"
+
+healthy() { timeout 240 python tools/tpu_health.py >>"$LOGDIR/health.log" 2>&1; }
+
+run() {  # run <name> <timeout-s> <cmd...>
+  local name=$1 to=$2; shift 2
+  until healthy; do
+    echo "chip unhealthy before $name $(date -u +%H:%M:%S); retry in ${INTERVAL}s"
+    sleep "$INTERVAL"
+  done
+  echo "=== $name ($(date -u +%H:%M:%S)) ==="
+  timeout "$to" "$@" >"$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  tail -4 "$LOGDIR/$name.log"
+  echo "--- $name rc=$rc"
+}
+
+# 1. the one job above all: does the segmented kernel lower + match?
+run smoke_segmented 1200 python tools/tpu_smoke.py --only segmented
+# full kernel-zoo parity (regression gate for everything else)
+run smoke 2400 python tools/tpu_smoke.py
+
+# 2. optimizer truth with the segmented schedule, 41.5M then 335M
+run optdiag_small 2400 python tools/tpu_optdiag.py --small
+run optdiag 3000 python tools/tpu_optdiag.py
+
+# 3. bert/gpt Mosaic crasher bisection (evidence for the fix)
+run bisect 1800 python tools/tpu_bisect.py
+
+# 3b. engine bandwidth factor ladder (where do the GB/s go?)
+run kprobe 1800 python tools/tpu_kprobe.py
+
+# 4. driver-format bench records, headline first
+export APEX_TPU_BENCH_PROBE_BUDGET=240
+run bench_headline 2400 python bench.py
+run bench_attn     1800 python bench.py attn
+run bench_bert     2400 python bench.py bert
+run bench_gpt      2400 python bench.py gpt
+run bench_resnet   2400 python bench.py resnet
+run bench_moe      1800 python bench.py moe
+
+# 5. re-validate tile defaults with the fixed chained timer
+run tune_attnbwd 2400 python tools/tpu_tune.py attnbwd
+run tune_opt     1800 python tools/tpu_tune.py opt
+run tune_ln      1200 python tools/tpu_tune.py ln
+
+echo "QUEUE DONE ($(date -u +%H:%M:%S)); logs in $LOGDIR"

@@ -244,6 +244,59 @@ def run(perf=False, kimpl="pallas", only=None):
               max_grad_norm=0.0, impl=impl),
           seg_p, seg_g, seg_m, seg_v, tol=1e-4)
 
+    # segmented + in-kernel SR: the combination has no interpret
+    # lowering, so (like the SGD SR check below) chip statistics are
+    # its only validation surface: a tiny constant update must round
+    # up/down ~50/50 and be unbiased in the mean
+    name = "fused_lamb_segmented SR bf16 (in-kernel prng)"
+    if kimpl == "pallas" and not (only and only not in name):
+        try:
+            sr_tree = {"w": jnp.full((2 * SEG_CHUNK,), 1.0, jnp.bfloat16)}
+            sr_space, sr_meta = segmented_space(sr_tree,
+                                                seg_elems=2 * SEG_CHUNK)
+            sr_p = sr_space.pack(sr_tree, dtype=jnp.bfloat16)
+            # grads sized so the LAMB update lands well below one bf16
+            # ulp of 1.0 (2^-8): SR must preserve it in expectation
+            sr_g = jnp.full((sr_space.total,), 1.0, jnp.float32)
+            sr_m = jnp.zeros((sr_space.total,), jnp.float32)
+            sr_v = jnp.zeros((sr_space.total,), jnp.float32)
+            p2s, *_ = jax.jit(
+                lambda p_, m_, v_, g_: fused_lamb_segmented_update(
+                    p_, m_, v_, g_, sr_space, sr_meta, lr=2.0 ** -11,
+                    weight_decay=0.0, use_nvlamb=False, step=1,
+                    max_grad_norm=0.0, bias_correction=False,
+                    impl=kimpl, sr_seed=11))(sr_p, sr_m, sr_v, sr_g)
+            vals = np.asarray(jax.device_get(p2s), np.float32)
+            # exact update: 1 - 2^-11 (trust ratio 1: wd=0, nvlamb off);
+            # bf16 neighbors are 1.0 and 1-2^-8 -> frac_hi ~ 1-2^-3/...
+            exp = 1.0 - 2.0 ** -11
+            mean_err = abs(float(vals.mean()) - exp)
+            uniq = np.unique(vals)
+            ok = mean_err < 2e-4 and 1 < uniq.size <= 3
+            results.append((name, ok, mean_err, None, None))
+            print(f"  [{'PASS' if ok else 'FAIL'}] {name:42s} "
+                  f"mean_err {mean_err:.2e} uniq {uniq.size}")
+        except Exception as e:  # noqa: BLE001 — report, keep sweeping
+            results.append((name, False, float("inf"), None, None))
+            msg = str(e).split("\n")[0][:140]
+            print(f"  [FAIL] {name:42s} {type(e).__name__}: {msg}")
+
+    # the VMEM-budget variants must also lower: p-streaming (half the
+    # scratch) and the bf16 u-stash
+    check("fused_lamb_segmented stream_p",
+          lambda p, g, m_, v_, impl: fused_lamb_segmented_update(
+              p, m_, v_, g, seg_space, seg_meta, lr=1e-3,
+              weight_decay=0.01, use_nvlamb=True, step=1,
+              max_grad_norm=0.0, stash_p=False, impl=impl),
+          seg_p, seg_g, seg_m, seg_v, tol=1e-4)
+    check("fused_lamb_segmented bf16-u",
+          lambda p, g, m_, v_, impl: fused_lamb_segmented_update(
+              p, m_, v_, g, seg_space, seg_meta, lr=1e-3,
+              weight_decay=0.01, use_nvlamb=True, step=1,
+              max_grad_norm=0.0, stash_p=False, u_dtype=jnp.bfloat16,
+              impl=impl),
+          seg_p, seg_g, seg_m, seg_v, tol=1e-2)
+
     check("fused_novograd_update",
           lambda p, g, m_, impl: mt.fused_novograd_update(
               p, m_, jnp.zeros((space.num_leaves,), jnp.float32), g, space,
@@ -423,6 +476,26 @@ def run(perf=False, kimpl="pallas", only=None):
     n_fail = sum(1 for _, ok, *_ in results if not ok)
     print(f"\n{len(results) - n_fail}/{len(results)} ops pass on "
           f"{jax.default_backend()}")
+    if jax.default_backend() == "tpu":
+        from apex_tpu.records import write_record
+
+        path = write_record("smoke", {
+            "passed": len(results) - n_fail,
+            "total": len(results),
+            "impl": kimpl,
+            "only": only,
+            "perf": bool(perf),
+            "results": [
+                {"name": n, "ok": bool(ok),
+                 "max_err": (float(err) if np.isfinite(err) else None),
+                 **({"pallas_ms": round(tp * 1e3, 3),
+                     "xla_ms": round(tx * 1e3, 3)}
+                    if tp is not None and tx is not None else {})}
+                for n, ok, err, tp, tx in results
+            ],
+        }, backend="tpu")
+        if path:
+            print(f"# record: {path}", file=sys.stderr)
     return n_fail
 
 
@@ -436,7 +509,15 @@ if __name__ == "__main__":
     ap.add_argument("--only", default=None,
                     help="substring filter: run only configs whose name "
                          "contains this (targeted hardware re-checks)")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the plain CPU backend (strips the "
+                         "tunnel plugin) — for interpret-mode logic "
+                         "validation without touching the chip slot")
     args = ap.parse_args()
+    if args.cpu:
+        from _cpu_mode import force_cpu
+
+        force_cpu()
     from apex_tpu.backend_guard import tpu_slot_lock
 
     # the tunnel serves ONE client; serialize against bench/tune runs

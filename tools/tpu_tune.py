@@ -20,6 +20,16 @@ from tpu_smoke import _time  # noqa: E402  (chained timer)
 from tpu_smoke import grad_feed as _grad_feed  # noqa: E402
 from tpu_smoke import opt_feed as _opt_feed  # noqa: E402
 
+from apex_tpu.ops.mosaic_limits import block_ok  # noqa: E402
+
+_LINES = []
+_print = print
+
+
+def print(*args, **kw):  # noqa: A001 — tee stdout into the record
+    _LINES.append(" ".join(str(a) for a in args))
+    _print(*args, **kw)
+
 def tune_attn():
     import jax
     import jax.numpy as jnp
@@ -38,6 +48,11 @@ def tune_attn():
         for bq, bk in [(256, 256), (512, 512), (512, 1024), (1024, 512),
                        (1024, 1024), (2048, 1024), (1024, 2048)]:
             if bq > s or bk > s:
+                continue
+            isz = jnp.dtype(dt).itemsize
+            if not (block_ok(bq, d, isz) and block_ok(bk, d, isz)):
+                print(f"  bq={bq:5d} bk={bk:5d}  SKIP (Mosaic crash "
+                      "region, docs/HARDWARE_NOTES.md)")
                 continue
 
             def fwd_bwd(q, k, v, bq=bq, bk=bk):
@@ -96,6 +111,11 @@ def tune_attn_bwd():
                          (2048, 2048), (256, 1024), (1024, 256)]:
             if bbq > s or bbk > s:
                 continue
+            isz = jnp.dtype(dt).itemsize
+            if not (block_ok(bbq, d, isz) and block_ok(bbk, d, isz)):
+                print(f"  bbq={bbq:5d} bbk={bbk:5d}  SKIP (Mosaic crash "
+                      "region, docs/HARDWARE_NOTES.md)")
+                continue
 
             def fwd_bwd(q, k, v, bbq=bbq, bbk=bbk):
                 def loss(q, k, v):
@@ -142,6 +162,10 @@ def tune_ln():
     print(f"layer_norm fwd+bwd rows={rows} hidden={hidden} bf16 x")
     orig = ln_mod._DEF_ROWS
     for tile_rows in (64, 128, 256, 512, 1024):
+        if not block_ok(tile_rows, hidden, 2):
+            print(f"  tile_rows={tile_rows:5d}  SKIP (Mosaic crash "
+                  "region, docs/HARDWARE_NOTES.md)")
+            continue
         ln_mod._DEF_ROWS = tile_rows
         try:
             t = _time(lambda x, w, b: fwd_bwd(x, w, b, "pallas"),
@@ -193,6 +217,10 @@ def _sweep_tile_rows(label, step_fn, args, n, accesses_per_elem):
     print(f"{label} n={n}")
     orig = engine.DEFAULT_TILE_ROWS
     for tile_rows in (128, 256, 512, 1024, 2048):
+        if not block_ok(tile_rows, 128, 4):
+            print(f"  tile_rows={tile_rows:5d}  SKIP (Mosaic crash "
+                  "region, docs/HARDWARE_NOTES.md)")
+            continue
         engine.DEFAULT_TILE_ROWS = tile_rows
         try:
             t = _time(step_fn, *args, iters=3, chain=5, feed=_opt_feed)
@@ -265,3 +293,11 @@ if __name__ == "__main__":
         which = sys.argv[1:] or list(ALL)
         for name in which:
             ALL[name]()
+        if jax.default_backend() == "tpu":
+            from apex_tpu.records import write_record
+
+            path = write_record(
+                "tune", {"modes": which, "lines": _LINES},
+                backend="tpu")
+            if path:
+                _print(f"# record: {path}", file=sys.stderr)
