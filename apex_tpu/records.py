@@ -43,11 +43,15 @@ def _git_sha() -> str:
 
 
 def write_record(kind: str, payload: Dict[str, Any],
-                 backend: Optional[str] = None) -> Optional[str]:
+                 backend: Optional[str] = None,
+                 captured: bool = True) -> Optional[str]:
     """Persist one measurement under ``bench_records/``.
 
     ``kind`` groups records for retrieval (e.g. ``"headline"``,
-    ``"attn"``, ``"smoke"``, ``"optdiag"``, ``"tune_ln"``). Returns the
+    ``"attn"``, ``"smoke"``, ``"optdiag"``, ``"tune_ln"``).
+    ``captured=False`` marks a hand-transcribed record (evidence copied
+    from session notes, not written by the measuring process itself);
+    it is stored top-level so consumers cannot miss it. Returns the
     written path, or None if persistence failed (never raises — a
     failed disk write must not kill a measurement run).
     """
@@ -59,6 +63,7 @@ def write_record(kind: str, payload: Dict[str, Any],
             "utc": stamp,
             "git_sha": _git_sha(),
             **({"backend": backend} if backend else {}),
+            "captured": bool(captured),
             "payload": payload,
         }
         base = f"{kind}_{stamp}_{rec['git_sha']}"
@@ -74,27 +79,66 @@ def write_record(kind: str, payload: Dict[str, Any],
         return None
 
 
+def _uniquifier(name: str) -> int:
+    # "kind_stamp_sha.3.json" -> 3; "kind_stamp_sha.json" -> 0.
+    parts = name[:-len(".json")].rsplit(".", 1)
+    return int(parts[1]) if len(parts) == 2 and parts[1].isdigit() else 0
+
+
+def is_transcribed(rec: Dict[str, Any]) -> bool:
+    """True when a record is hand-transcribed evidence, not written by
+    the measuring process itself (top-level ``captured: false`` or the
+    legacy ``"tpu-transcribed"`` backend tag)."""
+    return (rec.get("captured") is False
+            or str(rec.get("backend", "")).endswith("-transcribed"))
+
+
 def latest_record(kind: str,
-                  require_backend: Optional[str] = "tpu"
+                  require_backend: Optional[str] = "tpu",
+                  allow_transcribed: bool = True
                   ) -> Optional[Dict[str, Any]]:
-    """Newest record of ``kind`` (by filename timestamp), optionally
-    filtered to a backend. None when there is no matching record."""
+    """Newest record of ``kind``, optionally filtered to a backend.
+
+    The kind is matched against the *loaded* record's ``kind`` field
+    (never the filename, which would cross-match kinds that are
+    prefixes of other kinds), and recency comes from the record's
+    ``utc`` field with the filename uniquifier as tiebreaker.
+    Driver-captured records always win over transcribed ones of the
+    same kind regardless of age; ``allow_transcribed=False`` excludes
+    transcribed records entirely. ``require_backend="tpu"`` also admits
+    the ``"tpu-transcribed"`` tag (subject to ``allow_transcribed``).
+    None when there is no matching record.
+    """
     try:
-        names = sorted(
-            n for n in os.listdir(RECORDS_DIR)
-            if n.startswith(f"{kind}_") and n.endswith(".json"))
+        # filename prefix is a cheap pre-filter only (write_record names
+        # files '{kind}_...'); the authoritative match is rec['kind']
+        # below, so prefix-of-another-kind files just parse and drop out
+        names = [n for n in os.listdir(RECORDS_DIR)
+                 if n.startswith(f"{kind}_") and n.endswith(".json")]
     except OSError:
         return None
-    for name in reversed(names):
+    matches = []
+    for name in names:
         try:
             with open(os.path.join(RECORDS_DIR, name)) as f:
                 rec = json.load(f)
         except (OSError, ValueError):
             continue
-        if require_backend and rec.get("backend") not in (require_backend,):
+        if rec.get("kind") != kind:
             continue
-        return rec
-    return None
+        transcribed = is_transcribed(rec)
+        if transcribed and not allow_transcribed:
+            continue
+        if require_backend:
+            accepted = {require_backend, f"{require_backend}-transcribed"}
+            if rec.get("backend") not in accepted:
+                continue
+        matches.append((not transcribed, str(rec.get("utc", "")),
+                        _uniquifier(name), rec))
+    if not matches:
+        return None
+    return max(matches, key=lambda t: t[:3])[3]
 
 
-__all__ = ["write_record", "latest_record", "RECORDS_DIR"]
+__all__ = ["write_record", "latest_record", "is_transcribed",
+           "RECORDS_DIR"]

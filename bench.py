@@ -53,7 +53,7 @@ def emit(rec, kind):
     kind (with its timestamp + git SHA) so a tunnel-dead artifact still
     carries real-chip evidence with provenance (round-1..3 lost every
     chip-window number this way)."""
-    from apex_tpu.records import latest_record, write_record
+    from apex_tpu.records import is_transcribed, latest_record, write_record
 
     detail = rec.setdefault("detail", {})
     on_tpu = detail.get("backend") == "tpu"
@@ -69,6 +69,12 @@ def emit(rec, kind):
         last = latest_record(kind, require_backend="tpu")
         if last is not None:
             detail["last_tpu_record"] = last
+            if is_transcribed(last):
+                detail["last_tpu_record_note"] = (
+                    "TRANSCRIBED from session notes, not driver-captured"
+                    + (": " + str(last["payload"]["provenance"])
+                       if isinstance(last.get("payload"), dict)
+                       and "provenance" in last["payload"] else ""))
     print(json.dumps(rec))
 
 
@@ -926,18 +932,35 @@ def main():
         detail["impl_note"] = (
             f"default impl {default_name!r} failed; ratio is from "
             f"{impl_used!r}")
-    if jax.default_backend() != "tpu":
+    # single source of truth for "was this a TPU measurement": the same
+    # detail['backend'] field emit() gates headline_valid on (the guard
+    # probe and the in-process backend can disagree if the tunnel dies
+    # mid-run; the record must not contradict itself)
+    on_tpu = detail.get("backend") == "tpu"
+    if not on_tpu:
         # the optimizer-truth decomposition is the headline's best
         # chip-side evidence; ride the newest one on fallback records
-        from apex_tpu.records import latest_record
+        from apex_tpu.records import is_transcribed, latest_record
         od = latest_record("optdiag", require_backend="tpu")
         if od is not None:
             detail["last_tpu_optdiag"] = od
+            if is_transcribed(od):
+                detail["last_tpu_optdiag_note"] = (
+                    "TRANSCRIBED from session notes, not driver-captured")
+    # The headline value is a TPU number or nothing: a fallback-backend
+    # ratio in `value` reads as a regression/improvement story across
+    # rounds that is actually tunnel noise (r2->r4 told a fake one).
+    # The fallback measurement stays in detail for debugging.
+    if not on_tpu:
+        detail["fallback_ratio"] = round(ratio, 4)
+        detail["fallback_ratio_note"] = (
+            "fused/optax on the fallback backend — diagnostic only, "
+            "never the headline value")
     emit({
         "metric": "fused_lamb_step_time_vs_optax",
-        "value": round(ratio, 4),
+        "value": round(ratio, 4) if on_tpu else None,
         "unit": "x (fused/optax, lower is better; target <= 1.1)",
-        "vs_baseline": round(ratio, 4),
+        "vs_baseline": round(ratio, 4) if on_tpu else None,
         "detail": detail,
     }, "headline")
 

@@ -1,0 +1,60 @@
+#!/bin/bash
+# Round-5 hardware queue, health-gated — priority order from VERDICT r4:
+# (1) the only must-win: prove the segmented one-pass LAMB through
+#     Mosaic (all scratch configs + SR) and time it vs optax,
+# (2) BERT/GPT model benches (scan_layers fix verification / bisect),
+# (3) resnet + moe BASELINE rows,
+# (4) re-sweep LN/engine/opt tile defaults with the fixed timer.
+# Every successful measurement persists to bench_records/ so evidence
+# survives a dead tunnel; the driver-format BENCH payload comes from
+# bench.py at the end of the round.
+set -u
+cd "$(dirname "$0")/.."
+INTERVAL=${INTERVAL:-480}
+LOGDIR=${LOGDIR:-/tmp/tpu_queue_r5}
+mkdir -p "$LOGDIR"
+echo "logs -> $LOGDIR"
+
+healthy() { timeout 240 python tools/tpu_health.py >>"$LOGDIR/health.log" 2>&1; }
+
+run() {  # run <name> <timeout-s> <cmd...>
+  local name=$1 to=$2; shift 2
+  until healthy; do
+    echo "chip unhealthy before $name $(date -u +%H:%M:%S); retry in ${INTERVAL}s"
+    sleep "$INTERVAL"
+  done
+  echo "=== $name ($(date -u +%H:%M:%S)) ==="
+  timeout "$to" "$@" >"$LOGDIR/$name.log" 2>&1
+  local rc=$?
+  tail -4 "$LOGDIR/$name.log"
+  echo "--- $name rc=$rc"
+}
+
+# 1. the one job above all: does the segmented kernel lower + match?
+run smoke_segmented 1200 python tools/tpu_smoke.py --only segmented
+run smoke 2400 python tools/tpu_smoke.py
+
+# 2. optimizer truth with the segmented schedule, 41.5M then 335M
+run optdiag_small 2400 python tools/tpu_optdiag.py --small
+run optdiag 3000 python tools/tpu_optdiag.py
+
+# 3. driver-format bench records, headline first (segmented is the
+#    production impl on tpu as of round 5)
+export APEX_TPU_BENCH_PROBE_BUDGET=240
+run bench_headline 2400 python bench.py
+run bench_gpt      2400 python bench.py gpt
+run bench_bert     2400 python bench.py bert
+run bench_attn     1800 python bench.py attn
+run bench_resnet   2400 python bench.py resnet
+run bench_moe      1800 python bench.py moe
+
+# 4. crasher bisection + bandwidth ladder (diagnostics if 2/3 failed)
+run bisect 1800 python tools/tpu_bisect.py
+run kprobe 1800 python tools/tpu_kprobe.py
+
+# 5. re-validate tile defaults with the fixed chained timer
+run tune_opt     1800 python tools/tpu_tune.py opt
+run tune_ln      1200 python tools/tpu_tune.py ln
+run tune_attnbwd 2400 python tools/tpu_tune.py attnbwd
+
+echo "QUEUE DONE ($(date -u +%H:%M:%S)); logs in $LOGDIR"

@@ -264,7 +264,7 @@ def run(perf=False, kimpl="pallas", only=None):
                 lambda p_, m_, v_, g_: fused_lamb_segmented_update(
                     p_, m_, v_, g_, sr_space, sr_meta, lr=2.0 ** -11,
                     weight_decay=0.0, use_nvlamb=False, step=1,
-                    max_grad_norm=0.0, bias_correction=False,
+                    max_grad_norm=0.0, bias_correction=True,
                     impl=kimpl, sr_seed=11))(sr_p, sr_m, sr_v, sr_g)
             vals = np.asarray(jax.device_get(p2s), np.float32)
             # exact update: 1 - 2^-11 (trust ratio 1: wd=0, nvlamb off);
