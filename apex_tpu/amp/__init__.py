@@ -28,6 +28,9 @@ from apex_tpu.amp.functional import (
 )
 from apex_tpu.amp.handle import disable_casts, scale_loss
 from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.amp import lists
+from apex_tpu.amp import nn_functional as F
+from apex_tpu.amp._amp_state import policy_scope
 
 __all__ = [
     "scale_loss",
@@ -51,4 +54,7 @@ __all__ = [
     "register_float_function",
     "register_promote_function",
     "master_params",
+    "lists",
+    "F",
+    "policy_scope",
 ]

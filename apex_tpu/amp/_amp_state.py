@@ -1,0 +1,72 @@
+"""Process-level active amp policy (ref: apex/amp/_amp_state.py:1-70).
+
+The reference keeps a module-global ``_amp_state`` that its patched
+torch functions consult at call time. The TPU-native equivalent is the
+same idea one level up: :mod:`apex_tpu.amp.nn_functional` wrappers read
+the policy registered here *at trace time* (everything under ``jit`` is
+traced once, so the policy is baked into the compiled program — exactly
+the static behavior the reference's per-call checks approximate).
+
+``amp.initialize`` activates the policy; ``policy_scope`` scopes one;
+``amp.disable_casts`` suspends casting inside a block
+(ref: apex/amp/handle.py:163-167, here actually meaningful again).
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+_active_props: Optional[Any] = None
+_casts_disabled: int = 0
+allow_banned: bool = False
+
+
+def set_active(props: Optional[Any]) -> None:
+    global _active_props
+    _active_props = props
+
+
+def get_active() -> Optional[Any]:
+    return _active_props
+
+
+def casts_enabled() -> bool:
+    return _casts_disabled == 0
+
+
+def active_compute_dtype():
+    """The dtype whitelist ops should run in right now, or None when
+    no patch-style policy (O1/O4) is active or casts are suspended."""
+    if not casts_enabled() or _active_props is None:
+        return None
+    return getattr(_active_props, "compute_dtype", None)
+
+
+@contextlib.contextmanager
+def policy_scope(props: Optional[Any]):
+    """Activate ``props`` for the duration of the block (the scoped
+    alternative to ``amp.initialize``'s process-global activation)."""
+    global _active_props
+    prev = _active_props
+    _active_props = props
+    try:
+        yield
+    finally:
+        _active_props = prev
+
+
+@contextlib.contextmanager
+def suspend_casts():
+    global _casts_disabled
+    _casts_disabled += 1
+    try:
+        yield
+    finally:
+        _casts_disabled -= 1
+
+
+__all__ = [
+    "set_active", "get_active", "casts_enabled", "active_compute_dtype",
+    "policy_scope", "suspend_casts", "allow_banned",
+]

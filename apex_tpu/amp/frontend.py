@@ -123,6 +123,7 @@ def initialize(
     loss_scale=None,
     min_loss_scale=None,
     max_loss_scale=2.0 ** 24,
+    allow_banned=False,
 ):
     """Configure mixed precision (ref: apex/amp/frontend.py:259-431).
 
@@ -147,6 +148,13 @@ def initialize(
         master_weights=master_weights if master_weights is not None else base.master_weights,
         loss_scale=loss_scale if loss_scale is not None else base.loss_scale,
     )
+
+    # activate the policy for the shipped functional namespace (amp.F
+    # consults it at trace time — the analog of the reference's
+    # amp.init patching pass, ref apex/amp/_initialize.py:229-263)
+    from apex_tpu.amp import _amp_state
+    _amp_state.set_active(props)
+    _amp_state.allow_banned = bool(allow_banned)
 
     cast_params = params
     if props.cast_model_type is not None:

@@ -84,10 +84,13 @@ def scale_loss(loss, amp_state: AmpState, *, loss_id: int = 0,
 
 @contextlib.contextmanager
 def disable_casts():
-    """API-parity no-op (ref handle.py:163-167): the reference suspends
-    its function patches inside this block; here dtypes are explicit
-    policies, so there is nothing to suspend."""
-    yield
+    """Suspend amp casting inside the block (ref handle.py:163-167):
+    every ``amp.F`` wrapper becomes a passthrough until exit. (Only
+    meaningful OUTSIDE jit or at trace time — a compiled program has
+    its casts baked in.)"""
+    from apex_tpu.amp import _amp_state
+    with _amp_state.suspend_casts():
+        yield
 
 
 __all__ = ["scale_loss", "disable_casts"]

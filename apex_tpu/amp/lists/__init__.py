@@ -1,0 +1,150 @@
+"""Shipped op-classification defaults.
+
+The reference's O1 value is that it *ships* the judgment call of which
+ops are fp16-safe (ref: apex/amp/lists/functional_overrides.py:18-92,
+apex/amp/lists/torch_overrides.py:7-133); users get a working mixed-
+precision policy with zero registration. These tables are that judgment
+call for the JAX/TPU op surface, consumed two ways:
+
+- :mod:`apex_tpu.amp.nn_functional` (exported as ``amp.F``) ships a
+  functional namespace with the classification pre-applied — the
+  equivalent of the reference's patched ``torch.nn.functional``;
+- :func:`register_defaults` applies the same classification to any
+  user module holding same-named functions, via the
+  ``amp.functional.register_*`` machinery.
+
+Classification rationale (TPU terms):
+- COMPUTE_FUNCS ride the MXU: matmuls/convs are where bf16/fp16 wins
+  throughput and the systolic array accumulates in fp32 anyway.
+- FP32_FUNCS are numerically unsafe in 16-bit: exponent-range ops
+  (softmax/logsumexp family), variance-style reductions (norms), and
+  loss functions whose gradients scale poorly.
+- PROMOTE/SEQUENCE_CASTS mix dtypes: promote to the widest float.
+- BANNED: sigmoid-output BCE saturates in fp16; the reference refuses
+  it with guidance (functional_overrides.py:95-107) and so do we.
+"""
+
+from __future__ import annotations
+
+# -- whitelist: run in the policy compute dtype (fp16 for O1, bf16 for
+#    O4) -----------------------------------------------------------------
+COMPUTE_FUNCS = [
+    "linear",
+    "dense",
+    "conv1d",
+    "conv2d",
+    "conv3d",
+    "conv_transpose2d",
+    "matmul",
+    "bmm",
+    "einsum",
+    "dot",
+]
+
+# fork parity: the reference fork classifies the same ops for bf16
+# (ref: apex/amp/lists/functional_overrides.py BFLOAT16_FUNCS)
+FP16_FUNCS = list(COMPUTE_FUNCS)
+BFLOAT16_FUNCS = list(COMPUTE_FUNCS)
+
+# -- blacklist: always computed (and returned) in fp32 -------------------
+FP32_FUNCS = [
+    # softmax family / exponent-range pointwise
+    "softmax",
+    "log_softmax",
+    "softmin",
+    "softplus",
+    "gelu",
+    "logsumexp",
+    # normalization
+    "layer_norm",
+    "rms_norm",
+    "group_norm",
+    "batch_norm",
+    "normalize",
+    "cosine_similarity",
+    # variance-style reductions
+    "norm",
+    "var",
+    "std",
+    "cumsum",
+    "cumprod",
+    # losses
+    "mse_loss",
+    "l1_loss",
+    "smooth_l1_loss",
+    "nll_loss",
+    "cross_entropy",
+    "kl_div",
+    "poisson_nll_loss",
+    "binary_cross_entropy_with_logits",
+]
+
+# -- mixed-argument math: cast every float arg to the widest dtype -------
+PROMOTE_FUNCS = [
+    "add",
+    "mul",
+    "div",
+    "atan2",
+]
+
+# sequence-taking variants of the same (ref torch_overrides.py:116-133)
+SEQUENCE_CASTS = [
+    "cat",
+    "stack",
+    "concatenate",
+]
+
+# -- run in whatever dtype the input already has -------------------------
+MATCH_INPUT_FUNCS = [
+    "relu",
+    "tanh",
+    "sigmoid",
+    "silu",
+]
+
+BANNED_MESSAGE = (
+    "amp does not work out-of-the-box with `binary_cross_entropy` on "
+    "probabilities: a sigmoid output saturates to exactly 0/1 in 16-bit "
+    "and the loss gradient blows up. Use "
+    "`binary_cross_entropy_with_logits` (sigmoid fused into the loss, "
+    "classified fp32 here), or if you really know what you are doing "
+    "pass allow_banned=True to amp.initialize. "
+    "(ref: apex/amp/lists/functional_overrides.py:95-107)"
+)
+
+BANNED_FUNCS = [("binary_cross_entropy", BANNED_MESSAGE)]
+
+
+def register_defaults(module, compute_dtype="float16") -> int:
+    """Apply the default classification to ``module`` in place.
+
+    For each table name present on ``module``, rebinds it through the
+    matching ``amp.functional`` decorator (the reference's amp.init
+    patching pass, ref: apex/amp/amp.py:75-198, applied eagerly to one
+    namespace). Returns the number of functions rebound.
+    """
+    import jax.numpy as jnp
+
+    from apex_tpu.amp import functional as afn
+
+    compute = (afn.bfloat16_function
+               if jnp.dtype(compute_dtype) == jnp.dtype(jnp.bfloat16)
+               else afn.half_function)
+    n = 0
+    for names, deco in (
+        (COMPUTE_FUNCS, compute),
+        (FP32_FUNCS, afn.float_function),
+        (PROMOTE_FUNCS + SEQUENCE_CASTS, afn.promote_function),
+    ):
+        for name in names:
+            if callable(getattr(module, name, None)):
+                setattr(module, name, deco(getattr(module, name)))
+                n += 1
+    return n
+
+
+__all__ = [
+    "COMPUTE_FUNCS", "FP16_FUNCS", "BFLOAT16_FUNCS", "FP32_FUNCS",
+    "PROMOTE_FUNCS", "SEQUENCE_CASTS", "MATCH_INPUT_FUNCS",
+    "BANNED_FUNCS", "BANNED_MESSAGE", "register_defaults",
+]

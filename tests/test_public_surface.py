@@ -20,7 +20,8 @@ SURFACE = {
         "half_function", "bfloat16_function", "float_function",
         "promote_function", "register_half_function",
         "register_bfloat16_function", "register_float_function",
-        "register_promote_function",
+        "register_promote_function", "lists", "F", "policy_scope",
+        "disable_casts",
     ],
     "apex_tpu.optimizers": [
         "FusedAdam", "FusedLAMB", "FusedMixedPrecisionLamb", "FusedSGD",

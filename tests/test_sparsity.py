@@ -74,6 +74,100 @@ class TestPermutationSearch:
         assert sorted(perm) == list(range(16))
         assert permutation_retained_magnitude(w, perm) >= base - 1e-6
 
+    def test_partition_tables_match_reference_counts(self):
+        """Canonical-unique window permutations: 35 for 8 columns,
+        5775 for 12 (ref exhaustive_search.py
+        predict_unique_combinations: C! / ((M!)^G * G!))."""
+        from apex_tpu.contrib.sparsity import _unique_partitions_np
+
+        assert _unique_partitions_np(8).shape == (35, 2)
+        assert _unique_partitions_np(12).shape == (5775, 3)
+
+    def test_exhaustive_beats_identity_on_adversarial(self, rng):
+        """Columns grouped so same-magnitude channels share stripes:
+        2:4 on the identity layout throws away half the large-magnitude
+        entries; the search must recover them by mixing stripes (the
+        accuracy-retention mechanism of ref permutation_lib.py)."""
+        from apex_tpu.contrib.sparsity import (
+            _hill_climb_permutation,
+            exhaustive_search,
+            permutation_retained_magnitude,
+        )
+
+        mags = np.repeat([5.0, 5.0, 0.1, 0.1], 4).astype(np.float32)
+        w = rng.randn(64, 16).astype(np.float32) * mags
+        base = permutation_retained_magnitude(w, np.arange(16))
+        perm = exhaustive_search(w, window_cols=8, seed=0)
+        score = permutation_retained_magnitude(w, perm)
+        assert sorted(perm) == list(range(16))
+        # the improvement is structural, not epsilon: >10% retained
+        assert score > base * 1.1, (base, score)
+        # and at least as good as the old hill-climb at its budget
+        hc = permutation_retained_magnitude(
+            w, _hill_climb_permutation(w, 100, 0))
+        assert score >= hc - 1e-4
+
+    def test_window12_at_least_window8(self, rng):
+        from apex_tpu.contrib.sparsity import (
+            exhaustive_search,
+            permutation_retained_magnitude,
+        )
+
+        mags = np.repeat([5.0, 5.0, 0.1, 0.1], 4).astype(np.float32)
+        w = rng.randn(32, 16).astype(np.float32) * mags
+        s8 = permutation_retained_magnitude(
+            w, exhaustive_search(w, window_cols=8, seed=0))
+        s12 = permutation_retained_magnitude(
+            w, exhaustive_search(w, window_cols=12, seed=0))
+        assert s12 >= s8 - 1e-3
+
+    def test_escape_attempts_help_or_keep(self, rng):
+        from apex_tpu.contrib.sparsity import (
+            exhaustive_search,
+            permutation_retained_magnitude,
+        )
+
+        w = rng.randn(32, 24).astype(np.float32) * np.repeat(
+            rng.uniform(0.1, 5.0, 6), 4).astype(np.float32)
+        s0 = permutation_retained_magnitude(
+            w, exhaustive_search(w, window_cols=8, escape_attempts=0,
+                                 seed=0))
+        s10 = permutation_retained_magnitude(
+            w, exhaustive_search(w, window_cols=8, escape_attempts=10,
+                                 seed=0))
+        assert s10 >= s0 - 1e-4
+
+    def test_permuted_mask_preserves_toy_model_quality(self, rng):
+        """End-to-end accuracy retention: prune a linear regressor's
+        input channels 2:4 with and without the searched permutation;
+        the permuted pruning must lose less test error (the claim the
+        reference's whole permutation subsystem exists to make)."""
+        from apex_tpu.contrib.sparsity import (
+            exhaustive_search,
+            mn_1d_best,
+        )
+
+        # teacher weights with adversarially-striped importance
+        mags = np.repeat([4.0, 4.0, 0.05, 0.05], 4).astype(np.float32)
+        W = (rng.randn(16, 8).astype(np.float32)
+             * mags[:, None])                       # (in=16, out=8)
+        X = rng.randn(512, 16).astype(np.float32)
+        Y = X @ W
+
+        def pruned_err(perm):
+            Wp = W[perm]                            # permute input rows
+            mask = np.asarray(mn_1d_best(jnp.asarray(Wp.T), 4, 2)).T
+            Wmasked = Wp * mask
+            # un-permute back to original channel order
+            inv = np.argsort(perm)
+            pred = X @ Wmasked[inv]
+            return float(np.mean((pred - Y) ** 2))
+
+        err_id = pruned_err(np.arange(16))
+        perm = exhaustive_search(W.T, window_cols=8, seed=0)
+        err_perm = pruned_err(np.asarray(perm))
+        assert err_perm < err_id * 0.9, (err_id, err_perm)
+
 
 class TestASPWorkflow:
     def _params(self, rng):

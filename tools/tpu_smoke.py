@@ -244,10 +244,11 @@ def run(perf=False, kimpl="pallas", only=None):
               max_grad_norm=0.0, impl=impl),
           seg_p, seg_g, seg_m, seg_v, tol=1e-4)
 
-    # segmented + in-kernel SR: the combination has no interpret
-    # lowering, so (like the SGD SR check below) chip statistics are
-    # its only validation surface: a tiny constant update must round
-    # up/down ~50/50 and be unbiased in the mean
+    # segmented + in-kernel SR: the counter-hash bits make the stream
+    # impl-independent (tests/test_multi_tensor.py pins the interpret
+    # schedule); this chip check proves the SAME schedule lowers
+    # through Mosaic and stays unbiased: a tiny constant update must
+    # round up/down ~50/50 and be unbiased in the mean
     name = "fused_lamb_segmented SR bf16 (in-kernel prng)"
     if kimpl == "pallas" and not (only and only not in name):
         try:
