@@ -47,11 +47,6 @@ from apex_tpu.amp.lists import BANNED_MESSAGE
 # amp/functional.py and shared)
 # --------------------------------------------------------------------------
 
-def _is_float(x) -> bool:
-    return isinstance(x, (jax.Array, jnp.ndarray)) and jnp.issubdtype(
-        jnp.asarray(x).dtype, jnp.floating)
-
-
 def whitelisted(fn):
     """Run in the active compute dtype (MXU-bound op)."""
 
@@ -80,17 +75,18 @@ def blacklisted(fn):
 
 
 def promoted(fn):
-    """Cast mixed float args to the widest float dtype among them."""
+    """Cast mixed float args to the widest float dtype among them when a
+    patch-style policy is active (delegates to amp.functional's
+    promote_function so the promotion semantics live in one place)."""
+    from apex_tpu.amp.functional import promote_function
+
+    promoted_fn = promote_function(fn)
 
     @functools.wraps(fn)
     def wrapper(*args, **kwargs):
         if _amp_state.active_compute_dtype() is None:
             return fn(*args, **kwargs)
-        leaves = [x for x in jax.tree.leaves((args, kwargs)) if _is_float(x)]
-        if not leaves:
-            return fn(*args, **kwargs)
-        widest = jnp.result_type(*[jnp.asarray(x).dtype for x in leaves])
-        return fn(*_cast_floats(args, widest), **_cast_floats(kwargs, widest))
+        return promoted_fn(*args, **kwargs)
 
     return wrapper
 
@@ -190,6 +186,9 @@ def conv_transpose2d(x, weight, bias=None, stride=1, padding=0, groups=1):
         stride = (stride, stride)
     if isinstance(padding, int):
         padding = ((padding, padding), (padding, padding))
+    elif isinstance(padding, (tuple, list)) and padding and isinstance(
+            padding[0], int):
+        padding = tuple((p, p) for p in padding)
     # torch transposed-conv weight is (in, out/groups, H, W): the IOHW
     # spec swaps in/out channels; the gradient-of-conv kernel flip is
     # explicit

@@ -287,14 +287,14 @@ def exhaustive_search(
     # search each side at full window, then a global window-8 fixup
     if window_cols == 12 and C > 512:
         half = (C // 8) * 4
-        pl = exhaustive_search(w[:, :half], 12, escape_attempts,
-                               max_iters, seed)
-        pr = exhaustive_search(w[:, half:], 12, escape_attempts,
-                               max_iters, seed + 1)
+        sub = dict(escape_attempts=escape_attempts, max_iters=max_iters,
+                   max_stripe_groups=max_stripe_groups,
+                   hill_climb_rounds=hill_climb_rounds)
+        pl = exhaustive_search(w[:, :half], 12, seed=seed, **sub)
+        pr = exhaustive_search(w[:, half:], 12, seed=seed + 1, **sub)
         perm = np.concatenate([pl, pr + half])
-        final = exhaustive_search(w[:, perm], 8,
-                                  max(escape_attempts, 100), max_iters,
-                                  seed + 2)
+        sub["escape_attempts"] = max(escape_attempts, 100)
+        final = exhaustive_search(w[:, perm], 8, seed=seed + 2, **sub)
         return perm[final]
 
     n_stripes = C // 4
@@ -317,6 +317,9 @@ def exhaustive_search(
     # so the returned permutation is never degraded by a failed escape
     best_perm = perm.copy()
     best_score = permutation_retained_magnitude(w, perm)
+    # improvement cutoff relative to the matrix's own scale — an
+    # absolute epsilon would freeze small-magnitude layers entirely
+    tol = 1e-7 * max(best_score, np.abs(w).sum() * 1e-3) + 1e-30
 
     best_rows, improv = _score_stripe_groups(
         np.abs(cur), stripe_groups, window_cols)
@@ -325,7 +328,7 @@ def exhaustive_search(
         used_stripes: set = set()
         applied = 0
         for gi in order:
-            if improv[gi] <= 1e-4:
+            if improv[gi] <= tol:
                 break
             if any(int(s) in used_stripes for s in stripe_groups[gi]):
                 continue
@@ -376,21 +379,50 @@ def exhaustive_search(
 def _hill_climb_permutation(weight2d, num_rounds: int,
                             seed: int) -> np.ndarray:
     """Random-pair hill climb — the bounded-budget fallback for shapes
-    where the stripe-group table would not fit (and the original
-    round-2 search)."""
+    where the stripe-group table would not fit.
+
+    Incremental scoring: a swap of two columns only changes the two
+    4-column groups (or the dense trailing remainder) they live in, so
+    each candidate costs two small numpy rescores, not a full-matrix
+    mask pass on device.
+    """
+    w = np.abs(np.asarray(jax.device_get(weight2d), np.float32))
     rng = np.random.RandomState(seed)
-    C = weight2d.shape[1]
+    R, C = w.shape
+    n_stripes = C // 4
+
+    def group_score(cols_abs, is_remainder):
+        if is_remainder:
+            return float(cols_abs.sum())         # remainder stays dense
+        return float(np.sort(cols_abs, axis=1)[:, 2:].sum())
+
+    def group_of(col):
+        g = col // 4
+        return (n_stripes, True) if g >= n_stripes else (g, False)
+
+    def group_cols(g, perm):
+        if g == n_stripes:
+            return perm[n_stripes * 4:]
+        return perm[g * 4:g * 4 + 4]
+
     perm = np.arange(C)
-    best = permutation_retained_magnitude(weight2d, perm)
+    scores = {}
+    for g in range(n_stripes + (1 if C % 4 else 0)):
+        scores[g] = group_score(w[:, group_cols(g, perm)],
+                                g == n_stripes)
     for _ in range(num_rounds):
         i, j = rng.randint(0, C, 2)
-        if i == j:
+        gi, _ = group_of(i)
+        gj, _ = group_of(j)
+        if gi == gj:
             continue
         cand = perm.copy()
         cand[i], cand[j] = cand[j], cand[i]
-        score = permutation_retained_magnitude(weight2d, cand)
-        if score > best:
-            best, perm = score, cand
+        si = group_score(w[:, group_cols(gi, cand)], gi == n_stripes)
+        sj = group_score(w[:, group_cols(gj, cand)], gj == n_stripes)
+        if si + sj > scores[gi] + scores[gj]:
+            perm = cand
+            scores[gi], scores[gj] = si, sj
     return perm
 
 

@@ -75,6 +75,9 @@ class TestBasicCasts:
                 jnp.ones((2, 3, 16), dt), jnp.ones((4, 3, 3), dt))),
             ("conv_transpose2d", lambda dt: F.conv_transpose2d(
                 img.astype(dt), jnp.ones((3, 4, 3, 3), dt), stride=2)),
+            ("conv_transpose2d_tuplepad", lambda dt: F.conv_transpose2d(
+                img.astype(dt), jnp.ones((3, 4, 3, 3), dt), stride=2,
+                padding=(1, 1))),
         ]
 
     @pytest.mark.parametrize("props,expect", [("O1", jnp.float16),
