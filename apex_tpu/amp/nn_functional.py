@@ -36,7 +36,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from apex_tpu.amp import _amp_state
-from apex_tpu.amp.functional import _cast_floats
+from apex_tpu.amp.functional import _cast_floats, promote_function
 from apex_tpu.amp.lists import BANNED_MESSAGE
 
 
@@ -78,8 +78,6 @@ def promoted(fn):
     """Cast mixed float args to the widest float dtype among them when a
     patch-style policy is active (delegates to amp.functional's
     promote_function so the promotion semantics live in one place)."""
-    from apex_tpu.amp.functional import promote_function
-
     promoted_fn = promote_function(fn)
 
     @functools.wraps(fn)

@@ -223,13 +223,19 @@ def _subset_scores(stacked_abs, window_cols: int):
 
 
 def _score_stripe_groups(abs_np, stripe_groups, window_cols,
-                         chunk=64):
+                         chunk=None):
     """Best permutation + improvement for each stripe group.
 
     Returns (best_part_row, improvement) arrays over ``stripe_groups``
     (a (G, W/4) int array of stripe indices). Memory-bounded by
-    chunking groups; each chunk is one jit'd scoring call.
+    chunking groups; each chunk is one jit'd scoring call. The default
+    chunk targets ~256 MB for the (chunk, R, S, 4) gather — window 12
+    has 495 subsets vs window 8's 70, so it chunks ~7x smaller.
     """
+    if chunk is None:
+        n_subsets = len(_four_subsets_np(window_cols))
+        per_group = abs_np.shape[0] * n_subsets * 4 * 4     # bytes
+        chunk = max(1, min(64, (256 << 20) // max(per_group, 1)))
     parts = _unique_partitions_np(window_cols)                  # (P, W/4)
     n_groups = len(stripe_groups)
     best_rows = np.zeros((n_groups,), np.int64)
@@ -281,8 +287,7 @@ def exhaustive_search(
     w = np.asarray(jax.device_get(weight2d), np.float32)
     R, C = w.shape
     if C % 4 != 0 or C < window_cols:
-        return _hill_climb_permutation(
-            weight2d, hill_climb_rounds or 100, seed)
+        return _hill_climb_permutation(w, hill_climb_rounds or 100, seed)
     # large-matrix subdivision, ref exhaustive_search.py:330-338: halve,
     # search each side at full window, then a global window-8 fixup
     if window_cols == 12 and C > 512:
@@ -301,8 +306,8 @@ def exhaustive_search(
     window_stripes = window_cols // 4
     from math import comb
     if comb(n_stripes, window_stripes) > max_stripe_groups:
-        return _hill_climb_permutation(
-            weight2d, hill_climb_rounds or 4 * C, seed)
+        return _hill_climb_permutation(w, hill_climb_rounds or 4 * C,
+                                       seed)
 
     stripe_groups = np.asarray(
         list(itertools.combinations(range(n_stripes), window_stripes)),
@@ -416,13 +421,13 @@ def _hill_climb_permutation(weight2d, num_rounds: int,
         gj, _ = group_of(j)
         if gi == gj:
             continue
-        cand = perm.copy()
-        cand[i], cand[j] = cand[j], cand[i]
-        si = group_score(w[:, group_cols(gi, cand)], gi == n_stripes)
-        sj = group_score(w[:, group_cols(gj, cand)], gj == n_stripes)
+        perm[i], perm[j] = perm[j], perm[i]      # try in place
+        si = group_score(w[:, group_cols(gi, perm)], gi == n_stripes)
+        sj = group_score(w[:, group_cols(gj, perm)], gj == n_stripes)
         if si + sj > scores[gi] + scores[gj]:
-            perm = cand
             scores[gi], scores[gj] = si, sj
+        else:
+            perm[i], perm[j] = perm[j], perm[i]  # revert
     return perm
 
 
