@@ -210,6 +210,18 @@ class TestExamples:
         loss = ex.main(["--steps", "25", "--batch-size", "32"])
         assert np.isfinite(loss) and loss < 2.35
 
+    def test_amp_functional_o1(self):
+        """The zero-registration O1 port path (amp.F + shipped lists)."""
+        from apex_tpu.amp import _amp_state
+
+        ex = _load_example("examples/amp_functional/main.py",
+                           "ex_amp_functional")
+        prev = _amp_state.get_active()
+        try:
+            ex.main()          # asserts its own loss improvement
+        finally:
+            _amp_state.set_active(prev)
+
     @pytest.mark.parametrize("opt_level", ["O1", "O5"])
     def test_imagenet_tiny(self, opt_level, tmp_path):
         ex = _load_example("examples/imagenet/main_amp.py", "ex_imagenet")
