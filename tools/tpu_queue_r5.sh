@@ -52,9 +52,11 @@ run bench_moe      1800 python bench.py moe
 run bisect 1800 python tools/tpu_bisect.py
 run kprobe 1800 python tools/tpu_kprobe.py
 
-# 5. re-validate tile defaults with the fixed chained timer
-run tune_opt     1800 python tools/tpu_tune.py opt
-run tune_ln      1200 python tools/tpu_tune.py ln
-run tune_attnbwd 2400 python tools/tpu_tune.py attnbwd
+# 5. re-validate tile defaults with the fixed chained timer; the
+#    segmented sweep tunes the production headline impl's knobs
+run tune_opt       1800 python tools/tpu_tune.py opt
+run tune_segmented 1800 python tools/tpu_tune.py segmented
+run tune_ln        1200 python tools/tpu_tune.py ln
+run tune_attnbwd   2400 python tools/tpu_tune.py attnbwd
 
 echo "QUEUE DONE ($(date -u +%H:%M:%S)); logs in $LOGDIR"

@@ -278,8 +278,80 @@ def tune_opt():
                      lamb_step, (pL, mL, vL, gL), space.total, 10)
 
 
+def tune_segmented():
+    """Sweep the segmented one-pass LAMB's knobs: segment size
+    (VMEM-scratch bound) x scratch config (stash_p / p-stream /
+    bf16-u). This is the production headline impl — its winner feeds
+    flat_buffer.default_seg_elems / DEFAULT_SEG_VMEM_BUDGET."""
+    import jax
+    import jax.numpy as jnp
+
+    from apex_tpu.multi_tensor.flat_buffer import (
+        default_seg_elems,
+        segmented_space,
+    )
+    from apex_tpu.multi_tensor.segmented import (
+        CHUNK,
+        fused_lamb_segmented_update,
+    )
+
+    rng = np.random.RandomState(0)
+    # optdiag's 41.5M-param tensor mix: many smalls + a few large leaves
+    tree = {}
+    for i in range(48):
+        tree[f"w{i}"] = jax.ShapeDtypeStruct((512, 1024), jnp.float32)
+    for i in range(8):
+        tree[f"b{i}"] = jax.ShapeDtypeStruct((1024,), jnp.float32)
+    for i in range(4):
+        tree[f"W{i}"] = jax.ShapeDtypeStruct((4096, 1024), jnp.float32)
+
+    est = sum(int(np.prod(s.shape)) for s in jax.tree.leaves(tree))
+    base_seg = default_seg_elems(est)
+    configs = [("stash_p", dict(stash_p=True)),
+               ("p-stream", dict(stash_p=False)),
+               ("bf16-u", dict(stash_p=False, u_dtype=jnp.bfloat16))]
+    for seg_mult in (0.5, 1.0, 2.0):
+        seg = max(CHUNK, int(base_seg * seg_mult) // CHUNK * CHUNK)
+        space, meta = segmented_space(tree, seg_elems=seg)
+        p = jnp.asarray(rng.randn(space.total).astype(np.float32))
+        g = jnp.asarray(
+            rng.randn(space.total).astype(np.float32) * 1e-3)
+        m = jnp.zeros_like(p)
+        v = jnp.zeros_like(p)
+
+        for label, kw in configs:
+            def step(p_, m_, v_, g_, kw=kw):
+                p2, m2, v2, f = fused_lamb_segmented_update(
+                    p_, m_, v_, g_, space, meta, lr=1e-3, step=2,
+                    weight_decay=0.01, use_nvlamb=True,
+                    max_grad_norm=0.0, impl="pallas", **kw)
+                return (p2, m2, v2)
+
+            # traffic model: small segments ride the one-pass kernel
+            # (7 accesses/elem, 8 with p-stream); leaves larger than a
+            # segment take the two-stage path (~10). Weight by the
+            # actual split so the GB/s is comparable with tune_opt's.
+            acc_small = 8 if not kw.get("stash_p", True) else 7
+            large_elems = sum(plen for _, _, plen in meta.large)
+            small_elems = space.total - large_elems
+            traffic = (acc_small * small_elems + 10 * large_elems) * 4
+            try:
+                t = _time(step, p, m, v, g, iters=3, chain=5,
+                          feed=_opt_feed)
+                gbps = traffic / t / 1e9
+                print(f"  seg={seg:>9} ({seg_mult:3.1f}x) {label:9s} "
+                      f"{t*1e3:8.3f} ms ({gbps:6.1f} GB/s, "
+                      f"{small_elems/space.total:4.0%} one-pass)")
+            except Exception as e:  # noqa: BLE001 — sweep must finish
+                msg = str(e).split("\n")[0][:100]
+                print(f"  seg={seg:>9} ({seg_mult:3.1f}x) {label:9s} "
+                      f"FAILED {type(e).__name__}: {msg}")
+        del p, g, m, v
+
+
 ALL = {"attn": tune_attn, "attnbwd": tune_attn_bwd, "ln": tune_ln,
-       "softmax": tune_softmax, "opt": tune_opt}
+       "softmax": tune_softmax, "opt": tune_opt,
+       "segmented": tune_segmented}
 
 if __name__ == "__main__":
     import jax
