@@ -1,0 +1,74 @@
+"""jax version-compat shims.
+
+The package targets the current jax surface (``jax.shard_map`` with
+``check_vma``); older releases ship ``shard_map`` under
+``jax.experimental`` with the ``check_rep`` spelling of the same knob.
+Importing through this module keeps every call site written against the
+modern API while degrading cleanly on the older runtime — the analog of
+the reference's version-gated ``torch`` imports (ref:
+apex/transformer/utils.py torch_version gates).
+"""
+
+from __future__ import annotations
+
+try:  # modern surface (jax >= 0.6): top-level, check_vma spelling
+    from jax import shard_map as _shard_map
+
+    _VMA_KW = "check_vma"
+except ImportError:  # older runtime: experimental, check_rep spelling
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _VMA_KW = "check_rep"
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma=None, **kwargs):
+    """``jax.shard_map`` with the ``check_vma`` knob on every version.
+
+    ``check_vma`` (None = the runtime's default) maps to ``check_rep``
+    on runtimes that predate the rename; all other kwargs pass through.
+    """
+    if check_vma is not None:
+        kwargs[_VMA_KW] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs,
+                      out_specs=out_specs, **kwargs)
+
+
+try:  # modern surface: static mapped-axis size lookup
+    from jax.lax import axis_size
+except ImportError:
+    def axis_size(axis_name):
+        """``lax.axis_size`` for runtimes that predate it: psum of the
+        constant 1 over the axis constant-folds to the static size
+        (a plain int under shard_map tracing) and raises the same
+        NameError for unbound axis names."""
+        from jax import lax
+
+        return lax.psum(1, axis_name)
+
+
+def _install_polyfills() -> None:
+    """Backfill the missing names onto jax itself so the package's
+    (and its tests'/examples') call sites — written against the modern
+    surface — run unmodified on the older runtime. Pure additions:
+    nothing existing is overridden."""
+    import jax
+    from jax import lax
+
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = axis_size
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = shard_map
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+
+        if not hasattr(pltpu, "CompilerParams"):
+            # pre-rename spelling of the same params dataclass
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except Exception:  # noqa: BLE001 — pallas backend absent is fine
+        pass
+
+
+_install_polyfills()
+
+
+__all__ = ["shard_map", "axis_size"]

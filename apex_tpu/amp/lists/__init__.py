@@ -115,13 +115,23 @@ BANNED_MESSAGE = (
 BANNED_FUNCS = [("binary_cross_entropy", BANNED_MESSAGE)]
 
 
+# attribute stamped on every wrapper register_defaults installs, so a
+# repeated call (or an alias pair like linear/dense resolving to an
+# already-wrapped function) can never stack a second cast wrapper —
+# double-wrapping double-casts every call and breaks disable_casts
+_WRAPPED_FLAG = "_apex_tpu_amp_wrapped"
+
+
 def register_defaults(module, compute_dtype="float16") -> int:
     """Apply the default classification to ``module`` in place.
 
     For each table name present on ``module``, rebinds it through the
     matching ``amp.functional`` decorator (the reference's amp.init
     patching pass, ref: apex/amp/amp.py:75-198, applied eagerly to one
-    namespace). Returns the number of functions rebound.
+    namespace). Idempotent: functions already wrapped by a previous
+    call (marked with a wrapper attribute) are skipped, so re-running
+    amp.initialize never stacks casts. Returns the number of functions
+    NEWLY rebound.
     """
     import jax.numpy as jnp
 
@@ -137,9 +147,16 @@ def register_defaults(module, compute_dtype="float16") -> int:
         (PROMOTE_FUNCS + SEQUENCE_CASTS, afn.promote_function),
     ):
         for name in names:
-            if callable(getattr(module, name, None)):
-                setattr(module, name, deco(getattr(module, name)))
-                n += 1
+            fn = getattr(module, name, None)
+            if not callable(fn) or getattr(fn, _WRAPPED_FLAG, False):
+                continue
+            wrapped = deco(fn)
+            try:
+                setattr(wrapped, _WRAPPED_FLAG, True)
+            except (AttributeError, TypeError):
+                pass      # non-function callable; wrap but can't mark
+            setattr(module, name, wrapped)
+            n += 1
     return n
 
 

@@ -263,6 +263,17 @@ def _score_stripe_groups(abs_np, stripe_groups, window_cols,
     return best_rows, improvements
 
 
+def _warn_hill_climb_fallback(reason: str) -> None:
+    """Exhaustive search degrading to the hill-climb is a quality
+    cliff; warn with the trigger so method='exhaustive'/'auto' callers
+    see which layers were NOT searched exhaustively."""
+    import warnings
+
+    warnings.warn(
+        "exhaustive_search fell back to the random-swap hill-climb: "
+        + reason, RuntimeWarning, stacklevel=3)
+
+
 def exhaustive_search(
     weight2d,
     window_cols: int = 8,
@@ -287,6 +298,9 @@ def exhaustive_search(
     w = np.asarray(jax.device_get(weight2d), np.float32)
     R, C = w.shape
     if C % 4 != 0 or C < window_cols:
+        _warn_hill_climb_fallback(
+            f"shape {w.shape} is not stripe-alignable "
+            f"(C % 4 != 0 or C < window_cols={window_cols})")
         return _hill_climb_permutation(w, hill_climb_rounds or 100, seed)
     # large-matrix subdivision, ref exhaustive_search.py:330-338: halve,
     # search each side at full window, then a global window-8 fixup
@@ -306,6 +320,13 @@ def exhaustive_search(
     window_stripes = window_cols // 4
     from math import comb
     if comb(n_stripes, window_stripes) > max_stripe_groups:
+        # production-sized layers (C >= ~1024 at window 8) land here:
+        # a silent degrade reads as "exhaustive ran" while the weaker
+        # climb decided the mask — name it so callers can raise the cap
+        _warn_hill_climb_fallback(
+            f"stripe-group table {comb(n_stripes, window_stripes)} > "
+            f"max_stripe_groups={max_stripe_groups} at C={C} "
+            f"(raise max_stripe_groups to search exhaustively)")
         return _hill_climb_permutation(w, hill_climb_rounds or 4 * C,
                                        seed)
 

@@ -28,9 +28,11 @@ from typing import Any, Callable, Optional, Tuple
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import lax, shard_map
+from jax import lax
 from jax.sharding import Mesh
 from jax.sharding import PartitionSpec as P
+
+from apex_tpu._compat import shard_map
 
 from apex_tpu.models.gpt import GPTConfig, GPTLayer, gpt_param_specs
 from apex_tpu.normalization import FusedLayerNorm

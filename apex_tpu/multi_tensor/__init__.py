@@ -22,6 +22,7 @@ from apex_tpu.multi_tensor.ops import (
     fused_adam_update,
     fused_lamb_compute_update_term,
     fused_lamb_update,
+    fused_unscale_l2norm,
     lamb_trust_ratio,
     fused_lars_update,
     fused_novograd_update,
@@ -42,6 +43,7 @@ __all__ = [
     "multi_tensor_scale",
     "multi_tensor_axpby",
     "multi_tensor_l2norm",
+    "fused_unscale_l2norm",
     "per_tensor_l2norm",
     "fused_adam_update",
     "fused_adagrad_update",

@@ -460,6 +460,7 @@ def fused_sumsq_partials(
     *,
     impl: Optional[str] = None,
     tile_rows: Optional[int] = None,
+    scale=None,
 ) -> jax.Array:
     """Per-tile partial sums of squares over a flat buffer.
 
@@ -472,6 +473,13 @@ def fused_sumsq_partials(
     (no alignment constraint; a 2048-element tile would cost a 32x
     larger grid). Per-tensor callers pass PER_TENSOR_TILE_ROWS so tiles
     never straddle a leaf.
+
+    ``scale`` (a traced f32 scalar is fine) multiplies every element
+    BEFORE squaring, in the same read — the fused train-step's
+    unscale+norm reduction: ``sumsq((1/loss_scale) * g)`` in one pass
+    over ``g`` with no unscaled buffer ever materialized. The multiply
+    happens first (then the square), so the partials bit-match
+    squaring an explicitly unscaled copy of the buffer.
     """
     impl = resolve_impl(impl)
     if tile_rows is None:
@@ -483,26 +491,56 @@ def fused_sumsq_partials(
     padded_n = ((n + tile - 1) // tile) * tile
     num_tiles = padded_n // tile
     if impl == "xla":
-        x = _pad_to(buf, padded_n).astype(jnp.float32).reshape(num_tiles, tile)
+        x = _pad_to(buf, padded_n).astype(jnp.float32)
+        if scale is not None:
+            x = x * jnp.asarray(scale, jnp.float32)
+        x = x.reshape(num_tiles, tile)
         return jnp.sum(x * x, axis=1)
 
-    def kernel(in_ref, out_ref):
-        x = in_ref[...].astype(jnp.float32)
-        # reduce the sublane (row) dim in-kernel; the cross-lane sum is a
-        # tiny XLA reduction. The (num_tiles, 1, LANES) output layout
-        # keeps the last-two block dims (1, LANES) legal under Mosaic's
-        # tiling rule (a (1, 1) SMEM block per grid step is not).
+    if scale is None:
+        def kernel(in_ref, out_ref):
+            x = in_ref[...].astype(jnp.float32)
+            # reduce the sublane (row) dim in-kernel; the cross-lane sum
+            # is a tiny XLA reduction. The (num_tiles, 1, LANES) output
+            # layout keeps the last-two block dims (1, LANES) legal under
+            # Mosaic's tiling rule (a (1, 1) SMEM block per grid step is
+            # not).
+            out_ref[0] = jnp.sum(x * x, axis=0, keepdims=True)
+
+        out = pl.pallas_call(
+            kernel,
+            grid=(num_tiles,),
+            in_specs=[
+                pl.BlockSpec((tile_rows, LANES), lambda i: (i, 0),
+                             memory_space=pltpu.VMEM)
+            ],
+            out_specs=pl.BlockSpec((1, 1, LANES), lambda i: (i, 0, 0),
+                                   memory_space=pltpu.VMEM),
+            out_shape=jax.ShapeDtypeStruct((num_tiles, 1, LANES),
+                                           jnp.float32),
+            interpret=interpret_flag(impl),
+        )(_pad_to(buf, padded_n).reshape(padded_n // LANES, LANES))
+        return jnp.sum(out, axis=(1, 2))
+
+    def scaled_kernel(scal_ref, in_ref, out_ref):
+        x = in_ref[...].astype(jnp.float32) * scal_ref[0]
         out_ref[0] = jnp.sum(x * x, axis=0, keepdims=True)
 
-    out = pl.pallas_call(
-        kernel,
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
         grid=(num_tiles,),
         in_specs=[
-            pl.BlockSpec((tile_rows, LANES), lambda i: (i, 0), memory_space=pltpu.VMEM)
+            pl.BlockSpec((tile_rows, LANES), lambda i, *_: (i, 0),
+                         memory_space=pltpu.VMEM)
         ],
-        out_specs=pl.BlockSpec((1, 1, LANES), lambda i: (i, 0, 0),
+        out_specs=pl.BlockSpec((1, 1, LANES), lambda i, *_: (i, 0, 0),
                                memory_space=pltpu.VMEM),
+    )
+    out = pl.pallas_call(
+        scaled_kernel,
+        grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((num_tiles, 1, LANES), jnp.float32),
         interpret=interpret_flag(impl),
-    )(_pad_to(buf, padded_n).reshape(padded_n // LANES, LANES))
+    )(jnp.asarray(scale, jnp.float32).reshape(1),
+      _pad_to(buf, padded_n).reshape(padded_n // LANES, LANES))
     return jnp.sum(out, axis=(1, 2))

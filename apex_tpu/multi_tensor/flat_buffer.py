@@ -111,6 +111,32 @@ class FlatSpace:
     def zeros(self, dtype=jnp.float32) -> jax.Array:
         return jnp.zeros((self.total,), dtype=dtype)
 
+    def grad_fn(self, loss_fn, *, has_aux: bool = False,
+                with_value: bool = False):
+        """Differentiate a pytree-taking loss straight into this space.
+
+        ``loss_fn(params, *args, **kwargs)`` sees the unpacked tree;
+        the returned function takes the FLAT master buffer and yields
+        gradients already in the flat layout (unpack's transpose
+        scatters every leaf cotangent back into one buffer), so a
+        training loop never pays the per-leaf pack that
+        ``FlatFusedOptimizer.step`` performs on tree gradients —
+        feed the result to ``step_flat`` / ``make_train_step``::
+
+            flat_grad = state.space.grad_fn(loss_fn)
+            g = flat_grad(state.master, batch)
+            new_params, state = opt.step_flat(state, g)
+
+        ``with_value=True`` returns ``jax.value_and_grad`` of the same
+        flat function; ``has_aux`` passes through to the transform.
+        """
+        def flat_loss(master, *args, **kwargs):
+            return loss_fn(self.unpack(master), *args, **kwargs)
+
+        if with_value:
+            return jax.value_and_grad(flat_loss, has_aux=has_aux)
+        return jax.grad(flat_loss, has_aux=has_aux)
+
     # -- per-tensor maps ---------------------------------------------------
 
     def tile_leaf_ids(self, tile_elems: int) -> np.ndarray:

@@ -107,6 +107,31 @@ def multi_tensor_l2norm(buf, space: Optional[FlatSpace] = None, *,
     return jnp.sqrt(jnp.sum(partials)), None
 
 
+def fused_unscale_l2norm(g, *, inv_scale=1.0, impl=None):
+    """Global L2 norm of ``inv_scale * g`` plus found_inf in ONE read.
+
+    The fused train-step's clip pre-reduction (optimizers/train_step.py):
+    replaces the composed three-sweep sequence — ``multi_tensor_scale``
+    unscale (read+write of g), ``multi_tensor_l2norm`` (read), and the
+    nonfinite check that rode the unscale — with one read of ``g`` that
+    never materializes the unscaled buffer. The multiply happens before
+    the square in-register, so the norm bit-matches
+    ``multi_tensor_l2norm(multi_tensor_scale(g, inv_scale))`` on the
+    same impl.
+
+    found_inf is derived from the partials: any non-finite grad makes
+    its partial non-finite (as does a finite grad whose unscaled square
+    overflows — the same saturating convention the reference's
+    l2norm-based overflow check has, csrc/multi_tensor_l2norm_kernel.cu).
+
+    Returns ``(norm, found_inf)``.
+    """
+    partials = fused_sumsq_partials(g, impl=impl, scale=inv_scale)
+    total = jnp.sum(partials)
+    found = jnp.where(jnp.isfinite(total), 0.0, 1.0).astype(jnp.float32)
+    return jnp.sqrt(total), found
+
+
 # ---------------------------------------------------------------------------
 # Adam / AdamW  (ref: csrc/multi_tensor_adam.cu:24-129 AdamFunctor)
 # ---------------------------------------------------------------------------
@@ -241,7 +266,7 @@ def fused_lamb_compute_update_term(
     p, m, v, g, *,
     beta1, beta2, beta3, eps, weight_decay, bias_correction1,
     bias_correction2, adam_w_mode, inv_scale, impl=None,
-    with_norm_partials=False,
+    with_norm_partials=False, with_grad_partials=False,
 ):
     """LAMB stage 1: Adam-style update term + moment updates on any flat
     fp32 buffer (full or ZeRO shard).
@@ -257,11 +282,20 @@ def fused_lamb_compute_update_term(
     pass — the ||p|| / ||update|| the trust ratio needs, without the two
     full re-read passes separate per_tensor_l2norm calls would cost
     (~15% of the step's HBM traffic at BERT-large scale).
+    ``with_grad_partials=True`` appends partials of the RAW streamed
+    gradient too (pre ``inv_scale``) — the zero-extra-pass grad-norm
+    monitoring the fused train step exposes.
 
     Returns ((update, m', v'), found_inf), with
-    (..., p_sumsq_partials, u_sumsq_partials) appended when requested.
+    (..., p_sumsq_partials, u_sumsq_partials[, g_sumsq_partials])
+    appended when requested.
     """
     mode = 1.0 if adam_w_mode else 0.0
+    sumsq = ()
+    if with_norm_partials:
+        sumsq = (("in", 0), ("out", 0))
+    if with_grad_partials:
+        sumsq = sumsq + (("in", 3),)
 
     def stage1(ins, s, _):
         p_, m_, v_, g_ = [x.astype(jnp.float32) for x in ins]
@@ -281,8 +315,7 @@ def fused_lamb_compute_update_term(
         num_outputs=3, out_dtypes=[jnp.float32, m.dtype, v.dtype],
         check_finite=(3,), impl=impl,
         aliases={3: 0, 1: 1, 2: 2},   # g's buffer becomes the update term
-        sumsq_subtiles=((("in", 0), ("out", 0))
-                        if with_norm_partials else ()),
+        sumsq_subtiles=sumsq,
     )
 
 
@@ -301,6 +334,7 @@ def fused_lamb_update(
     weight_decay=0.0, bias_correction=True, grad_averaging=True,
     max_grad_norm=0.0, adam_w_mode=True, use_nvlamb=False,
     global_grad_norm=None, grad_scale=1.0, impl=None, sr_seed=None,
+    with_grad_norm=False,
 ):
     """One fused LAMB step over flat fp32 buffers.
 
@@ -311,7 +345,12 @@ def fused_lamb_update(
     per-tensor norms use the tile->leaf map instead of the reference's
     per-tensor kernel outputs.
 
-    Returns (p', m', v', found_inf).
+    ``with_grad_norm=True`` appends per-tensor L2 norms of the RAW
+    gradient (pre unscale/clip), reduced in the same stage-1 sweep that
+    already emits the ||p||/||update|| partials — grad-norm monitoring
+    at zero extra HBM passes.
+
+    Returns (p', m', v', found_inf[, grad_norm_per_tensor]).
     """
     step = jnp.asarray(step, jnp.float32)
     b1 = jnp.asarray(beta1, jnp.float32)
@@ -334,13 +373,19 @@ def fused_lamb_update(
         clip = jnp.float32(1.0)
     inv_scale = clip * jnp.asarray(grad_scale, jnp.float32)
 
-    (u, m2, v2, p_part, u_part), found = fused_lamb_compute_update_term(
+    outs, found = fused_lamb_compute_update_term(
         p, m, v, g,
         beta1=b1, beta2=b2, beta3=beta3, eps=eps,
         weight_decay=weight_decay, bias_correction1=bc1,
         bias_correction2=bc2, adam_w_mode=adam_w_mode,
         inv_scale=inv_scale, impl=impl, with_norm_partials=True,
+        with_grad_partials=with_grad_norm,
     )
+    if with_grad_norm:
+        u, m2, v2, p_part, u_part, g_part = outs
+        g_norm_pt = _norms_from_subtile_partials(g_part, space)
+    else:
+        u, m2, v2, p_part, u_part = outs
 
     w_norm = _norms_from_subtile_partials(p_part, space)
     u_norm = _norms_from_subtile_partials(u_part, space)
@@ -361,6 +406,8 @@ def fused_lamb_update(
         aliases={0: 0},
         sr_outputs=(0,) if sr_seed is not None else (), sr_seed=sr_seed,
     )
+    if with_grad_norm:
+        return p2, m2, v2, found, g_norm_pt
     return p2, m2, v2, found
 
 

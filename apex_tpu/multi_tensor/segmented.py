@@ -79,10 +79,18 @@ def _small_segment_pass(
     interpret: bool = False,
     stash_p: bool = True,
     u_dtype=jnp.float32,
+    with_grad_norm: bool = False,
 ):
     """The one-pass pallas kernel over the small segments. Regions not
     in meta.small_segments flow through untouched via input/output
-    aliasing. Returns (p2, m2, v2, found).
+    aliasing. Returns (p2, m2, v2, found[, gg_per_slot]).
+
+    ``with_grad_norm=True`` additionally accumulates per-slot sums of
+    squares of the RAW streamed gradient through the same phase-0
+    one-hot matmuls that build the ‖p‖²/‖u‖² accumulators (acc row 3),
+    and dumps them per segment — per-tensor grad norms at zero extra
+    HBM passes. Off by default so the flag cannot perturb the
+    chip-validated default schedule.
 
     VMEM scratch knobs (the per-core budget is ~16 MB, flat_buffer.
     DEFAULT_SEG_VMEM_BUDGET):
@@ -119,12 +127,16 @@ def _small_segment_pass(
         if sr:
             (scal_ref, segid_ref, sr_ref, p_ref, m_ref, v_ref, g_ref,
              ids_ref, p2_ref, m2_ref, v2_ref, found_ref,
-             *scratch) = args
+             *rest) = args
         else:
             (scal_ref, segid_ref, p_ref, m_ref, v_ref, g_ref,
              ids_ref, p2_ref, m2_ref, v2_ref, found_ref,
-             *scratch) = args
+             *rest) = args
             sr_ref = None
+        if with_grad_norm:
+            gg_ref, *scratch = rest
+        else:
+            gg_ref, scratch = None, rest
         if stash_p:
             u_buf, p_buf, acc_ref = scratch
         else:
@@ -180,6 +192,15 @@ def _small_segment_pass(
             acc_ref[0:2, :] = acc_ref[0:2, :] + jax.lax.dot_general(
                 both, oh, (((1,), (0,)), ((), ())),
                 preferred_element_type=jnp.float32)
+            if with_grad_norm:
+                # raw-grad sumsq rides the same one-hot matmul; row 3
+                # keeps clear of the ratio slot (row 2, phase 1)
+                gg = jnp.sum(
+                    (g_ * g_).reshape(sub_chunk, PER_TENSOR_TILE_ROWS,
+                                      LANES), axis=(1, 2))
+                acc_ref[3:4, :] = acc_ref[3:4, :] + jax.lax.dot_general(
+                    gg[None, :], oh, (((1,), (0,)), ((), ())),
+                    preferred_element_type=jnp.float32)
 
         @pl.when((ph == 1) & (c == 0))
         def _():
@@ -191,6 +212,8 @@ def _small_segment_pass(
                 # unless NVLAMB (csrc/multi_tensor_lamb.cu:270-283)
                 ratio = jnp.ones_like(ratio)
             acc_ref[2:3, :] = ratio
+            if with_grad_norm:
+                gg_ref[0] = acc_ref[3:4, :]
 
         @pl.when(ph == 1)
         def _():
@@ -282,7 +305,10 @@ def _small_segment_pass(
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((1, 1), lambda *_: (0, 0),
                          memory_space=pltpu.SMEM),
-        ],
+        ] + ([
+            pl.BlockSpec((1, 1, ms), lambda s, ph, c, *_: (s, 0, 0),
+                         memory_space=pltpu.VMEM)
+        ] if with_grad_norm else []),
         scratch_shapes=(
             [pltpu.VMEM((C * CHUNK_ROWS, LANES), jnp.dtype(u_dtype))]
             + ([pltpu.VMEM((C * CHUNK_ROWS, LANES), jnp.float32)]
@@ -296,7 +322,7 @@ def _small_segment_pass(
         prefetch.append(jnp.asarray(sr_seed, jnp.int32).reshape(1))
     n_prefetch = len(prefetch)
 
-    p2, m2, v2, found = pl.pallas_call(
+    outs = pl.pallas_call(
         kernel,
         grid_spec=grid_spec,
         out_shape=[
@@ -304,7 +330,8 @@ def _small_segment_pass(
             jax.ShapeDtypeStruct(rows2, jnp.float32),
             jax.ShapeDtypeStruct(rows2, jnp.float32),
             jax.ShapeDtypeStruct((1, 1), jnp.float32),
-        ],
+        ] + ([jax.ShapeDtypeStruct((n_small, 1, ms), jnp.float32)]
+             if with_grad_norm else []),
         input_output_aliases=(
             {n_prefetch + 0: 0, n_prefetch + 1: 1, n_prefetch + 2: 2}
             if jnp.dtype(p.dtype) == jnp.dtype(out_dtype) else
@@ -313,7 +340,11 @@ def _small_segment_pass(
         interpret=interpret,
     )(*prefetch, p.reshape(rows2), m.reshape(rows2), v.reshape(rows2),
       g.reshape(rows2), ids_col)
-    return (p2.reshape(n), m2.reshape(n), v2.reshape(n), found[0, 0])
+    p2, m2, v2, found = outs[:4]
+    ret = (p2.reshape(n), m2.reshape(n), v2.reshape(n), found[0, 0])
+    if with_grad_norm:
+        ret = ret + (outs[4][:, 0, :],)        # (n_small, ms) gg sums
+    return ret
 
 
 def fused_lamb_segmented_update(
@@ -322,7 +353,7 @@ def fused_lamb_segmented_update(
     weight_decay=0.0, bias_correction=True, grad_averaging=True,
     max_grad_norm=0.0, adam_w_mode=True, use_nvlamb=False,
     global_grad_norm=None, grad_scale=1.0, impl=None, sr_seed=None,
-    stash_p=None, u_dtype=None,
+    stash_p=None, u_dtype=None, with_grad_norm=False,
 ):
     """LAMB step over a segment-aligned flat space: one-pass kernel for
     the small segments + the two-stage path for each large leaf.
@@ -332,7 +363,12 @@ def fused_lamb_segmented_update(
     ops.fused_lamb_update (identical math, two-stage schedule), which
     is what CPU tests compare the kernel against.
 
-    Returns (p', m', v', found_inf).
+    ``with_grad_norm=True`` appends per-tensor L2 norms of the RAW
+    gradient, accumulated through the phase-0 one-hot matmuls (small
+    segments) and the stage-1 sumsq ride-along (large leaves) — no
+    standalone norm pass over the buffer.
+
+    Returns (p', m', v', found_inf[, grad_norm_per_tensor]).
     """
     from apex_tpu.multi_tensor.ops import (
         fused_lamb_compute_update_term,
@@ -371,7 +407,8 @@ def fused_lamb_segmented_update(
             bias_correction=bias_correction, grad_averaging=grad_averaging,
             max_grad_norm=max_grad_norm, adam_w_mode=adam_w_mode,
             use_nvlamb=use_nvlamb, global_grad_norm=global_grad_norm,
-            grad_scale=grad_scale, impl=impl, sr_seed=sr_seed)
+            grad_scale=grad_scale, impl=impl, sr_seed=sr_seed,
+            with_grad_norm=with_grad_norm)
 
     step = jnp.asarray(step, jnp.float32)
     b1 = jnp.asarray(beta1, jnp.float32)
@@ -397,13 +434,26 @@ def fused_lamb_segmented_update(
         inv_scale, lr_f,
     ])
 
+    leaf_gg = (jnp.zeros((space.num_leaves,), jnp.float32)
+               if with_grad_norm else None)
     if len(meta.small_segments):
-        p2, m2, v2, found = _small_segment_pass(
+        outs = _small_segment_pass(
             p, m, v, g, meta=meta, scalars=scalars,
             use_nvlamb=use_nvlamb,
             wd_is_zero=not (weight_decay > 0.0), out_dtype=p.dtype,
             sr_seed=sr_seed, interpret=impl == "interpret",
-            stash_p=stash_p, u_dtype=u_dtype)
+            stash_p=stash_p, u_dtype=u_dtype,
+            with_grad_norm=with_grad_norm)
+        p2, m2, v2, found = outs[:4]
+        if with_grad_norm:
+            # (n_small, ms) per-slot gg -> per-leaf via the static
+            # slot->leaf map (padding slots carry -1 and zero value)
+            sl = jnp.asarray(np.asarray(meta.slot_leaf, np.int32))
+            gg = outs[4]
+            leaf_gg = jax.ops.segment_sum(
+                jnp.where(sl >= 0, gg, 0.0).reshape(-1),
+                jnp.maximum(sl, 0).reshape(-1),
+                num_segments=space.num_leaves)
     else:
         p2, m2, v2 = p, m, v
         found = jnp.float32(0.0)
@@ -413,13 +463,19 @@ def fused_lamb_segmented_update(
     for leaf_idx, start, plen in meta.large:
         size = space.sizes[leaf_idx]
         sl = lambda b: jax.lax.slice(b, (start,), (start + plen,))
-        (u_l, m2_l, v2_l, pp_l, uu_l), found_l = \
+        stage1_outs, found_l = \
             fused_lamb_compute_update_term(
                 sl(p2).astype(jnp.float32), sl(m2), sl(v2), sl(g),
                 beta1=b1, beta2=b2, beta3=beta3, eps=eps,
                 weight_decay=weight_decay, bias_correction1=bc1,
                 bias_correction2=bc2, adam_w_mode=adam_w_mode,
-                inv_scale=inv_scale, impl=impl, with_norm_partials=True)
+                inv_scale=inv_scale, impl=impl, with_norm_partials=True,
+                with_grad_partials=with_grad_norm)
+        if with_grad_norm:
+            u_l, m2_l, v2_l, pp_l, uu_l, gg_l = stage1_outs
+            leaf_gg = leaf_gg.at[leaf_idx].add(jnp.sum(gg_l))
+        else:
+            u_l, m2_l, v2_l, pp_l, uu_l = stage1_outs
         w_norm = jnp.sqrt(jnp.sum(pp_l))
         u_norm = jnp.sqrt(jnp.sum(uu_l))
         ratio = lamb_trust_ratio(w_norm, u_norm,
@@ -447,6 +503,8 @@ def fused_lamb_segmented_update(
         v2 = jax.lax.dynamic_update_slice(v2, v2_l, (start,))
         found = jnp.maximum(found, found_l)
 
+    if with_grad_norm:
+        return p2, m2, v2, found, jnp.sqrt(leaf_gg)
     return p2, m2, v2, found
 
 

@@ -4,6 +4,9 @@
 `FusedNovoGrad`, `FusedAdagrad`, `FusedLARS` — functional flat-space optimizers with fp32 master weights
 and in-kernel found_inf. `as_optax` adapts any of them to an
 `optax.GradientTransformation` for drop-in use in optax training loops.
+`make_train_step` compiles the whole hot path (unscale + clip +
+nonfinite check + update + scaler schedule) into one jitted,
+donation-aware program (see `train_step.py`).
 """
 
 from apex_tpu.optimizers.fused import (
@@ -18,6 +21,13 @@ from apex_tpu.optimizers.fused import (
     FusedSGD,
 )
 from apex_tpu.optimizers.optax_adapter import as_optax
+from apex_tpu.optimizers.train_step import (
+    StepAux,
+    TrainStep,
+    clear_step_cache,
+    make_train_step,
+    step_cache_stats,
+)
 
 __all__ = [
     "FlatFusedOptimizer",
@@ -30,4 +40,9 @@ __all__ = [
     "FusedAdagrad",
     "FusedLARS",
     "as_optax",
+    "make_train_step",
+    "TrainStep",
+    "StepAux",
+    "step_cache_stats",
+    "clear_step_cache",
 ]

@@ -161,6 +161,9 @@ class FlatFusedOptimizer:
         lr: Optional[Schedule] = None,
         grad_scale=1.0,
         skip_if_nonfinite: bool = False,
+        global_grad_norm=None,
+        extra_found_inf=None,
+        with_grad_norm: bool = False,
     ) -> Tuple[Any, FlatOptState]:
         """One optimizer step. ``grads`` is a pytree congruent with params.
 
@@ -171,19 +174,23 @@ class FlatFusedOptimizer:
 
         Packing the grad tree costs a full extra read+write of the
         gradients every step; a flat-native training loop avoids it by
-        differentiating straight into the flat space and calling
-        :meth:`step_flat`::
+        differentiating straight into the flat space
+        (``state.space.grad_fn``) and calling :meth:`step_flat` — or
+        the fully fused, donation-aware program
+        ``optimizers.make_train_step`` builds around it::
 
-            grads_flat = jax.grad(
-                lambda master: loss_fn(state.space.unpack(master))
-            )(state.master)
+            flat_grad = state.space.grad_fn(loss_fn)
+            grads_flat = flat_grad(state.master)
             new_params, state = opt.step_flat(state, grads_flat)
             # the updated FLAT buffer for the next iteration is
             # state.master; new_params is the unpacked tree
         """
         g = state.space.pack(grads, dtype=jnp.float32)
         return self.step_flat(state, g, lr=lr, grad_scale=grad_scale,
-                              skip_if_nonfinite=skip_if_nonfinite)
+                              skip_if_nonfinite=skip_if_nonfinite,
+                              global_grad_norm=global_grad_norm,
+                              extra_found_inf=extra_found_inf,
+                              with_grad_norm=with_grad_norm)
 
     def step_flat(
         self,
@@ -193,6 +200,9 @@ class FlatFusedOptimizer:
         lr: Optional[Schedule] = None,
         grad_scale=1.0,
         skip_if_nonfinite: bool = False,
+        global_grad_norm=None,
+        extra_found_inf=None,
+        with_grad_norm: bool = False,
     ) -> Tuple[Any, FlatOptState]:
         """:meth:`step` for gradients already in the flat space — the
         layout ``jax.grad`` produces when the loss closes over
@@ -201,14 +211,37 @@ class FlatFusedOptimizer:
         the packed-layout analog of the reference feeding its flat DDP
         bucket straight into ``multi_tensor_*``
         (ref: apex/contrib/optimizers/distributed_fused_lamb.py flat
-        grad blocks)."""
+        grad blocks).
+
+        The extra knobs serve the fused train-step path
+        (optimizers/train_step.py): ``global_grad_norm`` hands a
+        precomputed norm to optimizers that clip internally (FusedLAMB)
+        so no second norm pass is issued; ``extra_found_inf`` folds an
+        externally detected overflow (e.g. from the fused unscale+norm
+        reduction) into the skip gate and the recorded ``found_inf``;
+        ``with_grad_norm=True`` makes the call return
+        ``(params, state, grad_norm_per_tensor)`` with per-tensor raw
+        grad norms reduced inside the update kernel itself (supported
+        by FusedLAMB)."""
         g = flat_grads
         if g.shape != state.master.shape:
             raise ValueError(
                 f"flat_grads shape {g.shape} != master {state.master.shape}")
         g = g.astype(jnp.float32)
         lr_val = _resolve_lr(lr if lr is not None else self.lr, state.count)
-        new_master, new_slots, found = self._update(state, g, lr_val, grad_scale)
+        extra_kw = {}
+        if global_grad_norm is not None:
+            extra_kw["global_grad_norm"] = global_grad_norm
+        if with_grad_norm:
+            extra_kw["with_grad_norm"] = True
+        upd = self._update(state, g, lr_val, grad_scale, **extra_kw)
+        if with_grad_norm:
+            new_master, new_slots, found, grad_norm_pt = upd
+        else:
+            new_master, new_slots, found = upd
+        if extra_found_inf is not None:
+            found = jnp.maximum(found, jnp.asarray(extra_found_inf,
+                                                   jnp.float32))
 
         if skip_if_nonfinite:
             def keep(_):
@@ -225,6 +258,8 @@ class FlatFusedOptimizer:
             space=state.space, master=master2, slots=slots2,
             count=count2, found_inf=found, seg_meta=state.seg_meta,
         )
+        if with_grad_norm:
+            return state.space.unpack(master2), new_state, grad_norm_pt
         return state.space.unpack(master2), new_state
 
     def master_params(self, state: FlatOptState) -> Any:
@@ -417,7 +452,8 @@ class FusedLAMB(FlatFusedOptimizer):
     def _init_slots(self, space, master):
         return _mv_slots(master)
 
-    def _update(self, state, g, lr, grad_scale):
+    def _update(self, state, g, lr, grad_scale, global_grad_norm=None,
+                with_grad_norm=False):
         kw = dict(
             lr=lr, beta1=self.betas[0], beta2=self.betas[1], eps=self.eps,
             step=state.count + 1, weight_decay=self.weight_decay,
@@ -425,6 +461,8 @@ class FusedLAMB(FlatFusedOptimizer):
             grad_averaging=self.grad_averaging,
             max_grad_norm=self.max_grad_norm, adam_w_mode=self.adam_w_mode,
             use_nvlamb=self.use_nvlamb, grad_scale=grad_scale,
+            global_grad_norm=global_grad_norm,
+            with_grad_norm=with_grad_norm,
             impl=self.impl, sr_seed=self._sr_seed(state),
         )
         if self.segmented and state.seg_meta is not None:
@@ -432,13 +470,16 @@ class FusedLAMB(FlatFusedOptimizer):
                 fused_lamb_segmented_update,
             )
 
-            p2, m2, v2, found = fused_lamb_segmented_update(
+            outs = fused_lamb_segmented_update(
                 state.master, state.slots["m"], state.slots["v"], g,
                 state.space, state.seg_meta, **kw)
         else:
-            p2, m2, v2, found = fused_lamb_update(
+            outs = fused_lamb_update(
                 state.master, state.slots["m"], state.slots["v"], g,
                 state.space, **kw)
+        p2, m2, v2, found = outs[:4]
+        if with_grad_norm:
+            return p2, {"m": m2, "v": v2}, found, outs[4]
         return p2, {"m": m2, "v": v2}, found
 
 

@@ -1,0 +1,360 @@
+"""Zero-copy fused train-step path.
+
+``make_train_step`` compiles the whole optimizer hot path — loss-scale
+unscale, optional global-grad-norm clipping, nonfinite detection, the
+fused update, and the loss-scaler schedule — into ONE jitted,
+donation-aware program:
+
+- ``state.master`` and every slot buffer are donated
+  (``donate_argnums``), so the update runs in-place and the compiled
+  step never holds two master-sized copies of the optimizer state:
+  peak optimizer HBM drops by ~the master+slots size vs a non-donating
+  step (the jit-level analog of the reference's in-place
+  ``multi_tensor_*`` updates, csrc/multi_tensor_apply.cuh:44-147).
+- grad unscale (``1/loss_scale``) never materializes an unscaled
+  buffer: on kernel impls it folds into the update kernel's scalar; on
+  the XLA impl the multiply fuses into the update's read of ``g``.
+  Nonfinite detection rides the update kernel's existing
+  ``check_finite`` sweep.
+- when clipping is on, the global-grad-norm reduction is ONE fused
+  read (`multi_tensor.fused_unscale_l2norm`) whose result feeds
+  FusedLAMB's in-update clip through the ``global_grad_norm``
+  plumbing — no second norm pass inside the update, and no unscale
+  sweep before it. (An exact pre-moment clip fundamentally needs one
+  read of the gradients before the update consumes them — the clip
+  factor is a global function of every element — so the clip path is
+  update+1 passes; everything else is zero-extra-pass.)
+- per-tensor grad norms (``with_grad_norm=True``) ride the update
+  itself: the segmented kernel's phase-0 one-hot matmul accumulators
+  and the two-stage stage-1 sumsq partials (multi_tensor/segmented.py,
+  multi_tensor/ops.py) — monitoring at zero extra HBM passes.
+
+Compiled steps are cached in an eviction-free dict keyed on the
+optimizer + options (jax.jit then specializes per static FlatSpace
+layout); `step_cache_stats` — also surfaced through
+``apex_tpu.profiler`` — reports factory and per-layout hit/miss
+counts.
+
+HBM-accesses-per-element budget this path targets (see
+docs/train_step.md): optax per-leaf fusion ~7, the classic two-stage
+flat schedule ~10, segmented one-pass kernel + this step path 7
+(8 with ``seg_stash_p=False``; +1 when clipping).
+
+Composition with amp (the reference's ``with amp.scale_loss(...)``
+flow, apex/amp/handle.py:16-158)::
+
+    scaler = amp.make_scaler(amp_state.properties)
+    step = make_train_step(opt, scaler=scaler)
+    flat_grad = state.space.grad_fn(
+        lambda p, scale: loss_fn(p) * scale)      # grads of SCALED loss
+    g = flat_grad(state.master, scaler_state.loss_scale)
+    state, scaler_state, aux = step(state, g, scaler_state)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu._backend import resolve_impl
+from apex_tpu.amp.scaler import LossScaler, ScalerState
+from apex_tpu.multi_tensor.ops import fused_unscale_l2norm
+from apex_tpu.optimizers.fused import FlatFusedOptimizer, FlatOptState, FusedLAMB
+
+
+class StepAux(NamedTuple):
+    """Per-step diagnostics returned by a fused train step."""
+
+    found_inf: jax.Array                      # f32 {0,1}
+    grad_norm: Optional[jax.Array] = None     # unscaled global L2 norm
+    grad_norm_per_tensor: Optional[jax.Array] = None
+    loss_scale: Optional[jax.Array] = None    # scale the step unscaled by
+
+
+class TrainStep:
+    """A compiled, donation-aware optimizer step (see module docstring).
+
+    Call as ``step(state, flat_grads)`` or, with a scaler,
+    ``step(state, flat_grads, scaler_state)``. Returns
+    ``(new_state, aux)`` / ``(new_state, new_scaler_state, aux)``.
+    The state (and scaler state) arguments are DONATED: rebind them to
+    the returned values — the passed-in buffers are dead after the call.
+    """
+
+    def __init__(self, opt: FlatFusedOptimizer, scaler: Optional[LossScaler],
+                 jitted, body, options: Dict[str, Any]):
+        self.opt = opt
+        self.scaler = scaler
+        self.options = dict(options)
+        self._jitted = jitted
+        self._body = body
+        self._chained: Dict[int, Any] = {}
+        self._layouts = set()
+
+    def _track(self, state: FlatOptState):
+        key = (state.space, state.seg_meta)
+        if key in self._layouts:
+            _STATS["layout_hits"] += 1
+        else:
+            self._layouts.add(key)
+            _STATS["layout_misses"] += 1
+
+    def __call__(self, state: FlatOptState, flat_grads: jax.Array,
+                 scaler_state: Optional[ScalerState] = None, *, lr=None):
+        self._track(state)
+        if self.scaler is not None:
+            if scaler_state is None:
+                raise ValueError(
+                    "this step was built with a scaler; pass scaler_state")
+            return self._jitted(state, flat_grads, scaler_state, lr)
+        if scaler_state is not None:
+            raise ValueError(
+                "this step was built without a scaler; drop scaler_state "
+                "or rebuild with make_train_step(opt, scaler=...)")
+        return self._jitted(state, flat_grads, lr)
+
+    def lower(self, state: FlatOptState, flat_grads: jax.Array,
+              scaler_state: Optional[ScalerState] = None, lr=None):
+        """``jax.jit(...).lower`` passthrough — lets tests assert the
+        compiled program's input/output aliasing (donation) and memory
+        analysis without running a step."""
+        if self.scaler is not None:
+            return self._jitted.lower(state, flat_grads, scaler_state, lr)
+        return self._jitted.lower(state, flat_grads, lr)
+
+    def chained(self, k: int):
+        """``k`` steps of this train step as ONE jitted call — the same
+        fused body iterated in a ``lax.fori_loop`` with the carry
+        donated. This is the bench timing protocol (it amortizes
+        per-dispatch overhead so schedule comparisons measure memory
+        traffic, not Python), and the right shape for drivers that
+        checkpoint every k steps.
+
+        Without a scaler: ``fn(state, flat_grads, lr=None) ->
+        (state, found_sum)``. With one: ``fn((state, scaler_state),
+        flat_grads, lr=None) -> ((state, scaler_state), found_sum)``.
+        The same gradient buffer feeds every iteration.
+        """
+        k = int(k)
+        cached = self._chained.get(k)
+        if cached is not None:
+            return cached
+        body = self._body
+        if self.scaler is not None:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def chained(carry, flat_grads, lr=None):
+                def it(_, c):
+                    state, ss, probe = c
+                    state, ss, aux = body(state, flat_grads, ss, lr)
+                    return state, ss, probe + aux.found_inf
+                state, ss, probe = jax.lax.fori_loop(
+                    0, k, it, (*carry, jnp.float32(0.0)))
+                return (state, ss), probe
+        else:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def chained(state, flat_grads, lr=None):
+                def it(_, c):
+                    state, probe = c
+                    state, aux = body(state, flat_grads, None, lr)
+                    return state, probe + aux.found_inf
+                state, probe = jax.lax.fori_loop(
+                    0, k, it, (state, jnp.float32(0.0)))
+                return state, probe
+        self._chained[k] = chained
+        return chained
+
+
+# eviction-free: a training process uses a handful of (optimizer,
+# options) pairs and each compiled step is precious — evicting one
+# silently re-pays a multi-second XLA compile mid-training
+_FACTORY_CACHE: Dict[tuple, TrainStep] = {}
+_STATS = {"factory_hits": 0, "factory_misses": 0,
+          "layout_hits": 0, "layout_misses": 0}
+
+
+def step_cache_stats() -> Dict[str, int]:
+    """Counters for the train-step compile cache (also exposed as
+    ``apex_tpu.profiler.optimizer_step_cache_stats``): ``factory_*``
+    count `make_train_step` lookups, ``layout_*`` count distinct static
+    layouts seen by the cached steps (each layout miss is one XLA
+    compile; hits reuse it)."""
+    return {
+        **_STATS,
+        "factories": len(_FACTORY_CACHE),
+        "layouts": sum(len(s._layouts) for s in _FACTORY_CACHE.values()),
+    }
+
+
+def clear_step_cache() -> None:
+    _FACTORY_CACHE.clear()
+    for k in _STATS:
+        _STATS[k] = 0
+
+
+def _scaler_key(scaler: Optional[LossScaler]):
+    if scaler is None:
+        return None
+    return (scaler.dynamic, scaler._static_scale, scaler.init_scale,
+            scaler.scale_factor, scaler.scale_window,
+            scaler.min_loss_scale, scaler.max_loss_scale)
+
+
+def make_train_step(
+    opt: FlatFusedOptimizer,
+    *,
+    scaler: Optional[LossScaler] = None,
+    max_grad_norm: Optional[float] = None,
+    skip_if_nonfinite: Optional[bool] = None,
+    donate_grads: bool = False,
+    with_grad_norm: bool = False,
+) -> TrainStep:
+    """Build (or fetch from the cache) the fused train step for ``opt``.
+
+    - ``scaler``: a :class:`~apex_tpu.amp.LossScaler`; the step then
+      takes (and donates) a ``ScalerState``, unscales the gradients of
+      the SCALED loss in the update sweep itself, and advances the
+      scale schedule — the whole ``with amp.scale_loss(...)`` flow in
+      one compiled program.
+    - ``max_grad_norm``: global-grad-norm clip. Default: the
+      optimizer's own ``max_grad_norm`` (FusedLAMB) or off. For
+      FusedLAMB the precomputed norm feeds the in-update clip
+      (``global_grad_norm``); for other optimizers the clip factor
+      folds into the update's ``grad_scale``. Passing a value that
+      conflicts with a FusedLAMB's own configured clip raises.
+    - ``skip_if_nonfinite``: gate the update on overflow. Default True
+      when a scaler is given (the amp dynamic-scaling contract), else
+      False.
+    - ``donate_grads``: also donate the grad buffer (safe only when the
+      caller doesn't reuse it — e.g. grads recomputed every step).
+    - ``with_grad_norm``: report per-tensor + global raw-grad norms in
+      the aux, reduced inside the update kernels (FusedLAMB; other
+      optimizers pay one fused norm read).
+
+    The returned :class:`TrainStep` donates ``state`` (master + every
+    slot buffer) and ``scaler_state``; callers MUST rebind both to the
+    returned values.
+    """
+    key = (id(opt), _scaler_key(scaler), max_grad_norm,
+           skip_if_nonfinite, donate_grads, with_grad_norm)
+    cached = _FACTORY_CACHE.get(key)
+    if cached is not None:
+        _STATS["factory_hits"] += 1
+        return cached
+    _STATS["factory_misses"] += 1
+
+    is_lamb = isinstance(opt, FusedLAMB)
+    opt_mgn = float(getattr(opt, "max_grad_norm", 0.0) or 0.0)
+    mgn = opt_mgn if max_grad_norm is None else float(max_grad_norm)
+    if is_lamb and opt_mgn > 0.0 and mgn != opt_mgn:
+        raise ValueError(
+            f"max_grad_norm={mgn} conflicts with the optimizer's own "
+            f"max_grad_norm={opt_mgn}; configure the clip in ONE place")
+    # LAMB with its own clip consumes the precomputed norm through
+    # global_grad_norm; everything else folds the clip into grad_scale
+    internal_clip = is_lamb and opt_mgn > 0.0
+    generic_clip = mgn > 0.0 and not internal_clip
+    skip = (scaler is not None) if skip_if_nonfinite is None \
+        else bool(skip_if_nonfinite)
+    impl = resolve_impl(opt.impl)
+    # On the XLA impl the unscale is the literal multi_tensor_scale
+    # multiply (XLA fuses it into the update's read of g), so the fused
+    # step is BITWISE equal to the composed separate-pass reference; on
+    # kernel impls the unscale folds into the kernel's grad_scale
+    # scalar instead (pallas_call boundaries block producer fusion).
+    xla_compose = impl == "xla"
+
+    def body(state, flat_grads, scaler_state, lr):
+        g = flat_grads.astype(jnp.float32)
+        loss_scale = (scaler_state.loss_scale
+                      if scaler_state is not None else None)
+        extra_found = None
+        grad_scale = 1.0
+        ggn = None                      # norm handed to LAMB's clip
+        unscaled_norm = None            # aux-reported global grad norm
+
+        if xla_compose and loss_scale is not None:
+            inv = 1.0 / loss_scale
+            g = g * inv                 # fuses into the update's read
+            # multi_tensor_scale's convention: flag non-finite OUTPUTS
+            extra_found = jnp.where(
+                jnp.all(jnp.isfinite(g)), 0.0, 1.0).astype(jnp.float32)
+        elif loss_scale is not None:
+            grad_scale = loss_scale     # in-kernel fold (g / grad_scale)
+
+        # LAMB's with_grad_norm rides the update kernel itself, so the
+        # only cases that pay this one fused read are clipping (the
+        # clip factor must exist BEFORE the update consumes g) and
+        # norm-reporting for optimizers without an in-kernel reduction
+        if internal_clip or generic_clip or (with_grad_norm
+                                             and not is_lamb):
+            # one fused read of g; on the xla branch g is already the
+            # unscaled buffer, on kernel branches the unscale is a
+            # scalar op on the reduced value
+            norm, norm_found = fused_unscale_l2norm(
+                g, inv_scale=1.0, impl=impl)
+            unscaled_norm = (norm / loss_scale
+                             if loss_scale is not None and not xla_compose
+                             else norm)
+            extra_found = (norm_found if extra_found is None
+                           else jnp.maximum(extra_found, norm_found))
+            if internal_clip:
+                # FusedLAMB divides the given norm by grad_scale itself
+                ggn = norm
+            elif generic_clip:
+                clip = jnp.maximum(unscaled_norm / mgn, 1.0)
+                grad_scale = (grad_scale * clip
+                              if loss_scale is not None and not xla_compose
+                              else clip)
+
+        outs = opt.step_flat(
+            state, g, lr=lr, grad_scale=grad_scale,
+            skip_if_nonfinite=skip,
+            global_grad_norm=ggn, extra_found_inf=extra_found,
+            with_grad_norm=with_grad_norm and is_lamb)
+        gnorm_pt = None
+        if with_grad_norm and is_lamb:
+            _, new_state, gnorm_pt = outs
+            # kernels reduce the RAW streamed gradient; under a scaler
+            # on kernel impls that is the scaled one — unscale the
+            # reduced values (scalar work)
+            if loss_scale is not None and not xla_compose:
+                gnorm_pt = gnorm_pt / loss_scale
+            unscaled_norm = jnp.sqrt(jnp.sum(gnorm_pt * gnorm_pt))
+        else:
+            _, new_state = outs
+
+        aux = StepAux(found_inf=new_state.found_inf,
+                      grad_norm=unscaled_norm,
+                      grad_norm_per_tensor=gnorm_pt,
+                      loss_scale=loss_scale)
+        if scaler_state is not None:
+            new_scaler_state = scaler.update(scaler_state,
+                                             new_state.found_inf)
+            return new_state, new_scaler_state, aux
+        return new_state, aux
+
+    if scaler is not None:
+        donate = (0, 2) + ((1,) if donate_grads else ())
+
+        @functools.partial(jax.jit, donate_argnums=donate)
+        def jitted(state, flat_grads, scaler_state, lr):
+            return body(state, flat_grads, scaler_state, lr)
+    else:
+        donate = (0,) + ((1,) if donate_grads else ())
+
+        @functools.partial(jax.jit, donate_argnums=donate)
+        def jitted(state, flat_grads, lr):
+            return body(state, flat_grads, None, lr)
+
+    step = TrainStep(opt, scaler, jitted, body, options=dict(
+        max_grad_norm=mgn, skip_if_nonfinite=skip, impl=impl,
+        donate_grads=donate_grads, with_grad_norm=with_grad_norm))
+    _FACTORY_CACHE[key] = step
+    return step
+
+
+__all__ = ["make_train_step", "TrainStep", "StepAux",
+           "step_cache_stats", "clear_step_cache"]

@@ -61,7 +61,20 @@ def annotate(name: Optional[str] = None):
     return wrap
 
 
+def optimizer_step_cache_stats() -> dict:
+    """Hit/miss counters of the fused train-step compile cache
+    (optimizers/train_step.py): ``factory_*`` are `make_train_step`
+    lookups, ``layout_*`` are distinct static FlatSpace layouts (each
+    layout miss paid one XLA compile). The observability hook for the
+    donation-aware step path — a training loop that keeps missing here
+    is re-compiling its hot path every step."""
+    from apex_tpu.optimizers.train_step import step_cache_stats
+
+    return step_cache_stats()
+
+
 # ``range`` stays importable as an attribute for nvtx-name parity, but
 # is deliberately NOT in __all__: star-importing this module must not
 # shadow the ``range`` builtin in user code (advisor finding, round 1).
-__all__ = ["mark_range", "start_trace", "stop_trace", "trace", "annotate"]
+__all__ = ["mark_range", "start_trace", "stop_trace", "trace", "annotate",
+           "optimizer_step_cache_stats"]

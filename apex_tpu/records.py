@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import subprocess
 import time
 from typing import Any, Dict, Optional
@@ -85,6 +86,13 @@ def _uniquifier(name: str) -> int:
     return int(parts[1]) if len(parts) == 2 and parts[1].isdigit() else 0
 
 
+# what follows "{kind}_" in a write_record filename: the UTC stamp.
+# Used to recognize legacy records that predate the top-level ``kind``
+# field without re-introducing the filename-prefix cross-match bug
+# ("tune" must still not swallow "tune_ln_<stamp>..." files).
+_STAMP_RE = re.compile(r"\d{8}T\d{6}Z_")
+
+
 def is_transcribed(rec: Dict[str, Any]) -> bool:
     """True when a record is hand-transcribed evidence, not written by
     the measuring process itself (top-level ``captured: false`` or the
@@ -101,7 +109,10 @@ def latest_record(kind: str,
 
     The kind is matched against the *loaded* record's ``kind`` field
     (never the filename, which would cross-match kinds that are
-    prefixes of other kinds), and recency comes from the record's
+    prefixes of other kinds). Legacy records with no top-level ``kind``
+    match through their filename instead — the exact ``{kind}_{stamp}``
+    shape ``write_record`` produces, so prefix kinds still cannot
+    cross-match. Recency comes from the record's
     ``utc`` field with the filename uniquifier as tiebreaker.
     Driver-captured records always win over transcribed ones of the
     same kind regardless of age; ``allow_transcribed=False`` excludes
@@ -124,7 +135,13 @@ def latest_record(kind: str,
                 rec = json.load(f)
         except (OSError, ValueError):
             continue
-        if rec.get("kind") != kind:
+        if "kind" in rec:
+            if rec["kind"] != kind:
+                continue
+        elif not _STAMP_RE.match(name[len(kind) + 1:]):
+            # legacy driver-captured records lack the top-level field;
+            # accept them when the filename is exactly this kind plus a
+            # stamp (ADVICE round 5: they silently vanished before)
             continue
         transcribed = is_transcribed(rec)
         if transcribed and not allow_transcribed:

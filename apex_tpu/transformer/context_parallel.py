@@ -42,7 +42,8 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
-from jax import shard_map
+
+from apex_tpu._compat import shard_map
 
 from apex_tpu.ops.attention import NEG_INF, flash_attention
 from apex_tpu.transformer.parallel_state import CONTEXT_AXIS, DATA_AXIS

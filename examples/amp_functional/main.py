@@ -14,6 +14,14 @@ equivalent: write the model against ``amp.F`` — the same shipped
 classification as a policy-aware functional namespace — and let
 ``amp.initialize`` activate the policy. Nothing else to register.
 
+The training loop runs the fused train-step path
+(``optimizers.make_train_step``): everything the reference's
+``scale_loss`` block does imperatively — unscale, overflow check,
+skip-step, scale schedule — plus the optimizer update compiles into
+ONE jitted, donation-aware program, and the gradients are taken
+straight into the flat master buffer (``space.grad_fn``) so the hot
+loop never packs a per-leaf tree.
+
 Run (CPU ok): python examples/amp_functional/main.py
 """
 
@@ -23,7 +31,7 @@ import jax
 import jax.numpy as jnp
 
 from apex_tpu import amp
-from apex_tpu.optimizers import FusedSGD
+from apex_tpu.optimizers import FusedSGD, make_train_step
 
 F = amp.F
 
@@ -65,28 +73,30 @@ def main():
     def loss_fn(p):
         return F.cross_entropy(model(p, X), Y)     # fp32 loss (blacklist)
 
-    @jax.jit
-    def train_step(p, opt_state, amp_state):
-        loss = loss_fn(p)
-        scale = amp_state.scalers[0].loss_scale
-        with amp.scale_loss(loss, amp_state) as scaled:
-            # grads of the SCALED loss — the ".backward()" line
-            scaled.grads = jax.grad(lambda q: loss_fn(q) * scale)(p)
-        # exit unscaled the grads and advanced the scaler; the fused
-        # step skips itself if any grad overflowed (lax.cond inside)
-        p, opt_state = opt.step(opt_state, scaled.grads,
-                                skip_if_nonfinite=True)
-        return p, opt_state, scaled.amp_state, loss
+    # ONE compiled program per step: unscale (1/loss_scale) folded into
+    # the fused update sweep, overflow-gated skip, scaler schedule
+    # advanced — the whole `with amp.scale_loss(...)` flow. The state
+    # and scaler-state arguments are DONATED: rebind both every step.
+    scaler = amp.make_scaler(amp_state.properties)
+    step = make_train_step(opt, scaler=scaler)
+    scaler_state = amp_state.scalers[0]
 
-    l0 = None
+    # grads of the SCALED loss, taken straight into the flat master
+    # buffer — the ".backward()" line, with no per-leaf pack after it
+    flat_vg = jax.jit(opt_state.space.grad_fn(
+        lambda p, scale: loss_fn(p) * scale, with_value=True))
+
+    l0 = loss = None
     for _ in range(30):
-        params, opt_state, amp_state, loss = train_step(
-            params, opt_state, amp_state)
+        scale = scaler_state.loss_scale
+        scaled_loss, g = flat_vg(opt_state.master, scale)
+        loss = float(scaled_loss) / float(scale)
+        opt_state, scaler_state, _aux = step(opt_state, g, scaler_state)
         if l0 is None:
-            l0 = float(loss)
-    print(f"O1 training: loss {l0:.4f} -> {float(loss):.4f} "
-          f"(scale {float(amp_state.scalers[0].loss_scale):.0f})")
-    assert float(loss) < l0, "loss did not improve"
+            l0 = loss
+    print(f"O1 training: loss {l0:.4f} -> {loss:.4f} "
+          f"(scale {float(scaler_state.loss_scale):.0f})")
+    assert loss < l0, "loss did not improve"
 
 
 if __name__ == "__main__":

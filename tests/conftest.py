@@ -37,6 +37,23 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 jax.config.update("jax_threefry_partitionable", True)
 
+# Older jax runtimes ship shard_map under jax.experimental with the
+# check_rep spelling of check_vma; tests are written against the modern
+# surface (`from jax import shard_map`, check_vma=...). Install the
+# package's compat wrapper as the top-level name so every test module
+# runs on both runtimes (same shim apex_tpu._compat uses internally).
+if not hasattr(jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _exp_shard_map
+
+    def _compat_shard_map(f, *, mesh, in_specs, out_specs,
+                          check_vma=None, **kw):
+        if check_vma is not None:
+            kw["check_rep"] = check_vma
+        return _exp_shard_map(f, mesh=mesh, in_specs=in_specs,
+                              out_specs=out_specs, **kw)
+
+    jax.shard_map = _compat_shard_map
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 
@@ -58,3 +75,7 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers",
         "l1: cross-product integration tier (ref tests/L1/cross_product)")
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running integration tests excluded from the tier-1 "
+        "budget (-m 'not slow'); run with -m slow before release")

@@ -217,6 +217,27 @@ class TestRegisterDefaults:
         assert ns.add(jnp.ones((8,), jnp.float16), x32[0]).dtype == jnp.float32
         assert ns.unrelated == "leave me"
 
+    def test_repeated_registration_is_idempotent(self):
+        """A second register_defaults (e.g. amp.initialize called
+        twice) must not stack a second cast wrapper — wrapped functions
+        carry a marker and are skipped; the dense alias of linear gets
+        its own single wrapper too."""
+        base = lambda x, w: x @ w  # noqa: E731
+        ns = types.SimpleNamespace(
+            linear=base, dense=base,
+            softmax=lambda x: jax.nn.softmax(x),
+        )
+        n1 = register_defaults(ns, compute_dtype="float16")
+        assert n1 == 3                       # linear, dense, softmax
+        wrapped_linear, wrapped_dense = ns.linear, ns.dense
+        n2 = register_defaults(ns, compute_dtype="float16")
+        assert n2 == 0                       # nothing newly rebound
+        assert ns.linear is wrapped_linear   # same single wrapper
+        assert ns.dense is wrapped_dense
+        # behavior unchanged: one cast, fp16 out
+        out = ns.linear(jnp.ones((4, 8), jnp.float32), jnp.ones((8, 8)))
+        assert out.dtype == jnp.float16
+
     def test_tables_cover_reference_judgment(self):
         # the reference's core classification must be present
         for name in ("linear", "conv2d", "matmul"):

@@ -265,6 +265,7 @@ def test_kv_segment_ids_only(rng, impl):
                                atol=5e-3, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_flash_bias_grad_broadcast_shapes(rng):
     """dbias must come back in the bias's own (broadcast) shape and match
     the XLA path (code-review regression for the chunked recompute)."""
@@ -714,6 +715,7 @@ class TestPositions:
         np.testing.assert_allclose(np.asarray(o_pos), np.asarray(o_stat),
                                    rtol=1e-5, atol=1e-5)
 
+    @pytest.mark.slow
     def test_chunked_causal_merge(self, rng, impl):
         """KV chunks attended with global positions + lse merge must
         equal full causal attention — including grads through the

@@ -99,6 +99,7 @@ class TestSingleDevice:
         gsum = sum(float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads))
         assert gsum > 0
 
+    @pytest.mark.slow
     def test_tiny_convergence(self, rng):
         model = BertModel(TINY)
         toks, attn, labels, lmask, nsp = synth_batch(rng, 4, 16, TINY.vocab_size)

@@ -163,6 +163,7 @@ class TestArguments:
 
 
 class TestResNet:
+    @pytest.mark.slow
     def test_forward_and_train_smoke(self, rng):
         cfg = ResNetConfig.resnet18ish(num_classes=10, dtype=jnp.float32)
         model = ResNet(cfg)
@@ -222,7 +223,8 @@ class TestExamples:
         finally:
             _amp_state.set_active(prev)
 
-    @pytest.mark.parametrize("opt_level", ["O1", "O5"])
+    @pytest.mark.parametrize("opt_level", [
+        "O1", pytest.param("O5", marks=pytest.mark.slow)])
     def test_imagenet_tiny(self, opt_level, tmp_path):
         ex = _load_example("examples/imagenet/main_amp.py", "ex_imagenet")
         ckpt = str(tmp_path / "ck.npz")
@@ -235,6 +237,7 @@ class TestExamples:
                          "--resume", ckpt])
         assert np.isfinite(loss2)
 
+    @pytest.mark.slow
     def test_gpt_pretrain(self, tmp_path):
         """The L5 example: tp x pp x dp mesh train loop + orbax resume."""
         ex = _load_example("examples/gpt_pretrain/pretrain_gpt.py",
@@ -249,7 +252,8 @@ class TestExamples:
         loss2 = ex.main(argv[:1] + ["6"] + argv[2:])
         assert np.isfinite(loss2)
 
-    @pytest.mark.parametrize("attn", ["ring", "ulysses"])
+    @pytest.mark.parametrize("attn", [
+        pytest.param("ring", marks=pytest.mark.slow), "ulysses"])
     def test_long_context(self, attn):
         """Beyond-reference long-context example: sequence sharded over
         the cp axis, exact causal attention via ring/Ulysses."""
@@ -280,6 +284,7 @@ class TestExamples:
                         "--fwd", "--norm-add", "--biases"])
         assert len(rows) == 1
 
+    @pytest.mark.slow
     def test_dcgan(self):
         ex = _load_example("examples/dcgan/main_amp.py", "ex_dcgan")
         lD, lG = ex.main(["--steps", "4", "--batch-size", "8",

@@ -25,12 +25,17 @@ def clean():
 
 
 class TestPretrainStep:
+    # one TP+SP config stays in tier-1; the rest of the grid (~10s per
+    # config of simulated-mesh compute) runs in the slow tier
     @pytest.mark.parametrize("tp,pp,sp,vpp", [
-        (2, 2, True, 1), (2, 2, False, 1), (4, 2, True, 1),
-        (1, 4, False, 1),
+        (2, 2, True, 1),
+        pytest.param(2, 2, False, 1, marks=pytest.mark.slow),
+        pytest.param(4, 2, True, 1, marks=pytest.mark.slow),
+        pytest.param(1, 4, False, 1, marks=pytest.mark.slow),
         # interleaved schedule composed with TP(+SP): the vpp tick scan
         # must interoperate with the TP collectives inside each chunk
-        (2, 2, True, 2), (2, 2, False, 2),
+        pytest.param(2, 2, True, 2, marks=pytest.mark.slow),
+        pytest.param(2, 2, False, 2, marks=pytest.mark.slow),
     ])
     def test_step_runs_and_loss_decreases(self, rng, tp, pp, sp, vpp):
         mesh = ps.initialize_model_parallel(tp, pp)
@@ -56,6 +61,7 @@ class TestPretrainStep:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_sr_bf16_master_free(self, rng):
         """The full parallel pretrain stack composes with the
         master-free bf16 stochastic-rounding optimizer mode: params and
@@ -128,6 +134,7 @@ class TestPretrainStep:
         np.testing.assert_allclose(float(loss), float(dense_loss(params)),
                                    rtol=2e-4)
 
+    @pytest.mark.slow
     def test_interleaved_matches_non_interleaved(self, rng):
         """vpp=2 pretrain step computes the same loss as the vpp=1 step
         on semantically-identical params: stacking the layers in the
@@ -185,6 +192,7 @@ class TestGraftEntry:
         out = jax.jit(fn)(*args)
         assert out.shape[0] == 256
 
+    @pytest.mark.slow
     def test_dryrun_multichip(self):
         sys.path.insert(0, "/root/repo")
         import __graft_entry__ as g

@@ -34,6 +34,32 @@ class TestRecords:
         # unknown kind -> None, not an exception
         assert records.latest_record("nope") is None
 
+    def test_legacy_record_without_kind_field(self, tmp_path, monkeypatch):
+        """Early driver-captured chip records predate the top-level
+        ``kind`` field; a missing ``kind`` matches through the exact
+        ``{kind}_{stamp}`` filename shape instead of being dropped
+        (ADVICE round 5) — without resurrecting the prefix cross-match
+        bug ('tune' must not swallow 'tune_ln' files)."""
+        from apex_tpu import records
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        legacy = tmp_path / "headline_20260101T000000Z_aaaa.json"
+        legacy.write_text(json.dumps({
+            "utc": "20260101T000000Z", "backend": "tpu",
+            "payload": {"v": "legacy"}}))
+        rec = records.latest_record("headline", require_backend="tpu")
+        assert rec is not None and rec["payload"] == {"v": "legacy"}
+        # a newer record WITH the field still wins on recency
+        records.write_record("headline", {"v": "new"}, backend="tpu")
+        rec = records.latest_record("headline", require_backend="tpu")
+        assert rec["payload"] == {"v": "new"}
+        # kind-less file whose name is another kind plus suffix: no match
+        other = tmp_path / "tune_ln_20260101T000000Z_aaaa.json"
+        other.write_text(json.dumps({
+            "utc": "20260101T000000Z", "backend": "tpu",
+            "payload": {"v": "ln"}}))
+        assert records.latest_record("tune", require_backend="tpu") is None
+
     def test_corrupt_record_skipped(self, tmp_path, monkeypatch):
         from apex_tpu import records
 

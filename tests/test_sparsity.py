@@ -74,6 +74,32 @@ class TestPermutationSearch:
         assert sorted(perm) == list(range(16))
         assert permutation_retained_magnitude(w, perm) >= base - 1e-6
 
+    def test_exhaustive_degrade_warns_with_fallback_name(self, rng):
+        """Production-sized layers trip max_stripe_groups and degrade
+        to the hill-climb; that quality cliff must be named, not
+        silent, for method='exhaustive'/'auto' callers."""
+        import warnings
+
+        from apex_tpu.contrib.sparsity import exhaustive_search
+
+        w = jnp.asarray(rng.randn(4, 1024), jnp.float32)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            perm = exhaustive_search(np.asarray(w), max_iters=1,
+                                     escape_attempts=0)
+        assert sorted(perm) == list(range(1024))
+        msgs = [str(c.message) for c in caught
+                if issubclass(c.category, RuntimeWarning)]
+        assert any("hill-climb" in m and "max_stripe_groups" in m
+                   for m in msgs), msgs
+        # small shapes that the table covers stay silent
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            exhaustive_search(rng.randn(4, 16).astype(np.float32),
+                              max_iters=1, escape_attempts=0)
+        assert not [c for c in caught
+                    if issubclass(c.category, RuntimeWarning)]
+
     def test_partition_tables_match_reference_counts(self):
         """Canonical-unique window permutations: 35 for 8 columns,
         5775 for 12 (ref exhaustive_search.py

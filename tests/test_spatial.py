@@ -325,6 +325,7 @@ class TestConvMixedPrecision:
             np.asarray(gw16, np.float32), np.asarray(gw32), rtol=0.1,
             atol=0.5)
 
+    @pytest.mark.slow
     def test_resnet_bf16_train_step(self):
         from apex_tpu.models.resnet import (ResNet, ResNetConfig,
                                             cross_entropy_logits)

@@ -79,6 +79,7 @@ class TestSingleDevice:
                                    np.asarray(out2[:7]), atol=1e-5)
         assert not np.allclose(np.asarray(out1[7:]), np.asarray(out2[7:]))
 
+    @pytest.mark.slow
     def test_tiny_convergence(self, rng):
         model = T5Model(TINY)
         enc, mask, dec, labels, lmask = synth_batch(
@@ -132,6 +133,7 @@ class TestTensorParallel:
         ))(params, enc, mask, dec, labels, lmask)
         np.testing.assert_allclose(float(loss), float(dense), rtol=2e-4)
 
+    @pytest.mark.slow
     def test_tp_grads_match_dense(self, mesh, rng):
         cfg = T5Config(
             vocab_size=64, max_seq_len=16, hidden_size=32,
@@ -169,6 +171,7 @@ class TestT5FlashBackend:
     """T5 on the Pallas kernel: encoder padding as segment ids, causal
     decoder, key-side-masked cross attention."""
 
+    @pytest.mark.slow
     def test_flash_matches_softmax(self, rng):
         base = dict(vocab_size=256, max_seq_len=64, hidden_size=64,
                     num_encoder_layers=2, num_decoder_layers=2,

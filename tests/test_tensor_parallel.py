@@ -460,7 +460,12 @@ class TestRandom:
         np.testing.assert_allclose(float(ck(w, x)), float(block(w, x)), rtol=1e-6)
         g1 = jax.grad(ck)(w, x)
         g2 = jax.grad(block)(w, x)
-        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-5)
+        # remat recomputes the forward inside the backward; XLA may
+        # reassociate the recomputed chain, so grads match to float
+        # noise (observed ~2e-4 rel on ~1e-7-magnitude elements), not
+        # bitwise
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2),
+                                   rtol=1e-5, atol=1e-6)
 
 
 class TestSplitUtil:

@@ -1,0 +1,353 @@
+"""Fused train-step path tests (optimizers/train_step.py).
+
+Pins the three claims the zero-copy step makes: (1) master/slot buffers
+are DONATED — the compiled program aliases them onto outputs, so no
+second master-sized live buffer exists; (2) fusing unscale + clip +
+nonfinite-check + update into one call is EXACTLY the composed
+separate-pass reference (bitwise, fp32, xla impl, segmented layout);
+(3) the compile cache hits on a second call with the same layout.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.multi_tensor import (
+    fused_unscale_l2norm,
+    multi_tensor_l2norm,
+    multi_tensor_scale,
+)
+from apex_tpu.optimizers import (
+    FusedAdam,
+    FusedLAMB,
+    FusedSGD,
+    clear_step_cache,
+    make_train_step,
+    step_cache_stats,
+)
+
+
+def make_params(rng):
+    return {
+        "w1": jnp.asarray(rng.randn(300, 40), jnp.float32),
+        "b1": jnp.asarray(rng.randn(40), jnp.float32),
+        "w2": jnp.asarray(rng.randn(40, 11), jnp.float32),
+    }
+
+
+def make_flat_grads(rng, state, scale=0.1):
+    g = {k: jnp.asarray(rng.randn(*np.asarray(v).shape) * scale,
+                        jnp.float32)
+         for k, v in state.space.unpack(state.master).items()}
+    return state.space.pack(g, dtype=jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _fresh_cache():
+    clear_step_cache()
+    yield
+    clear_step_cache()
+
+
+class TestDonation:
+    def test_master_and_slots_donated(self, rng):
+        """The lowered program aliases the donated state buffers onto
+        outputs: no second master-sized live copy in the compiled step
+        (the jit-level analog of the reference's in-place updates)."""
+        opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=0.0,
+                        use_nvlamb=True, impl="xla", segmented=False)
+        state = opt.init(make_params(rng))
+        g = make_flat_grads(np.random.RandomState(0), state)
+        step = make_train_step(opt)
+        lowered = step.lower(state, g)
+        # StableHLO records the donation as output aliasing on the
+        # parameters regardless of backend
+        assert "tf.aliasing_output" in lowered.as_text()
+        ma = lowered.compile().memory_analysis()
+        if ma is not None and getattr(ma, "alias_size_in_bytes", 0):
+            master_bytes = state.master.size * 4
+            # master + both fp32 slots reuse input buffers
+            assert ma.alias_size_in_bytes >= 3 * master_bytes, (
+                ma.alias_size_in_bytes, master_bytes)
+
+    def test_scaler_state_donated_too(self, rng):
+        opt = FusedSGD(lr=0.1, momentum=0.9, impl="xla")
+        scaler = LossScaler("dynamic")
+        state = opt.init(make_params(rng))
+        g = make_flat_grads(np.random.RandomState(0), state)
+        step = make_train_step(opt, scaler=scaler)
+        txt = step.lower(state, g, scaler.init()).as_text()
+        assert "tf.aliasing_output" in txt
+
+    def test_threading_survives_donation(self, rng):
+        """Calling the step in a loop with rebinding (the documented
+        contract) works; reusing a donated state raises."""
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        state = opt.init(make_params(rng))
+        g = make_flat_grads(np.random.RandomState(0), state)
+        step = make_train_step(opt)
+        stale = state
+        for _ in range(3):
+            state, aux = step(state, g)
+        assert int(state.count) == 3
+        assert float(aux.found_inf) == 0.0
+        if jax.default_backend() != "cpu":   # donation is a no-op on cpu
+            with pytest.raises(RuntimeError):
+                step(stale, g)
+
+
+class TestFusedEqualsComposed:
+    @pytest.mark.parametrize("segmented", [False, True])
+    def test_unscale_clip_update_bitmatches_separate_passes(
+            self, rng, segmented):
+        """One fused call == the composed separate-pass reference
+        (multi_tensor_scale unscale -> multi_tensor_l2norm -> clipped
+        update -> scaler.update), exactly, in fp32, on the xla impl —
+        including on the segmented layout the TPU default uses."""
+        opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=1.0,
+                        use_nvlamb=True, impl="xla", segmented=segmented)
+        scaler = LossScaler("dynamic")
+        params = make_params(rng)
+        state = opt.init(params)
+        g = make_flat_grads(np.random.RandomState(1), state)
+        ss = scaler.init()
+        g_scaled = g * ss.loss_scale
+
+        step = make_train_step(opt, scaler=scaler)
+        st2, ss2, aux = step(state, g_scaled, ss)
+
+        @jax.jit
+        def composed(state, g_scaled, ss):
+            gu, f_scale = multi_tensor_scale(
+                g_scaled, 1.0 / ss.loss_scale, impl="xla")
+            norm, _ = multi_tensor_l2norm(gu, impl="xla")
+            _, st2 = opt.step_flat(
+                state, gu, grad_scale=1.0, global_grad_norm=norm,
+                skip_if_nonfinite=True, extra_found_inf=f_scale)
+            return st2, scaler.update(ss, st2.found_inf), norm
+
+        st_ref, ss_ref, norm = composed(opt.init(params), g_scaled,
+                                        scaler.init())
+        np.testing.assert_array_equal(np.asarray(st2.master),
+                                      np.asarray(st_ref.master))
+        np.testing.assert_array_equal(np.asarray(st2.slots["m"]),
+                                      np.asarray(st_ref.slots["m"]))
+        np.testing.assert_array_equal(np.asarray(st2.slots["v"]),
+                                      np.asarray(st_ref.slots["v"]))
+        assert float(aux.grad_norm) == float(norm)
+        assert float(ss2.loss_scale) == float(ss_ref.loss_scale)
+        assert int(ss2.unskipped) == int(ss_ref.unskipped)
+
+    def test_no_scaler_no_clip_equals_step_flat(self, rng):
+        opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=0.0,
+                        use_nvlamb=True, impl="xla", segmented=False)
+        params = make_params(rng)
+        state = opt.init(params)
+        g = make_flat_grads(np.random.RandomState(2), state)
+        step = make_train_step(opt)
+        st2, _ = step(state, g)
+        _, st_ref = jax.jit(lambda s, g: opt.step_flat(s, g))(
+            opt.init(params), g)
+        np.testing.assert_array_equal(np.asarray(st2.master),
+                                      np.asarray(st_ref.master))
+
+    def test_overflow_skips_update_and_halves_scale(self, rng):
+        opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=1.0,
+                        use_nvlamb=True, impl="xla", segmented=False)
+        scaler = LossScaler("dynamic")
+        state = opt.init(make_params(rng))
+        master0 = np.asarray(state.master).copy()
+        g = make_flat_grads(np.random.RandomState(3), state)
+        g = g.at[7].set(jnp.inf)
+        ss = scaler.init()
+        scale0 = float(ss.loss_scale)
+        step = make_train_step(opt, scaler=scaler)
+        st2, ss2, aux = step(state, g, ss)
+        assert float(aux.found_inf) == 1.0
+        assert int(st2.count) == 0                       # skipped
+        np.testing.assert_array_equal(np.asarray(st2.master), master0)
+        assert float(ss2.loss_scale) == scale0 / 2.0     # backed off
+        assert int(ss2.unskipped) == 0
+
+    def test_interpret_kernel_schedule_close_to_xla(self, rng):
+        """The kernel-fold path (unscale folded into the update's
+        grad_scale scalar) tracks the xla composition to fp32 tolerance
+        on the real segmented kernel schedule."""
+        params = make_params(rng)
+        results = {}
+        for impl in ("xla", "interpret"):
+            opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=1.0,
+                            use_nvlamb=True, impl=impl, segmented=True)
+            scaler = LossScaler("dynamic")
+            state = opt.init(params)
+            g = make_flat_grads(np.random.RandomState(4), state)
+            ss = scaler.init()
+            st2, ss2, aux = make_train_step(opt, scaler=scaler)(
+                state, g * ss.loss_scale, ss)
+            results[impl] = (np.asarray(st2.master), float(aux.grad_norm))
+        np.testing.assert_allclose(results["interpret"][0],
+                                   results["xla"][0],
+                                   rtol=2e-6, atol=1e-7)
+        assert results["interpret"][1] == pytest.approx(
+            results["xla"][1], rel=1e-5)
+
+
+class TestGradNormRideAlong:
+    @pytest.mark.parametrize("impl", ["xla", "interpret"])
+    def test_per_tensor_norms_from_update_kernels(self, rng, impl):
+        opt = FusedLAMB(lr=1e-3, weight_decay=0.01, max_grad_norm=0.0,
+                        use_nvlamb=True, impl=impl, segmented=True)
+        params = make_params(rng)
+        state = opt.init(params)
+        grads = {k: jnp.asarray(
+            np.random.RandomState(5).randn(*np.asarray(v).shape) * 0.1,
+            jnp.float32) for k, v in params.items()}
+        g = state.space.pack(grads, dtype=jnp.float32)
+        step = make_train_step(opt, with_grad_norm=True)
+        _, aux = step(state, g)
+        ref_pt = np.asarray(
+            [float(jnp.sqrt(jnp.sum(x * x)))
+             for x in jax.tree.leaves(grads)])
+        np.testing.assert_allclose(np.asarray(aux.grad_norm_per_tensor),
+                                   ref_pt, rtol=1e-5)
+        ref_global = float(np.sqrt((ref_pt ** 2).sum()))
+        assert float(aux.grad_norm) == pytest.approx(ref_global, rel=1e-5)
+
+    def test_fused_unscale_l2norm_matches_composition(self, rng, impl):
+        g = jnp.asarray(rng.randn(5000), jnp.float32)
+        inv = 1.0 / 1024.0
+        norm, found = fused_unscale_l2norm(g, inv_scale=inv, impl=impl)
+        gu, _ = multi_tensor_scale(g, inv, impl=impl)
+        ref, _ = multi_tensor_l2norm(gu, impl=impl)
+        assert float(found) == 0.0
+        if impl == "xla":
+            assert float(norm) == float(ref)     # bitwise: same order
+        else:
+            assert float(norm) == pytest.approx(float(ref), rel=1e-6)
+        bad = g.at[3].set(jnp.nan)
+        _, found = fused_unscale_l2norm(bad, inv_scale=inv, impl=impl)
+        assert float(found) == 1.0
+
+
+class TestCompileCache:
+    def test_factory_cache_hits_on_same_layout(self, rng):
+        opt = FusedLAMB(lr=1e-3, weight_decay=0.01, impl="xla",
+                        segmented=False)
+        params = make_params(rng)
+        state = opt.init(params)
+        g = make_flat_grads(np.random.RandomState(6), state)
+        step1 = make_train_step(opt)
+        state, _ = step1(state, g)
+        s0 = step_cache_stats()
+        assert s0["factory_misses"] == 1 and s0["layout_misses"] == 1
+        step2 = make_train_step(opt)
+        assert step2 is step1                    # eviction-free dict hit
+        # a re-init produces an equal (hash-identical) static layout:
+        # the cached compiled step is reused, not recompiled
+        state2 = opt.init(params)
+        state2, _ = step2(state2, g)
+        s1 = step_cache_stats()
+        assert s1["factory_hits"] == 1
+        assert s1["layout_hits"] == 1 and s1["layout_misses"] == 1
+
+    def test_distinct_layouts_counted(self, rng):
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        step = make_train_step(opt)
+        st_a = opt.init(make_params(rng))
+        st_b = opt.init({"w": jnp.asarray(rng.randn(64, 3), jnp.float32)})
+        step(st_a, make_flat_grads(np.random.RandomState(7), st_a))
+        step(st_b, make_flat_grads(np.random.RandomState(8), st_b))
+        s = step_cache_stats()
+        assert s["layout_misses"] == 2 and s["layouts"] == 2
+
+    def test_conflicting_lamb_clip_rejected(self, rng):
+        opt = FusedLAMB(lr=1e-3, max_grad_norm=1.0, impl="xla")
+        with pytest.raises(ValueError, match="conflicts"):
+            make_train_step(opt, max_grad_norm=2.0)
+
+
+class TestFlatGradTransform:
+    def test_grad_fn_matches_tree_grad_pack(self, rng):
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        params = make_params(rng)
+        state = opt.init(params)
+        X = jnp.asarray(rng.randn(8, 300), jnp.float32)
+
+        def loss_fn(p):
+            h = X @ p["w1"] + p["b1"]
+            return jnp.sum((h @ p["w2"]) ** 2)
+
+        flat_g = state.space.grad_fn(loss_fn)(state.master)
+        tree_g = jax.grad(loss_fn)(params)
+        ref = state.space.pack(tree_g, dtype=jnp.float32)
+        np.testing.assert_allclose(np.asarray(flat_g), np.asarray(ref),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_grad_fn_with_value_and_args(self, rng):
+        opt = FusedSGD(lr=0.1, impl="xla")
+        state = opt.init({"w": jnp.asarray(rng.randn(32, 4), jnp.float32)})
+        X = jnp.asarray(rng.randn(8, 32), jnp.float32)
+
+        def loss_fn(p, scale):
+            return jnp.sum((X @ p["w"]) ** 2) * scale
+
+        vg = state.space.grad_fn(loss_fn, with_value=True)
+        val, g = vg(state.master, 2.0)
+        assert g.shape == state.master.shape
+        assert float(val) == pytest.approx(
+            2.0 * float(jnp.sum((X @ state.space.unpack(
+                state.master)["w"]) ** 2)), rel=1e-6)
+
+    def test_end_to_end_flat_native_training(self, rng):
+        """grad_fn + make_train_step trains a toy regression — the
+        pack-free hot loop the docs describe."""
+        rng_np = np.random.RandomState(0)
+        X = jnp.asarray(rng_np.randn(64, 16), jnp.float32)
+        W = rng_np.randn(16, 4).astype(np.float32)
+        Y = jnp.asarray(X @ W)
+        opt = FusedAdam(lr=3e-2, impl="xla")
+        state = opt.init(
+            {"w": jnp.asarray(rng_np.randn(16, 4) * 0.1, jnp.float32)})
+
+        def loss_fn(p):
+            return jnp.mean((X @ p["w"] - Y) ** 2)
+
+        flat_g = jax.jit(state.space.grad_fn(loss_fn))
+        step = make_train_step(opt)
+        l0 = float(loss_fn(state.space.unpack(state.master)))
+        for _ in range(60):
+            g = flat_g(state.master)
+            state, _ = step(state, g)
+        l1 = float(loss_fn(state.space.unpack(state.master)))
+        assert l1 < 0.1 * l0, (l0, l1)
+
+
+class TestGenericClip:
+    @pytest.mark.parametrize("impl", ["xla", "interpret"])
+    def test_adam_clip_matches_manual(self, rng, impl):
+        """Non-LAMB optimizers clip by folding max(1, ||g||/mn) into
+        grad_scale — equal to clipping the grads by hand."""
+        params = make_params(rng)
+        grads = {k: jnp.asarray(
+            np.random.RandomState(9).randn(*np.asarray(v).shape),
+            jnp.float32) for k, v in params.items()}
+        mn = 0.5
+        opt = FusedAdam(lr=1e-3, impl=impl)
+        state = opt.init(params)
+        g = state.space.pack(grads, dtype=jnp.float32)
+        st2, aux = make_train_step(opt, max_grad_norm=mn)(state, g)
+
+        norm = float(jnp.sqrt(sum(jnp.sum(x * x)
+                                  for x in jax.tree.leaves(grads))))
+        assert float(aux.grad_norm) == pytest.approx(norm, rel=1e-6)
+        clip = max(norm / mn, 1.0)
+        opt_ref = FusedAdam(lr=1e-3, impl=impl)
+        _, st_ref = jax.jit(
+            lambda s, g: opt_ref.step_flat(s, g, grad_scale=clip))(
+            opt_ref.init(params), g)
+        np.testing.assert_allclose(np.asarray(st2.master),
+                                   np.asarray(st_ref.master),
+                                   rtol=1e-6, atol=1e-7)
