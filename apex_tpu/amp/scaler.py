@@ -108,14 +108,21 @@ class LossScaler:
     # -- (de)serialization: ref apex/amp/frontend.py:434-473 ---------------
 
     def state_dict(self, state: ScalerState) -> Dict[str, Any]:
+        """Full (de)serializable state — including ``found_inf``, so a
+        checkpoint written right after a skipped step resumes with the
+        skip visible (the resilience checkpoint payload embeds exactly
+        this dict; apex_tpu/resilience/checkpoint.py)."""
         return {
             "loss_scale": float(state.loss_scale),
             "unskipped": int(state.unskipped),
+            "found_inf": float(state.found_inf),
         }
 
     def load_state_dict(self, d: Dict[str, Any]) -> ScalerState:
         return ScalerState(
             loss_scale=jnp.asarray(d["loss_scale"], jnp.float32),
             unskipped=jnp.asarray(d["unskipped"], jnp.int32),
-            found_inf=jnp.zeros((), jnp.float32),
+            # pre-found_inf checkpoints (and the reference's state_dict
+            # shape) default to "last step was clean"
+            found_inf=jnp.asarray(d.get("found_inf", 0.0), jnp.float32),
         )

@@ -508,4 +508,60 @@ def fused_lamb_segmented_update(
     return p2, m2, v2, found
 
 
-__all__ = ["fused_lamb_segmented_update", "CHUNK", "CHUNK_ROWS"]
+def segmented_per_leaf_sumsq(buf, space: FlatSpace,
+                             meta: SegmentMeta) -> jax.Array:
+    """(num_leaves,) per-leaf sums of squares of a flat buffer, reduced
+    through the segmented layout's per-segment slot machinery — the
+    same ``slot_ids``/``slot_leaf`` maps the one-pass kernel's phase-0
+    accumulators ride (``with_grad_norm``), expressed in XLA so it runs
+    on any backend.
+
+    This is the resilience watchdog's localization primitive
+    (apex_tpu/resilience/watchdog.py): a NaN/Inf gradient makes exactly
+    its own leaf's sum nonfinite. The reduction is therefore routed
+    per-slot via ``segment_sum`` (not the kernel's one-hot matmul,
+    whose ``0 * NaN`` contributions would bleed a NaN across every slot
+    in the segment) so localization stays leaf-exact.
+    """
+    if meta.n_segments * meta.seg_elems != space.total:
+        raise ValueError(
+            f"SegmentMeta (n_segments={meta.n_segments}, "
+            f"seg_elems={meta.seg_elems}) does not cover the space "
+            f"(total={space.total})")
+    x = buf.astype(jnp.float32)
+    nl = space.num_leaves
+    leaf_sumsq = jnp.zeros((nl,), jnp.float32)
+
+    n_small = len(meta.small_segments)
+    if n_small:
+        align = space.align
+        sub_per_seg = meta.seg_elems // align
+        ms = meta.max_slots
+        segs = x.reshape(meta.n_segments, meta.seg_elems)[
+            np.asarray(meta.small_segments, np.int64)]
+        # per-subtile partial sums — the accumulators' input granularity
+        sub = jnp.sum(
+            segs.reshape(n_small, sub_per_seg, align) ** 2, axis=-1)
+        # subtile -> (segment-local) slot: a static global-slot id per
+        # subtile (padding subtiles carry slot -1 and zero value; they
+        # route to a dump bucket that is dropped)
+        ids = np.asarray(meta.slot_ids, np.int64)
+        rows = np.arange(n_small, dtype=np.int64)[:, None]
+        gslot = np.where(ids >= 0, rows * ms + ids, n_small * ms)
+        per_slot = jax.ops.segment_sum(
+            sub.reshape(-1), jnp.asarray(gslot.reshape(-1)),
+            num_segments=n_small * ms + 1)[:-1]
+        # slot -> global leaf via the static slot_leaf map
+        sl = np.asarray(meta.slot_leaf, np.int64).reshape(-1)
+        gleaf = np.where(sl >= 0, sl, nl)
+        leaf_sumsq = jax.ops.segment_sum(
+            per_slot, jnp.asarray(gleaf), num_segments=nl + 1)[:-1]
+
+    for leaf_idx, start, plen in meta.large:
+        sl_ = jax.lax.slice(x, (start,), (start + plen,))
+        leaf_sumsq = leaf_sumsq.at[leaf_idx].add(jnp.sum(sl_ * sl_))
+    return leaf_sumsq
+
+
+__all__ = ["fused_lamb_segmented_update", "segmented_per_leaf_sumsq",
+           "CHUNK", "CHUNK_ROWS"]

@@ -125,6 +125,24 @@ class TrainStep:
             return self._jitted.lower(state, flat_grads, scaler_state, lr)
         return self._jitted.lower(state, flat_grads, lr)
 
+    def with_options(self, **overrides) -> "TrainStep":
+        """A sibling step for the same optimizer/scaler with some
+        factory options changed, served from the factory cache — e.g.
+        the resilience watchdog's norm-reporting variant
+        ``step.with_options(with_grad_norm=True)`` (its per-tensor
+        norms ride the segmented kernel's phase-0 accumulators, so a
+        monitored step costs zero extra HBM passes)."""
+        base = {k: self.options[k] for k in
+                ("max_grad_norm", "skip_if_nonfinite", "donate_grads",
+                 "with_grad_norm")}
+        unknown = set(overrides) - set(base)
+        if unknown:
+            raise ValueError(
+                f"unknown train-step options {sorted(unknown)}; "
+                f"overridable: {sorted(base)}")
+        base.update(overrides)
+        return make_train_step(self.opt, scaler=self.scaler, **base)
+
     def chained(self, k: int):
         """``k`` steps of this train step as ONE jitted call — the same
         fused body iterated in a ``lax.fori_loop`` with the carry

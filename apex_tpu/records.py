@@ -49,15 +49,24 @@ def write_record(kind: str, payload: Dict[str, Any],
     """Persist one measurement under ``bench_records/``.
 
     ``kind`` groups records for retrieval (e.g. ``"headline"``,
-    ``"attn"``, ``"smoke"``, ``"optdiag"``, ``"tune_ln"``).
+    ``"attn"``, ``"smoke"``, ``"optdiag"``, ``"tune_ln"``,
+    ``"resilience"``).
     ``captured=False`` marks a hand-transcribed record (evidence copied
     from session notes, not written by the measuring process itself);
     it is stored top-level so consumers cannot miss it. Returns the
     written path, or None if persistence failed (never raises — a
     failed disk write must not kill a measurement run).
+
+    The filename stamp has 1-second resolution, so same-second writes
+    collide: the name is claimed with ``O_CREAT|O_EXCL`` (an
+    exists-then-open check is a TOCTOU race across processes) and
+    collisions fall back to a ``time.monotonic_ns()`` disambiguator —
+    strictly increasing, so ``latest_record``'s uniquifier tiebreak
+    still orders same-second records by write order. Transient disk
+    errors are absorbed by a short deadline-bounded retry
+    (apex_tpu/resilience/retry.py) before giving up.
     """
     try:
-        os.makedirs(RECORDS_DIR, exist_ok=True)
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
         rec = {
             "kind": kind,
@@ -68,14 +77,40 @@ def write_record(kind: str, payload: Dict[str, Any],
             "payload": payload,
         }
         base = f"{kind}_{stamp}_{rec['git_sha']}"
-        path = os.path.join(RECORDS_DIR, f"{base}.json")
-        n = 1
-        while os.path.exists(path):      # same kind+second+sha: uniquify
-            path = os.path.join(RECORDS_DIR, f"{base}.{n}.json")
-            n += 1
-        with open(path, "w") as f:
-            json.dump(rec, f, indent=1, sort_keys=True)
-        return path
+        body = json.dumps(rec, indent=1, sort_keys=True)
+
+        def attempt() -> str:
+            from apex_tpu.resilience import faults
+
+            faults.check("record_write")
+            os.makedirs(RECORDS_DIR, exist_ok=True)
+            path = os.path.join(RECORDS_DIR, f"{base}.json")
+            while True:
+                try:
+                    fd = os.open(path,
+                                 os.O_CREAT | os.O_EXCL | os.O_WRONLY,
+                                 0o644)
+                    break
+                except FileExistsError:
+                    path = os.path.join(
+                        RECORDS_DIR,
+                        f"{base}.{time.monotonic_ns()}.json")
+            try:
+                with os.fdopen(fd, "w") as f:
+                    f.write(body)
+            except BaseException:
+                try:
+                    os.unlink(path)      # never leave a truncated claim
+                except OSError:
+                    pass
+                raise
+            return path
+
+        from apex_tpu.resilience.retry import retry_call
+
+        return retry_call(attempt, retries=3, base_delay=0.02,
+                          max_delay=0.25, deadline=2.0,
+                          retry_on=(OSError,))
     except Exception:  # noqa: BLE001
         return None
 

@@ -1,0 +1,55 @@
+"""Fault-tolerance layer over the fused train step.
+
+Production JAX training lives or dies on crash/preemption/NaN
+recovery (TorchTitan makes recoverable distributed checkpointing a
+first-class pillar; this repo's own records module exists because
+three rounds of hardware evidence died to a flaky tunnel). This
+package makes recovery a native subsystem:
+
+- :mod:`~apex_tpu.resilience.checkpoint` — atomic, self-validating,
+  keep-last-k checkpoints of the full train state over the flat host
+  buffers; ``latest_valid()`` auto-resume that skips corruption.
+- :mod:`~apex_tpu.resilience.watchdog` — ``NonfiniteWatchdog``:
+  consecutive-skip counting, per-parameter NaN localization, and
+  rollback with a re-initialized loss scale.
+- :mod:`~apex_tpu.resilience.retry` — deadline-aware exponential
+  backoff with jitter, applied to the prefetch pipeline's device
+  transfers and ``records`` disk writes.
+- :mod:`~apex_tpu.resilience.faults` — deterministic fault injection
+  (context manager + ``APEX_TPU_FAULTS`` env knob) driving the
+  kill-and-resume and fault-matrix tests.
+
+See docs/resilience.md for the recovery story end to end.
+"""
+
+from apex_tpu.resilience import faults
+from apex_tpu.resilience.checkpoint import (
+    CheckpointError,
+    CheckpointManager,
+    RestoredState,
+)
+from apex_tpu.resilience.faults import FaultError, FaultInjector, SimulatedCrash
+from apex_tpu.resilience.retry import backoff_delays, retry, retry_call
+from apex_tpu.resilience.watchdog import (
+    NonfiniteWatchdog,
+    RollbackLimitExceeded,
+    leaf_names,
+    localize_nonfinite,
+)
+
+__all__ = [
+    "CheckpointError",
+    "CheckpointManager",
+    "FaultError",
+    "FaultInjector",
+    "NonfiniteWatchdog",
+    "RestoredState",
+    "RollbackLimitExceeded",
+    "SimulatedCrash",
+    "backoff_delays",
+    "faults",
+    "leaf_names",
+    "localize_nonfinite",
+    "retry",
+    "retry_call",
+]

@@ -1,0 +1,235 @@
+"""Deterministic fault injection for the resilience subsystem.
+
+Three rounds of hardware evidence were lost to a flaky tunneled-TPU
+environment (apex_tpu/records.py:3-17) with nothing in the codebase
+able to *reproduce* that flakiness on demand. This module is the
+reproduction harness: every failure mode the resilience layer defends
+against — NaN gradients, transient/permanent I/O errors, truncated
+checkpoint files, a process dying mid-run — can be injected at exact,
+deterministic points (no randomness, no wall-clock), either from test
+code via the :func:`inject` context manager or from the environment
+via the ``APEX_TPU_FAULTS`` knob.
+
+Injection is *site + counter* based: components call
+``faults.check("site")`` at their fault points, and the active
+:class:`FaultInjector` raises at the call indices the plan names.
+Sites wired into the package:
+
+===================  ======================================================
+site                 fault point
+===================  ======================================================
+``device_put``       ``PrefetchLoader``'s worker-thread host->device
+                     transfer (apex_tpu/runtime)
+``record_write``     ``records.write_record``'s disk write
+``checkpoint_write`` ``resilience.checkpoint.CheckpointManager._write``
+===================  ======================================================
+
+Env knob grammar (semicolon-separated clauses)::
+
+    APEX_TPU_FAULTS="nan_grads=3,4;nan_leaf=2;io:device_put=0,1;
+                     io_permanent:record_write=5;truncate=12;crash=7"
+
+- ``nan_grads=<steps>``          poison the flat gradient at these steps
+- ``nan_leaf=<i>``               which leaf to poison (default: element 0)
+- ``io:<site>=<indices>``        transient ``FaultError`` at these call
+                                 indices of ``site`` (0-based)
+- ``io_permanent:<site>=<k>``    every call of ``site`` from index ``k``
+                                 on raises (a dead disk / dead transport)
+- ``truncate=<steps>``           truncate the checkpoint payload written
+                                 at these steps AFTER it is finalized
+                                 (simulated on-disk corruption)
+- ``crash=<steps>``              ``SimulatedCrash`` from
+                                 :func:`maybe_crash` at these steps
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+from typing import Any, Dict, FrozenSet, Optional
+
+ENV_KNOB = "APEX_TPU_FAULTS"
+
+
+class FaultError(OSError):
+    """An injected I/O failure (an ``OSError`` so the same retry
+    policies that absorb real transient I/O absorb injected ones)."""
+
+
+class SimulatedCrash(RuntimeError):
+    """An injected process death (kill-and-resume tests raise and catch
+    this where a real run would be SIGKILLed / preempted)."""
+
+
+def _int_set(val: str) -> FrozenSet[int]:
+    return frozenset(int(v) for v in val.split(",") if v.strip() != "")
+
+
+@dataclasses.dataclass
+class FaultInjector:
+    """A deterministic fault plan. All counters are call-order based;
+    two identical runs inject at identical points."""
+
+    nan_grad_steps: FrozenSet[int] = frozenset()
+    nan_leaf: Optional[int] = None          # None -> poison element 0
+    # site -> 0-based call indices that raise a transient FaultError
+    io_errors: Dict[str, FrozenSet[int]] = dataclasses.field(
+        default_factory=dict)
+    # site -> first call index from which EVERY call raises
+    io_permanent_from: Dict[str, int] = dataclasses.field(
+        default_factory=dict)
+    truncate_steps: FrozenSet[int] = frozenset()
+    crash_steps: FrozenSet[int] = frozenset()
+
+    def __post_init__(self):
+        self._counts: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    # -- site/counter I/O faults ------------------------------------------
+
+    def count(self, site: str) -> int:
+        """Calls of ``site`` seen so far."""
+        with self._lock:
+            return self._counts.get(site, 0)
+
+    def check(self, site: str) -> None:
+        """Record one call of ``site``; raise if the plan says so."""
+        with self._lock:
+            idx = self._counts.get(site, 0)
+            self._counts[site] = idx + 1
+        perm = self.io_permanent_from.get(site)
+        if perm is not None and idx >= perm:
+            raise FaultError(
+                f"injected permanent I/O failure at {site}[{idx}]")
+        if idx in self.io_errors.get(site, frozenset()):
+            raise FaultError(
+                f"injected transient I/O failure at {site}[{idx}]")
+
+    # -- NaN gradients -----------------------------------------------------
+
+    def should_poison(self, step: int) -> bool:
+        return int(step) in self.nan_grad_steps
+
+    def poison_grads(self, flat_grads, step: int, space=None):
+        """Return ``flat_grads`` with NaN written into the configured
+        leaf's slice (element 0 when no leaf/space is given) when
+        ``step`` is in the plan; unchanged otherwise."""
+        if not self.should_poison(step):
+            return flat_grads
+        import jax.numpy as jnp
+
+        if self.nan_leaf is not None and space is not None:
+            off = space.offsets[self.nan_leaf]
+            size = max(1, min(space.sizes[self.nan_leaf], 8))
+            return flat_grads.at[off:off + size].set(jnp.nan)
+        return flat_grads.at[0].set(jnp.nan)
+
+    # -- checkpoint corruption / crash ------------------------------------
+
+    def should_truncate(self, step: int) -> bool:
+        return int(step) in self.truncate_steps
+
+    def maybe_crash(self, step: int) -> None:
+        if int(step) in self.crash_steps:
+            raise SimulatedCrash(f"injected crash at step {int(step)}")
+
+    # -- env knob ----------------------------------------------------------
+
+    @classmethod
+    def from_env(cls, spec: str) -> "FaultInjector":
+        """Parse the ``APEX_TPU_FAULTS`` grammar (module docstring)."""
+        kw: Dict[str, Any] = {"io_errors": {}, "io_permanent_from": {}}
+        for clause in spec.split(";"):
+            clause = clause.strip()
+            if not clause:
+                continue
+            key, _, val = clause.partition("=")
+            key = key.strip()
+            if key == "nan_grads":
+                kw["nan_grad_steps"] = _int_set(val)
+            elif key == "nan_leaf":
+                kw["nan_leaf"] = int(val)
+            elif key == "truncate":
+                kw["truncate_steps"] = _int_set(val)
+            elif key == "crash":
+                kw["crash_steps"] = _int_set(val)
+            elif key.startswith("io:"):
+                kw["io_errors"][key[len("io:"):]] = _int_set(val)
+            elif key.startswith("io_permanent:"):
+                kw["io_permanent_from"][key[len("io_permanent:"):]] = int(val)
+            else:
+                raise ValueError(
+                    f"unknown {ENV_KNOB} clause {clause!r} (see "
+                    "apex_tpu/resilience/faults.py for the grammar)")
+        return cls(**kw)
+
+
+# -- module-level active plan ----------------------------------------------
+
+_ACTIVE: Optional[FaultInjector] = None
+_ENV_CACHE: tuple = (None, None)          # (spec string, parsed injector)
+
+
+def install(injector: Optional[FaultInjector]) -> None:
+    """Install (or clear, with None) the process-wide fault plan."""
+    global _ACTIVE
+    _ACTIVE = injector
+
+
+def active() -> Optional[FaultInjector]:
+    """The installed injector, else one parsed from ``APEX_TPU_FAULTS``
+    (cached per spec string), else None — the no-faults fast path."""
+    if _ACTIVE is not None:
+        return _ACTIVE
+    spec = os.environ.get(ENV_KNOB)
+    if not spec:
+        return None
+    global _ENV_CACHE
+    if _ENV_CACHE[0] != spec:
+        _ENV_CACHE = (spec, FaultInjector.from_env(spec))
+    return _ENV_CACHE[1]
+
+
+@contextlib.contextmanager
+def inject(**kwargs):
+    """``with faults.inject(nan_grad_steps={3}, ...):`` — install a plan
+    for the block, restoring whatever was active before."""
+    prev = _ACTIVE
+    install(FaultInjector(**kwargs))
+    try:
+        yield _ACTIVE
+    finally:
+        install(prev)
+
+
+def check(site: str) -> None:
+    inj = active()
+    if inj is not None:
+        inj.check(site)
+
+
+def poison_grads(flat_grads, step: int, space=None):
+    inj = active()
+    if inj is None:
+        return flat_grads
+    return inj.poison_grads(flat_grads, step, space=space)
+
+
+def should_truncate(step: int) -> bool:
+    inj = active()
+    return inj is not None and inj.should_truncate(step)
+
+
+def maybe_crash(step: int) -> None:
+    inj = active()
+    if inj is not None:
+        inj.maybe_crash(step)
+
+
+__all__ = [
+    "ENV_KNOB", "FaultError", "FaultInjector", "SimulatedCrash",
+    "active", "check", "inject", "install", "maybe_crash",
+    "poison_grads", "should_truncate",
+]

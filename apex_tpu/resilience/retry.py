@@ -1,0 +1,112 @@
+"""Deadline-aware exponential backoff with jitter.
+
+The runtime's own history (apex_tpu/records.py:3-17) is three rounds of
+measurements lost to transient tunnel/disk failures with zero retry
+machinery anywhere. This module is that machinery: one policy,
+expressed once, applied to every I/O edge that can transiently fail —
+``PrefetchLoader``'s host->device transfers, ``records`` disk writes,
+and checkpoint I/O.
+
+Design points:
+
+- **deadline-aware**: ``deadline`` bounds the TOTAL time spent
+  (attempts + sleeps) from the first call, so a retry loop can never
+  outlive the budget of the operation it serves (a checkpoint save
+  that retries past the next save interval is worse than a failed one).
+  The last sleep is clamped to the remaining budget.
+- **decorrelated jitter**: each delay is scaled by a factor drawn from
+  ``[1-jitter, 1+jitter]`` so N workers hitting the same dead disk
+  don't retry in lockstep. The jitter source is an injectable
+  ``random.Random`` — tests pass a seeded instance (or ``jitter=0``)
+  and get bit-identical schedules.
+- **injectable clock/sleep**: ``sleep`` and ``monotonic`` are
+  parameters, so tests run the full schedule in microseconds.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import time
+from typing import Callable, Optional, Tuple, Type
+
+_RNG = random.Random()
+
+
+def backoff_delays(retries: int, *, base_delay: float = 0.05,
+                   factor: float = 2.0, max_delay: float = 2.0,
+                   jitter: float = 0.5, rng: Optional[random.Random] = None):
+    """The delay schedule ``retry_call`` sleeps through, as a list —
+    exposed so tests (and capacity planning) can inspect the exact
+    schedule a policy produces."""
+    rng = rng if rng is not None else _RNG
+    out = []
+    for i in range(retries):
+        d = min(max_delay, base_delay * (factor ** i))
+        if jitter:
+            d *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
+        out.append(max(0.0, d))
+    return out
+
+
+def retry_call(
+    fn: Callable,
+    *args,
+    retries: int = 4,
+    base_delay: float = 0.05,
+    factor: float = 2.0,
+    max_delay: float = 2.0,
+    jitter: float = 0.5,
+    deadline: Optional[float] = None,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
+    sleep: Callable[[float], None] = time.sleep,
+    monotonic: Callable[[], float] = time.monotonic,
+    rng: Optional[random.Random] = None,
+    **kwargs,
+):
+    """Call ``fn(*args, **kwargs)``, retrying ``retry_on`` exceptions up
+    to ``retries`` times (``retries + 1`` attempts total) with
+    exponential backoff, jitter, and an optional total ``deadline`` in
+    seconds. The last exception is re-raised unchanged when the budget
+    is exhausted (callers keep catching the original type).
+    ``on_retry(attempt, exc, delay)`` fires before each sleep."""
+    rng = rng if rng is not None else _RNG
+    start = monotonic()
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as e:
+            if attempt >= retries:
+                raise
+            delay = min(max_delay, base_delay * (factor ** attempt))
+            if jitter:
+                delay *= 1.0 + jitter * (2.0 * rng.random() - 1.0)
+            delay = max(0.0, delay)
+            if deadline is not None:
+                remaining = deadline - (monotonic() - start)
+                if remaining <= 0:
+                    raise
+                delay = min(delay, remaining)
+            if on_retry is not None:
+                on_retry(attempt, e, delay)
+            sleep(delay)
+            attempt += 1
+
+
+def retry(**policy):
+    """Decorator form of :func:`retry_call`::
+
+        @retry(retries=3, deadline=2.0)
+        def flaky_io(...): ...
+    """
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapped(*args, **kwargs):
+            return retry_call(fn, *args, **policy, **kwargs)
+        return wrapped
+    return deco
+
+
+__all__ = ["backoff_delays", "retry", "retry_call"]

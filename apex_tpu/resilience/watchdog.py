@@ -1,0 +1,205 @@
+"""Nonfinite-gradient watchdog with segment localization and rollback.
+
+The amp contract already *skips* overflowed steps (the fused train
+step gates the update on ``found_inf`` and the scaler halves), which
+is the right response to the occasional fp16/bf16 overflow. It is the
+WRONG response to persistent NaNs (poisoned batch, diverged layer,
+bad-math kernel): the scaler halves every step until it pins at
+``min_loss_scale`` and the run spins forever, burning a chip while
+updating nothing. :class:`NonfiniteWatchdog` is the escalation ladder
+on top of the skip:
+
+1. **count** — consecutive skipped steps, reset by any good step.
+2. **localize** (past ``threshold``) — name WHICH parameters produced
+   nonfinite gradients. When the inner step already reports per-tensor
+   grad norms (``with_grad_norm=True`` rides the segmented kernel's
+   phase-0 one-hot accumulators at zero extra passes), the names come
+   straight from the step's aux; otherwise one cold-path reduction over
+   the flat gradient runs through the same per-segment slot machinery
+   (``multi_tensor.segmented.segmented_per_leaf_sumsq``).
+3. **report** — a structured ``resilience`` record via
+   ``records.write_record`` (event ``nonfinite_escalation``) carrying
+   the suspects, scale trajectory, and the action taken.
+4. **roll back** — restore the last valid checkpoint with a
+   RE-INITIALIZED loss scale (not the ground-down one — a rolled-back
+   run at ``min_loss_scale`` would immediately re-skip everything),
+   or, with no checkpoint manager attached, reset just the scaler.
+5. **give up loudly** — more than ``max_rollbacks`` escalations raises
+   :class:`RollbackLimitExceeded` with the suspects attached, instead
+   of looping a rollback<->NaN cycle forever.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+
+class RollbackLimitExceeded(RuntimeError):
+    """The watchdog escalated more than ``max_rollbacks`` times — the
+    nonfinite source survives rollback (deterministically poisoned
+    data or a genuine divergence) and needs a human."""
+
+    def __init__(self, msg: str, suspects=None):
+        super().__init__(msg)
+        self.suspects = suspects or []
+
+
+def leaf_names(space) -> List[str]:
+    """Human-readable key paths for every leaf of a ``FlatSpace``, in
+    flat-buffer order (``['w']`` -> ``"['w']"`` etc.)."""
+    dummy = space.treedef.unflatten(list(range(space.num_leaves)))
+    flat = jax.tree_util.tree_flatten_with_path(dummy)[0]
+    out = [""] * space.num_leaves
+    for path, idx in flat:
+        out[int(idx)] = jax.tree_util.keystr(path)
+    return out
+
+
+def localize_nonfinite(space, flat_grads, seg_meta=None,
+                       per_tensor_norms=None) -> List[Dict[str, Any]]:
+    """Suspects list: one ``{"leaf", "name", "norm"}`` per parameter
+    whose gradient norm is nonfinite. ``per_tensor_norms`` (e.g. from a
+    ``with_grad_norm=True`` step's aux) is used verbatim when given;
+    otherwise the reduction runs over ``flat_grads`` — through the
+    segmented layout's per-segment slot accumulators when ``seg_meta``
+    is present, else the subtile-partial path."""
+    if per_tensor_norms is not None:
+        norms = np.asarray(per_tensor_norms)
+    elif seg_meta is not None:
+        from apex_tpu.multi_tensor.segmented import segmented_per_leaf_sumsq
+
+        norms = np.sqrt(np.asarray(
+            segmented_per_leaf_sumsq(flat_grads, space, seg_meta)))
+    else:
+        from apex_tpu.multi_tensor.ops import per_tensor_l2norm
+
+        norms = np.asarray(per_tensor_l2norm(flat_grads, space))
+    names = leaf_names(space)
+    out = []
+    for i in np.nonzero(~np.isfinite(norms))[0]:
+        n = float(norms[int(i)])
+        out.append({"leaf": int(i), "name": names[int(i)],
+                    "norm": n if np.isfinite(n) else str(n)})
+    return out
+
+
+class NonfiniteWatchdog:
+    """Wrap a compiled ``TrainStep`` with the escalation ladder above.
+
+    Call-compatible with the wrapped step (same donation contract:
+    rebind state/scaler_state to the returned values). The HOST-side
+    read of ``aux.found_inf`` each step is the one sync the ladder
+    costs; a training loop that already fetches the loss pays nothing
+    extra.
+
+    After a rollback the returned state IS the restored checkpoint
+    state — the loop should consult :attr:`last_restored_step` to
+    rewind its data cursor (see tests/test_watchdog.py for the shape
+    of such a loop).
+    """
+
+    def __init__(self, step, *, manager=None, scaler=None, threshold: int = 3,
+                 max_rollbacks: int = 8, record_kind: str = "resilience",
+                 on_event=None):
+        self.step = step
+        self.manager = manager
+        self.scaler = scaler if scaler is not None else step.scaler
+        self.threshold = int(threshold)
+        self.max_rollbacks = int(max_rollbacks)
+        self.record_kind = record_kind
+        self.on_event = on_event
+        self.consecutive_skips = 0
+        self.escalations = 0
+        self.last_event: Optional[Dict[str, Any]] = None
+        self.last_restored_step: Optional[int] = None
+
+    def __call__(self, state, flat_grads, scaler_state=None, *, lr=None):
+        outs = self.step(state, flat_grads, scaler_state, lr=lr)
+        if self.step.scaler is not None:
+            new_state, new_sstate, aux = outs
+        else:
+            new_state, aux = outs
+            new_sstate = None
+        if float(aux.found_inf) == 0.0:
+            self.consecutive_skips = 0
+            return outs
+        self.consecutive_skips += 1
+        if self.consecutive_skips < self.threshold:
+            return outs                      # a plain amp skip
+        return self._escalate(new_state, flat_grads, new_sstate, aux)
+
+    # -- escalation --------------------------------------------------------
+
+    def _escalate(self, state, flat_grads, scaler_state, aux):
+        from apex_tpu import records
+
+        self.escalations += 1
+        suspects = self._localize(state, flat_grads, aux)
+        scale_before = (float(scaler_state.loss_scale)
+                        if scaler_state is not None else None)
+
+        action = "none"
+        restored = None
+        if self.manager is not None:
+            path = self.manager.latest_valid()
+            if path is not None:
+                restored = self.manager.restore(path, template=state)
+                action = "rollback"
+        new_sstate = scaler_state
+        if self.scaler is not None:
+            # re-initialized loss scale: the ground-down (or pinned-at-
+            # min) scale is part of the failure state being discarded
+            new_sstate = self.scaler.init()
+            if action == "none":
+                action = "scaler_reset"
+
+        event = {
+            "event": "nonfinite_escalation",
+            "consecutive_skips": self.consecutive_skips,
+            "threshold": self.threshold,
+            "escalations": self.escalations,
+            "suspects": suspects,
+            "action": action,
+            "restored_step": restored.step if restored else None,
+            "loss_scale_before": scale_before,
+            "loss_scale_after": (float(new_sstate.loss_scale)
+                                 if new_sstate is not None else None),
+        }
+        self.last_event = event
+        self.last_restored_step = restored.step if restored else None
+        records.write_record(self.record_kind, event)
+        if self.on_event is not None:
+            self.on_event(event)
+
+        if self.escalations > self.max_rollbacks:
+            raise RollbackLimitExceeded(
+                f"nonfinite gradients survived {self.escalations - 1} "
+                f"rollbacks (suspects: "
+                f"{[s['name'] for s in suspects] or 'unlocalized'})",
+                suspects=suspects)
+
+        self.consecutive_skips = 0
+        new_state = restored.opt_state if restored else state
+        if self.step.scaler is not None:
+            return new_state, new_sstate, aux
+        return new_state, aux
+
+    def _localize(self, state, flat_grads, aux):
+        if self.step.options.get("donate_grads"):
+            # the compiled step consumed the grad buffer; per-tensor
+            # norms from the step's aux are the only safe source
+            if aux.grad_norm_per_tensor is None:
+                return []
+            return localize_nonfinite(
+                state.space, None,
+                per_tensor_norms=aux.grad_norm_per_tensor)
+        return localize_nonfinite(
+            state.space, flat_grads, seg_meta=state.seg_meta,
+            per_tensor_norms=aux.grad_norm_per_tensor)
+
+
+__all__ = ["NonfiniteWatchdog", "RollbackLimitExceeded",
+           "leaf_names", "localize_nonfinite"]

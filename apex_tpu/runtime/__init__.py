@@ -227,15 +227,35 @@ class PrefetchLoader:
     are staged ahead: while the device computes step N, the worker
     stages/transfers N+1..N+depth. Optional ``transform`` runs on the
     worker thread (host-side augmentation/cast).
+
+    Transfer fault tolerance (apex_tpu/resilience): each
+    ``jax.device_put`` is retried ``transfer_retries`` times with
+    exponential backoff + jitter; a batch that still fails kills the
+    worker, which is restarted (resuming from the SAME source iterator,
+    the failed batch first) up to ``max_worker_restarts`` times; past
+    that the loader **degrades to synchronous loading** — remaining
+    batches are transformed and transferred inline on the consumer
+    thread, with errors propagating undecorated (``degraded`` records
+    that the pipeline fell back). Exceptions raised by the source
+    iterable or ``transform`` are never retried: they propagate to the
+    consumer unchanged, first time.
     """
 
     def __init__(self, batches: Iterable, depth: int = 2,
-                 transform: Optional[Callable] = None, device=None):
+                 transform: Optional[Callable] = None, device=None,
+                 transfer_retries: int = 3, max_worker_restarts: int = 2,
+                 retry_base_delay: float = 0.05, join_timeout: float = 5.0):
         self._batches = batches
         self._depth = depth
         self._transform = transform
         self._device = device
         self._consumed = False
+        self._transfer_retries = int(transfer_retries)
+        self._max_worker_restarts = int(max_worker_restarts)
+        self._retry_base_delay = float(retry_base_delay)
+        self._join_timeout = float(join_timeout)
+        self.degraded = False          # fell back to synchronous loading
+        self.worker_deaths = 0
 
     def __iter__(self) -> Iterator:
         # eager check (a generator body would defer it to first next())
@@ -250,9 +270,26 @@ class PrefetchLoader:
     def _run(self) -> Iterator:
         import jax
 
+        # lazy: resilience imports runtime (checkpoint payloads ride
+        # HostFlatSpace), so the dependency must not be module-level
+        from apex_tpu.resilience import faults
+        from apex_tpu.resilience.retry import retry_call
+
+        src = iter(self._batches)
         q: "queue.Queue" = queue.Queue(maxsize=self._depth)
         stop = threading.Event()
         END = object()
+
+        class _TransferFailure:
+            """Worker-side transfer death notice (retries exhausted)."""
+
+            def __init__(self, exc):
+                self.exc = exc
+
+        # the batch the dying worker had staged but not delivered: the
+        # restarted worker (or the synchronous fallback) takes it first
+        # so no source batch is ever dropped by a transfer failure
+        pending = {"batch": None}
 
         def put(item) -> bool:
             """Enqueue, backing off so the worker notices a stopped
@@ -265,41 +302,86 @@ class PrefetchLoader:
                     continue
             return False
 
+        def transfer(b):
+            faults.check("device_put")
+            return jax.tree.map(
+                lambda a: jax.device_put(a, self._device), b)
+
         def worker():
             try:
-                for b in self._batches:
-                    if stop.is_set():
+                while not stop.is_set():
+                    if pending["batch"] is not None:
+                        b, pending["batch"] = pending["batch"], None
+                    else:
+                        try:
+                            b = next(src)
+                        except StopIteration:
+                            put(END)
+                            return
+                        if self._transform is not None:
+                            b = self._transform(b)
+                    pending["batch"] = b
+                    try:
+                        d = retry_call(
+                            transfer, b,
+                            retries=self._transfer_retries,
+                            base_delay=self._retry_base_delay,
+                            retry_on=(Exception,))
+                    except Exception as e:  # noqa: BLE001 — death notice
+                        put(_TransferFailure(e))
                         return
-                    if self._transform is not None:
-                        b = self._transform(b)
-                    b = jax.tree.map(
-                        lambda a: jax.device_put(a, self._device), b)
-                    if not put(b):
+                    pending["batch"] = None
+                    if not put(d):
                         return
-                put(END)
-            except BaseException as e:  # propagate to the consumer
+            except BaseException as e:  # source/transform: propagate as-is
                 put(e)
 
-        t = threading.Thread(target=worker, daemon=True)
-        t.start()
+        def spawn():
+            t = threading.Thread(target=worker, daemon=True)
+            t.start()
+            return t
+
+        t = spawn()
         try:
             while True:
                 item = q.get()
                 if item is END:
+                    break
+                if isinstance(item, _TransferFailure):
+                    t.join(timeout=self._join_timeout)
+                    self.worker_deaths += 1
+                    if self.worker_deaths <= self._max_worker_restarts:
+                        t = spawn()
+                        continue
+                    # graceful degradation: no more background workers —
+                    # finish the epoch synchronously (plain transfers,
+                    # errors propagate; prefetch overlap is lost, data
+                    # is not)
+                    self.degraded = True
+                    if pending["batch"] is not None:
+                        b, pending["batch"] = pending["batch"], None
+                        yield transfer(b)
+                    for b in src:
+                        if self._transform is not None:
+                            b = self._transform(b)
+                        yield transfer(b)
                     break
                 if isinstance(item, BaseException):
                     raise item
                 yield item
         finally:
             # consumer stopped (exhausted, errored, or abandoned):
-            # release the worker and its staged device batches
+            # release the worker and its staged device batches. The
+            # join is bounded — a worker wedged inside a dead
+            # transport's device_put must not hang the consumer too
+            # (it is a daemon thread; process exit stays clean).
             stop.set()
             try:
                 while True:
                     q.get_nowait()
             except queue.Empty:
                 pass
-            t.join()
+            t.join(timeout=self._join_timeout)
 
 
 __all__ = [

@@ -144,6 +144,63 @@ class TestLossScaler:
         st2 = s.load_state_dict(d)
         assert float(st2.loss_scale) == float(st.loss_scale)
         assert int(st2.unskipped) == int(st.unskipped)
+        # full state: the overflow that just happened survives the trip
+        assert d["found_inf"] == 1.0
+        assert float(st2.found_inf) == 1.0
+        # pre-found_inf checkpoints load as "last step clean"
+        legacy = {"loss_scale": 2.0 ** 12, "unskipped": 3}
+        st3 = s.load_state_dict(legacy)
+        assert float(st3.found_inf) == 0.0
+
+
+class TestLossScalerScheduleEdges:
+    """The dynamic-schedule corner cases (ref apex/amp/scaler.py:206-226):
+    min floor under repeated overflow, max cap under sustained growth,
+    and overflow landing on the exact would-grow step."""
+
+    def test_min_floor_repeated_overflow_then_regrow(self):
+        s = LossScaler(min_loss_scale=2.0 ** 14, scale_window=2)
+        st = s.init()
+        for _ in range(6):                      # far past the floor
+            st = s.update(st, jnp.asarray(1.0))
+            assert int(st.unskipped) == 0       # overflow always resets
+        assert float(st.loss_scale) == 2.0 ** 14   # floored, not 2^10
+        # the floor is not a trap: a clean window regrows
+        st = s.update(st, jnp.asarray(0.0))
+        assert float(st.loss_scale) == 2.0 ** 14
+        st = s.update(st, jnp.asarray(0.0))
+        assert float(st.loss_scale) == 2.0 ** 15
+
+    def test_max_cap_holds_and_window_keeps_resetting(self):
+        s = LossScaler(scale_window=1, max_loss_scale=2.0 ** 18)
+        st = s.init()
+        for _ in range(8):
+            st = s.update(st, jnp.asarray(0.0))
+            # every grow step resets the window counter, capped or not
+            assert int(st.unskipped) == 0
+        assert float(st.loss_scale) == 2.0 ** 18
+        # one overflow still backs off from the cap
+        st = s.update(st, jnp.asarray(1.0))
+        assert float(st.loss_scale) == 2.0 ** 17
+
+    def test_overflow_on_exact_grow_step_backs_off_and_resets_window(self):
+        s = LossScaler(scale_window=3)
+        st = s.init()
+        st = s.update(st, jnp.asarray(0.0))
+        st = s.update(st, jnp.asarray(0.0))
+        assert int(st.unskipped) == 2
+        # this step WOULD grow (3rd good step) — but it overflows:
+        # overflow wins, the scale halves, and the window restarts
+        st = s.update(st, jnp.asarray(1.0))
+        assert float(st.loss_scale) == 2.0 ** 15
+        assert int(st.unskipped) == 0
+        # a full fresh window is required before growing again
+        st = s.update(st, jnp.asarray(0.0))
+        st = s.update(st, jnp.asarray(0.0))
+        assert float(st.loss_scale) == 2.0 ** 15
+        st = s.update(st, jnp.asarray(0.0))
+        assert float(st.loss_scale) == 2.0 ** 16
+        assert int(st.unskipped) == 0
 
     def test_amp_state_dict_roundtrip(self):
         params, state = amp.initialize(make_params(), opt_level="O2", num_losses=3)

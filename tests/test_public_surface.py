@@ -13,7 +13,14 @@ import pytest
 SURFACE = {
     "apex_tpu": ["amp", "optimizers", "normalization", "parallel",
                  "transformer", "contrib", "multi_tensor", "moe", "rnn",
-                 "fp16_utils", "runtime", "profiler", "testing"],
+                 "fp16_utils", "runtime", "resilience", "profiler",
+                 "testing"],
+    "apex_tpu.resilience": [
+        "CheckpointManager", "CheckpointError", "RestoredState",
+        "NonfiniteWatchdog", "RollbackLimitExceeded", "FaultInjector",
+        "SimulatedCrash", "retry", "retry_call", "faults",
+        "localize_nonfinite", "leaf_names",
+    ],
     "apex_tpu.amp": [
         "initialize", "state_dict", "load_state_dict", "make_scaler",
         "LossScaler", "ScalerState", "OPT_LEVELS", "master_params",

@@ -137,6 +137,52 @@ class TestRecords:
         rec = records.latest_record("k")
         assert rec["payload"] == {"n": "future"}
 
+    def test_same_second_writes_never_overwrite(self, tmp_path,
+                                                monkeypatch):
+        """The filename stamp is 1-second resolution; same-second
+        writes must land in DISTINCT files (monotonic disambiguator +
+        O_EXCL claim), with the later write winning recency."""
+        from apex_tpu import records
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        # freeze the stamp so every write collides on the base name
+        monkeypatch.setattr(records.time, "strftime",
+                            lambda *a: "20260101T000000Z")
+        paths = [records.write_record("k", {"n": i}, backend="tpu")
+                 for i in range(3)]
+        assert None not in paths
+        assert len(set(paths)) == 3               # three distinct files
+        assert len(list(tmp_path.iterdir())) == 3  # nothing overwritten
+        # the monotonic disambiguator orders same-second writes: the
+        # LAST write is the latest record
+        rec = records.latest_record("k")
+        assert rec["payload"] == {"n": 2}
+
+    def test_claim_is_exclusive_not_exists_check(self, tmp_path,
+                                                 monkeypatch):
+        """A pre-existing file with the exact base name (the TOCTOU
+        partner in a cross-process race) is never clobbered: the claim
+        is O_CREAT|O_EXCL, so the writer falls through to a
+        disambiguated name."""
+        import json
+
+        from apex_tpu import records
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        monkeypatch.setattr(records.time, "strftime",
+                            lambda *a: "20260101T000000Z")
+        sha = records._git_sha()
+        victim = tmp_path / f"k_20260101T000000Z_{sha}.json"
+        victim.write_text(json.dumps({
+            "kind": "k", "utc": "20260101T000000Z", "backend": "tpu",
+            "captured": True, "payload": {"n": "first"}}))
+        p = records.write_record("k", {"n": "second"}, backend="tpu")
+        assert p is not None and p != str(victim)
+        # the racing writer's record is intact...
+        assert json.loads(victim.read_text())["payload"] == {"n": "first"}
+        # ...and the new write still wins recency via the disambiguator
+        assert records.latest_record("k")["payload"] == {"n": "second"}
+
     def test_bench_emit_marks_fallback(self, tmp_path, monkeypatch, capsys):
         import bench
         from apex_tpu import records

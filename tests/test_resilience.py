@@ -1,0 +1,482 @@
+"""Resilience subsystem: retry policy, fault injection, atomic
+checkpointing, kill-and-resume, and the prefetch pipeline's transfer
+fault tolerance (apex_tpu/resilience, docs/resilience.md).
+
+The acceptance bar (ISSUE 2): a run killed mid-training by an injected
+fault auto-resumes from ``latest_valid()`` and replays a
+bitwise-identical trajectory vs. the uninterrupted run; with the
+newest checkpoint fault-injected to be truncated, resume falls back to
+the previous valid checkpoint and a corrupt-checkpoint event is
+recorded.
+"""
+
+import json
+import os
+import random
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import records
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.train_step import make_train_step
+from apex_tpu.resilience import (
+    CheckpointError,
+    CheckpointManager,
+    FaultInjector,
+    SimulatedCrash,
+    backoff_delays,
+    faults,
+    retry_call,
+)
+from apex_tpu.runtime import PrefetchLoader
+
+
+class TestRetry:
+    def test_success_after_transient(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        slept = []
+        assert retry_call(flaky, retries=4, base_delay=0.1, jitter=0.0,
+                          sleep=slept.append) == "ok"
+        assert calls["n"] == 3
+        assert slept == [0.1, 0.2]        # exponential, no jitter
+
+    def test_exhaustion_reraises_original(self):
+        def dead():
+            raise OSError("dead disk")
+
+        with pytest.raises(OSError, match="dead disk"):
+            retry_call(dead, retries=2, base_delay=0.0, sleep=lambda d: None)
+
+    def test_retry_on_filters(self):
+        def typed():
+            raise ValueError("not retryable")
+
+        calls = {"n": 0}
+
+        def count():
+            calls["n"] += 1
+            raise ValueError("boom")
+
+        with pytest.raises(ValueError):
+            retry_call(typed, retries=3, retry_on=(OSError,),
+                       sleep=lambda d: None)
+        with pytest.raises(ValueError):
+            retry_call(count, retries=3, retry_on=(ValueError,),
+                       base_delay=0.0, sleep=lambda d: None)
+        assert calls["n"] == 4            # retried when listed
+
+    def test_deadline_bounds_total_time(self):
+        clock = {"t": 0.0}
+
+        def monotonic():
+            return clock["t"]
+
+        def sleep(d):
+            clock["t"] += d
+
+        calls = {"n": 0}
+
+        def dead():
+            calls["n"] += 1
+            clock["t"] += 0.4             # each attempt costs 0.4s
+            raise OSError("down")
+
+        with pytest.raises(OSError):
+            retry_call(dead, retries=50, base_delay=0.1, factor=1.0,
+                       jitter=0.0, deadline=1.0, sleep=sleep,
+                       monotonic=monotonic)
+        # attempts stop once the 1s budget is gone — nowhere near 51
+        assert calls["n"] <= 3
+        assert clock["t"] <= 1.5
+
+    def test_jitter_is_deterministic_with_seeded_rng(self):
+        a = backoff_delays(4, jitter=0.5, rng=random.Random(7))
+        b = backoff_delays(4, jitter=0.5, rng=random.Random(7))
+        c = backoff_delays(4, jitter=0.5, rng=random.Random(8))
+        assert a == b and a != c
+
+    def test_on_retry_callback(self):
+        seen = []
+
+        def flaky():
+            if len(seen) < 2:
+                raise OSError("x")
+            return 1
+
+        retry_call(flaky, retries=3, base_delay=0.01, jitter=0.0,
+                   on_retry=lambda i, e, d: seen.append((i, str(e), d)),
+                   sleep=lambda d: None)
+        assert [s[0] for s in seen] == [0, 1]
+
+
+class TestFaults:
+    def test_env_grammar_roundtrip(self):
+        inj = FaultInjector.from_env(
+            "nan_grads=3,4;nan_leaf=2;io:device_put=0,1;"
+            "io_permanent:record_write=5;truncate=12;crash=7")
+        assert inj.nan_grad_steps == frozenset({3, 4})
+        assert inj.nan_leaf == 2
+        assert inj.io_errors["device_put"] == frozenset({0, 1})
+        assert inj.io_permanent_from["record_write"] == 5
+        assert inj.should_truncate(12) and not inj.should_truncate(11)
+        with pytest.raises(SimulatedCrash):
+            inj.maybe_crash(7)
+        inj.maybe_crash(6)                # no-op
+        with pytest.raises(ValueError, match="unknown"):
+            FaultInjector.from_env("frobnicate=1")
+
+    def test_site_counters_are_deterministic(self):
+        inj = FaultInjector(io_errors={"s": frozenset({1})},
+                            io_permanent_from={"p": 2})
+        inj.check("s")                    # idx 0: ok
+        with pytest.raises(faults.FaultError):
+            inj.check("s")                # idx 1: transient
+        inj.check("s")                    # idx 2: ok again
+        inj.check("p"), inj.check("p")    # 0, 1 ok
+        for _ in range(3):
+            with pytest.raises(faults.FaultError):
+                inj.check("p")            # 2.. permanent
+
+    def test_poison_grads_targets_leaf(self):
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        st = opt.init({"a": jnp.zeros((16,)), "b": jnp.zeros((4, 4))})
+        inj = FaultInjector(nan_grad_steps=frozenset({5}), nan_leaf=1)
+        g = st.space.zeros()
+        assert np.isfinite(np.asarray(inj.poison_grads(g, 4,
+                                                       space=st.space))).all()
+        bad = np.asarray(inj.poison_grads(g, 5, space=st.space))
+        off = st.space.offsets[1]
+        assert np.isnan(bad[off])
+        assert np.isfinite(bad[:off]).all()   # other leaf untouched
+
+    def test_env_knob_activates(self, monkeypatch):
+        monkeypatch.setenv(faults.ENV_KNOB, "nan_grads=2")
+        faults.install(None)
+        inj = faults.active()
+        assert inj is not None and inj.should_poison(2)
+        monkeypatch.delenv(faults.ENV_KNOB)
+        assert faults.active() is None
+
+    def test_inject_restores_previous(self):
+        assert faults.active() is None
+        with faults.inject(crash_steps=frozenset({1})):
+            assert faults.active() is not None
+        assert faults.active() is None
+
+
+def _params(seed=0, n=48, d=6):
+    r = np.random.RandomState(seed)
+    return {"w": jnp.asarray(r.randn(n, d), jnp.float32),
+            "b": jnp.zeros((d,), jnp.float32)}
+
+
+class TestCheckpointManager:
+    def _state(self, seed=0):
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        return opt, opt.init(_params(seed))
+
+    def test_roundtrip_bitwise(self, tmp_path):
+        opt, st = self._state()
+        scaler = LossScaler()
+        ss = scaler.update(scaler.init(), jnp.asarray(1.0))
+        rng = np.random.RandomState(3)
+        rng.randn(5)
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(7, st, scaler_state=ss, rng_state=rng,
+                 extra={"epoch": 2})
+        r = mgr.restore(template=self._state(seed=1)[1])
+        assert r.step == 7 and r.extra == {"epoch": 2}
+        np.testing.assert_array_equal(np.asarray(r.opt_state.master),
+                                      np.asarray(st.master))
+        for k in st.slots:
+            np.testing.assert_array_equal(np.asarray(r.opt_state.slots[k]),
+                                          np.asarray(st.slots[k]))
+        assert int(r.opt_state.count) == int(st.count)
+        assert float(r.scaler_state.loss_scale) == float(ss.loss_scale)
+        assert float(r.scaler_state.found_inf) == 1.0
+        # host RNG stream continues exactly where the original left off
+        np.testing.assert_array_equal(r.rng_state.randn(4), rng.randn(4))
+
+    def test_retention_keeps_last_k(self, tmp_path):
+        _, st = self._state()
+        mgr = CheckpointManager(tmp_path, keep=2)
+        for step in (1, 2, 3, 4):
+            mgr.save(step, st)
+        assert mgr.all_steps() == [3, 4]
+
+    def test_latest_valid_skips_truncated_and_records_event(
+            self, tmp_path, monkeypatch):
+        monkeypatch.setattr(records, "RECORDS_DIR",
+                            str(tmp_path / "records"))
+        _, st = self._state()
+        mgr = CheckpointManager(tmp_path / "ckpt", keep=3)
+        mgr.save(1, st)
+        with faults.inject(truncate_steps=frozenset({2})):
+            mgr.save(2, st)               # finalized, then corrupted
+        ok, reason = mgr.validate(mgr.path_for(2))
+        assert not ok and "truncated" in reason
+        assert mgr.latest_valid() == mgr.path_for(1)
+        rec = records.latest_record("resilience", require_backend=None)
+        assert rec["payload"]["event"] == "corrupt_checkpoint"
+        assert rec["payload"]["step"] == 2
+
+    def test_latest_valid_skips_corrupt_manifest_and_bitrot(self, tmp_path):
+        _, st = self._state()
+        mgr = CheckpointManager(tmp_path, keep=4)
+        mgr.save(1, st), mgr.save(2, st), mgr.save(3, st)
+        with open(os.path.join(mgr.path_for(3), "manifest.json"), "w") as f:
+            f.write("{not json")
+        # same-size bit flip: only the sha catches it
+        ppath = os.path.join(mgr.path_for(2), "payload.bin")
+        with open(ppath, "r+b") as f:
+            f.seek(8)
+            b = f.read(1)
+            f.seek(8)
+            f.write(bytes([b[0] ^ 0xFF]))
+        assert mgr.latest_valid(record_events=False) == mgr.path_for(1)
+        assert mgr.validate(mgr.path_for(2))[1] == "sha256 mismatch"
+
+    def test_failed_write_leaves_no_partial_checkpoint(self, tmp_path):
+        _, st = self._state()
+        mgr = CheckpointManager(tmp_path, keep=3)
+        with faults.inject(io_permanent_from={"checkpoint_write": 0}):
+            with pytest.raises(OSError):
+                mgr.save(1, st)
+        assert mgr.all_steps() == []
+        assert not [n for n in os.listdir(tmp_path) if ".tmp-" in n]
+        # transient write errors are absorbed by the retry
+        with faults.inject(io_errors={"checkpoint_write": frozenset({0})}):
+            mgr.save(2, st)
+        assert mgr.latest_valid(record_events=False) == mgr.path_for(2)
+
+    def test_stale_tmp_dirs_swept_at_startup(self, tmp_path):
+        os.makedirs(tmp_path / "step_000000000009.tmp-123-456")
+        CheckpointManager(tmp_path)
+        assert not [n for n in os.listdir(tmp_path) if ".tmp-" in n]
+
+    def test_bf16_compressed_master(self, tmp_path):
+        import ml_dtypes
+
+        _, st = self._state()
+        mgr = CheckpointManager(tmp_path, compress_master=True)
+        mgr.save(1, st)
+        manifest = mgr.read_manifest(mgr.path_for(1))
+        assert manifest["master_compressed"] is True
+        assert manifest["arrays"][0]["dtype"] == "bfloat16"
+        r = mgr.restore(template=st)
+        # bf16 round-trip: exact at bf16 resolution, fp32 dtype back
+        assert r.opt_state.master.dtype == jnp.float32
+        np.testing.assert_array_equal(
+            np.asarray(r.opt_state.master),
+            np.asarray(st.master).astype(ml_dtypes.bfloat16).astype(
+                np.float32))
+
+    def test_async_save_overlaps_and_wait_raises(self, tmp_path):
+        _, st = self._state()
+        mgr = CheckpointManager(tmp_path, async_save=True)
+        path = mgr.save(1, st)
+        mgr.wait()
+        assert mgr.validate(path)[0]
+        with faults.inject(io_permanent_from={"checkpoint_write": 0}):
+            mgr.save(2, st)
+            with pytest.raises(OSError):
+                mgr.wait()
+        # a failed async save must not poison the next one
+        mgr.save(3, st)
+        mgr.wait()
+        assert mgr.latest_valid(record_events=False) == mgr.path_for(3)
+
+    def test_restore_rejects_layout_mismatch(self, tmp_path):
+        opt, st = self._state()
+        mgr = CheckpointManager(tmp_path)
+        mgr.save(1, st)
+        other = FusedAdam(lr=1e-2, impl="xla").init(
+            {"w": jnp.zeros((4, 4), jnp.float32)})
+        with pytest.raises(CheckpointError, match="different parameter"):
+            mgr.restore(template=other)
+
+    def test_restore_without_any_checkpoint(self, tmp_path):
+        _, st = self._state()
+        with pytest.raises(CheckpointError, match="no valid checkpoint"):
+            CheckpointManager(tmp_path).restore(template=st)
+
+
+# ---------------------------------------------------------------------------
+# Kill-and-resume (the acceptance scenario)
+# ---------------------------------------------------------------------------
+
+
+class _Trainer:
+    """Deterministic fused-step training harness: per-step gradients are
+    a pure function of the step index, so two runs over the same steps
+    are comparable bitwise."""
+
+    def __init__(self):
+        self.opt = FusedAdam(lr=1e-2, impl="xla")
+        self.scaler = LossScaler(init_scale=2.0 ** 10, scale_window=3)
+        self.step = make_train_step(self.opt, scaler=self.scaler)
+        self.state = self.opt.init(_params())
+        self.sstate = self.scaler.init()
+
+    def grad(self, i):
+        r = np.random.RandomState(1000 + i)
+        return jnp.asarray(
+            r.randn(self.state.space.total).astype(np.float32) * 0.01)
+
+    def run(self, start, stop, mgr=None, ckpt_every=2):
+        probes = {}
+        for i in range(start, stop):
+            faults.maybe_crash(i)
+            self.state, self.sstate, _ = self.step(
+                self.state, self.grad(i), self.sstate)
+            probes[i] = np.asarray(self.state.master[:16]).copy()
+            if mgr is not None and (i + 1) % ckpt_every == 0:
+                # manifest step = the next step to run on resume
+                mgr.save(i + 1, self.state, scaler_state=self.sstate)
+        return probes
+
+    def resume_from(self, mgr):
+        restored = mgr.restore(template=self.state)
+        self.state = restored.opt_state
+        self.sstate = restored.scaler_state
+        return restored.step
+
+
+class TestKillAndResume:
+    STEPS = 9
+
+    def test_resume_replays_bitwise(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(records, "RECORDS_DIR",
+                            str(tmp_path / "records"))
+        golden = _Trainer()
+        ref = golden.run(0, self.STEPS)
+
+        mgr = CheckpointManager(tmp_path / "ckpt", keep=3)
+        victim = _Trainer()
+        with faults.inject(crash_steps=frozenset({5})):
+            with pytest.raises(SimulatedCrash):
+                victim.run(0, self.STEPS, mgr=mgr)
+
+        # "new process": fresh optimizer/step/state, auto-resume
+        revived = _Trainer()
+        start = revived.resume_from(mgr)
+        assert start == 4                 # newest checkpoint before the kill
+        probes = revived.run(start, self.STEPS, mgr=mgr)
+        for i in range(start, self.STEPS):
+            np.testing.assert_array_equal(probes[i], ref[i])
+        np.testing.assert_array_equal(np.asarray(revived.state.master),
+                                      np.asarray(golden.state.master))
+        assert float(revived.sstate.loss_scale) == float(
+            golden.sstate.loss_scale)
+        assert int(revived.state.count) == int(golden.state.count)
+
+    def test_corrupt_newest_falls_back_to_previous(self, tmp_path,
+                                                   monkeypatch):
+        monkeypatch.setattr(records, "RECORDS_DIR",
+                            str(tmp_path / "records"))
+        golden = _Trainer()
+        ref = golden.run(0, self.STEPS)
+
+        mgr = CheckpointManager(tmp_path / "ckpt", keep=3)
+        victim = _Trainer()
+        # checkpoint written at step 6 is truncated ON DISK after
+        # finalize, and the run is killed right after
+        with faults.inject(crash_steps=frozenset({7}),
+                           truncate_steps=frozenset({6})):
+            with pytest.raises(SimulatedCrash):
+                victim.run(0, self.STEPS, mgr=mgr)
+
+        revived = _Trainer()
+        start = revived.resume_from(mgr)
+        assert start == 4                 # fell PAST the corrupt step-6 ckpt
+        rec = records.latest_record("resilience", require_backend=None)
+        assert rec["payload"]["event"] == "corrupt_checkpoint"
+        assert rec["payload"]["step"] == 6
+        probes = revived.run(start, self.STEPS)
+        for i in range(start, self.STEPS):
+            np.testing.assert_array_equal(probes[i], ref[i])
+
+
+class TestPrefetchTransferFaults:
+    def _batches(self, n=5):
+        return [np.full((3,), i, np.float32) for i in range(n)]
+
+    def test_transient_failures_retried_in_order(self):
+        with faults.inject(io_errors={"device_put": frozenset({0, 2})}):
+            loader = PrefetchLoader(iter(self._batches()), depth=2,
+                                    retry_base_delay=0.001)
+            out = list(loader)
+        assert len(out) == 5 and not loader.degraded
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(b),
+                                          np.full((3,), i, np.float32))
+
+    def test_repeated_deaths_degrade_to_synchronous(self):
+        # retries=1 -> 2 tries/attempt; restarts=1 -> 2 workers die on
+        # batch 0 (injected calls 0..3), then the synchronous fallback
+        # finishes the epoch — no batch lost, order preserved
+        with faults.inject(io_errors={"device_put": frozenset({0, 1, 2, 3})}):
+            loader = PrefetchLoader(iter(self._batches(4)), depth=2,
+                                    transfer_retries=1,
+                                    max_worker_restarts=1,
+                                    retry_base_delay=0.001)
+            out = list(loader)
+        assert loader.degraded and loader.worker_deaths == 2
+        assert len(out) == 4
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(b),
+                                          np.full((3,), i, np.float32))
+
+    def test_transform_runs_once_per_batch_across_restarts(self):
+        seen = []
+
+        def transform(b):
+            seen.append(int(b[0]))
+            return b * 2
+
+        with faults.inject(io_errors={"device_put": frozenset({0, 1})}):
+            loader = PrefetchLoader(iter(self._batches(3)), depth=2,
+                                    transfer_retries=0,
+                                    max_worker_restarts=2,
+                                    transform=transform,
+                                    retry_base_delay=0.001)
+            out = list(loader)
+        assert sorted(seen) == [0, 1, 2]          # no double-transform
+        np.testing.assert_array_equal(np.asarray(out[0]),
+                                      np.zeros((3,), np.float32))
+
+    def test_source_errors_never_retried(self):
+        def gen():
+            yield np.zeros((1,), np.float32)
+            raise ValueError("boom")
+
+        loader = PrefetchLoader(gen(), depth=2, transfer_retries=5)
+        with pytest.raises(ValueError, match="boom"):
+            list(loader)
+        assert loader.worker_deaths == 0
+
+
+class TestRecordWriteFaults:
+    def test_transient_disk_error_absorbed(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        with faults.inject(io_errors={"record_write": frozenset({0})}):
+            path = records.write_record("resil_unit", {"x": 1})
+        assert path is not None and os.path.exists(path)
+        with open(path) as f:
+            assert json.load(f)["payload"] == {"x": 1}
+
+    def test_permanent_disk_error_returns_none(self, tmp_path, monkeypatch):
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        with faults.inject(io_permanent_from={"record_write": 0}):
+            assert records.write_record("resil_unit", {"x": 1}) is None
+        assert os.listdir(tmp_path) == []

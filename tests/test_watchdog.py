@@ -1,0 +1,249 @@
+"""NonfiniteWatchdog: skip counting, per-parameter NaN localization
+(riding the segmented layout's per-segment slot machinery), structured
+``resilience`` records, rollback with a re-initialized loss scale, and
+the give-up-loudly rollback limit (apex_tpu/resilience/watchdog.py).
+
+Acceptance bar (ISSUE 2): injected persistent-NaN grads trigger
+segment localization naming the poisoned parameter, a structured
+``resilience`` record, and rollback, while a single transient NaN step
+stays a plain skip (no rollback, no record).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import records
+from apex_tpu.amp.scaler import LossScaler
+from apex_tpu.multi_tensor.ops import per_tensor_l2norm
+from apex_tpu.multi_tensor.segmented import segmented_per_leaf_sumsq
+from apex_tpu.optimizers import FusedAdam, FusedLAMB
+from apex_tpu.optimizers.train_step import make_train_step
+from apex_tpu.resilience import (
+    CheckpointManager,
+    FaultInjector,
+    NonfiniteWatchdog,
+    RollbackLimitExceeded,
+    leaf_names,
+    localize_nonfinite,
+)
+
+
+def _params(seed=0):
+    r = np.random.RandomState(seed)
+    return {"b": jnp.zeros((6,), jnp.float32),
+            "w1": jnp.asarray(r.randn(32, 6), jnp.float32),
+            "w2": jnp.asarray(r.randn(6, 6), jnp.float32)}
+
+
+@pytest.fixture
+def records_dir(tmp_path, monkeypatch):
+    path = tmp_path / "records"
+    monkeypatch.setattr(records, "RECORDS_DIR", str(path))
+    return path
+
+
+class _Rig:
+    """Watchdog test rig: fused step + checkpoint manager + a
+    deterministic NaN injector poisoning one named leaf."""
+
+    def __init__(self, tmp_path, threshold=2, poison_leaf=2, opt=None,
+                 **wd_kwargs):
+        self.opt = opt if opt is not None else FusedAdam(lr=1e-2, impl="xla")
+        self.scaler = LossScaler(init_scale=2.0 ** 8, scale_window=100)
+        self.step = make_train_step(self.opt, scaler=self.scaler)
+        self.state = self.opt.init(_params())
+        self.sstate = self.scaler.init()
+        self.mgr = CheckpointManager(tmp_path / "ckpt", keep=3)
+        self.wd = NonfiniteWatchdog(self.step, manager=self.mgr,
+                                    threshold=threshold, **wd_kwargs)
+        self.inj = FaultInjector(nan_grad_steps=frozenset(),
+                                 nan_leaf=poison_leaf)
+        r = np.random.RandomState(42)
+        self.g = jnp.asarray(
+            r.randn(self.state.space.total).astype(np.float32) * 0.01)
+
+    def drive(self, i, poisoned=False):
+        g = self.g
+        if poisoned:
+            self.inj.nan_grad_steps = frozenset({i})
+            g = self.inj.poison_grads(g, i, space=self.state.space)
+        self.state, self.sstate, aux = self.wd(self.state, g, self.sstate)
+        return aux
+
+
+class TestPlainSkip:
+    def test_single_transient_nan_is_a_skip_not_a_rollback(
+            self, tmp_path, records_dir):
+        rig = _Rig(tmp_path, threshold=2)
+        rig.drive(0)
+        rig.mgr.save(1, rig.state, scaler_state=rig.sstate)
+        scale = float(rig.sstate.loss_scale)
+        aux = rig.drive(1, poisoned=True)          # one bad step
+        assert float(aux.found_inf) == 1.0
+        assert rig.wd.consecutive_skips == 1
+        # the amp contract, untouched: scale halved, update skipped
+        assert float(rig.sstate.loss_scale) == scale / 2
+        rig.drive(2)                               # clean step resets
+        assert rig.wd.consecutive_skips == 0
+        assert rig.wd.escalations == 0 and rig.wd.last_event is None
+        assert records.latest_record("resilience",
+                                     require_backend=None) is None
+
+    def test_good_steps_update_params(self, tmp_path, records_dir):
+        rig = _Rig(tmp_path)
+        before = np.asarray(rig.state.master).copy()
+        rig.drive(0)
+        assert not np.array_equal(np.asarray(rig.state.master), before)
+
+
+class TestEscalation:
+    def test_persistent_nan_localizes_records_and_rolls_back(
+            self, tmp_path, records_dir):
+        rig = _Rig(tmp_path, threshold=3, poison_leaf=2)
+        rig.drive(0)
+        rig.mgr.save(1, rig.state, scaler_state=rig.sstate)
+        ckpt_master = np.asarray(rig.state.master).copy()
+        rig.drive(1)                               # diverge past the ckpt
+        post_master = np.asarray(rig.state.master).copy()
+        assert not np.array_equal(post_master, ckpt_master)
+
+        for i in range(2, 5):                      # 3 consecutive NaN steps
+            rig.drive(i, poisoned=True)
+
+        event = rig.wd.last_event
+        assert event is not None
+        assert event["action"] == "rollback"
+        assert event["consecutive_skips"] == 3
+        # localization names EXACTLY the poisoned parameter
+        assert [s["name"] for s in event["suspects"]] == ["['w2']"]
+        assert event["restored_step"] == 1
+        # rolled back to the checkpointed master, not the diverged one
+        np.testing.assert_array_equal(np.asarray(rig.state.master),
+                                      ckpt_master)
+        # loss scale RE-INITIALIZED, not the ground-down one
+        assert float(rig.sstate.loss_scale) == 2.0 ** 8
+        # each NaN step halved the scale inside the compiled step
+        assert event["loss_scale_before"] == 2.0 ** 8 / 8
+        rec = records.latest_record("resilience", require_backend=None)
+        assert rec["payload"]["event"] == "nonfinite_escalation"
+        assert rec["payload"]["suspects"] == event["suspects"]
+        # training continues cleanly after rollback
+        rig.drive(5)
+        assert rig.wd.consecutive_skips == 0
+
+    def test_no_manager_resets_scaler_only(self, tmp_path, records_dir):
+        rig = _Rig(tmp_path, threshold=2)
+        rig.wd.manager = None
+        rig.drive(0)
+        for i in range(1, 3):
+            rig.drive(i, poisoned=True)
+        assert rig.wd.last_event["action"] == "scaler_reset"
+        assert float(rig.sstate.loss_scale) == 2.0 ** 8
+
+    def test_rollback_limit_raises_with_suspects(self, tmp_path,
+                                                 records_dir):
+        rig = _Rig(tmp_path, threshold=1, max_rollbacks=1, poison_leaf=0)
+        rig.drive(0)
+        rig.mgr.save(1, rig.state, scaler_state=rig.sstate)
+        rig.drive(1, poisoned=True)                # escalation 1: rollback
+        assert rig.wd.escalations == 1
+        with pytest.raises(RollbackLimitExceeded) as ei:
+            rig.drive(2, poisoned=True)            # escalation 2: give up
+        assert [s["name"] for s in ei.value.suspects] == ["['b']"]
+
+    def test_on_event_callback_fires(self, tmp_path, records_dir):
+        seen = []
+        rig = _Rig(tmp_path, threshold=1, on_event=seen.append)
+        rig.drive(0, poisoned=True)
+        assert len(seen) == 1 and seen[0]["event"] == "nonfinite_escalation"
+
+
+class TestLocalization:
+    def test_segmented_sumsq_matches_subtile_path_on_finite_data(self):
+        opt = FusedLAMB(lr=1e-3, impl="xla", segmented=True)
+        st = opt.init(_params())
+        r = np.random.RandomState(0)
+        # pack a gradient TREE so padding regions are zero, like a real
+        # grad buffer (the two reductions bill inter-leaf padding to
+        # different owners; on real buffers the padding is always zero)
+        gtree = {k: jnp.asarray(r.randn(*v.shape), jnp.float32)
+                 for k, v in _params().items()}
+        g = st.space.pack(gtree, dtype=jnp.float32)
+        seg = np.sqrt(np.asarray(
+            segmented_per_leaf_sumsq(g, st.space, st.seg_meta)))
+        ref = np.asarray(per_tensor_l2norm(g, st.space, impl="xla"))
+        np.testing.assert_allclose(seg, ref, rtol=1e-5)
+
+    def test_nan_flags_only_the_poisoned_leaf(self):
+        opt = FusedLAMB(lr=1e-3, impl="xla", segmented=True)
+        st = opt.init(_params())
+        g = st.space.zeros() + 1.0
+        off = st.space.offsets[1]                  # 'w1'
+        g = g.at[off + 3].set(jnp.nan)
+        sumsq = np.asarray(segmented_per_leaf_sumsq(g, st.space,
+                                                    st.seg_meta))
+        assert not np.isfinite(sumsq[1])
+        assert np.isfinite(np.delete(sumsq, 1)).all()
+        suspects = localize_nonfinite(st.space, g, seg_meta=st.seg_meta)
+        assert [s["leaf"] for s in suspects] == [1]
+        assert suspects[0]["name"] == "['w1']"
+
+    def test_leaf_names_follow_flat_order(self):
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        st = opt.init(_params())
+        assert leaf_names(st.space) == ["['b']", "['w1']", "['w2']"]
+
+    def test_with_grad_norm_variant_feeds_aux_norms(self, tmp_path,
+                                                    records_dir):
+        # the zero-extra-pass monitoring path: a with_grad_norm LAMB
+        # step reports per-tensor norms in its aux (segmented phase-0
+        # accumulators on kernel impls), and the watchdog localizes
+        # from them without touching the grads again
+        opt = FusedLAMB(lr=1e-3, impl="xla", segmented=True)
+        scaler = LossScaler(init_scale=2.0 ** 8, scale_window=100)
+        base = make_train_step(opt, scaler=scaler)
+        step = base.with_options(with_grad_norm=True)
+        assert step is base.with_options(with_grad_norm=True)  # cached
+        assert step.options["with_grad_norm"] is True
+        state = opt.init(_params())
+        sstate = scaler.init()
+        wd = NonfiniteWatchdog(step, threshold=1)
+        g = state.space.zeros() + 1e-3
+        g = g.at[state.space.offsets[2]].set(jnp.inf)          # 'w2'
+        state, sstate, aux = wd(state, g, sstate)
+        assert aux.grad_norm_per_tensor is not None
+        assert [s["name"] for s in wd.last_event["suspects"]] == ["['w2']"]
+
+    def test_donated_grads_localize_from_aux_only(self, tmp_path,
+                                                  records_dir):
+        opt = FusedLAMB(lr=1e-3, impl="xla", segmented=True)
+        scaler = LossScaler(init_scale=2.0 ** 8, scale_window=100)
+        step = make_train_step(opt, scaler=scaler, donate_grads=True,
+                               with_grad_norm=True)
+        state = opt.init(_params())
+        sstate = scaler.init()
+        wd = NonfiniteWatchdog(step, threshold=1)
+        g = state.space.zeros() + 1e-3
+        g = g.at[state.space.offsets[0]].set(jnp.nan)          # 'b'
+        state, sstate, aux = wd(state, g, sstate)
+        assert [s["name"] for s in wd.last_event["suspects"]] == ["['b']"]
+
+
+class TestNoScalerWatchdog:
+    def test_two_tuple_signature_and_rollback(self, tmp_path, records_dir):
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        step = make_train_step(opt, skip_if_nonfinite=True)
+        state = opt.init(_params())
+        mgr = CheckpointManager(tmp_path / "ckpt")
+        wd = NonfiniteWatchdog(step, manager=mgr, threshold=1)
+        r = np.random.RandomState(0)
+        g = jnp.asarray(r.randn(state.space.total).astype(np.float32))
+        state, aux = wd(state, g)
+        mgr.save(1, state)
+        ckpt = np.asarray(state.master).copy()
+        state, aux = wd(state, g)                  # diverge
+        state, aux = wd(state, g.at[0].set(jnp.nan))
+        assert wd.last_event["action"] == "rollback"
+        assert wd.last_event["loss_scale_before"] is None
+        np.testing.assert_array_equal(np.asarray(state.master), ckpt)

@@ -1,0 +1,1 @@
+# makes tools/ importable so pytest -p tools._marker_audit resolves

@@ -1,0 +1,51 @@
+"""Pytest plugin: slow-marker audit.
+
+Tier-1 runs ``-m 'not slow'`` under a hard 870 s budget (ROADMAP.md);
+a long test that forgets the ``slow`` marker silently eats that budget
+for every future round. This plugin asserts the invariant over
+whatever selection it runs with: any test whose call phase exceeds
+``APEX_TPU_SLOW_BUDGET_S`` seconds (default 20) and does NOT carry the
+``slow`` marker is reported and fails the session.
+
+Usage (tools/check_resilience.sh wires it up)::
+
+    python -m pytest tests/ -p tools._marker_audit ...
+
+The summary line is machine-grepable: ``marker-audit: OK`` or
+``marker-audit: FAILED (<n> unmarked slow tests)``.
+"""
+
+import os
+
+BUDGET_S = float(os.environ.get("APEX_TPU_SLOW_BUDGET_S", "20"))
+
+_offenders = []
+
+
+def pytest_runtest_logreport(report):
+    if report.when != "call":
+        return
+    if report.duration > BUDGET_S and "slow" not in report.keywords:
+        _offenders.append((report.nodeid, report.duration))
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    tr = terminalreporter
+    if not _offenders:
+        tr.write_line(f"marker-audit: OK (budget {BUDGET_S:g}s)")
+        return
+    tr.write_line(
+        f"marker-audit: FAILED ({len(_offenders)} unmarked slow tests)")
+    for nodeid, dur in sorted(_offenders, key=lambda t: -t[1]):
+        tr.write_line(
+            f"  {dur:7.1f}s  {nodeid}  — add @pytest.mark.slow or "
+            "shrink it under the tier-1 budget")
+
+
+def pytest_sessionfinish(session, exitstatus):
+    # flip the process exit code; the grep on the summary line is the
+    # belt to this suspender (pytest versions differ on whether a
+    # plugin may mutate exitstatus here)
+    if _offenders and exitstatus == 0:
+        session.exitstatus = 1
+        session.testsfailed += 1
