@@ -12,6 +12,10 @@ Public surface (ref: apex/multi_tensor_apply/__init__.py + csrc/amp_C):
 """
 
 from apex_tpu.multi_tensor.flat_buffer import DEFAULT_ALIGN, FlatSpace, pack_like
+from apex_tpu.multi_tensor.segmented import (
+    segmented_per_leaf_checksum,
+    segmented_per_leaf_sumsq,
+)
 from apex_tpu.multi_tensor.engine import (
     fused_elementwise,
     fused_sumsq_partials,
@@ -53,4 +57,6 @@ __all__ = [
     "fused_lamb_update",
     "fused_novograd_update",
     "fused_lars_update",
+    "segmented_per_leaf_checksum",
+    "segmented_per_leaf_sumsq",
 ]

@@ -563,5 +563,71 @@ def segmented_per_leaf_sumsq(buf, space: FlatSpace,
     return leaf_sumsq
 
 
+def segmented_per_leaf_checksum(buf, space: FlatSpace,
+                                meta: Optional[SegmentMeta] = None
+                                ) -> jax.Array:
+    """(num_leaves,) BITWISE checksums of a flat buffer: the buffer is
+    reinterpreted as uint32 words (``lax.bitcast_convert_type`` — no
+    value semantics, so two buffers checksum equal iff they are
+    bit-identical up to word order) and each leaf's words are summed
+    mod 2^32. Integer addition is exactly associative, so the result is
+    reduction-order independent: every replica of a data-parallel run
+    computes the identical fingerprint for identical state, and any
+    single bit flip changes its leaf's sum.
+
+    With ``meta`` the reduction rides the segmented layout's per-slot
+    machinery — the same ``slot_ids``/``slot_leaf`` maps as
+    :func:`segmented_per_leaf_sumsq` (per-subtile partial sums routed
+    subtile -> slot -> leaf) — so fingerprinting shares the static maps
+    the one-pass kernel already carries. Without ``meta`` the words are
+    routed straight through the space's per-leaf padded extents. Both
+    paths include each leaf's padding words (zero on any buffer built
+    by ``FlatSpace.pack``/``zeros``, and deterministic either way).
+
+    This is the resilience consistency guard's divergence primitive
+    (apex_tpu/resilience/guard.py): fingerprints are all-gathered over
+    the data axis and a mismatch localizes to (leaf, replica).
+    """
+    words = jax.lax.bitcast_convert_type(
+        buf.astype(jnp.float32), jnp.uint32)
+    nl = space.num_leaves
+    if meta is None:
+        # leaf-id per element via the padded extents (static map)
+        reps = np.asarray(space.padded_sizes, np.int64)
+        owner = jnp.asarray(np.repeat(np.arange(nl, dtype=np.int32), reps))
+        return jax.ops.segment_sum(words, owner, num_segments=nl)
+    if meta.n_segments * meta.seg_elems != space.total:
+        raise ValueError(
+            f"SegmentMeta (n_segments={meta.n_segments}, "
+            f"seg_elems={meta.seg_elems}) does not cover the space "
+            f"(total={space.total})")
+    leaf_sum = jnp.zeros((nl,), jnp.uint32)
+
+    n_small = len(meta.small_segments)
+    if n_small:
+        align = space.align
+        sub_per_seg = meta.seg_elems // align
+        ms = meta.max_slots
+        segs = words.reshape(meta.n_segments, meta.seg_elems)[
+            np.asarray(meta.small_segments, np.int64)]
+        # per-subtile partial word-sums (mod 2^32 all the way down)
+        sub = jnp.sum(segs.reshape(n_small, sub_per_seg, align), axis=-1)
+        ids = np.asarray(meta.slot_ids, np.int64)
+        rows = np.arange(n_small, dtype=np.int64)[:, None]
+        gslot = np.where(ids >= 0, rows * ms + ids, n_small * ms)
+        per_slot = jax.ops.segment_sum(
+            sub.reshape(-1), jnp.asarray(gslot.reshape(-1)),
+            num_segments=n_small * ms + 1)[:-1]
+        sl = np.asarray(meta.slot_leaf, np.int64).reshape(-1)
+        gleaf = np.where(sl >= 0, sl, nl)
+        leaf_sum = jax.ops.segment_sum(
+            per_slot, jnp.asarray(gleaf), num_segments=nl + 1)[:-1]
+
+    for leaf_idx, start, plen in meta.large:
+        sl_ = jax.lax.slice(words, (start,), (start + plen,))
+        leaf_sum = leaf_sum.at[leaf_idx].add(jnp.sum(sl_))
+    return leaf_sum
+
+
 __all__ = ["fused_lamb_segmented_update", "segmented_per_leaf_sumsq",
-           "CHUNK", "CHUNK_ROWS"]
+           "segmented_per_leaf_checksum", "CHUNK", "CHUNK_ROWS"]

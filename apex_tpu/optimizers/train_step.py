@@ -72,6 +72,10 @@ class StepAux(NamedTuple):
     grad_norm: Optional[jax.Array] = None     # unscaled global L2 norm
     grad_norm_per_tensor: Optional[jax.Array] = None
     loss_scale: Optional[jax.Array] = None    # scale the step unscaled by
+    # (n_buffers, num_leaves) uint32 bitwise checksums of the UPDATED
+    # master + slots, computed in-jit every ``fingerprint_every``
+    # applied steps (zeros off-boundary); None when the option is off
+    state_fingerprint: Optional[jax.Array] = None
 
 
 class TrainStep:
@@ -134,7 +138,7 @@ class TrainStep:
         monitored step costs zero extra HBM passes)."""
         base = {k: self.options[k] for k in
                 ("max_grad_norm", "skip_if_nonfinite", "donate_grads",
-                 "with_grad_norm")}
+                 "with_grad_norm", "fingerprint_every")}
         unknown = set(overrides) - set(base)
         if unknown:
             raise ValueError(
@@ -228,6 +232,7 @@ def make_train_step(
     skip_if_nonfinite: Optional[bool] = None,
     donate_grads: bool = False,
     with_grad_norm: bool = False,
+    fingerprint_every: Optional[int] = None,
 ) -> TrainStep:
     """Build (or fetch from the cache) the fused train step for ``opt``.
 
@@ -250,13 +255,28 @@ def make_train_step(
     - ``with_grad_norm``: report per-tensor + global raw-grad norms in
       the aux, reduced inside the update kernels (FusedLAMB; other
       optimizers pay one fused norm read).
+    - ``fingerprint_every``: every N applied steps (``count % N == 0``)
+      compute per-leaf BITWISE uint32 checksums of the updated master +
+      slot buffers inside the jitted program and report them in
+      ``aux.state_fingerprint`` (zeros off-boundary — the reduction is
+      gated behind ``lax.cond`` so non-boundary steps pay nothing).
+      This is the resilience consistency guard's divergence primitive
+      (apex_tpu/resilience/guard.py): fingerprints ride the donating
+      program itself, so cross-replica integrity monitoring never
+      copies or re-reads the state on the host.
 
     The returned :class:`TrainStep` donates ``state`` (master + every
     slot buffer) and ``scaler_state``; callers MUST rebind both to the
     returned values.
     """
+    if fingerprint_every is not None:
+        fingerprint_every = int(fingerprint_every)
+        if fingerprint_every <= 0:
+            raise ValueError(
+                f"fingerprint_every must be positive, got {fingerprint_every}")
     key = (id(opt), _scaler_key(scaler), max_grad_norm,
-           skip_if_nonfinite, donate_grads, with_grad_norm)
+           skip_if_nonfinite, donate_grads, with_grad_norm,
+           fingerprint_every)
     cached = _FACTORY_CACHE.get(key)
     if cached is not None:
         _STATS["factory_hits"] += 1
@@ -344,10 +364,27 @@ def make_train_step(
         else:
             _, new_state = outs
 
+        fingerprint = None
+        if fingerprint_every is not None:
+            from apex_tpu.resilience.guard import state_fingerprint_array
+
+            def _fp(st):
+                return state_fingerprint_array(st)
+
+            def _zeros(st):
+                n_bufs = 1 + len(st.slots)
+                return jnp.zeros((n_bufs, st.space.num_leaves), jnp.uint32)
+
+            at_boundary = jnp.equal(
+                jax.lax.rem(new_state.count,
+                            jnp.int32(fingerprint_every)), 0)
+            fingerprint = jax.lax.cond(at_boundary, _fp, _zeros, new_state)
+
         aux = StepAux(found_inf=new_state.found_inf,
                       grad_norm=unscaled_norm,
                       grad_norm_per_tensor=gnorm_pt,
-                      loss_scale=loss_scale)
+                      loss_scale=loss_scale,
+                      state_fingerprint=fingerprint)
         if scaler_state is not None:
             new_scaler_state = scaler.update(scaler_state,
                                              new_state.found_inf)
@@ -369,7 +406,8 @@ def make_train_step(
 
     step = TrainStep(opt, scaler, jitted, body, options=dict(
         max_grad_norm=mgn, skip_if_nonfinite=skip, impl=impl,
-        donate_grads=donate_grads, with_grad_norm=with_grad_norm))
+        donate_grads=donate_grads, with_grad_norm=with_grad_norm,
+        fingerprint_every=fingerprint_every))
     _FACTORY_CACHE[key] = step
     return step
 

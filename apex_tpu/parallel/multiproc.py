@@ -47,6 +47,39 @@ def initialize_distributed(
     )
 
 
+def is_coordinator() -> bool:
+    """True on the process that owns cluster-singleton duties (rank 0):
+    the quorum-checkpoint commit manifest (resilience/checkpoint.py),
+    fleet-level records, progress logging."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def process_collective():
+    """The resilience guard's :class:`~apex_tpu.resilience.guard.
+    Collective` for THIS runtime: a ``ProcessCollective`` over
+    ``jax.experimental.multihost_utils`` when the cluster has more than
+    one process (call :func:`initialize_distributed` first), else the
+    no-op ``NullCollective`` — so single-host code paths cost nothing
+    and the same training loop runs unchanged at both scales::
+
+        multiproc.initialize_distributed()
+        col = multiproc.process_collective()
+        mgr = CheckpointManager(dir, process_id=col.replica_id,
+                                n_processes=col.n_replicas)
+        guard = ConsistencyGuard(step.with_options(fingerprint_every=N),
+                                 collective=col, manager=mgr)
+    """
+    import jax
+
+    from apex_tpu.resilience.guard import NullCollective, ProcessCollective
+
+    if jax.process_count() > 1:
+        return ProcessCollective()
+    return NullCollective()
+
+
 def local_rank() -> int:
     """ref launcher's --local_rank was the per-node device index; with
     one JAX process driving all local chips it is always 0 (use
@@ -67,5 +100,5 @@ def world_size() -> int:
     return jax.process_count()
 
 
-__all__ = ["initialize_distributed", "local_rank", "process_index",
-           "world_size"]
+__all__ = ["initialize_distributed", "is_coordinator", "local_rank",
+           "process_collective", "process_index", "world_size"]

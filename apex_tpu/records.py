@@ -62,9 +62,16 @@ def write_record(kind: str, payload: Dict[str, Any],
     exists-then-open check is a TOCTOU race across processes) and
     collisions fall back to a ``time.monotonic_ns()`` disambiguator —
     strictly increasing, so ``latest_record``'s uniquifier tiebreak
-    still orders same-second records by write order. Transient disk
-    errors are absorbed by a short deadline-bounded retry
-    (apex_tpu/resilience/retry.py) before giving up.
+    still orders same-second records by write order. The content is
+    ``fsync``'d and then the records DIRECTORY is ``fsync``'d (site
+    ``record_fsync``): the O_EXCL claim creates a directory entry, and
+    a crash — or the preemption kill that resilience records precede —
+    immediately after the write could otherwise lose the entry (and
+    with it the record) even though the data hit the platter. Transient
+    disk errors are absorbed by a short deadline-bounded retry
+    (apex_tpu/resilience/retry.py) before giving up; a failed attempt
+    unlinks its claim, so a retried attempt's disambiguator name never
+    collides with a truncated ghost.
     """
     try:
         stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
@@ -98,6 +105,17 @@ def write_record(kind: str, payload: Dict[str, Any],
             try:
                 with os.fdopen(fd, "w") as f:
                     f.write(body)
+                    f.flush()
+                    os.fsync(f.fileno())
+                # the claim is a directory entry: fsync the directory
+                # too, or a crash right after this return can erase a
+                # record the caller was told exists
+                faults.check("record_fsync")
+                dfd = os.open(RECORDS_DIR, os.O_RDONLY)
+                try:
+                    os.fsync(dfd)
+                finally:
+                    os.close(dfd)
             except BaseException:
                 try:
                     os.unlink(path)      # never leave a truncated claim

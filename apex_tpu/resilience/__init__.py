@@ -18,6 +18,14 @@ package makes recovery a native subsystem:
 - :mod:`~apex_tpu.resilience.faults` — deterministic fault injection
   (context manager + ``APEX_TPU_FAULTS`` env knob) driving the
   kill-and-resume and fault-matrix tests.
+- :mod:`~apex_tpu.resilience.guard` — the DISTRIBUTED tier:
+  ``ConsistencyGuard`` detects cross-replica state divergence via
+  bitwise per-leaf fingerprints all-gathered over the replica set,
+  localizes it to (parameter leaf, replica), and repairs it by
+  broadcasting the agreeing majority's state; ``PreemptionHandler`` +
+  ``graceful_shutdown`` turn SIGTERM into a cross-host-agreed priority
+  final checkpoint. ``checkpoint.py``'s quorum mode gives the fleet
+  multi-host checkpoints a partial host-set can never corrupt.
 
 See docs/resilience.md for the recovery story end to end.
 """
@@ -29,10 +37,30 @@ from apex_tpu.resilience.checkpoint import (
     RestoredState,
 )
 from apex_tpu.resilience.faults import FaultError, FaultInjector, SimulatedCrash
-from apex_tpu.resilience.retry import backoff_delays, retry, retry_call
+from apex_tpu.resilience.guard import (
+    Collective,
+    ConsistencyGuard,
+    DivergenceError,
+    DivergenceReport,
+    LocalCollective,
+    NullCollective,
+    PreemptionHandler,
+    ProcessCollective,
+    compare_fingerprints,
+    graceful_shutdown,
+    install_preemption_handler,
+    state_fingerprint,
+)
+from apex_tpu.resilience.retry import (
+    NON_RETRYABLE,
+    backoff_delays,
+    retry,
+    retry_call,
+)
 from apex_tpu.resilience.watchdog import (
     NonfiniteWatchdog,
     RollbackLimitExceeded,
+    RollbackUnavailable,
     leaf_names,
     localize_nonfinite,
 )
@@ -40,16 +68,30 @@ from apex_tpu.resilience.watchdog import (
 __all__ = [
     "CheckpointError",
     "CheckpointManager",
+    "Collective",
+    "ConsistencyGuard",
+    "DivergenceError",
+    "DivergenceReport",
     "FaultError",
     "FaultInjector",
+    "LocalCollective",
+    "NON_RETRYABLE",
     "NonfiniteWatchdog",
+    "NullCollective",
+    "PreemptionHandler",
+    "ProcessCollective",
     "RestoredState",
     "RollbackLimitExceeded",
+    "RollbackUnavailable",
     "SimulatedCrash",
     "backoff_delays",
+    "compare_fingerprints",
     "faults",
+    "graceful_shutdown",
+    "install_preemption_handler",
     "leaf_names",
     "localize_nonfinite",
     "retry",
     "retry_call",
+    "state_fingerprint",
 ]

@@ -36,6 +36,23 @@ Fault-injection hooks (apex_tpu/resilience/faults.py): the disk write
 checks the ``checkpoint_write`` site, and a finalized checkpoint is
 truncated in place when the active plan says so — which is exactly the
 corruption ``latest_valid`` must survive.
+
+Multi-host (quorum) mode — ``n_processes > 1``: every host writes its
+OWN shard ``step_X/host_{pid:04d}/{payload.bin,manifest.json}`` with
+the same tmp→fsync→rename protocol, and the coordinator (process 0)
+records ``COMMIT.json`` — the quorum manifest naming every host shard
+and its sha256 — only after ALL hosts' shards are present and verify.
+A checkpoint without a commit manifest (a host died mid-save, the
+coordinator was preempted before commit) is never valid, no matter how
+many intact shards it holds: ``latest_valid()`` demands the complete
+host-set, so resume can never mix step-N state on some hosts with
+step-M on others. ``restore()`` prefers this process's own shard but
+accepts ANY committed host's copy — data-parallel-replicated state is
+bit-identical across hosts, so a slice that restarts with fewer
+processes (or as a single process) still resumes. The
+``crash_before_commit`` fault site (faults.py) kills a host between
+its shard write and the commit, which is exactly the partial host-set
+``latest_valid`` must refuse.
 """
 
 from __future__ import annotations
@@ -57,7 +74,13 @@ from apex_tpu.resilience.retry import retry_call
 FORMAT_VERSION = 1
 MANIFEST = "manifest.json"
 PAYLOAD = "payload.bin"
+COMMIT = "COMMIT.json"
 _STEP_RE = re.compile(r"^step_(\d{12})$")
+_HOST_RE = re.compile(r"^host_(\d{4})$")
+
+
+def host_dirname(process_id: int) -> str:
+    return f"host_{int(process_id):04d}"
 
 
 class CheckpointError(RuntimeError):
@@ -153,22 +176,44 @@ class CheckpointManager:
 
     def __init__(self, directory: str, *, keep: int = 3,
                  compress_master: bool = False, async_save: bool = False,
-                 fsync: bool = True):
+                 fsync: bool = True, process_id: int = 0,
+                 n_processes: int = 1, quorum_timeout: float = 120.0):
         self.directory = str(directory)
         self.keep = int(keep)
         self.compress_master = bool(compress_master)
         self.async_save = bool(async_save)
         self.fsync = bool(fsync)
+        self.process_id = int(process_id)
+        self.n_processes = int(n_processes)
+        self.quorum_timeout = float(quorum_timeout)
+        if not (0 <= self.process_id < max(self.n_processes, 1)):
+            raise ValueError(
+                f"process_id {self.process_id} out of range for "
+                f"n_processes {self.n_processes}")
         self._thread: Optional[threading.Thread] = None
         self._error: Optional[BaseException] = None
         self._reported_corrupt: set = set()
         os.makedirs(self.directory, exist_ok=True)
         # stale temp dirs from a previous crashed process: no reader
-        # considers them, but they hold disk — sweep at startup
+        # considers them, but they hold disk — sweep at startup (one
+        # level into step dirs too, where multi-host shard tmps live)
         for name in os.listdir(self.directory):
+            path = os.path.join(self.directory, name)
             if ".tmp-" in name:
-                shutil.rmtree(os.path.join(self.directory, name),
-                              ignore_errors=True)
+                shutil.rmtree(path, ignore_errors=True)
+            elif _STEP_RE.match(name) and os.path.isdir(path):
+                for sub in os.listdir(path):
+                    if ".tmp-" in sub:
+                        shutil.rmtree(os.path.join(path, sub),
+                                      ignore_errors=True)
+
+    @property
+    def multihost(self) -> bool:
+        return self.n_processes > 1
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_id == 0
 
     # -- naming ------------------------------------------------------------
 
@@ -207,6 +252,9 @@ class CheckpointManager:
             "extra": extra,
             **meta,
         }
+        if self.multihost:
+            manifest_extra["process_id"] = self.process_id
+            manifest_extra["n_processes"] = self.n_processes
         if extra is not None:
             json.dumps(extra)            # fail fast, not on the save thread
         final = self.path_for(step)
@@ -273,18 +321,99 @@ class CheckpointManager:
             ],
             **manifest_extra,
         }
+        target = final
+        if self.multihost:
+            os.makedirs(final, exist_ok=True)
+            target = os.path.join(final, host_dirname(self.process_id))
+            # a host dying here (step dir claimed, shard not yet
+            # landed) leaves a partial host-set: the coordinator MUST
+            # time out and refuse the commit, and latest_valid() must
+            # keep answering the previous quorum checkpoint (fault
+            # site: crash_before_commit — the two-process drill in
+            # tools/check_resilience.sh)
+            faults.maybe_crash_before_commit(step)
         # transient disk errors (incl. injected FaultError) are retried
         # under a deadline; a permanently dead disk surfaces as the
         # original OSError
-        retry_call(self._write_once, final, buf, manifest,
+        retry_call(self._write_once, target, buf, manifest,
                    retries=3, base_delay=0.05, max_delay=0.5, deadline=5.0,
                    retry_on=(OSError,))
         if faults.should_truncate(step):
             # simulated on-disk corruption of the FINALIZED checkpoint
             # (what latest_valid must skip): chop the payload in half
-            with open(os.path.join(final, PAYLOAD), "r+b") as f:
+            with open(os.path.join(target, PAYLOAD), "r+b") as f:
                 f.truncate(max(1, space.total_bytes // 2))
+        if self.multihost:
+            if not self.is_coordinator:
+                return
+            self._commit_quorum(step, final)
         self._prune()
+
+    # -- quorum commit (multi-host) ----------------------------------------
+
+    def _commit_quorum(self, step: int, final: str) -> None:
+        """Coordinator: wait for every host's shard to land and verify,
+        then atomically record the commit manifest. No COMMIT.json ->
+        the whole step is invisible to every reader, forever."""
+        deadline = time.monotonic() + self.quorum_timeout
+        hosts = [host_dirname(h) for h in range(self.n_processes)]
+        pending = set(hosts)
+        shas: Dict[str, str] = {}
+        while pending:
+            for h in sorted(pending):
+                hp = os.path.join(final, h)
+                if not os.path.exists(os.path.join(hp, MANIFEST)):
+                    continue
+                ok, reason = self._validate_leaf(hp)
+                if not ok:
+                    raise CheckpointError(
+                        f"quorum commit aborted: host shard {hp} is "
+                        f"invalid ({reason})")
+                shas[h] = self.read_manifest(hp)["sha256"]
+                pending.discard(h)
+            if not pending:
+                break
+            if time.monotonic() >= deadline:
+                raise CheckpointError(
+                    f"quorum timeout after {self.quorum_timeout:.0f}s: "
+                    f"missing host shards {sorted(pending)} under {final} "
+                    "— no commit recorded; the previous quorum "
+                    "checkpoint remains the newest valid one")
+            time.sleep(0.05)
+        commit = {
+            "format": FORMAT_VERSION,
+            "step": int(step),
+            "n_hosts": self.n_processes,
+            "utc": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
+            "hosts": shas,
+        }
+        retry_call(self._write_commit_once, final, commit,
+                   retries=3, base_delay=0.05, max_delay=0.5, deadline=5.0,
+                   retry_on=(OSError,))
+
+    def _write_commit_once(self, final: str, commit: Dict[str, Any]) -> None:
+        faults.check("quorum_commit")
+        tmp = os.path.join(
+            final, f"{COMMIT}.tmp-{os.getpid()}-{time.monotonic_ns()}")
+        try:
+            with open(tmp, "w") as f:
+                json.dump(commit, f, indent=1, sort_keys=True)
+                f.flush()
+                if self.fsync:
+                    os.fsync(f.fileno())
+            os.replace(tmp, os.path.join(final, COMMIT))
+            if self.fsync:
+                self._fsync_dir(final)
+        except BaseException:
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+
+    def read_commit(self, path: str) -> Dict[str, Any]:
+        with open(os.path.join(path, COMMIT)) as f:
+            return json.load(f)
 
     def _write_once(self, final: str, buf: np.ndarray,
                     manifest: Dict[str, Any]) -> None:
@@ -310,7 +439,7 @@ class CheckpointManager:
                 shutil.rmtree(final)
             os.rename(tmp, final)
             if self.fsync:
-                self._fsync_dir(self.directory)
+                self._fsync_dir(os.path.dirname(final) or ".")
         except BaseException:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
@@ -333,9 +462,52 @@ class CheckpointManager:
 
     # -- validation / recovery ---------------------------------------------
 
+    @staticmethod
+    def _is_multihost_layout(path: str) -> bool:
+        """A step dir holding host shards (or a commit manifest) uses
+        the quorum layout — decided from DISK, not from this manager's
+        ``n_processes``, so a shrunken/single-process slice still
+        recognizes (and restores from) a multi-host checkpoint."""
+        if os.path.exists(os.path.join(path, COMMIT)):
+            return True
+        try:
+            return any(_HOST_RE.match(n) for n in os.listdir(path))
+        except OSError:
+            return False
+
     def validate(self, path: str) -> Tuple[bool, str]:
-        """(ok, reason). Re-hashes the payload against the manifest, so
-        truncation or bit-rot anywhere in the payload is caught."""
+        """(ok, reason). Single-host checkpoints re-hash the payload
+        against the manifest. Quorum checkpoints additionally demand
+        the commit manifest and the COMPLETE host-set it names, each
+        shard re-hashed and matched against the commit's recorded
+        sha256 — a partial host-set (host died before commit) or a
+        swapped shard is never valid."""
+        if self._is_multihost_layout(path):
+            return self._validate_quorum(path)
+        return self._validate_leaf(path)
+
+    def _validate_quorum(self, path: str) -> Tuple[bool, str]:
+        try:
+            commit = self.read_commit(path)
+        except (OSError, ValueError) as e:
+            return False, ("no commit manifest (host died before commit, "
+                           f"or coordinator crashed): {type(e).__name__}")
+        if commit.get("format") != FORMAT_VERSION:
+            return False, f"unsupported commit format {commit.get('format')!r}"
+        hosts = commit.get("hosts") or {}
+        if len(hosts) != commit.get("n_hosts"):
+            return False, (f"commit names {len(hosts)} hosts, expected "
+                           f"{commit.get('n_hosts')}")
+        for h, sha in sorted(hosts.items()):
+            hp = os.path.join(path, h)
+            ok, reason = self._validate_leaf(hp)
+            if not ok:
+                return False, f"host shard {h}: {reason}"
+            if self.read_manifest(hp).get("sha256") != sha:
+                return False, f"host shard {h}: sha256 differs from commit"
+        return True, ""
+
+    def _validate_leaf(self, path: str) -> Tuple[bool, str]:
         mpath = os.path.join(path, MANIFEST)
         ppath = os.path.join(path, PAYLOAD)
         try:
@@ -390,7 +562,7 @@ class CheckpointManager:
             return json.load(f)
 
     def restore(self, path: Optional[str] = None, *,
-                template) -> RestoredState:
+                template, host: Optional[int] = None) -> RestoredState:
         """Load a checkpoint into the layout of ``template`` (a
         ``FlatOptState`` from ``opt.init(params)`` — its static
         ``space``/``seg_meta`` nodes are reused, so a restored state is
@@ -399,11 +571,13 @@ class CheckpointManager:
         ``path=None`` restores from :meth:`latest_valid`. Raises
         :class:`CheckpointError` when nothing valid exists or the
         checkpoint's layout does not match the template.
+
+        On a quorum (multi-host) checkpoint, this process's own shard
+        is preferred, falling back to any committed host's copy — the
+        state is data-parallel replicated, so every shard is the same
+        bits and a slice resuming with FEWER processes (or one) still
+        restores. ``host`` pins a specific shard instead.
         """
-        import jax.numpy as jnp
-
-        from apex_tpu.runtime import HostFlatSpace, cast_bf16_f32
-
         if path is None:
             path = self.latest_valid()
             if path is None:
@@ -412,6 +586,29 @@ class CheckpointManager:
         ok, reason = self.validate(path)
         if not ok:
             raise CheckpointError(f"{path}: {reason}")
+        if self._is_multihost_layout(path):
+            commit = self.read_commit(path)
+            named = sorted(commit.get("hosts") or {})
+            if host is not None:
+                order = [host_dirname(host)]
+                if order[0] not in named:
+                    raise CheckpointError(
+                        f"{path}: host shard {order[0]} not in the commit "
+                        f"manifest (hosts: {named})")
+            else:
+                own = host_dirname(self.process_id)
+                order = ([own] + [h for h in named if h != own]
+                         if own in named else named)
+            # validate() already verified every shard; any one works
+            return self._restore_leaf(os.path.join(path, order[0]),
+                                      template)
+        return self._restore_leaf(path, template)
+
+    def _restore_leaf(self, path: str, template) -> RestoredState:
+        import jax.numpy as jnp
+
+        from apex_tpu.runtime import HostFlatSpace, cast_bf16_f32
+
         manifest = self.read_manifest(path)
         entries = manifest["arrays"]
         space = HostFlatSpace(
@@ -460,4 +657,5 @@ class CheckpointManager:
 
 
 __all__ = ["CheckpointError", "CheckpointManager", "RestoredState",
-           "FORMAT_VERSION", "MANIFEST", "PAYLOAD"]
+           "FORMAT_VERSION", "MANIFEST", "PAYLOAD", "COMMIT",
+           "host_dirname"]

@@ -40,6 +40,24 @@ Env knob grammar (semicolon-separated clauses)::
                                  (simulated on-disk corruption)
 - ``crash=<steps>``              ``SimulatedCrash`` from
                                  :func:`maybe_crash` at these steps
+
+Distributed sites (the guard/quorum tier, docs/resilience.md):
+
+- ``bit_flip=<steps>``           flip ONE bit of the flat master at
+                                 these steps (silent data corruption)
+- ``bit_flip_replica=<r>``       only on replica/process ``r``
+                                 (default: every replica)
+- ``bit_flip_leaf=<i>``          which parameter leaf takes the flip
+                                 (default: element 0 of the buffer)
+- ``crash_before_commit=<steps>`` ``SimulatedCrash`` inside a host's
+                                 quorum-checkpoint save, after the step
+                                 dir is claimed but before the host's
+                                 shard lands — the coordinator must
+                                 time out, refuse the commit, and the
+                                 partial host-set must never be resumed
+- ``sigterm=<steps>``            deliver a REAL ``SIGTERM`` to this
+                                 process at these steps (exercises the
+                                 async-signal preemption path)
 """
 
 from __future__ import annotations
@@ -82,6 +100,12 @@ class FaultInjector:
         default_factory=dict)
     truncate_steps: FrozenSet[int] = frozenset()
     crash_steps: FrozenSet[int] = frozenset()
+    # distributed sites
+    bit_flip_steps: FrozenSet[int] = frozenset()
+    bit_flip_replica: Optional[int] = None   # None -> every replica
+    bit_flip_leaf: Optional[int] = None      # None -> buffer element 0
+    crash_before_commit_steps: FrozenSet[int] = frozenset()
+    sigterm_steps: FrozenSet[int] = frozenset()
 
     def __post_init__(self):
         self._counts: Dict[str, int] = {}
@@ -135,6 +159,49 @@ class FaultInjector:
         if int(step) in self.crash_steps:
             raise SimulatedCrash(f"injected crash at step {int(step)}")
 
+    # -- distributed sites -------------------------------------------------
+
+    def should_bit_flip(self, step: int, replica: int = 0) -> bool:
+        return (int(step) in self.bit_flip_steps
+                and (self.bit_flip_replica is None
+                     or int(replica) == self.bit_flip_replica))
+
+    def flip_bits(self, buf, step: int, replica: int = 0, space=None):
+        """Return ``buf`` with ONE mantissa bit of one element flipped
+        (element 0 of the configured leaf's slice, or of the buffer)
+        when the plan targets (step, replica); unchanged otherwise.
+        The silent-data-corruption model: a value that is still finite
+        and plausible, detectable only bitwise."""
+        if not self.should_bit_flip(step, replica):
+            return buf
+        import jax
+        import jax.numpy as jnp
+
+        idx = 0
+        if self.bit_flip_leaf is not None and space is not None:
+            idx = space.offsets[self.bit_flip_leaf]
+        word = jax.lax.bitcast_convert_type(buf[idx], jnp.uint32)
+        flipped = jax.lax.bitcast_convert_type(
+            word ^ jnp.uint32(1 << 12), buf.dtype)
+        return buf.at[idx].set(flipped)
+
+    def maybe_crash_before_commit(self, step: int) -> None:
+        if int(step) in self.crash_before_commit_steps:
+            raise SimulatedCrash(
+                f"injected host crash before quorum commit at step "
+                f"{int(step)}")
+
+    def maybe_sigterm(self, step: int) -> None:
+        """Deliver a REAL SIGTERM to this process at planned steps —
+        the deterministic stand-in for the scheduler's preemption
+        notice, exercising the actual async-signal path
+        (resilience/guard.py PreemptionHandler)."""
+        if int(step) in self.sigterm_steps:
+            import os as _os
+            import signal as _signal
+
+            _os.kill(_os.getpid(), _signal.SIGTERM)
+
     # -- env knob ----------------------------------------------------------
 
     @classmethod
@@ -155,6 +222,16 @@ class FaultInjector:
                 kw["truncate_steps"] = _int_set(val)
             elif key == "crash":
                 kw["crash_steps"] = _int_set(val)
+            elif key == "bit_flip":
+                kw["bit_flip_steps"] = _int_set(val)
+            elif key == "bit_flip_replica":
+                kw["bit_flip_replica"] = int(val)
+            elif key == "bit_flip_leaf":
+                kw["bit_flip_leaf"] = int(val)
+            elif key == "crash_before_commit":
+                kw["crash_before_commit_steps"] = _int_set(val)
+            elif key == "sigterm":
+                kw["sigterm_steps"] = _int_set(val)
             elif key.startswith("io:"):
                 kw["io_errors"][key[len("io:"):]] = _int_set(val)
             elif key.startswith("io_permanent:"):
@@ -228,8 +305,28 @@ def maybe_crash(step: int) -> None:
         inj.maybe_crash(step)
 
 
+def flip_bits(buf, step: int, replica: int = 0, space=None):
+    inj = active()
+    if inj is None:
+        return buf
+    return inj.flip_bits(buf, step, replica=replica, space=space)
+
+
+def maybe_crash_before_commit(step: int) -> None:
+    inj = active()
+    if inj is not None:
+        inj.maybe_crash_before_commit(step)
+
+
+def maybe_sigterm(step: int) -> None:
+    inj = active()
+    if inj is not None:
+        inj.maybe_sigterm(step)
+
+
 __all__ = [
     "ENV_KNOB", "FaultError", "FaultInjector", "SimulatedCrash",
-    "active", "check", "inject", "install", "maybe_crash",
-    "poison_grads", "should_truncate",
+    "active", "check", "flip_bits", "inject", "install", "maybe_crash",
+    "maybe_crash_before_commit", "maybe_sigterm", "poison_grads",
+    "should_truncate",
 ]

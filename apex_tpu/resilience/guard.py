@@ -1,0 +1,588 @@
+"""Distributed consistency guard: cross-replica divergence detection,
+majority repair, and preemption-safe shutdown.
+
+PR 2's resilience stack makes ONE host survive crashes and NaNs; the
+failure modes that dominate large TPU fleets are distributed. Silent
+data corruption bit-flips one replica's optimizer state and the fleet
+trains on quietly diverged weights; the scheduler SIGTERMs the slice
+mid-step and the last minutes of training evaporate. This module is
+the distributed tier (TorchTitan treats exactly this — replicated-
+state integrity plus interruptible checkpointing — as table stakes):
+
+- **fingerprints** — :func:`state_fingerprint` reduces the master +
+  every slot buffer to per-leaf BITWISE uint32 checksums
+  (``multi_tensor.segmented.segmented_per_leaf_checksum`` — the words
+  of the buffer reinterpreted as integers and summed mod 2^32 through
+  the segmented layout's slot maps). Data-parallel replicas hold
+  bit-identical state by construction, so fingerprints must match
+  exactly; integer addition is order-independent, so they DO match
+  when the state does. The fused train step computes the same
+  reduction in-jit every ``fingerprint_every`` steps
+  (``TrainStep.with_options(fingerprint_every=N)``) so the donation
+  path stays zero-copy and monitoring costs one gated extra read.
+- **detection + repair** — :class:`ConsistencyGuard` wraps a compiled
+  ``TrainStep`` (call-compatible, like the NonfiniteWatchdog). At each
+  fingerprint boundary the local fingerprint is all-gathered over the
+  replica set and compared bitwise. A mismatch is localized to the
+  offending (parameter leaf, buffer, replica), reported as a
+  structured ``resilience`` record, and **repaired**: the state of the
+  agreeing majority is broadcast to the minority, after which the run
+  is bit-identical to an undamaged one. With no majority (1v1 split,
+  three-way disagreement) the guard falls back to the PR-2 rollback
+  ladder — every replica restores the last quorum checkpoint — or
+  raises :class:`DivergenceError` when no manager is attached.
+- **collectives** — the guard talks to its peers through a tiny
+  :class:`Collective` interface: :class:`ProcessCollective` rides
+  ``jax.experimental.multihost_utils`` on a real multi-process
+  deployment; :class:`LocalCollective` runs the identical protocol
+  between threads of one process (the simulated-fleet analog of the
+  8-device CPU mesh the multichip drills use); the default
+  :class:`NullCollective` makes a single replica a no-op.
+- **preemption** — :func:`install_preemption_handler` registers an
+  async-signal-safe SIGTERM/SIGINT handler (it only sets a flag — no
+  allocation, no locks, no I/O in signal context). The step loop
+  drains the flag via :meth:`PreemptionHandler.should_stop`, which
+  runs a cross-host agreement reduction (ANY flagged host stops the
+  fleet — a half-shut-down slice is worse than a stopped one), and
+  :func:`graceful_shutdown` writes a priority final checkpoint behind
+  a barrier and records the event, so a fresh process auto-resumes
+  from the very step the SIGTERM landed on.
+
+Fault sites (apex_tpu/resilience/faults.py): ``bit_flip=<steps>`` +
+``bit_flip_replica``/``bit_flip_leaf`` flips one mantissa bit of one
+replica's master; ``sigterm=<steps>`` delivers a real SIGTERM to the
+process at those steps — both deterministic, both driven from the
+``APEX_TPU_FAULTS`` env grammar.
+"""
+
+from __future__ import annotations
+
+import signal
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+class DivergenceError(RuntimeError):
+    """Cross-replica state divergence that could not be repaired (no
+    agreeing majority and no checkpoint manager to roll back with)."""
+
+    def __init__(self, msg: str, report=None):
+        super().__init__(msg)
+        self.report = report
+
+
+# ---------------------------------------------------------------------------
+# Fingerprints
+# ---------------------------------------------------------------------------
+
+
+class Fingerprint(NamedTuple):
+    """Per-leaf bitwise checksums of one replica's train state."""
+
+    names: Tuple[str, ...]      # buffer names, checkpoint _snapshot order
+    sums: Any                   # (n_buffers, num_leaves) uint32
+    count: int                  # the state's applied-step counter
+
+
+def fingerprint_buffer_names(state) -> Tuple[str, ...]:
+    """Buffer-row names of a state's fingerprint, in the exact order
+    :func:`state_fingerprint_array` stacks them (the checkpoint
+    module's ``_snapshot`` order: master, then sorted slots)."""
+    return ("master",) + tuple(f"slot:{k}" for k in sorted(state.slots))
+
+
+def state_fingerprint_array(state):
+    """JIT-traceable core: (n_buffers, num_leaves) uint32 checksums of
+    ``state.master`` and every slot buffer, reduced through
+    ``segmented_per_leaf_checksum`` (slot maps when the state carries
+    ``seg_meta``, padded-extent routing otherwise)."""
+    import jax.numpy as jnp
+
+    from apex_tpu.multi_tensor.segmented import segmented_per_leaf_checksum
+
+    rows = [segmented_per_leaf_checksum(state.master, state.space,
+                                        state.seg_meta)]
+    for k in sorted(state.slots):
+        rows.append(segmented_per_leaf_checksum(state.slots[k], state.space,
+                                                state.seg_meta))
+    return jnp.stack(rows)
+
+
+_FP_JITTED = None
+
+
+def state_fingerprint(state) -> Fingerprint:
+    """Host-side fingerprint of a ``FlatOptState`` (one jitted
+    reduction over master + slots; cold path — the in-jit variant
+    rides the train step's aux, see ``fingerprint_every``)."""
+    global _FP_JITTED
+    if _FP_JITTED is None:
+        import jax
+
+        _FP_JITTED = jax.jit(state_fingerprint_array)
+    sums = np.asarray(_FP_JITTED(state))
+    return Fingerprint(names=fingerprint_buffer_names(state),
+                       sums=sums, count=int(state.count))
+
+
+class DivergenceReport(NamedTuple):
+    """Outcome of comparing one fingerprint per replica."""
+
+    divergent: bool
+    has_quorum: bool                    # a strict majority agrees
+    majority_replica: Optional[int]     # lowest-id member of the majority
+    minority_replicas: Tuple[int, ...]  # replicas needing repair
+    # (replica, buffer_row, leaf) triples that disagree with the majority
+    sites: Tuple[Tuple[int, int, int], ...]
+
+
+def compare_fingerprints(stacked: np.ndarray) -> DivergenceReport:
+    """Compare replicas' fingerprints bitwise.
+
+    ``stacked`` is ``(n_replicas, n_buffers, num_leaves)`` uint32. The
+    majority is the most common full-fingerprint value (ties broken
+    toward the lowest replica id holding it); a *quorum* is a strict
+    majority of the replica set. Pure and deterministic, so every
+    replica computes the identical report from the identical gather.
+    """
+    stacked = np.asarray(stacked)
+    n = stacked.shape[0]
+    groups: Dict[bytes, List[int]] = {}
+    for r in range(n):
+        groups.setdefault(stacked[r].tobytes(), []).append(r)
+    if len(groups) == 1:
+        return DivergenceReport(False, True, 0, (), ())
+    # most members, then lowest leader id
+    best = max(groups.values(), key=lambda ms: (len(ms), -ms[0]))
+    has_quorum = len(best) * 2 > n
+    majority = best[0] if has_quorum else None
+    minority = tuple(r for r in range(n) if r not in best)
+    sites: List[Tuple[int, int, int]] = []
+    ref = stacked[best[0]]
+    for r in minority:
+        for b, leaf in zip(*np.nonzero(stacked[r] != ref)):
+            sites.append((int(r), int(b), int(leaf)))
+    return DivergenceReport(True, has_quorum, majority, minority,
+                            tuple(sites))
+
+
+# ---------------------------------------------------------------------------
+# Collectives
+# ---------------------------------------------------------------------------
+
+
+class Collective:
+    """The minimal replica-set interface the guard needs. Replicas are
+    the members of the data axis that hold (supposedly) bit-identical
+    state — one per host process on a multi-host deployment."""
+
+    n_replicas: int = 1
+    replica_id: int = 0
+
+    def all_gather(self, arr: np.ndarray) -> np.ndarray:
+        """(n_replicas, *arr.shape) — every replica's copy, by id."""
+        raise NotImplementedError
+
+    def broadcast_from(self, src: int,
+                       arrays: Sequence[np.ndarray]) -> List[np.ndarray]:
+        """Every replica returns replica ``src``'s ``arrays``. A
+        collective op: ALL replicas must call it."""
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        """Block until every replica has arrived."""
+        raise NotImplementedError
+
+    def agree_any(self, flag: bool) -> bool:
+        """True on every replica iff ANY replica passed True."""
+        out = self.all_gather(np.asarray([1 if flag else 0], np.int32))
+        return bool(np.any(out))
+
+
+class NullCollective(Collective):
+    """Single replica: gathers are identity, broadcasts echo."""
+
+    def all_gather(self, arr: np.ndarray) -> np.ndarray:
+        return np.asarray(arr)[None]
+
+    def broadcast_from(self, src, arrays):
+        return [np.asarray(a) for a in arrays]
+
+    def barrier(self) -> None:
+        pass
+
+
+class ProcessCollective(Collective):
+    """Real multi-process replica set over
+    ``jax.experimental.multihost_utils`` (one replica per host process;
+    requires ``jax.distributed.initialize`` — see
+    ``apex_tpu.parallel.multiproc.initialize_distributed``)."""
+
+    def __init__(self):
+        import jax
+
+        self.n_replicas = jax.process_count()
+        self.replica_id = jax.process_index()
+
+    def all_gather(self, arr: np.ndarray) -> np.ndarray:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(
+            multihost_utils.process_allgather(np.asarray(arr)))
+
+    def broadcast_from(self, src, arrays):
+        from jax.experimental import multihost_utils
+
+        out = multihost_utils.broadcast_one_to_all(
+            tuple(np.asarray(a) for a in arrays),
+            is_source=self.replica_id == src)
+        return [np.asarray(a) for a in out]
+
+    def barrier(self) -> None:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices("apex_tpu_guard_barrier")
+
+
+class LocalCollective:
+    """An in-process replica set: ``handles(n)`` returns one
+    :class:`Collective` per simulated host, synchronized with barriers.
+    Each replica runs the SAME loop code a real host would, on its own
+    thread — the threaded analog of the repo's simulated 8-device CPU
+    mesh, and what tests/test_guard.py and the fleet drills drive.
+    """
+
+    def __init__(self, n_replicas: int, timeout: float = 60.0):
+        self.n_replicas = int(n_replicas)
+        self.timeout = float(timeout)
+        self._barrier = threading.Barrier(self.n_replicas)
+        self._lock = threading.Lock()
+        self._slots: Dict[int, Any] = {}
+
+    def handles(self) -> List["_LocalHandle"]:
+        return [_LocalHandle(self, r) for r in range(self.n_replicas)]
+
+    def _exchange(self, replica_id: int, value):
+        """All replicas deposit, then all read the full slot map."""
+        with self._lock:
+            self._slots[replica_id] = value
+        self._barrier.wait(self.timeout)
+        out = dict(self._slots)
+        # second barrier: nobody may start the NEXT exchange (and
+        # overwrite slots) until everyone has read this one
+        self._barrier.wait(self.timeout)
+        return out
+
+
+class _LocalHandle(Collective):
+    def __init__(self, group: LocalCollective, replica_id: int):
+        self.group = group
+        self.n_replicas = group.n_replicas
+        self.replica_id = int(replica_id)
+
+    def all_gather(self, arr: np.ndarray) -> np.ndarray:
+        slots = self.group._exchange(self.replica_id, np.asarray(arr))
+        return np.stack([slots[r] for r in range(self.n_replicas)])
+
+    def broadcast_from(self, src, arrays):
+        mine = ([np.asarray(a) for a in arrays]
+                if self.replica_id == src else None)
+        slots = self.group._exchange(self.replica_id, mine)
+        return [np.copy(a) for a in slots[src]]
+
+    def barrier(self) -> None:
+        self.group._barrier.wait(self.group.timeout)
+
+
+# ---------------------------------------------------------------------------
+# The guard
+# ---------------------------------------------------------------------------
+
+
+class ConsistencyGuard:
+    """Wrap a compiled ``TrainStep`` with cross-replica divergence
+    detection and majority repair (module docstring). Call-compatible
+    with the wrapped step — same donation contract; rebind state and
+    scaler_state to the returned values.
+
+    Build the inner step with
+    ``step.with_options(fingerprint_every=N)`` so the checksums ride
+    the jitted program's aux (in-jit, donation-safe, computed only at
+    boundaries); the guard then never re-reads the state on the hot
+    path. A step without the option still works — the guard falls back
+    to the cold-path :func:`state_fingerprint` at each boundary.
+
+    At a boundary (``state.count`` hits a multiple of
+    ``fingerprint_every``, checked once per new count value):
+
+    1. all-gather fingerprints over ``collective``;
+    2. identical everywhere -> done (the overwhelmingly common case);
+    3. divergent with a quorum -> structured ``resilience`` record
+       (event ``replica_divergence``, sites localized to buffer +
+       parameter leaf + replica), then the majority replica's full
+       state is broadcast and the minority adopts it — every replica
+       leaves the boundary bit-identical;
+    4. divergent with NO quorum -> record, then every replica restores
+       ``manager.latest_valid()`` (the PR-2 rollback ladder), or
+       :class:`DivergenceError` with the report when no manager.
+    """
+
+    def __init__(self, step, *, collective: Optional[Collective] = None,
+                 fingerprint_every: Optional[int] = None, manager=None,
+                 record_kind: str = "resilience", on_event=None):
+        self.step = step
+        self.collective = collective or NullCollective()
+        every = (fingerprint_every if fingerprint_every is not None
+                 else step.options.get("fingerprint_every"))
+        if not every or int(every) <= 0:
+            raise ValueError(
+                "fingerprint_every must be a positive int (pass it here "
+                "or build the step with_options(fingerprint_every=N))")
+        self.fingerprint_every = int(every)
+        self._aux_carries_fp = (
+            step.options.get("fingerprint_every") == self.fingerprint_every)
+        self.manager = manager
+        self.record_kind = record_kind
+        self.on_event = on_event
+        self.last_report: Optional[DivergenceReport] = None
+        self.last_event: Optional[Dict[str, Any]] = None
+        self.repairs = 0
+        self.rollbacks = 0
+        self._last_checked_count = -1
+
+    def __call__(self, state, flat_grads, scaler_state=None, *, lr=None):
+        outs = self.step(state, flat_grads, scaler_state, lr=lr)
+        if self.step.scaler is not None:
+            new_state, new_sstate, aux = outs
+        else:
+            new_state, aux = outs
+            new_sstate = None
+        count = int(new_state.count)
+        if (count % self.fingerprint_every != 0
+                or count == self._last_checked_count):
+            return outs
+        self._last_checked_count = count
+        new_state = self._check(new_state, aux)
+        if self.step.scaler is not None:
+            return new_state, new_sstate, aux
+        return new_state, aux
+
+    # -- boundary ----------------------------------------------------------
+
+    def _local_sums(self, state, aux) -> np.ndarray:
+        if self._aux_carries_fp and aux.state_fingerprint is not None:
+            return np.asarray(aux.state_fingerprint)
+        return state_fingerprint(state).sums
+
+    def _check(self, state, aux):
+        col = self.collective
+        if col.n_replicas <= 1:
+            return state
+        sums = self._local_sums(state, aux)
+        # one payload: [count | flattened sums] so step agreement and
+        # state agreement ride a single gather
+        payload = np.concatenate(
+            [np.asarray([int(state.count)], np.uint32), sums.reshape(-1)])
+        gathered = col.all_gather(payload)
+        counts = gathered[:, 0].astype(np.int64)
+        if len(set(counts.tolist())) != 1:
+            raise DivergenceError(
+                f"replicas are at different step counts {counts.tolist()} "
+                "— the fleet lost lockstep (check data sharding and "
+                "skipped-step divergence) and fingerprints cannot be "
+                "compared")
+        report = compare_fingerprints(
+            gathered[:, 1:].reshape((col.n_replicas,) + sums.shape))
+        self.last_report = report
+        if not report.divergent:
+            return state
+        return self._repair(state, report)
+
+    def _repair(self, state, report: DivergenceReport):
+        from apex_tpu import records
+        from apex_tpu.resilience.watchdog import leaf_names
+
+        col = self.collective
+        names = leaf_names(state.space)
+        buffers = fingerprint_buffer_names(state)
+        sites = [{"replica": r, "buffer": buffers[b], "leaf": leaf,
+                  "name": names[leaf]}
+                 for r, b, leaf in report.sites]
+        action = ("majority_repair" if report.has_quorum
+                  else ("rollback" if self.manager is not None
+                        else "unrecoverable"))
+        event = {
+            "event": "replica_divergence",
+            "n_replicas": col.n_replicas,
+            "replica_id": col.replica_id,
+            "count": int(state.count),
+            "has_quorum": report.has_quorum,
+            "majority_replica": report.majority_replica,
+            "minority_replicas": list(report.minority_replicas),
+            "sites": sites,
+            "action": action,
+        }
+        self.last_event = event
+        records.write_record(self.record_kind, event)
+        if self.on_event is not None:
+            self.on_event(event)
+
+        if report.has_quorum:
+            self.repairs += 1
+            return self._adopt_majority(state, report.majority_replica)
+        if self.manager is not None:
+            self.rollbacks += 1
+            col.barrier()          # nobody restores while a peer still saves
+            restored = self.manager.restore(template=state)
+            return restored.opt_state
+        raise DivergenceError(
+            f"replica state diverged with no agreeing majority "
+            f"({col.n_replicas} replicas, sites: "
+            f"{[s['name'] for s in sites] or 'unlocalized'}) and no "
+            "checkpoint manager to roll back with", report=report)
+
+    def _adopt_majority(self, state, src: int):
+        """Broadcast the majority replica's buffers; every replica
+        rebuilds its state from the received copy (bit-identical for
+        agreeing members, the repair for the minority)."""
+        import jax.numpy as jnp
+
+        keys = sorted(state.slots)
+        local = ([np.asarray(state.master)]
+                 + [np.asarray(state.slots[k]) for k in keys]
+                 + [np.asarray(state.count), np.asarray(state.found_inf)])
+        got = self.collective.broadcast_from(src, local)
+        master, slot_vals = got[0], got[1:1 + len(keys)]
+        count, found_inf = got[-2], got[-1]
+        return state._replace(
+            master=jnp.asarray(master),
+            slots={k: jnp.asarray(v) for k, v in zip(keys, slot_vals)},
+            count=jnp.asarray(count, jnp.int32),
+            found_inf=jnp.asarray(found_inf, jnp.float32))
+
+
+# ---------------------------------------------------------------------------
+# Preemption-safe shutdown
+# ---------------------------------------------------------------------------
+
+
+class PreemptionHandler:
+    """Flag-drain preemption protocol.
+
+    The signal handler body is async-signal-safe: it assigns two
+    attributes and nothing else (no allocation beyond an int, no
+    locks, no I/O — everything heavy happens later, on the step loop's
+    thread, when it polls :meth:`should_stop`).
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self.requested = False
+        self.signum: Optional[int] = None
+        self._previous: Dict[int, Any] = {}
+        self._installed = False
+
+    # the signal-context entry point — keep it trivial
+    def _handle(self, signum, frame):  # noqa: ARG002
+        self.requested = True
+        self.signum = signum
+
+    def install(self) -> "PreemptionHandler":
+        """Register on the configured signals (main thread only, per
+        the ``signal`` module's contract); previous handlers are saved
+        and restored by :meth:`uninstall`."""
+        for s in self.signals:
+            self._previous[s] = signal.signal(s, self._handle)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        for s, prev in self._previous.items():
+            signal.signal(s, prev)
+        self._previous.clear()
+        self._installed = False
+
+    def should_stop(self, collective: Optional[Collective] = None) -> bool:
+        """Drain point for the step loop. With a collective, runs the
+        cross-host agreement reduction: ANY flagged host stops the
+        whole fleet (the scheduler rarely signals every host in the
+        same instant; a fleet that half-stops deadlocks its next
+        collective). Without one, just the local flag."""
+        if collective is None or collective.n_replicas <= 1:
+            return self.requested
+        return collective.agree_any(self.requested)
+
+    def __enter__(self):
+        return self.install()
+
+    def __exit__(self, *exc):
+        self.uninstall()
+        return False
+
+
+def install_preemption_handler(
+        signals=(signal.SIGTERM, signal.SIGINT)) -> PreemptionHandler:
+    """Install and return a :class:`PreemptionHandler` (see
+    :class:`PreemptionHandler` and docs/resilience.md "Preemption")."""
+    return PreemptionHandler(signals).install()
+
+
+def graceful_shutdown(manager, step: int, state, *, scaler_state=None,
+                      rng_state=None, extra=None,
+                      collective: Optional[Collective] = None,
+                      handler: Optional[PreemptionHandler] = None,
+                      record_kind: str = "resilience") -> str:
+    """The drain action: cross-host barrier, priority final checkpoint,
+    structured record. Returns the checkpoint path; the caller exits
+    its loop afterwards and a fresh process auto-resumes from
+    ``manager.latest_valid()`` (tests/test_guard.py pins the round
+    trip).
+
+    The barrier runs FIRST so no host checkpoints while a peer is
+    still mid-step (a multi-host quorum save needs every host's shard;
+    see checkpoint.py's quorum mode). Any in-flight async save is
+    drained, then the final save runs SYNCHRONOUSLY — on SIGTERM there
+    is no later step to overlap with, only a kill deadline.
+    """
+    from apex_tpu import records
+
+    col = collective or NullCollective()
+    col.barrier()
+    manager.wait()
+    was_async = manager.async_save
+    manager.async_save = False
+    try:
+        path = manager.save(step, state, scaler_state=scaler_state,
+                            rng_state=rng_state, extra=extra)
+    finally:
+        manager.async_save = was_async
+    records.write_record(record_kind, {
+        "event": "preemption_checkpoint",
+        "step": int(step),
+        "signum": handler.signum if handler is not None else None,
+        "path": path,
+        "n_replicas": col.n_replicas,
+        "replica_id": col.replica_id,
+    })
+    return path
+
+
+__all__ = [
+    "Collective",
+    "ConsistencyGuard",
+    "DivergenceError",
+    "DivergenceReport",
+    "Fingerprint",
+    "LocalCollective",
+    "NullCollective",
+    "PreemptionHandler",
+    "ProcessCollective",
+    "compare_fingerprints",
+    "fingerprint_buffer_names",
+    "graceful_shutdown",
+    "install_preemption_handler",
+    "state_fingerprint",
+    "state_fingerprint_array",
+]

@@ -21,6 +21,13 @@ Design points:
   and get bit-identical schedules.
 - **injectable clock/sleep**: ``sleep`` and ``monotonic`` are
   parameters, so tests run the full schedule in microseconds.
+- **non-retryable allowlist**: exceptions in ``give_up_on`` (plus the
+  module default ``NON_RETRYABLE``) pass through IMMEDIATELY even when
+  they match ``retry_on`` — a ctrl-C, an interpreter shutdown, or a
+  checkpoint that failed VALIDATION (``CheckpointError`` is
+  deterministic: the bytes on disk will hash the same on every
+  attempt) must not burn the deadline pretending to be a transient
+  disk hiccup.
 """
 
 from __future__ import annotations
@@ -31,6 +38,12 @@ import time
 from typing import Callable, Optional, Tuple, Type
 
 _RNG = random.Random()
+
+# Never retried, whatever retry_on says: retrying cannot change the
+# outcome (deterministic failures) or actively fights the user/runtime
+# (interrupts, shutdown). Extended per call via ``give_up_on``.
+NON_RETRYABLE: Tuple[Type[BaseException], ...] = (
+    KeyboardInterrupt, SystemExit)
 
 
 def backoff_delays(retries: int, *, base_delay: float = 0.05,
@@ -59,6 +72,7 @@ def retry_call(
     jitter: float = 0.5,
     deadline: Optional[float] = None,
     retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    give_up_on: Tuple[Type[BaseException], ...] = (),
     on_retry: Optional[Callable[[int, BaseException, float], None]] = None,
     sleep: Callable[[float], None] = time.sleep,
     monotonic: Callable[[], float] = time.monotonic,
@@ -70,14 +84,23 @@ def retry_call(
     exponential backoff, jitter, and an optional total ``deadline`` in
     seconds. The last exception is re-raised unchanged when the budget
     is exhausted (callers keep catching the original type).
-    ``on_retry(attempt, exc, delay)`` fires before each sleep."""
+    ``on_retry(attempt, exc, delay)`` fires before each sleep.
+
+    ``give_up_on`` exceptions (always including :data:`NON_RETRYABLE`)
+    re-raise from the FIRST attempt even when they also match
+    ``retry_on`` — the escape hatch for deterministic failures dressed
+    as I/O errors (e.g. a ``CheckpointError`` raised on validation:
+    the same bytes fail the same way on every retry)."""
     rng = rng if rng is not None else _RNG
+    no_retry = NON_RETRYABLE + tuple(give_up_on)
     start = monotonic()
     attempt = 0
     while True:
         try:
             return fn(*args, **kwargs)
         except retry_on as e:
+            if isinstance(e, no_retry):
+                raise
             if attempt >= retries:
                 raise
             delay = min(max_delay, base_delay * (factor ** attempt))
@@ -109,4 +132,4 @@ def retry(**policy):
     return deco
 
 
-__all__ = ["backoff_delays", "retry", "retry_call"]
+__all__ = ["NON_RETRYABLE", "backoff_delays", "retry", "retry_call"]

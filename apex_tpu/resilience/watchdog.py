@@ -47,6 +47,15 @@ class RollbackLimitExceeded(RuntimeError):
         self.suspects = suspects or []
 
 
+class RollbackUnavailable(RollbackLimitExceeded):
+    """Escalation demanded a rollback but the attached manager has NO
+    valid checkpoint (cold start: empty or absent directory). Raised
+    immediately — looping scaler resets against a persistent NaN
+    source and then reporting "survived N rollbacks" would blame
+    rollbacks that never happened. The message names the directory so
+    the operator can tell a wrong path from a genuinely cold run."""
+
+
 def leaf_names(space) -> List[str]:
     """Human-readable key paths for every leaf of a ``FlatSpace``, in
     flat-buffer order (``['w']`` -> ``"['w']"`` etc.)."""
@@ -145,9 +154,18 @@ class NonfiniteWatchdog:
         restored = None
         if self.manager is not None:
             path = self.manager.latest_valid()
-            if path is not None:
-                restored = self.manager.restore(path, template=state)
-                action = "rollback"
+            if path is None:
+                raise RollbackUnavailable(
+                    "nonfinite gradients escalated past the skip "
+                    f"threshold ({self.consecutive_skips} consecutive "
+                    "skips) but the checkpoint directory "
+                    f"{self.manager.directory!r} holds no valid "
+                    "checkpoint to roll back to (cold start, or the "
+                    "wrong directory); suspects: "
+                    f"{[s['name'] for s in suspects] or 'unlocalized'}",
+                    suspects=suspects)
+            restored = self.manager.restore(path, template=state)
+            action = "rollback"
         new_sstate = scaler_state
         if self.scaler is not None:
             # re-initialized loss scale: the ground-down (or pinned-at-
@@ -202,4 +220,4 @@ class NonfiniteWatchdog:
 
 
 __all__ = ["NonfiniteWatchdog", "RollbackLimitExceeded",
-           "leaf_names", "localize_nonfinite"]
+           "RollbackUnavailable", "leaf_names", "localize_nonfinite"]

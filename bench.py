@@ -41,7 +41,11 @@ Accelerator modes emit absolute accounting (model_flops / tflops_per_sec
 / mfu, or HBM GB/s for the bandwidth-bound optimizer step) alongside the
 relative ratios. All runs take the single-slot TPU lock and retry the
 backend probe for APEX_TPU_BENCH_PROBE_BUDGET seconds (default 600)
-before consenting to a CPU-fallback record.
+before consenting to a CPU-fallback record. The probe VERDICT is cached
+(in-process + on-disk TTL, APEX_TPU_BACKEND_PROBE_CACHE_TTL, default
+300 s): a dead tunnel burns its 120 s probe timeouts once per window,
+not once per invocation, and a reused verdict is named in every
+record's detail (``backend_probe: {cached, age_s, ...}``).
 """
 
 import json
@@ -731,9 +735,11 @@ def bench_resilience():
     """Fault-tolerance overhead accounting (docs/resilience.md): atomic
     checkpoint save/restore latency + payload bandwidth over the flat
     host buffers, async-save submit latency (what the training loop
-    actually blocks on), and steps-to-recover — how many steps an
-    injected persistent-NaN burst costs end to end through the
-    NonfiniteWatchdog's skip -> localize -> rollback ladder."""
+    actually blocks on), steps-to-recover — how many steps an injected
+    persistent-NaN burst costs end to end through the
+    NonfiniteWatchdog's skip -> localize -> rollback ladder — and the
+    consistency guard's fingerprint cost (the per-boundary price of
+    cross-replica divergence detection, resilience/guard.py)."""
     import os
     import shutil
     import tempfile
@@ -824,6 +830,19 @@ def bench_resilience():
         steps_to_recover = (None if recovered_at is None
                             else recovered_at - first_bad + 1)
         rolled_back = wd.escalations > 0
+
+        # consistency-guard fingerprint: the cold-path jitted checksum
+        # reduction over master + slots — what one divergence-detection
+        # boundary costs a replica before the (tiny) all-gather
+        from apex_tpu.resilience.guard import state_fingerprint
+
+        state_fingerprint(state2)                  # compile + warm
+        fp_reps = 3 if on_cpu else 10
+        t0 = time.perf_counter()
+        for _ in range(fp_reps):
+            fp = state_fingerprint(state2)
+        fingerprint_s = (time.perf_counter() - t0) / fp_reps
+        fp_state_mb = state2.space.total * 4 * (1 + len(state2.slots)) / 1e6
     finally:
         _records.RECORDS_DIR = records_dir_save
         shutil.rmtree(workdir, ignore_errors=True)
@@ -844,6 +863,11 @@ def bench_resilience():
             "steps_to_recover": steps_to_recover,
             "recover_ms": round(recover_s * 1e3, 1),
             "watchdog_rolled_back": rolled_back,
+            "fingerprint_ms": round(fingerprint_s * 1e3, 2),
+            "fingerprint_state_mb": round(fp_state_mb, 1),
+            "fingerprint_gb_per_sec": round(
+                fp_state_mb / 1e3 / fingerprint_s, 1),
+            "fingerprint_leaves": int(fp.sums.shape[1]),
             **backend_detail(),
         },
     }, "resilience")

@@ -81,9 +81,16 @@ class TestEnsureBackend:
         assert report.n_devices >= 1
         assert "backend" in report.as_detail()
 
-    def test_retry_budget_retries_probe(self, monkeypatch):
+    @staticmethod
+    def _isolate_probe_cache(monkeypatch, tmp_path):
+        monkeypatch.setenv("APEX_TPU_BACKEND_PROBE_CACHE",
+                           str(tmp_path / "probe_cache.json"))
+        monkeypatch.setattr(bg, "_PROBE_VERDICT", None)
+
+    def test_retry_budget_retries_probe(self, monkeypatch, tmp_path):
         import jax._src.xla_bridge as xb
 
+        self._isolate_probe_cache(monkeypatch, tmp_path)
         calls = []
 
         def fake_probe(timeout=None):
@@ -99,9 +106,10 @@ class TestEnsureBackend:
         assert len(calls) >= 2          # retried, not one-shot
         assert "after" in report.note   # attempt count recorded
 
-    def test_zero_budget_single_probe(self, monkeypatch):
+    def test_zero_budget_single_probe(self, monkeypatch, tmp_path):
         import jax._src.xla_bridge as xb
 
+        self._isolate_probe_cache(monkeypatch, tmp_path)
         calls = []
         monkeypatch.setattr(
             bg, "probe_default_backend",
@@ -112,3 +120,63 @@ class TestEnsureBackend:
         report = bg.ensure_backend(min_devices=1, retry_budget=0.0)
         assert report.fallback and len(calls) == 1
         assert report.as_detail()["backend_fallback"] == "down"
+
+    def test_failed_verdict_cached_across_invocations(self, monkeypatch,
+                                                      tmp_path):
+        # invocation 1 burns the probe honestly; invocation 2 (fresh
+        # "process": in-process verdict cleared, disk cache kept) must
+        # reuse the failure verdict instead of re-probing 4x120s
+        import jax._src.xla_bridge as xb
+
+        self._isolate_probe_cache(monkeypatch, tmp_path)
+        calls = []
+        monkeypatch.setattr(
+            bg, "probe_default_backend",
+            lambda timeout=None: (calls.append(1)
+                                  or {"ok": False, "error": "probe timed "
+                                      "out after 120s"}))
+        monkeypatch.setattr(xb, "backends_are_initialized", lambda: False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        r1 = bg.ensure_backend(min_devices=1, retry_budget=0.0)
+        assert r1.fallback and len(calls) == 1
+
+        monkeypatch.setattr(bg, "_PROBE_VERDICT", None)  # "new process"
+        # force_cpu_backend pinned JAX_PLATFORMS; a fresh invocation
+        # starts without the pin
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        r2 = bg.ensure_backend(min_devices=1, retry_budget=0.0)
+        assert r2.fallback and len(calls) == 1           # no new probe
+        assert r2.probe.get("cached") is True
+        assert "cached probe verdict" in r2.note
+        # the cached verdict flows into the bench-record detail
+        d = r2.as_detail()
+        assert d["backend_probe"]["cached"] is True
+        assert "age_s" in d["backend_probe"]
+
+    def test_cache_ttl_zero_disables(self, monkeypatch, tmp_path):
+        import jax._src.xla_bridge as xb
+
+        self._isolate_probe_cache(monkeypatch, tmp_path)
+        monkeypatch.setenv("APEX_TPU_BACKEND_PROBE_CACHE_TTL", "0")
+        calls = []
+        monkeypatch.setattr(
+            bg, "probe_default_backend",
+            lambda timeout=None: (calls.append(1)
+                                  or {"ok": False, "error": "down"}))
+        monkeypatch.setattr(xb, "backends_are_initialized", lambda: False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        bg.ensure_backend(min_devices=1, retry_budget=0.0)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        bg.ensure_backend(min_devices=1, retry_budget=0.0)
+        assert len(calls) == 2           # every invocation probes fresh
+
+    def test_stale_verdict_ignored(self, monkeypatch, tmp_path):
+        self._isolate_probe_cache(monkeypatch, tmp_path)
+        bg.store_probe_verdict({"ok": False, "error": "old news"})
+        monkeypatch.setattr(bg, "_PROBE_VERDICT", None)
+        import json as _json
+        path = tmp_path / "probe_cache.json"
+        rec = _json.loads(path.read_text())
+        rec["wall_time"] -= 10_000.0     # far beyond any sane TTL
+        path.write_text(_json.dumps(rec))
+        assert bg.cached_probe_verdict() is None

@@ -1,0 +1,487 @@
+"""Distributed consistency guard (apex_tpu/resilience/guard.py):
+bitwise state fingerprints, cross-replica divergence detection +
+majority repair, no-quorum fallback, and preemption-safe shutdown.
+
+Acceptance bar (ISSUE 3): an injected one-replica bit-flip
+(APEX_TPU_FAULTS ``bit_flip`` site) is detected within
+``fingerprint_every`` steps, localized to the correct parameter leaf +
+replica in the structured ``resilience`` record, and after majority
+repair the run is bitwise-identical to an uninjected run from the next
+fingerprint boundary on; SIGTERM mid-step produces a final checkpoint
+a fresh process auto-resumes from.
+
+Replica sets are simulated with ``LocalCollective`` — one thread per
+"host", every thread running the same loop code a real host would,
+barrier-synchronized inside the collective ops (the threaded analog of
+the repo's simulated 8-device CPU mesh).
+"""
+
+import os
+import signal
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import records
+from apex_tpu.multi_tensor.ops import per_tensor_l2norm
+from apex_tpu.multi_tensor.segmented import segmented_per_leaf_checksum
+from apex_tpu.optimizers import FusedAdam, FusedLAMB
+from apex_tpu.optimizers.train_step import make_train_step
+from apex_tpu.resilience import (
+    CheckpointManager,
+    ConsistencyGuard,
+    DivergenceError,
+    FaultInjector,
+    LocalCollective,
+    NullCollective,
+    PreemptionHandler,
+    compare_fingerprints,
+    faults,
+    graceful_shutdown,
+    install_preemption_handler,
+    state_fingerprint,
+)
+from apex_tpu.resilience.guard import fingerprint_buffer_names
+
+
+def _params(seed=0):
+    r = np.random.RandomState(seed)
+    return {"b": jnp.zeros((6,), jnp.float32),
+            "w1": jnp.asarray(r.randn(32, 6), jnp.float32),
+            "w2": jnp.asarray(r.randn(6, 6), jnp.float32)}
+
+
+@pytest.fixture
+def records_dir(tmp_path, monkeypatch):
+    path = tmp_path / "records"
+    monkeypatch.setattr(records, "RECORDS_DIR", str(path))
+    return path
+
+
+def _flip_one_bit(buf, idx, bit=12):
+    word = jax.lax.bitcast_convert_type(buf[idx], jnp.uint32)
+    val = jax.lax.bitcast_convert_type(word ^ jnp.uint32(1 << bit),
+                                       jnp.float32)
+    return buf.at[idx].set(val)
+
+
+class TestChecksum:
+    def test_segmented_matches_plain_routing(self):
+        opt = FusedLAMB(lr=1e-3, impl="xla", segmented=True)
+        st = opt.init(_params())
+        r = np.random.RandomState(0)
+        gtree = {k: jnp.asarray(r.randn(*v.shape), jnp.float32)
+                 for k, v in _params().items()}
+        buf = st.space.pack(gtree, dtype=jnp.float32)
+        seg = np.asarray(segmented_per_leaf_checksum(buf, st.space,
+                                                     st.seg_meta))
+        plain = np.asarray(segmented_per_leaf_checksum(buf, st.space, None))
+        assert seg.dtype == np.uint32
+        np.testing.assert_array_equal(seg, plain)
+
+    def test_single_bit_flip_changes_exactly_its_leaf(self):
+        opt = FusedLAMB(lr=1e-3, impl="xla", segmented=True)
+        st = opt.init(_params())
+        base = np.asarray(segmented_per_leaf_checksum(
+            st.master, st.space, st.seg_meta))
+        flipped = _flip_one_bit(st.master, st.space.offsets[1] + 3)
+        after = np.asarray(segmented_per_leaf_checksum(
+            flipped, st.space, st.seg_meta))
+        diff = np.nonzero(after != base)[0]
+        np.testing.assert_array_equal(diff, [1])       # only 'w1'
+
+    def test_checksum_is_value_blind_but_bit_exact(self):
+        # two buffers equal as floats but different bits (0.0 vs -0.0)
+        # MUST fingerprint differently: the guard is bitwise, not
+        # numeric
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        st = opt.init(_params())
+        buf = st.space.zeros()
+        neg = buf.at[0].set(-0.0)
+        a = np.asarray(segmented_per_leaf_checksum(buf, st.space, None))
+        b = np.asarray(segmented_per_leaf_checksum(neg, st.space, None))
+        assert np.asarray(buf[0]) == np.asarray(neg[0])   # numerically ==
+        assert not np.array_equal(a, b)                   # bitwise !=
+
+    def test_state_fingerprint_covers_master_and_slots(self):
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        st = opt.init(_params())
+        fp = state_fingerprint(st)
+        assert fp.names == ("master", "slot:m", "slot:v")
+        assert fp.names == fingerprint_buffer_names(st)
+        assert fp.sums.shape == (3, st.space.num_leaves)
+        # a flip in a SLOT buffer is caught too (SDC doesn't pick
+        # polite targets)
+        st2 = st._replace(slots={**st.slots,
+                                 "m": _flip_one_bit(st.slots["m"], 0)})
+        fp2 = state_fingerprint(st2)
+        assert not np.array_equal(fp.sums[1], fp2.sums[1])
+        np.testing.assert_array_equal(fp.sums[0], fp2.sums[0])
+
+
+class TestCompare:
+    def test_identical_is_clean(self):
+        a = np.arange(6, dtype=np.uint32).reshape(2, 3)
+        rep = compare_fingerprints(np.stack([a, a, a]))
+        assert not rep.divergent and rep.has_quorum
+
+    def test_majority_localizes_minority(self):
+        a = np.zeros((2, 3), np.uint32)
+        b = a.copy()
+        b[1, 2] = 7
+        rep = compare_fingerprints(np.stack([a, b, a]))
+        assert rep.divergent and rep.has_quorum
+        assert rep.majority_replica == 0
+        assert rep.minority_replicas == (1,)
+        assert rep.sites == ((1, 1, 2),)
+
+    def test_one_vs_one_has_no_quorum(self):
+        a = np.zeros((1, 2), np.uint32)
+        b = a + 1
+        rep = compare_fingerprints(np.stack([a, b]))
+        assert rep.divergent and not rep.has_quorum
+        assert rep.majority_replica is None
+
+    def test_three_way_split_has_no_quorum(self):
+        a = np.zeros((1, 1), np.uint32)
+        rep = compare_fingerprints(np.stack([a, a + 1, a + 2]))
+        assert rep.divergent and not rep.has_quorum
+
+
+class TestFingerprintOption:
+    def test_aux_fingerprint_at_boundaries_only(self):
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        st = opt.init(_params())
+        step = make_train_step(opt, fingerprint_every=3)
+        assert step.options["fingerprint_every"] == 3
+        r = np.random.RandomState(0)
+        g = jnp.asarray(r.randn(st.space.total).astype(np.float32) * 0.01)
+        for _ in range(6):
+            st, aux = step(st, g)
+            fp = np.asarray(aux.state_fingerprint)
+            if int(st.count) % 3 == 0:
+                np.testing.assert_array_equal(fp, state_fingerprint(st).sums)
+            else:
+                assert not fp.any()       # gated off-boundary
+
+    def test_with_options_builds_fingerprint_sibling(self):
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        base = make_train_step(opt)
+        assert base.options["fingerprint_every"] is None
+        sib = base.with_options(fingerprint_every=4)
+        assert sib.options["fingerprint_every"] == 4
+        assert sib is base.with_options(fingerprint_every=4)   # cached
+        with pytest.raises(ValueError, match="positive"):
+            make_train_step(opt, fingerprint_every=0)
+
+    def test_guard_requires_an_interval(self):
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        step = make_train_step(opt)
+        with pytest.raises(ValueError, match="fingerprint_every"):
+            ConsistencyGuard(step, collective=NullCollective())
+
+
+# ---------------------------------------------------------------------------
+# The acceptance scenario: one-replica bit flip -> detect, localize,
+# majority-repair, bitwise-identical from the next boundary on
+# ---------------------------------------------------------------------------
+
+
+FP_EVERY = 2
+STEPS = 8
+
+
+class _Fleet:
+    """N simulated hosts in lockstep threads, identical per-step grads
+    (the post-all-reduce data-parallel contract), each running the
+    same guard-wrapped loop a real host would."""
+
+    def __init__(self, n, step, opt, *, managers=None, events=None):
+        self.n = n
+        self.step = step
+        self.opt = opt
+        self.group = LocalCollective(n)
+        self.handles = self.group.handles()
+        self.managers = managers or [None] * n
+        self.events = events if events is not None else []
+        self.probes = [dict() for _ in range(n)]
+        self.states = [None] * n
+        self.errors = [None] * n
+
+    def grads(self, i, space):
+        r = np.random.RandomState(1000 + i)
+        return jnp.asarray(r.randn(space.total).astype(np.float32) * 0.01)
+
+    def run(self, steps=STEPS, mutate=None, ckpt_every=None):
+        def loop(rid):
+            try:
+                st = self.opt.init(_params())
+                guard = ConsistencyGuard(
+                    self.step, collective=self.handles[rid],
+                    manager=self.managers[rid],
+                    on_event=self.events.append)
+                for i in range(steps):
+                    if mutate is not None:
+                        st = mutate(rid, i, st)
+                    st, aux = guard(st, self.grads(i, st.space))
+                    self.probes[rid][i] = np.asarray(st.master).copy()
+                    # one writer per shared single-host directory (the
+                    # multi-WRITER protocol is checkpoint.py's quorum
+                    # mode, tests/test_quorum_checkpoint.py)
+                    if (rid == 0 and self.managers[0] is not None
+                            and ckpt_every
+                            and (i + 1) % ckpt_every == 0):
+                        self.managers[0].save(i + 1, st)
+                self.states[rid] = st
+            except BaseException as e:  # noqa: BLE001 — surfaced below
+                self.errors[rid] = e
+
+        ts = [threading.Thread(target=loop, args=(r,), daemon=True)
+              for r in range(self.n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        return self
+
+
+def _golden(opt, step, steps=STEPS):
+    st = opt.init(_params())
+    probes = {}
+    for i in range(steps):
+        r = np.random.RandomState(1000 + i)
+        g = jnp.asarray(r.randn(st.space.total).astype(np.float32) * 0.01)
+        st, _ = step(st, g)
+        probes[i] = np.asarray(st.master).copy()
+    return st, probes
+
+
+class TestMajorityRepair:
+    FLIP_STEP = 3          # strictly inside a fingerprint window
+    FLIP_LEAF = 2          # 'w2'
+
+    def _fleet_run(self, records_dir, monkeypatch):
+        monkeypatch.setenv(
+            faults.ENV_KNOB,
+            f"bit_flip={self.FLIP_STEP};bit_flip_replica=1;"
+            f"bit_flip_leaf={self.FLIP_LEAF}")
+        faults.install(None)
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        step = make_train_step(opt, fingerprint_every=FP_EVERY)
+        golden_state, golden_probes = _golden(opt, step)
+
+        def mutate(rid, i, st):
+            return st._replace(master=faults.flip_bits(
+                st.master, i, replica=rid, space=st.space))
+
+        fleet = _Fleet(3, step, opt).run(mutate=mutate)
+        assert fleet.errors == [None, None, None]
+        return fleet, golden_state, golden_probes
+
+    def test_bit_flip_detected_localized_repaired_bitwise(
+            self, records_dir, monkeypatch):
+        fleet, golden_state, golden_probes = self._fleet_run(
+            records_dir, monkeypatch)
+
+        # every replica reported the same event: replica 1, 'w2', with
+        # a quorum, repaired from the majority
+        assert len(fleet.events) == 3
+        for ev in fleet.events:
+            assert ev["event"] == "replica_divergence"
+            assert ev["has_quorum"] is True
+            assert ev["action"] == "majority_repair"
+            assert ev["minority_replicas"] == [1]
+            assert {(s["replica"], s["name"]) for s in ev["sites"]} \
+                == {(1, "['w2']")}
+            # detected within fingerprint_every steps of the flip
+            assert ev["count"] - self.FLIP_STEP <= FP_EVERY
+        rec = records.latest_record("resilience", require_backend=None)
+        assert rec["payload"]["event"] == "replica_divergence"
+        assert rec["payload"]["sites"][0]["name"] == "['w2']"
+        assert rec["payload"]["sites"][0]["replica"] == 1
+
+        # from the first fingerprint boundary after the flip on, every
+        # replica's trajectory is BITWISE the uninjected golden run
+        boundary = fleet.events[0]["count"]
+        for rid in range(3):
+            for i in range(boundary - 1, STEPS):
+                np.testing.assert_array_equal(
+                    fleet.probes[rid][i], golden_probes[i],
+                    err_msg=f"replica {rid} step {i}")
+            np.testing.assert_array_equal(
+                np.asarray(fleet.states[rid].master),
+                np.asarray(golden_state.master))
+            for k in golden_state.slots:
+                np.testing.assert_array_equal(
+                    np.asarray(fleet.states[rid].slots[k]),
+                    np.asarray(golden_state.slots[k]))
+            assert int(fleet.states[rid].count) == int(golden_state.count)
+
+    def test_clean_fleet_reports_nothing(self, records_dir):
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        step = make_train_step(opt, fingerprint_every=FP_EVERY)
+        fleet = _Fleet(3, step, opt).run()
+        assert fleet.errors == [None, None, None]
+        assert fleet.events == []
+        assert records.latest_record("resilience",
+                                     require_backend=None) is None
+
+
+class TestNoQuorum:
+    def _mutator(self):
+        inj = FaultInjector(bit_flip_steps=frozenset({1}),
+                            bit_flip_replica=1, bit_flip_leaf=0)
+
+        def mutate(rid, i, st):
+            return st._replace(master=inj.flip_bits(
+                st.master, i, replica=rid, space=st.space))
+        return mutate
+
+    def test_two_replicas_roll_back_to_checkpoint(self, tmp_path,
+                                                  records_dir):
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        step = make_train_step(opt, fingerprint_every=FP_EVERY)
+        # both replicas share one checkpoint directory (the shared-FS
+        # contract); single-host managers here — quorum checkpoints
+        # have their own suite (tests/test_quorum_checkpoint.py)
+        mgrs = [CheckpointManager(tmp_path / "ckpt", keep=3)
+                for _ in range(2)]
+        fleet = _Fleet(2, step, opt, managers=mgrs).run(
+            mutate=self._mutator(), ckpt_every=1)
+        assert fleet.errors == [None, None]
+        assert len(fleet.events) == 2
+        for ev in fleet.events:
+            assert ev["has_quorum"] is False
+            assert ev["action"] == "rollback"
+        # both replicas restored the same checkpoint -> bit-identical
+        np.testing.assert_array_equal(
+            np.asarray(fleet.states[0].master),
+            np.asarray(fleet.states[1].master))
+
+    def test_no_manager_raises_divergence_error(self, records_dir):
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        step = make_train_step(opt, fingerprint_every=FP_EVERY)
+        fleet = _Fleet(2, step, opt).run(mutate=self._mutator())
+        for err in fleet.errors:
+            assert isinstance(err, DivergenceError)
+            assert "no agreeing majority" in str(err)
+            assert err.report is not None and not err.report.has_quorum
+
+
+class TestLostLockstep:
+    def test_mismatched_counts_raise(self):
+        group = LocalCollective(2)
+        handles = group.handles()
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        step = make_train_step(opt, fingerprint_every=1)
+        errors = [None, None]
+
+        def loop(rid):
+            try:
+                st = opt.init(_params())
+                guard = ConsistencyGuard(step, collective=handles[rid])
+                r = np.random.RandomState(0)
+                g = jnp.asarray(
+                    r.randn(st.space.total).astype(np.float32) * 0.01)
+                if rid == 1:               # replica 1 sneaks an extra step
+                    st, _ = step(st, g)
+                guard(st, g)
+            except BaseException as e:  # noqa: BLE001
+                errors[rid] = e
+
+        ts = [threading.Thread(target=loop, args=(r,), daemon=True)
+              for r in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        for err in errors:
+            assert isinstance(err, DivergenceError)
+            assert "different step counts" in str(err)
+
+
+# ---------------------------------------------------------------------------
+# Preemption-safe shutdown
+# ---------------------------------------------------------------------------
+
+
+class TestPreemption:
+    def test_handler_sets_flag_only(self):
+        with PreemptionHandler(signals=(signal.SIGTERM,)) as h:
+            assert not h.should_stop()
+            os.kill(os.getpid(), signal.SIGTERM)
+            assert h.requested and h.signum == signal.SIGTERM
+            assert h.should_stop()
+        # uninstalled: the default disposition is restored
+        assert signal.getsignal(signal.SIGTERM) != h._handle
+
+    def test_faults_sigterm_site_drives_the_real_signal(self):
+        with PreemptionHandler(signals=(signal.SIGTERM,)) as h:
+            with faults.inject(sigterm_steps=frozenset({2})):
+                faults.maybe_sigterm(1)
+                assert not h.requested
+                faults.maybe_sigterm(2)
+                assert h.requested
+
+    def test_agreement_any_flagged_host_stops_the_fleet(self):
+        group = LocalCollective(3)
+        handles = group.handles()
+        out = [None] * 3
+
+        def loop(rid):
+            h = PreemptionHandler()
+            h.requested = rid == 1          # only one host got the signal
+            out[rid] = h.should_stop(handles[rid])
+
+        ts = [threading.Thread(target=loop, args=(r,), daemon=True)
+              for r in range(3)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert out == [True, True, True]
+
+    def test_sigterm_mid_step_checkpoint_resumes_bitwise(
+            self, tmp_path, records_dir):
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        step = make_train_step(opt)
+        golden_state, golden_probes = _golden(opt, step)
+
+        mgr = CheckpointManager(tmp_path / "ckpt", keep=3)
+        handler = install_preemption_handler(signals=(signal.SIGTERM,))
+        try:
+            st = opt.init(_params())
+            stopped_at = None
+            with faults.inject(sigterm_steps=frozenset({4})):
+                for i in range(STEPS):
+                    faults.maybe_sigterm(i)   # "the scheduler's notice"
+                    st, _ = step(st, _Fleet(1, step, opt).grads(
+                        i, st.space))
+                    if handler.should_stop():
+                        # drain: finish the in-flight step, then the
+                        # priority final checkpoint names the NEXT step
+                        graceful_shutdown(mgr, i + 1, st, handler=handler)
+                        stopped_at = i + 1
+                        break
+            assert stopped_at == 5
+        finally:
+            handler.uninstall()
+        rec = records.latest_record("resilience", require_backend=None)
+        assert rec["payload"]["event"] == "preemption_checkpoint"
+        assert rec["payload"]["step"] == 5
+        assert rec["payload"]["signum"] == signal.SIGTERM
+
+        # "fresh process": auto-resume from latest_valid, replay
+        # bitwise to the uninterrupted run
+        restored = mgr.restore(template=opt.init(_params(seed=1)))
+        assert restored.step == stopped_at
+        st2 = restored.opt_state
+        for i in range(restored.step, STEPS):
+            st2, _ = step(st2, _Fleet(1, step, opt).grads(i, st2.space))
+            np.testing.assert_array_equal(np.asarray(st2.master[:16]),
+                                          golden_probes[i][:16])
+        np.testing.assert_array_equal(np.asarray(st2.master),
+                                      np.asarray(golden_state.master))

@@ -1,0 +1,259 @@
+"""Quorum (multi-host) checkpoints (apex_tpu/resilience/checkpoint.py
+multi-host mode): per-host shards under the same atomic protocol, a
+coordinator commit manifest recorded only after every host's shard
+verifies, `latest_valid()` refusing any partial host-set, and restore
+from any committed host's copy (shrunken-slice resume).
+
+Acceptance bar (ISSUE 3): kill-one-host-before-commit resumes from the
+last *quorum* checkpoint — never a partial host-set.
+"""
+
+import json
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import records
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.train_step import make_train_step
+from apex_tpu.resilience import (
+    CheckpointError,
+    CheckpointManager,
+    SimulatedCrash,
+    faults,
+)
+from apex_tpu.resilience.checkpoint import COMMIT, host_dirname
+
+
+def _params(seed=0, n=48, d=6):
+    r = np.random.RandomState(seed)
+    return {"w": jnp.asarray(r.randn(n, d), jnp.float32),
+            "b": jnp.zeros((d,), jnp.float32)}
+
+
+@pytest.fixture
+def records_dir(tmp_path, monkeypatch):
+    path = tmp_path / "records"
+    monkeypatch.setattr(records, "RECORDS_DIR", str(path))
+    return path
+
+
+def _state(seed=0):
+    opt = FusedAdam(lr=1e-2, impl="xla")
+    return opt, opt.init(_params(seed))
+
+
+def _managers(directory, n_hosts, **kw):
+    kw.setdefault("quorum_timeout", 20.0)
+    return [CheckpointManager(directory, process_id=h, n_processes=n_hosts,
+                              **kw) for h in range(n_hosts)]
+
+
+def _save_all(mgrs, step, state, skip=(), plans=None, errors=None):
+    """Every host saves concurrently (the real fleet shape: each
+    process writes its shard; the coordinator blocks until all land,
+    then commits). ``skip`` hosts never save; ``plans`` maps host ->
+    fault plan installed around ITS save only (the per-process env
+    knob of a real fleet)."""
+    errors = errors if errors is not None else {}
+
+    def save(h):
+        try:
+            if plans and h in plans:
+                with faults.inject(**plans[h]):
+                    mgrs[h].save(step, state)
+            else:
+                mgrs[h].save(step, state)
+        except BaseException as e:  # noqa: BLE001 — asserted by callers
+            errors[h] = e
+
+    ts = [threading.Thread(target=save, args=(h,), daemon=True)
+          for h in range(len(mgrs)) if h not in skip]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    return errors
+
+
+class TestQuorumRoundtrip:
+    def test_shards_commit_and_restore_bitwise(self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 3)
+        assert mgrs[0].multihost and mgrs[0].is_coordinator
+        assert not mgrs[1].is_coordinator
+        errors = _save_all(mgrs, 4, st)
+        assert errors == {}
+        path = mgrs[0].path_for(4)
+        assert mgrs[0].all_steps() == [4]
+        ok, reason = mgrs[0].validate(path)
+        assert ok, reason
+        commit = mgrs[0].read_commit(path)
+        assert commit["n_hosts"] == 3
+        assert sorted(commit["hosts"]) == [host_dirname(h) for h in range(3)]
+        # every host restores its OWN shard, bitwise
+        for h, mgr in enumerate(mgrs):
+            r = mgr.restore(template=_state(seed=1)[1])
+            assert r.step == 4
+            np.testing.assert_array_equal(np.asarray(r.opt_state.master),
+                                          np.asarray(st.master))
+            manifest_host = mgr.read_manifest(
+                os.path.join(path, host_dirname(h)))
+            assert manifest_host["process_id"] == h
+            assert manifest_host["n_processes"] == 3
+
+    def test_shrunken_slice_restores_any_copy(self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 2, st) == {}
+        # a later SINGLE-process run (slice shrank) still resumes: the
+        # state is data-parallel replicated, any committed shard works
+        solo = CheckpointManager(tmp_path / "ckpt")
+        path = solo.latest_valid()
+        assert path == solo.path_for(2)
+        r = solo.restore(path, template=_state(seed=1)[1])
+        np.testing.assert_array_equal(np.asarray(r.opt_state.master),
+                                      np.asarray(st.master))
+        # a 4-host manager restoring a 2-host checkpoint: its own id
+        # has no shard, so it falls back to a committed one
+        big = CheckpointManager(tmp_path / "ckpt", process_id=3,
+                                n_processes=4)
+        r2 = big.restore(path, template=_state(seed=1)[1])
+        np.testing.assert_array_equal(np.asarray(r2.opt_state.master),
+                                      np.asarray(st.master))
+        # ... and pinning a shard that is not in the commit raises
+        with pytest.raises(CheckpointError, match="host_0007"):
+            solo.restore(path, template=_state(seed=1)[1], host=7)
+
+    def test_single_host_layout_is_unchanged(self, tmp_path):
+        _, st = _state()
+        mgr = CheckpointManager(tmp_path / "ckpt")
+        mgr.save(3, st)
+        path = mgr.path_for(3)
+        names = sorted(os.listdir(path))
+        assert names == ["manifest.json", "payload.bin"]   # no shards
+        assert not mgr._is_multihost_layout(path)
+
+
+class TestPartialHostSet:
+    def test_missing_shard_times_out_and_commits_nothing(
+            self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2, quorum_timeout=0.5)
+        assert _save_all(mgrs, 2, st) == {}            # quorum at step 2
+        # host 1 never saves step 4: the coordinator must time out,
+        # refuse the commit, and name the missing shard
+        errors = _save_all(mgrs, 4, st, skip={1})
+        assert isinstance(errors[0], CheckpointError)
+        assert "quorum timeout" in str(errors[0])
+        assert "host_0001" in str(errors[0])
+        assert not os.path.exists(os.path.join(mgrs[0].path_for(4), COMMIT))
+
+    def test_kill_one_host_before_commit_resumes_from_last_quorum(
+            self, tmp_path, records_dir):
+        # the acceptance drill, in-process: host 1 dies inside its
+        # step-4 save (shard never lands); resume must come from the
+        # step-2 QUORUM checkpoint, never the partial step-4 set
+        opt, st0 = _state()
+        scaler_free_step = make_train_step(opt)
+        mgrs = _managers(tmp_path / "ckpt", 2, quorum_timeout=0.5)
+        assert _save_all(mgrs, 2, st0) == {}
+
+        r = np.random.RandomState(7)
+        g = jnp.asarray(r.randn(st0.space.total).astype(np.float32) * 0.01)
+        ref_master = np.asarray(st0.master).copy()
+        st4, _ = scaler_free_step(st0, g)      # donates st0's buffers
+        # host 1 dies first (in a real fleet the fault plan is that
+        # process's own APEX_TPU_FAULTS; sequencing keeps the
+        # process-wide injector from leaking into host 0's save)
+        with faults.inject(crash_before_commit_steps=frozenset({4})):
+            with pytest.raises(SimulatedCrash):
+                mgrs[1].save(4, st4)           # the dead host
+        with pytest.raises(CheckpointError, match="quorum timeout"):
+            mgrs[0].save(4, st4)               # coordinator times out
+        ok, reason = mgrs[0].validate(mgrs[0].path_for(4))
+        assert not ok and "commit" in reason
+        # the dead host's shard never landed at all
+        assert not os.path.exists(
+            os.path.join(mgrs[0].path_for(4), host_dirname(1)))
+
+        for mgr in mgrs:
+            assert mgr.latest_valid() == mgr.path_for(2)
+            restored = mgr.restore(template=_state(seed=1)[1])
+            assert restored.step == 2
+            np.testing.assert_array_equal(
+                np.asarray(restored.opt_state.master), ref_master)
+        rec = records.latest_record("resilience", require_backend=None)
+        assert rec["payload"]["event"] == "corrupt_checkpoint"
+        assert rec["payload"]["step"] == 4
+        assert "commit" in rec["payload"]["reason"]
+
+    def test_committed_shard_corruption_invalidates_whole_step(
+            self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 2, st) == {}
+        assert _save_all(mgrs, 4, st) == {}
+        # bit-rot inside ONE host's committed shard: the whole step is
+        # out (a quorum restore must never mix a good shard with a
+        # rotten host-set), and resume falls back to the previous one
+        ppath = os.path.join(mgrs[0].path_for(4), host_dirname(1),
+                             "payload.bin")
+        with open(ppath, "r+b") as f:
+            f.seek(4)
+            b = f.read(1)
+            f.seek(4)
+            f.write(bytes([b[0] ^ 0xFF]))
+        ok, reason = mgrs[0].validate(mgrs[0].path_for(4))
+        assert not ok and "host_0001" in reason
+        assert mgrs[0].latest_valid() == mgrs[0].path_for(2)
+
+    def test_commit_sha_mismatch_rejected(self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 2, st) == {}
+        path = mgrs[0].path_for(2)
+        cpath = os.path.join(path, COMMIT)
+        with open(cpath) as f:
+            commit = json.load(f)
+        commit["hosts"][host_dirname(0)] = "0" * 64   # swapped shard
+        with open(cpath, "w") as f:
+            json.dump(commit, f)
+        ok, reason = mgrs[0].validate(path)
+        assert not ok and "sha256 differs" in reason
+
+
+class TestCommitFaults:
+    def test_transient_commit_write_fault_absorbed(self, tmp_path,
+                                                   records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        errors = _save_all(
+            mgrs, 2, st,
+            plans={0: dict(io_errors={"quorum_commit": frozenset({0})})})
+        assert errors == {}
+        assert mgrs[0].latest_valid() == mgrs[0].path_for(2)
+
+    def test_dead_disk_at_commit_surfaces_and_commits_nothing(
+            self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        errors = _save_all(
+            mgrs, 2, st,
+            plans={0: dict(io_permanent_from={"quorum_commit": 0})})
+        assert isinstance(errors.get(0), OSError)
+        assert not os.path.exists(os.path.join(mgrs[0].path_for(2), COMMIT))
+        assert mgrs[0].latest_valid(record_events=False) is None
+
+    def test_stale_shard_tmp_dirs_swept_at_startup(self, tmp_path):
+        step_dir = tmp_path / "ckpt" / "step_000000000002"
+        os.makedirs(step_dir / "host_0001.tmp-9-9")
+        CheckpointManager(tmp_path / "ckpt", process_id=0, n_processes=2)
+        assert not [n for n in os.listdir(step_dir) if ".tmp-" in n]
+
+    def test_bad_process_id_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="process_id"):
+            CheckpointManager(tmp_path, process_id=2, n_processes=2)

@@ -158,6 +158,39 @@ class TestRecords:
         rec = records.latest_record("k")
         assert rec["payload"] == {"n": 2}
 
+    def test_fsync_fault_absorbed_claim_never_lost(self, tmp_path,
+                                                   monkeypatch):
+        """The directory fsync after the O_EXCL claim (site
+        ``record_fsync``) is part of the retried attempt: a transient
+        failure there unlinks the claim and rewrites — one well-formed
+        record, no truncated ghost, disambiguator semantics intact."""
+        import json
+
+        from apex_tpu import records
+        from apex_tpu.resilience import faults
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        monkeypatch.setattr(records.time, "strftime",
+                            lambda *a: "20260101T000000Z")
+        with faults.inject(io_errors={"record_fsync": frozenset({0})}):
+            p1 = records.write_record("k", {"n": 1}, backend="tpu")
+        assert p1 is not None
+        files = list(tmp_path.iterdir())
+        assert len(files) == 1                    # no ghost from attempt 1
+        assert json.loads(files[0].read_text())["payload"] == {"n": 1}
+        # the retried claim reused the UNDISAMBIGUATED base name (the
+        # failed attempt unlinked its claim), so a same-second
+        # follow-up still orders after it
+        p2 = records.write_record("k", {"n": 2}, backend="tpu")
+        assert p2 != p1
+        assert records.latest_record("k")["payload"] == {"n": 2}
+        # a permanently failing fsync behaves like any dead disk:
+        # None returned, nothing left behind
+        with faults.inject(io_permanent_from={"record_fsync": 0}):
+            assert records.write_record("k2", {"n": 3}) is None
+        assert not [f for f in tmp_path.iterdir()
+                    if f.name.startswith("k2_")]
+
     def test_claim_is_exclusive_not_exists_check(self, tmp_path,
                                                  monkeypatch):
         """A pre-existing file with the exact base name (the TOCTOU

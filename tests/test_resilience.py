@@ -118,6 +118,59 @@ class TestRetry:
                    sleep=lambda d: None)
         assert [s[0] for s in seen] == [0, 1]
 
+    def test_give_up_on_passes_through_immediately(self):
+        from apex_tpu.resilience import CheckpointError
+
+        calls = {"n": 0}
+
+        def validation_failure():
+            calls["n"] += 1
+            raise CheckpointError("sha256 mismatch")
+
+        slept = []
+        # CheckpointError matches the broad retry_on, but the allowlist
+        # wins: ONE attempt, zero sleeps, original exception unchanged
+        with pytest.raises(CheckpointError, match="sha256"):
+            retry_call(validation_failure, retries=5, base_delay=0.1,
+                       retry_on=(RuntimeError,),
+                       give_up_on=(CheckpointError,),
+                       sleep=slept.append)
+        assert calls["n"] == 1 and slept == []
+
+    def test_give_up_on_does_not_shadow_retryable_siblings(self):
+        from apex_tpu.resilience import CheckpointError
+
+        calls = {"n": 0}
+
+        def transient():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise RuntimeError("transient")
+            return "ok"
+
+        # sibling RuntimeErrors NOT in the allowlist keep retrying
+        assert retry_call(transient, retries=5, base_delay=0.0,
+                          retry_on=(RuntimeError,),
+                          give_up_on=(CheckpointError,),
+                          sleep=lambda d: None) == "ok"
+        assert calls["n"] == 3
+
+    def test_keyboard_interrupt_never_retried(self):
+        from apex_tpu.resilience.retry import NON_RETRYABLE
+
+        assert KeyboardInterrupt in NON_RETRYABLE
+        calls = {"n": 0}
+
+        def interrupted():
+            calls["n"] += 1
+            raise KeyboardInterrupt
+
+        # even a catch-all retry_on cannot make ctrl-C burn the deadline
+        with pytest.raises(KeyboardInterrupt):
+            retry_call(interrupted, retries=5, base_delay=0.0,
+                       retry_on=(BaseException,), sleep=lambda d: None)
+        assert calls["n"] == 1
+
 
 class TestFaults:
     def test_env_grammar_roundtrip(self):

@@ -24,6 +24,7 @@ from apex_tpu.resilience import (
     FaultInjector,
     NonfiniteWatchdog,
     RollbackLimitExceeded,
+    RollbackUnavailable,
     leaf_names,
     localize_nonfinite,
 )
@@ -155,8 +156,35 @@ class TestEscalation:
     def test_on_event_callback_fires(self, tmp_path, records_dir):
         seen = []
         rig = _Rig(tmp_path, threshold=1, on_event=seen.append)
-        rig.drive(0, poisoned=True)
+        rig.drive(0)
+        rig.mgr.save(1, rig.state, scaler_state=rig.sstate)
+        rig.drive(1, poisoned=True)
         assert len(seen) == 1 and seen[0]["event"] == "nonfinite_escalation"
+
+    def test_cold_start_empty_directory_raises_clear_error(
+            self, tmp_path, records_dir):
+        # a manager is attached but its directory holds NO checkpoint
+        # (cold start / wrong path): escalation must raise a
+        # RollbackLimitExceeded-subclass NAMING the directory, not loop
+        # scaler resets or die on an internal error
+        rig = _Rig(tmp_path, threshold=2)
+        with pytest.raises(RollbackUnavailable) as ei:
+            for i in range(4):
+                rig.drive(i, poisoned=True)
+        msg = str(ei.value)
+        assert str(rig.mgr.directory) in msg
+        assert "no valid checkpoint" in msg
+        assert [s["name"] for s in ei.value.suspects] == ["['w2']"]
+        assert isinstance(ei.value, RollbackLimitExceeded)  # catchable as
+
+    def test_cold_start_absent_directory_raises_clear_error(
+            self, tmp_path, records_dir):
+        import shutil
+
+        rig = _Rig(tmp_path, threshold=1)
+        shutil.rmtree(rig.mgr.directory)        # directory vanished
+        with pytest.raises(RollbackUnavailable, match="no valid checkpoint"):
+            rig.drive(0, poisoned=True)
 
 
 class TestLocalization:

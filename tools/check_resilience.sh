@@ -13,7 +13,8 @@ log="$(mktemp)"
 trap 'rm -f "$log"' EXIT
 rc=0
 
-targets=(tests/test_resilience.py tests/test_watchdog.py)
+targets=(tests/test_resilience.py tests/test_watchdog.py
+         tests/test_guard.py tests/test_quorum_checkpoint.py)
 if [ "$#" -gt 0 ]; then targets=(); fi
 python -m pytest "${targets[@]}" "$@" -q \
     -p no:cacheprovider -p tools._marker_audit 2>&1 | tee "$log"
@@ -81,6 +82,82 @@ assert loader.degraded and len(out) == 4, (loader.degraded, len(out))
 print("synchronous degrade: OK")
 PY
 [ $? -ne 0 ] && rc=1
+
+# Distributed-site env-knob matrix: the guard/quorum clauses must parse
+# and fire from the environment exactly like the classic ones.
+echo "== distributed env-knob matrix =="
+APEX_TPU_FAULTS="bit_flip=3;bit_flip_replica=1;bit_flip_leaf=0;crash_before_commit=6;sigterm=9" \
+python - <<'PY'
+import signal
+
+import numpy as np
+
+from apex_tpu.resilience import faults
+from apex_tpu.resilience.guard import PreemptionHandler
+
+inj = faults.active()
+assert inj is not None, "env knob did not activate"
+assert inj.should_bit_flip(3, replica=1)
+assert not inj.should_bit_flip(3, replica=0)     # targeted replica only
+assert not inj.should_bit_flip(2, replica=1)
+
+import jax.numpy as jnp
+buf = jnp.zeros((16,), jnp.float32) + 1.0
+flipped = np.asarray(faults.flip_bits(buf, 3, replica=1))
+assert (flipped != np.asarray(buf)).sum() == 1   # exactly one element
+assert np.isfinite(flipped).all()                # SDC, not a NaN bomb
+
+try:
+    faults.maybe_crash_before_commit(6)
+    raise SystemExit("crash_before_commit did not fire")
+except faults.SimulatedCrash:
+    pass
+
+with PreemptionHandler(signals=(signal.SIGTERM,)) as h:
+    faults.maybe_sigterm(8)
+    assert not h.requested
+    faults.maybe_sigterm(9)                      # a REAL SIGTERM to self
+    assert h.requested and h.signum == signal.SIGTERM
+print("distributed env-knob matrix: OK")
+PY
+[ $? -ne 0 ] && rc=1
+
+# Two-process jax.distributed drill: kill host 1 before the quorum
+# commit, then resume BOTH hosts from the last quorum checkpoint
+# (tools/quorum_drill.py; the in-process analog is
+# tests/test_quorum_checkpoint.py).
+echo "== two-process quorum drill =="
+drill_dir="$(mktemp -d)"
+drill_port=$(( 20000 + RANDOM % 20000 ))
+drill_env=(MASTER_ADDR=127.0.0.1 "MASTER_PORT=$drill_port" WORLD_SIZE=2)
+env "${drill_env[@]}" RANK=0 python tools/quorum_drill.py train "$drill_dir" &
+h0=$!
+env "${drill_env[@]}" RANK=1 APEX_TPU_FAULTS="crash_before_commit=6" \
+    python tools/quorum_drill.py train "$drill_dir" &
+h1=$!
+wait $h0; rc0=$?
+wait $h1; rc1=$?
+if [ "$rc0" -ne 0 ] || [ "$rc1" -ne 42 ]; then
+    echo "quorum drill train phase FAILED (host0 rc=$rc0, host1 rc=$rc1," \
+         "expected 0/42)" >&2
+    rc=1
+else
+    drill_port=$(( 20000 + RANDOM % 20000 ))
+    drill_env=(MASTER_ADDR=127.0.0.1 "MASTER_PORT=$drill_port" WORLD_SIZE=2)
+    env "${drill_env[@]}" RANK=0 python tools/quorum_drill.py resume "$drill_dir" &
+    h0=$!
+    env "${drill_env[@]}" RANK=1 python tools/quorum_drill.py resume "$drill_dir" &
+    h1=$!
+    wait $h0; rc0=$?
+    wait $h1; rc1=$?
+    if [ "$rc0" -ne 0 ] || [ "$rc1" -ne 0 ]; then
+        echo "quorum drill resume phase FAILED (rc=$rc0/$rc1)" >&2
+        rc=1
+    else
+        echo "two-process quorum drill: OK"
+    fi
+fi
+rm -rf "$drill_dir"
 
 if [ "$rc" -eq 0 ]; then
     echo "check_resilience: OK"
