@@ -71,6 +71,50 @@ class BackendReport:
                 d["backend_probe"] = pd
         return d
 
+    def publish(self) -> "BackendReport":
+        """Surface this report through the telemetry registry — the
+        probe verdict every consumer (bench records, dashboards) reads
+        instead of an ad-hoc module global: ``info.backend_report``
+        plus ``backend_probe_cache_{hits,misses}`` /
+        ``backend_fallbacks`` counters and a ``backend_probe`` event.
+        Returns self; never raises."""
+        try:
+            from apex_tpu.telemetry import metrics as _metrics
+
+            reg = _metrics.registry()
+            reg.set_info("backend_report", self.as_detail())
+            if self.probe:
+                if self.probe.get("cached"):
+                    reg.counter("backend_probe_cache_hits",
+                                "probe verdicts served from cache").inc()
+                else:
+                    reg.counter("backend_probe_cache_misses",
+                                "fresh backend probes run").inc()
+            if self.fallback:
+                reg.counter("backend_fallbacks",
+                            "entry points forced onto the CPU "
+                            "backend").inc()
+            reg.event("backend_probe", platform=self.platform,
+                      n_devices=self.n_devices, fallback=self.fallback,
+                      cached=bool(self.probe.get("cached")),
+                      note=self.note or None)
+        except Exception:  # noqa: BLE001 — telemetry is best-effort here
+            pass
+        return self
+
+
+def published_report_detail() -> dict | None:
+    """The last :meth:`BackendReport.publish`'d report's detail dict
+    from the telemetry registry (``info.backend_report``), or None —
+    how bench modes name the backend that actually ran without
+    threading a global through every function."""
+    try:
+        from apex_tpu.telemetry import metrics as _metrics
+
+        return _metrics.registry().get_info("backend_report")
+    except Exception:  # noqa: BLE001
+        return None
+
 
 def _strip_plugin_hooks() -> None:
     """Unregister the axon tunnel plugin's backend hooks (idempotent).
@@ -340,6 +384,18 @@ def chip_peak_tflops(device_kind: str) -> float | None:
 def ensure_backend(min_devices: int = 1,
                    probe_timeout: float | None = None,
                    retry_budget: float | None = None) -> BackendReport:
+    """Guarantee a usable backend with >= ``min_devices`` devices.
+    The returned report is also published to the telemetry registry
+    (:meth:`BackendReport.publish`), so every record/dashboard reads
+    the same verdict.
+    """
+    return _ensure_backend(min_devices, probe_timeout,
+                           retry_budget).publish()
+
+
+def _ensure_backend(min_devices: int = 1,
+                    probe_timeout: float | None = None,
+                    retry_budget: float | None = None) -> BackendReport:
     """Guarantee a usable backend with >= ``min_devices`` devices.
 
     Order of preference: (1) a backend already initialized in-process,

@@ -97,6 +97,7 @@ class TrainStep:
         self._body = body
         self._chained: Dict[int, Any] = {}
         self._layouts = set()
+        self._telemetry = None          # host-side StepTimeline, or None
 
     def _track(self, state: FlatOptState):
         key = (state.space, state.seg_meta)
@@ -113,12 +114,42 @@ class TrainStep:
             if scaler_state is None:
                 raise ValueError(
                     "this step was built with a scaler; pass scaler_state")
-            return self._jitted(state, flat_grads, scaler_state, lr)
-        if scaler_state is not None:
+            args = (state, flat_grads, scaler_state, lr)
+        elif scaler_state is not None:
             raise ValueError(
                 "this step was built without a scaler; drop scaler_state "
                 "or rebuild with make_train_step(opt, scaler=...)")
-        return self._jitted(state, flat_grads, lr)
+        else:
+            args = (state, flat_grads, lr)
+        tl = self._telemetry
+        if tl is None:
+            return self._jitted(*args)
+        # host-side only: the jitted program (and its argument list) is
+        # byte-identical with telemetry on or off. sync=True blocks on
+        # the outputs so the span covers device execution, not dispatch.
+        t0 = tl.clock()
+        outs = self._jitted(*args)
+        if tl.sync:
+            jax.block_until_ready(outs)
+        tl.record_span("step", t0, tl.clock() - t0, category="train_step")
+        return outs
+
+    def with_telemetry(self, telemetry) -> "TrainStep":
+        """A view of this step whose dispatches are timed into the
+        given :class:`~apex_tpu.telemetry.StepTimeline` as ``"step"``
+        spans. The view SHARES the compiled program, chained cache,
+        and layout tracking — nothing recompiles. A None or disabled
+        timeline returns ``self`` unchanged, so the disabled path is
+        exactly the un-instrumented path (tools/check_telemetry.sh
+        holds its overhead to <1%)."""
+        if telemetry is None or not getattr(telemetry, "enabled", True):
+            return self
+        view = TrainStep(self.opt, self.scaler, self._jitted, self._body,
+                         self.options)
+        view._chained = self._chained
+        view._layouts = self._layouts
+        view._telemetry = telemetry
+        return view
 
     def lower(self, state: FlatOptState, flat_grads: jax.Array,
               scaler_state: Optional[ScalerState] = None, lr=None):
@@ -145,7 +176,8 @@ class TrainStep:
                 f"unknown train-step options {sorted(unknown)}; "
                 f"overridable: {sorted(base)}")
         base.update(overrides)
-        return make_train_step(self.opt, scaler=self.scaler, **base)
+        step = make_train_step(self.opt, scaler=self.scaler, **base)
+        return step.with_telemetry(self._telemetry)
 
     def chained(self, k: int):
         """``k`` steps of this train step as ONE jitted call — the same
@@ -233,6 +265,7 @@ def make_train_step(
     donate_grads: bool = False,
     with_grad_norm: bool = False,
     fingerprint_every: Optional[int] = None,
+    telemetry=None,
 ) -> TrainStep:
     """Build (or fetch from the cache) the fused train step for ``opt``.
 
@@ -264,6 +297,13 @@ def make_train_step(
       (apex_tpu/resilience/guard.py): fingerprints ride the donating
       program itself, so cross-replica integrity monitoring never
       copies or re-reads the state on the host.
+    - ``telemetry``: a :class:`~apex_tpu.telemetry.StepTimeline`; each
+      dispatch is then timed into it as a ``"step"`` span, HOST-SIDE
+      ONLY — telemetry is never part of the factory cache key, adds no
+      arguments to the jitted program, and changes no compiled byte
+      (the PR-1 donation/bit-match contracts hold verbatim). ``None``
+      or a disabled timeline returns the exact cached step object:
+      the disabled path IS the un-instrumented path.
 
     The returned :class:`TrainStep` donates ``state`` (master + every
     slot buffer) and ``scaler_state``; callers MUST rebind both to the
@@ -280,7 +320,7 @@ def make_train_step(
     cached = _FACTORY_CACHE.get(key)
     if cached is not None:
         _STATS["factory_hits"] += 1
-        return cached
+        return cached.with_telemetry(telemetry)
     _STATS["factory_misses"] += 1
 
     is_lamb = isinstance(opt, FusedLAMB)
@@ -409,7 +449,7 @@ def make_train_step(
         donate_grads=donate_grads, with_grad_norm=with_grad_norm,
         fingerprint_every=fingerprint_every))
     _FACTORY_CACHE[key] = step
-    return step
+    return step.with_telemetry(telemetry)
 
 
 __all__ = ["make_train_step", "TrainStep", "StepAux",

@@ -186,7 +186,22 @@ def latest_record(kind: str,
         try:
             with open(os.path.join(RECORDS_DIR, name)) as f:
                 rec = json.load(f)
-        except (OSError, ValueError):
+        except (OSError, ValueError) as e:
+            # corrupt/unreadable record files are skipped, but never
+            # silently: a structured telemetry event + counter names
+            # each one once per lookup (the bench-record analog of
+            # latest_valid's corrupt_checkpoint record)
+            try:
+                from apex_tpu.telemetry import metrics as _metrics
+
+                reg = _metrics.registry()
+                reg.counter("records_corrupt_skipped",
+                            "unreadable bench_records files skipped by "
+                            "latest_record").inc()
+                reg.event("record_corrupt_skipped", file=name,
+                          kind=kind, error=f"{type(e).__name__}: {e}")
+            except Exception:  # noqa: BLE001 — lookup must never fail
+                pass
             continue
         if "kind" in rec:
             if rec["kind"] != kind:

@@ -79,6 +79,25 @@ _STEP_RE = re.compile(r"^step_(\d{12})$")
 _HOST_RE = re.compile(r"^host_(\d{4})$")
 
 
+def _publish_io(kind: str, t0: float, seconds: float, **labels) -> None:
+    """Checkpoint save/restore latency into the telemetry registry
+    (histogram + counter) and, when the global timeline is on, a
+    ``checkpoint`` span in the step timeline. Never raises."""
+    try:
+        from apex_tpu.telemetry import metrics as _metrics
+        from apex_tpu.telemetry import timeline as _timeline
+
+        reg = _metrics.registry()
+        reg.counter(f"checkpoint_{kind}s",
+                    f"checkpoint {kind} operations").inc(**labels)
+        reg.histogram(f"checkpoint_{kind}_seconds",
+                      f"wall seconds per checkpoint {kind}").observe(
+            seconds, **labels)
+        _timeline.record_global_span("checkpoint", t0, seconds)
+    except Exception:  # noqa: BLE001 — telemetry must never break a save
+        pass
+
+
 def host_dirname(process_id: int) -> str:
     return f"host_{int(process_id):04d}"
 
@@ -259,12 +278,17 @@ class CheckpointManager:
             json.dumps(extra)            # fail fast, not on the save thread
         final = self.path_for(step)
         if not self.async_save:
+            t0 = time.perf_counter()
             self._write(int(step), final, names, arrays, manifest_extra)
+            _publish_io("save", t0, time.perf_counter() - t0, mode="sync")
             return final
 
         def run():
+            t0 = time.perf_counter()
             try:
                 self._write(int(step), final, names, arrays, manifest_extra)
+                _publish_io("save", t0, time.perf_counter() - t0,
+                            mode="async")
             except BaseException as e:  # noqa: BLE001 — re-raised in wait()
                 self._error = e
 
@@ -548,6 +572,7 @@ class CheckpointManager:
             if record_events and path not in self._reported_corrupt:
                 self._reported_corrupt.add(path)
                 from apex_tpu import records
+                from apex_tpu.telemetry import metrics as _metrics
 
                 records.write_record("resilience", {
                     "event": "corrupt_checkpoint",
@@ -555,6 +580,12 @@ class CheckpointManager:
                     "step": step,
                     "reason": reason,
                 })
+                reg = _metrics.registry()
+                reg.counter("checkpoint_corrupt_skipped",
+                            "corrupt checkpoints skipped by "
+                            "latest_valid").inc()
+                reg.event("corrupt_checkpoint", path=path, step=step,
+                          reason=reason)
         return None
 
     def read_manifest(self, path: str) -> Dict[str, Any]:
@@ -578,6 +609,7 @@ class CheckpointManager:
         bits and a slice resuming with FEWER processes (or one) still
         restores. ``host`` pins a specific shard instead.
         """
+        t0 = time.perf_counter()
         if path is None:
             path = self.latest_valid()
             if path is None:
@@ -600,9 +632,12 @@ class CheckpointManager:
                 order = ([own] + [h for h in named if h != own]
                          if own in named else named)
             # validate() already verified every shard; any one works
-            return self._restore_leaf(os.path.join(path, order[0]),
-                                      template)
-        return self._restore_leaf(path, template)
+            out = self._restore_leaf(os.path.join(path, order[0]),
+                                     template)
+        else:
+            out = self._restore_leaf(path, template)
+        _publish_io("restore", t0, time.perf_counter() - t0)
+        return out
 
     def _restore_leaf(self, path: str, template) -> RestoredState:
         import jax.numpy as jnp

@@ -426,14 +426,27 @@ class ConsistencyGuard:
         }
         self.last_event = event
         records.write_record(self.record_kind, event)
+        from apex_tpu.telemetry import metrics as _metrics
+
+        reg = _metrics.registry()
+        reg.counter("resilience_divergence_events",
+                    "cross-replica state divergences detected").inc(
+            action=action)
+        reg.event("replica_divergence", action=action,
+                  has_quorum=report.has_quorum,
+                  n_sites=len(sites), count=int(state.count))
         if self.on_event is not None:
             self.on_event(event)
 
         if report.has_quorum:
             self.repairs += 1
+            reg.counter("resilience_divergence_repairs",
+                        "divergences repaired by majority broadcast").inc()
             return self._adopt_majority(state, report.majority_replica)
         if self.manager is not None:
             self.rollbacks += 1
+            reg.counter("resilience_divergence_rollbacks",
+                        "no-quorum divergences resolved by rollback").inc()
             col.barrier()          # nobody restores while a peer still saves
             restored = self.manager.restore(template=state)
             return restored.opt_state

@@ -135,6 +135,13 @@ class NonfiniteWatchdog:
         if float(aux.found_inf) == 0.0:
             self.consecutive_skips = 0
             return outs
+        # cold path from here (a skipped step): registry lookups are
+        # dict hits, invisible next to the escalation machinery
+        from apex_tpu.telemetry import metrics as _metrics
+
+        _metrics.registry().counter(
+            "resilience_nonfinite_skips",
+            "train steps skipped on nonfinite gradients").inc()
         self.consecutive_skips += 1
         if self.consecutive_skips < self.threshold:
             return outs                      # a plain amp skip
@@ -189,6 +196,17 @@ class NonfiniteWatchdog:
         self.last_event = event
         self.last_restored_step = restored.step if restored else None
         records.write_record(self.record_kind, event)
+        from apex_tpu.telemetry import metrics as _metrics
+
+        reg = _metrics.registry()
+        reg.counter("resilience_watchdog_escalations",
+                    "nonfinite escalations past the skip threshold").inc(
+            action=action)
+        reg.event("nonfinite_escalation",
+                  consecutive_skips=self.consecutive_skips,
+                  action=action,
+                  suspects=[s["name"] for s in suspects],
+                  restored_step=event["restored_step"])
         if self.on_event is not None:
             self.on_event(event)
 

@@ -26,6 +26,7 @@ import os
 import queue
 import subprocess
 import threading
+import time
 from typing import Any, Callable, Iterable, Iterator, Optional, Sequence
 
 import numpy as np
@@ -239,6 +240,13 @@ class PrefetchLoader:
     that the pipeline fell back). Exceptions raised by the source
     iterable or ``transform`` are never retried: they propagate to the
     consumer unchanged, first time.
+
+    Telemetry (apex_tpu/telemetry): the loader publishes
+    ``prefetch_queue_depth`` / ``prefetch_batches`` /
+    ``prefetch_device_put_retries`` / ``prefetch_worker_deaths`` /
+    ``prefetch_degraded`` into the process metrics registry, and each
+    consumer-side queue wait as a ``data_wait`` span when the global
+    step timeline is enabled (docs/observability.md).
     """
 
     def __init__(self, batches: Iterable, depth: int = 2,
@@ -274,6 +282,23 @@ class PrefetchLoader:
         # HostFlatSpace), so the dependency must not be module-level
         from apex_tpu.resilience import faults
         from apex_tpu.resilience.retry import retry_call
+        from apex_tpu.telemetry import metrics as _metrics
+        from apex_tpu.telemetry import timeline as _timeline
+
+        # bound once: the per-batch hot path pays dict hits only
+        reg = _metrics.registry()
+        m_depth = reg.gauge("prefetch_queue_depth",
+                            "staged batches waiting in the prefetch queue")
+        m_batches = reg.counter("prefetch_batches",
+                                "batches delivered to the consumer")
+        m_retries = reg.counter("prefetch_device_put_retries",
+                                "device_put attempts that were retried")
+        m_deaths = reg.counter("prefetch_worker_deaths",
+                               "prefetch workers killed by exhausted "
+                               "transfer retries")
+        m_degraded = reg.gauge("prefetch_degraded",
+                               "1 = loader fell back to synchronous "
+                               "loading")
 
         src = iter(self._batches)
         q: "queue.Queue" = queue.Queue(maxsize=self._depth)
@@ -307,6 +332,9 @@ class PrefetchLoader:
             return jax.tree.map(
                 lambda a: jax.device_put(a, self._device), b)
 
+        def count_retry(attempt, exc, delay):  # noqa: ARG001
+            m_retries.inc()
+
         def worker():
             try:
                 while not stop.is_set():
@@ -326,7 +354,8 @@ class PrefetchLoader:
                             transfer, b,
                             retries=self._transfer_retries,
                             base_delay=self._retry_base_delay,
-                            retry_on=(Exception,))
+                            retry_on=(Exception,),
+                            on_retry=count_retry)
                     except Exception as e:  # noqa: BLE001 — death notice
                         put(_TransferFailure(e))
                         return
@@ -344,12 +373,19 @@ class PrefetchLoader:
         t = spawn()
         try:
             while True:
+                # the blocking q.get() IS the host loop's data stall:
+                # surface it as a data_wait span when anyone is looking
+                t0 = time.perf_counter()
                 item = q.get()
+                _timeline.record_global_span(
+                    "data_wait", t0, time.perf_counter() - t0)
+                m_depth.set(q.qsize())
                 if item is END:
                     break
                 if isinstance(item, _TransferFailure):
                     t.join(timeout=self._join_timeout)
                     self.worker_deaths += 1
+                    m_deaths.inc()
                     if self.worker_deaths <= self._max_worker_restarts:
                         t = spawn()
                         continue
@@ -358,16 +394,20 @@ class PrefetchLoader:
                     # errors propagate; prefetch overlap is lost, data
                     # is not)
                     self.degraded = True
+                    m_degraded.set(1.0)
                     if pending["batch"] is not None:
                         b, pending["batch"] = pending["batch"], None
+                        m_batches.inc()
                         yield transfer(b)
                     for b in src:
                         if self._transform is not None:
                             b = self._transform(b)
+                        m_batches.inc()
                         yield transfer(b)
                     break
                 if isinstance(item, BaseException):
                     raise item
+                m_batches.inc()
                 yield item
         finally:
             # consumer stopped (exhausted, errored, or abandoned):

@@ -1,0 +1,117 @@
+"""Telemetry subsystem: metrics registry + step timeline + cost model.
+
+The observability layer the rest of the runtime reports through
+(docs/observability.md). Three parts:
+
+- :mod:`~apex_tpu.telemetry.metrics` — process-global registry of
+  counters / gauges / fixed-bucket histograms with labeled series,
+  ``snapshot()`` as one JSON-able dict, structured events, and
+  pluggable sinks (in-memory, JSONL riding the records atomic-claim
+  writer, stdout line protocol).
+- :mod:`~apex_tpu.telemetry.timeline` — :class:`StepTimeline`: ring-
+  buffered per-phase host-loop spans (data wait, H2D, step,
+  checkpoint, collective) with Chrome-trace/perfetto export; the one
+  spine the legacy ``pipeline_parallel.Timers`` and
+  ``profiler.annotate`` now publish into.
+- :mod:`~apex_tpu.telemetry.cost` — static FLOPs/bytes from
+  ``jit(...).lower().compile().cost_analysis()`` and the MFU / HBM-
+  bandwidth estimates bench records carry (``None`` **with a reason**
+  when the backend has no cost model or the chip no peak entry).
+
+Who publishes here (the instrumentation pass):
+
+- ``optimizers.train_step.make_train_step(..., telemetry=tl)`` — the
+  host-side ``"step"`` phase; zero overhead (same object) when None.
+- ``resilience``: watchdog skip/escalation counters, guard divergence
+  repairs, checkpoint save/restore latency histograms.
+- ``runtime.PrefetchLoader``: queue depth, device_put retries, worker
+  deaths, degrade flag (+ ``data_wait`` spans when the global
+  timeline is on).
+- ``backend_guard``: probe verdicts and cache hits — what
+  ``bench.py`` reads instead of an ad-hoc module global.
+- ``records.latest_record``: corrupt/unreadable record files skipped.
+
+Everything is host-side; nothing here adds arguments to, or changes
+one byte of, a jitted program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from apex_tpu.telemetry import cost, metrics, timeline
+from apex_tpu.telemetry.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    InMemorySink,
+    JsonlSink,
+    MetricsRegistry,
+    StdoutSink,
+    registry,
+)
+from apex_tpu.telemetry.timeline import (
+    PHASES,
+    Span,
+    StepTimeline,
+    disable,
+    enable,
+    get_timeline,
+    global_enabled,
+)
+
+
+def snapshot() -> Dict[str, Any]:
+    """The process-global registry's snapshot (one JSON-able dict)."""
+    return metrics.registry().snapshot()
+
+
+def snapshot_detail() -> Dict[str, Any]:
+    """The standard ``detail.telemetry`` block bench records carry:
+    the registry snapshot, the global timeline's per-phase breakdown,
+    and an ``mfu`` field that is a value or an explicit null with a
+    reason — never absent, never silently null."""
+    reg = metrics.registry()
+    snap = reg.snapshot()
+    tl = timeline.get_timeline()
+    mfu = snap.get("gauges", {}).get("mfu")
+    out: Dict[str, Any] = {
+        "registry": snap,
+        "step_timeline": tl.summary() if tl.enabled else None,
+        "mfu": mfu,
+    }
+    if mfu is None:
+        out["mfu_reason"] = (reg.get_info("mfu_reason")
+                             or "no step cost published in this process")
+    return out
+
+
+def reset() -> None:
+    """Fresh registry + disabled global timeline (tests)."""
+    metrics.reset()
+    timeline.disable()
+
+
+__all__ = [
+    "Counter",
+    "Gauge",
+    "Histogram",
+    "InMemorySink",
+    "JsonlSink",
+    "MetricsRegistry",
+    "PHASES",
+    "Span",
+    "StdoutSink",
+    "StepTimeline",
+    "cost",
+    "disable",
+    "enable",
+    "get_timeline",
+    "global_enabled",
+    "metrics",
+    "registry",
+    "reset",
+    "snapshot",
+    "snapshot_detail",
+    "timeline",
+]

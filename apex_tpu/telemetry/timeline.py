@@ -1,0 +1,314 @@
+"""Step timeline: per-phase host-loop timing + Chrome-trace export.
+
+Answers "where did step time go" for the training host loop the way
+the reference's NVTX ranges + nsight answer it for kernels (ref
+apex/parallel/distributed.py:360-561 ``prof`` windows): every phase of
+every step — data wait, H2D transfer, the fused step dispatch,
+checkpoint writes, collectives — lands in a ring buffer as a
+:class:`Span`, and :meth:`StepTimeline.export_trace` emits the whole
+window as Chrome-trace / perfetto JSON (load it at ``chrome://tracing``
+or ui.perfetto.dev).
+
+This is the ONE spine the previously-duplicated host timers now ride:
+
+- ``transformer.pipeline_parallel.Timers`` (the reference's
+  ``_Timers`` port) publishes each stop() into the global timeline —
+  new code should use :class:`StepTimeline` directly (see
+  docs/transformer.md deprecation note);
+- ``profiler.annotate`` adds a host-side span alongside its
+  ``jax.named_scope`` HLO annotation when the global timeline is on;
+- the fused train step takes a ``telemetry=`` timeline and times each
+  dispatch under phase ``"step"`` (host-side only — the jitted
+  program is byte-identical with telemetry on or off).
+
+Overhead discipline: a **disabled** timeline records nothing and every
+entry point returns immediately (the ``make_train_step`` hook returns
+the *same* step object, so the disabled path is exactly the
+un-instrumented path — ``tools/check_telemetry.sh`` holds this to
+<1%). An enabled one costs one ``perf_counter`` pair + a deque append
+per span. ``sync=True`` additionally blocks on the step's outputs
+before stopping the clock — that's the wall/device-sync distinction:
+without it the "step" phase measures dispatch, with it device
+execution (and kills async pipelining, so it's for profiling windows,
+not production loops).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Callable, Dict, Iterable, NamedTuple, Optional
+
+# canonical phase names the instrumented layers use; arbitrary names
+# are fine — these exist so dashboards agree on spelling
+PHASES = ("data_wait", "h2d", "step", "checkpoint", "collective")
+
+
+class Span(NamedTuple):
+    """One timed region: ``t0`` is absolute ``perf_counter`` seconds,
+    ``dur`` seconds, ``step`` the host-loop step index it happened in
+    (-1 = outside any step scope)."""
+
+    name: str
+    t0: float
+    dur: float
+    step: int
+    category: str
+
+
+class StepTimeline:
+    """Ring-buffered span recorder for the training host loop.
+
+    ``capacity`` bounds memory: the newest ``capacity`` spans are kept,
+    older ones fall off (``summary()`` reports how many were dropped).
+    All methods are thread-safe; clock is ``time.perf_counter``.
+    """
+
+    def __init__(self, capacity: int = 4096, *, enabled: bool = True,
+                 sync: bool = False,
+                 clock: Callable[[], float] = time.perf_counter):
+        self.enabled = bool(enabled)
+        self.sync = bool(sync)
+        self.capacity = int(capacity)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._spans: "deque[Span]" = deque(maxlen=self.capacity)
+        self._recorded = 0
+        self._origin = clock()
+        self._step = -1
+        self._step_t0: Optional[float] = None
+
+    # -- recording ---------------------------------------------------------
+
+    def record_span(self, name: str, t0: float, dur: float, *,
+                    category: str = "phase",
+                    step: Optional[int] = None) -> None:
+        if not self.enabled:
+            return
+        with self._lock:
+            self._spans.append(Span(
+                str(name), float(t0), float(dur),
+                self._step if step is None else int(step), str(category)))
+            self._recorded += 1
+
+    @contextlib.contextmanager
+    def phase(self, name: str, *, sync_on: Any = None,
+              category: str = "phase"):
+        """``with tl.phase("h2d"): ...`` — record the block as a span.
+        ``sync_on`` blocks on a jax value before the clock stops, so
+        the span covers device completion, not just dispatch."""
+        if not self.enabled:
+            yield
+            return
+        t0 = self.clock()
+        try:
+            yield
+        finally:
+            if sync_on is not None:
+                import jax
+
+                jax.block_until_ready(sync_on)
+            self.record_span(name, t0, self.clock() - t0,
+                             category=category)
+
+    # -- step scopes -------------------------------------------------------
+
+    def begin_step(self) -> int:
+        """Open a host-loop step; spans recorded until ``end_step``
+        carry its index. Returns the step index."""
+        if not self.enabled:
+            return self._step
+        with self._lock:
+            self._step += 1
+            self._step_t0 = self.clock()
+        return self._step
+
+    def end_step(self) -> None:
+        """Close the open step, recording its whole wall span as
+        ``host_step`` (category ``step``)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            t0, self._step_t0 = self._step_t0, None
+        if t0 is not None:
+            self.record_span("host_step", t0, self.clock() - t0,
+                             category="step")
+
+    @contextlib.contextmanager
+    def step_scope(self):
+        """``with tl.step_scope(): ...`` — begin_step/end_step pair."""
+        self.begin_step()
+        try:
+            yield self._step
+        finally:
+            self.end_step()
+
+    def wrap_iter(self, batches: Iterable,
+                  name: str = "data_wait") -> Iterable:
+        """Time each ``next()`` of ``batches`` as a ``data_wait`` span
+        — wrap your (Prefetch)loader so stalls show in the timeline."""
+        it = iter(batches)
+        while True:
+            t0 = self.clock()
+            try:
+                b = next(it)
+            except StopIteration:
+                return
+            self.record_span(name, t0, self.clock() - t0)
+            yield b
+
+    # -- reading -----------------------------------------------------------
+
+    def spans(self) -> list:
+        with self._lock:
+            return list(self._spans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self._recorded = 0
+            self._step = -1
+            self._step_t0 = None
+            self._origin = self.clock()
+
+    def summary(self) -> Dict[str, Any]:
+        """Per-phase aggregate over the retained window: count,
+        total/mean/max/last ms — the JSON-able phase breakdown bench
+        records carry."""
+        spans = self.spans()
+        phases: Dict[str, Dict[str, float]] = {}
+        for s in spans:
+            p = phases.setdefault(s.name, {
+                "count": 0, "total_ms": 0.0, "max_ms": 0.0, "last_ms": 0.0})
+            ms = s.dur * 1e3
+            p["count"] += 1
+            p["total_ms"] += ms
+            p["max_ms"] = max(p["max_ms"], ms)
+            p["last_ms"] = ms
+        for p in phases.values():
+            p["mean_ms"] = p["total_ms"] / p["count"]
+            for k in ("total_ms", "mean_ms", "max_ms", "last_ms"):
+                p[k] = round(p[k], 4)
+        with self._lock:
+            dropped = self._recorded - len(spans)
+            steps = self._step + 1
+        return {"enabled": self.enabled, "steps": steps,
+                "spans": len(spans), "dropped_spans": dropped,
+                "phases": phases}
+
+    def export_trace(self, path: Optional[str] = None) -> Dict[str, Any]:
+        """The retained window as Chrome-trace JSON (the "JSON Array
+        Format" chrome://tracing and ui.perfetto.dev load): complete
+        ``"ph": "X"`` events with microsecond ``ts``/``dur`` relative
+        to the timeline origin, one tid per category. Writes to
+        ``path`` when given; always returns the dict."""
+        pid = os.getpid()
+        tids: Dict[str, int] = {}
+        events = []
+        for s in self.spans():
+            tid = tids.setdefault(s.category, len(tids))
+            events.append({
+                "name": s.name,
+                "cat": s.category,
+                "ph": "X",
+                "ts": round((s.t0 - self._origin) * 1e6, 3),
+                "dur": round(s.dur * 1e6, 3),
+                "pid": pid,
+                "tid": tid,
+                "args": {"step": s.step},
+            })
+        # thread-name metadata makes the perfetto track labels readable
+        for cat, tid in tids.items():
+            events.append({
+                "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+                "args": {"name": cat},
+            })
+        trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+        if path is not None:
+            tmp = f"{path}.tmp-{pid}"
+            with open(tmp, "w") as f:
+                json.dump(trace, f)
+            os.replace(tmp, path)
+        return trace
+
+    def publish(self, registry=None) -> Dict[str, Any]:
+        """Push the per-phase means into ``timeline_phase_ms`` gauges
+        on the metrics registry; returns the summary."""
+        from apex_tpu.telemetry import metrics as _metrics
+
+        reg = registry if registry is not None else _metrics.registry()
+        summ = self.summary()
+        g = reg.gauge("timeline_phase_ms",
+                      "mean host-loop phase duration over the window")
+        for name, p in summ["phases"].items():
+            g.set(p["mean_ms"], phase=name)
+        return summ
+
+
+# ---------------------------------------------------------------------------
+# The process-global timeline (the spine Timers/annotate/loaders ride)
+# ---------------------------------------------------------------------------
+
+_GLOBAL: Optional[StepTimeline] = None
+_ENV = "APEX_TPU_TELEMETRY"
+
+
+def _env_enabled() -> bool:
+    return os.environ.get(_ENV, "").strip().lower() in (
+        "1", "true", "yes", "on")
+
+
+def get_timeline() -> StepTimeline:
+    """The process-global timeline. Created on first use — DISABLED
+    unless ``APEX_TPU_TELEMETRY`` is truthy or :func:`enable` ran."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = StepTimeline(enabled=_env_enabled())
+    return _GLOBAL
+
+
+def enable(capacity: int = 4096, *, sync: bool = False) -> StepTimeline:
+    """Turn the global timeline on (fresh ring buffer); returns it."""
+    global _GLOBAL
+    _GLOBAL = StepTimeline(capacity=capacity, enabled=True, sync=sync)
+    return _GLOBAL
+
+
+def disable() -> None:
+    global _GLOBAL
+    _GLOBAL = StepTimeline(enabled=False)
+
+
+def global_enabled() -> bool:
+    """Cheap hot-path check: is anything listening?"""
+    tl = _GLOBAL
+    if tl is None:
+        return _env_enabled() and get_timeline().enabled
+    return tl.enabled
+
+
+def record_global_span(name: str, t0: float, dur: float, *,
+                       category: str = "phase") -> None:
+    """Record into the global timeline iff it is enabled (no-op —
+    not even a timeline construction — otherwise)."""
+    tl = _GLOBAL
+    if tl is not None and tl.enabled:
+        tl.record_span(name, t0, dur, category=category)
+    elif tl is None and _env_enabled():
+        get_timeline().record_span(name, t0, dur, category=category)
+
+
+__all__ = [
+    "PHASES",
+    "Span",
+    "StepTimeline",
+    "disable",
+    "enable",
+    "get_timeline",
+    "global_enabled",
+    "record_global_span",
+]

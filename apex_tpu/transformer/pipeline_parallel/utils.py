@@ -134,19 +134,26 @@ def get_ltor_masks_and_position_ids(
 
 class _Timer:
     """Host-side named timer with device sync
-    (ref _timers.py:6-50: cuda.synchronize becomes block_until_ready)."""
+    (ref _timers.py:6-50: cuda.synchronize becomes block_until_ready).
+
+    Every ``stop()`` also publishes the interval as a span into the
+    global :class:`apex_tpu.telemetry.StepTimeline` (category
+    ``timers``) when it is enabled — the legacy Timers surface and the
+    telemetry timeline are one spine, not two clocks."""
 
     def __init__(self, name: str):
         self.name = name
         self.elapsed_ = 0.0
         self.started_ = False
         self.start_time = 0.0
+        self._span_t0 = 0.0
 
     def start(self, barrier_data=None):
         assert not self.started_
         if barrier_data is not None:
             jax.block_until_ready(barrier_data)
         self.start_time = time.time()
+        self._span_t0 = time.perf_counter()
         self.started_ = True
 
     def stop(self, barrier_data=None):
@@ -155,6 +162,11 @@ class _Timer:
             jax.block_until_ready(barrier_data)
         self.elapsed_ += time.time() - self.start_time
         self.started_ = False
+        from apex_tpu.telemetry import timeline as _timeline
+
+        _timeline.record_global_span(
+            self.name, self._span_t0,
+            time.perf_counter() - self._span_t0, category="timers")
 
     def reset(self):
         self.elapsed_ = 0.0
@@ -174,7 +186,13 @@ class _Timer:
 
 class Timers:
     """Named timer registry (ref _timers.py:53-83 + get_timers
-    utils.py:146-157)."""
+    utils.py:146-157).
+
+    .. deprecated:: kept for reference-parity; new code should use
+       :class:`apex_tpu.telemetry.StepTimeline` (phases, ring buffer,
+       Chrome-trace export — docs/observability.md). These timers
+       already publish into the global timeline, so mixed codebases
+       see one merged trace either way."""
 
     def __init__(self):
         self.timers: Dict[str, _Timer] = {}

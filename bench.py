@@ -45,22 +45,35 @@ before consenting to a CPU-fallback record. The probe VERDICT is cached
 (in-process + on-disk TTL, APEX_TPU_BACKEND_PROBE_CACHE_TTL, default
 300 s): a dead tunnel burns its 120 s probe timeouts once per window,
 not once per invocation, and a reused verdict is named in every
-record's detail (``backend_probe: {cached, age_s, ...}``).
+record's detail (``backend_probe: {cached, age_s, ...}``) — read from
+the telemetry registry, where ``ensure_backend`` publishes it.
+
+Every record's ``detail.telemetry`` carries the process telemetry
+snapshot (apex_tpu/telemetry, docs/observability.md): the metrics-
+registry snapshot, the per-phase step timeline (headline mode runs a
+short instrumented loop through the telemetry-wrapped fused step), and
+an ``mfu`` field from XLA's static cost model — a value, or an
+explicit null with the reason (no cost model / unknown chip peak).
 """
 
 import json
 import sys
 import time
 
-# Set by __main__ after the backend guard runs; benches fold it into
-# their JSON detail so every record names the backend that actually ran
-# and whether it was a forced fallback.
-_BACKEND_REPORT = None
-
 
 def backend_detail():
-    if _BACKEND_REPORT is not None:
-        return _BACKEND_REPORT.as_detail()
+    """The backend that actually ran, for every record's detail.
+
+    Read from the telemetry registry (``info.backend_report``, put
+    there by ``ensure_backend(...).publish()`` in ``__main__``) — the
+    one source of truth every consumer shares, replacing the old
+    module-global report object a test or library caller would never
+    see populated."""
+    from apex_tpu.backend_guard import published_report_detail
+
+    detail = published_report_detail()
+    if detail is not None:
+        return dict(detail)
     import jax
 
     return {"backend": jax.default_backend()}
@@ -76,6 +89,7 @@ def emit(rec, kind):
     from apex_tpu.records import is_transcribed, latest_record, write_record
 
     detail = rec.setdefault("detail", {})
+    _fold_telemetry(detail)
     on_tpu = detail.get("backend") == "tpu"
     measured = rec.get("value") is not None
     detail["headline_valid"] = bool(on_tpu and measured)
@@ -96,6 +110,23 @@ def emit(rec, kind):
                        if isinstance(last.get("payload"), dict)
                        and "provenance" in last["payload"] else ""))
     print(json.dumps(rec))
+
+
+def _fold_telemetry(detail):
+    """Fold the process telemetry into this record's detail: registry
+    snapshot, the step-timeline phase breakdown, and an ``mfu`` that is
+    a value or an explicit null with a reason (docs/observability.md).
+    Benches that computed their own block (the headline) keep it; this
+    only fills what's missing, and never fails a record."""
+    try:
+        from apex_tpu import telemetry
+
+        tdet = detail.setdefault("telemetry", {})
+        std = telemetry.snapshot_detail()
+        for k, v in std.items():
+            tdet.setdefault(k, v)
+    except Exception as e:  # noqa: BLE001 — telemetry must not kill emit
+        detail.setdefault("telemetry", {"error": f"{type(e).__name__}: {e}"})
 
 
 def mfu_detail(model_flops, seconds):
@@ -1014,7 +1045,9 @@ def main():
     # in a real (non-fori_loop) training loop; donation is what keeps
     # the queued iterations at a single live state.
     seg_stash_p = True
+    telemetry_block = None
     try:
+        from apex_tpu import telemetry
         from apex_tpu.optimizers.train_step import make_train_step
 
         # segmented layout only where the one-pass kernel exists: on
@@ -1028,11 +1061,32 @@ def main():
             seg_stash_p = bool(fstate.seg_meta.stash_p)
         flat_g = fstate.space.pack(grads, dtype=jnp.float32)
         step = make_train_step(fused)
+        # static XLA accounting of the compiled step BEFORE anything is
+        # donated (lower() executes nothing): flops + bytes for the
+        # record's mfu/bandwidth fields
+        step_cost = telemetry.cost.train_step_cost(step, fstate, flat_g)
         # same K-chained protocol as every other row (TrainStep.chained
         # iterates the identical fused body in one donated fori_loop)
         ts, fstate = measure(step.chained(K), fstate, flat_g)
         fused_times["fused_step"] = ts[len(ts) // 2]
         fused_spreads["fused_step"] = ts
+        # phase breakdown: a short instrumented loop (NOT the headline
+        # timing) through the telemetry-wrapped step — h2d + step
+        # spans, device-synced so the spans cover execution
+        tl = telemetry.StepTimeline(capacity=256, sync=True)
+        inst = step.with_telemetry(tl)
+        host_g = np.asarray(flat_g)
+        for _ in range(3):
+            with tl.step_scope():
+                with tl.phase("h2d"):
+                    g_dev = jax.device_put(host_g)
+                    jax.block_until_ready(g_dev)
+                fstate, _aux = inst(fstate, g_dev)
+        est = telemetry.cost.mfu_estimate(step_cost,
+                                          fused_times["fused_step"])
+        telemetry.cost.publish_mfu(est)
+        tl.publish()
+        telemetry_block = {"step_timeline": tl.summary(), **est}
         del fstate
     except Exception as e:  # noqa: BLE001 — keep the record flowing
         msg = str(e).split("\n")[0][:120]
@@ -1128,6 +1182,10 @@ def main():
             approx_bytes / t_optax / 1e9, 1),
         **backend_detail(),
     }
+    if telemetry_block is not None:
+        # per-phase step timeline + XLA-cost mfu (emit() fills the
+        # registry snapshot and defaults when this block is absent)
+        detail["telemetry"] = telemetry_block
     if jax.default_backend() == "tpu":
         # chip-health context for the record: regressions are only
         # attributable when the streaming ceiling rides with the number
@@ -1199,11 +1257,12 @@ if __name__ == "__main__":
     budget = float(os.environ.get("APEX_TPU_BENCH_PROBE_BUDGET", 600.0))
     # the lock itself warns on stderr if it can't be acquired
     with _guard.tpu_slot_lock():
-        _BACKEND_REPORT = _guard.ensure_backend(
-            min_devices=1, retry_budget=budget)
-        if _BACKEND_REPORT.fallback:
-            print(f"# backend fallback: {_BACKEND_REPORT.note}",
-                  file=sys.stderr)
+        # ensure_backend publishes its report into the telemetry
+        # registry; backend_detail() (and through it every record)
+        # reads the verdict from there
+        report = _guard.ensure_backend(min_devices=1, retry_budget=budget)
+        if report.fallback:
+            print(f"# backend fallback: {report.note}", file=sys.stderr)
 
         modes = {"moe": bench_moe, "gpt": bench_gpt, "attn": bench_attn,
                  "resnet": bench_resnet, "bert": bench_bert,

@@ -1,0 +1,76 @@
+"""profiler surface: the nvtx-parity ``range`` alias must never shadow
+the builtin (module-scope binding removed; served via ``__getattr__``),
+plus the trace/annotate helpers."""
+
+import builtins
+
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import profiler
+
+
+class TestRangeShadowRegression:
+    def test_range_never_in_module_dict(self):
+        # the shadow bug: `range = jax.named_scope` at module scope
+        # meant any code added to profiler.py silently lost the
+        # builtin. The alias now lives ONLY in __getattr__.
+        assert "range" not in vars(profiler)
+        assert "range" not in profiler.__all__
+
+    def test_profiler_range_attribute_works(self):
+        # attribute access keeps nvtx-name parity...
+        assert profiler.range is jax.named_scope
+        with profiler.range("unit_region"):
+            x = jnp.ones((4,)) + 1.0
+        assert float(x.sum()) == 8.0
+        # ...and the decorator form too
+        @profiler.range("deco_region")
+        def f(y):
+            return y * 2
+
+        assert float(f(jnp.float32(3.0))) == 6.0
+
+    def test_from_import_still_resolves(self):
+        # module __getattr__ serves `from apex_tpu.profiler import range`
+        from apex_tpu.profiler import range as prof_range
+
+        assert prof_range is jax.named_scope
+
+    def test_builtin_range_is_the_builtin(self):
+        # calling BOTH in one scope: the builtin is untouched by the
+        # alias (the original regression: intra-module/star-import
+        # code picking up jax.named_scope as `range`)
+        assert list(range(3)) == [0, 1, 2]
+        assert range is builtins.range
+        with profiler.range("both"):
+            assert [i for i in range(2)] == [0, 1]
+
+    def test_star_import_does_not_shadow(self):
+        ns = {}
+        exec("from apex_tpu.profiler import *\n"
+             "out = list(range(3))", ns)
+        assert ns["out"] == [0, 1, 2]
+        assert ns.get("range") is None or ns["range"] is builtins.range
+
+    def test_unknown_attribute_raises(self):
+        try:
+            profiler.definitely_not_here
+        except AttributeError as e:
+            assert "definitely_not_here" in str(e)
+        else:
+            raise AssertionError("expected AttributeError")
+
+    def test_mark_range_and_annotate_still_exported(self):
+        assert profiler.mark_range is jax.named_scope
+        @profiler.annotate("ann")
+        def g(x):
+            return x + 1
+
+        assert g(1) == 2
+
+    def test_cache_stats_passthrough(self):
+        stats = profiler.optimizer_step_cache_stats()
+        for key in ("factory_hits", "factory_misses",
+                    "layout_hits", "layout_misses"):
+            assert key in stats

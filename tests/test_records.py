@@ -70,6 +70,60 @@ class TestRecords:
         rec = records.latest_record("k")
         assert rec is not None and rec["payload"] == {"ok": True}
 
+    def test_corrupt_record_skip_emits_structured_event(
+            self, tmp_path, monkeypatch):
+        """A corrupt JSON line is skipped WITH a telemetry event +
+        counter (never silently): the bench-record analog of
+        latest_valid's corrupt_checkpoint record."""
+        from apex_tpu import records, telemetry
+
+        telemetry.reset()
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        sink = telemetry.InMemorySink()
+        telemetry.registry().add_sink(sink)
+        records.write_record("k", {"ok": True}, backend="tpu")
+        (tmp_path / "k_99999999T999999Z_dead.json").write_text("{not json")
+        assert records.latest_record("k")["payload"] == {"ok": True}
+        reg = telemetry.registry()
+        assert reg.counter("records_corrupt_skipped").value() == 1.0
+        ev = [e for e in sink.events
+              if e["event"] == "record_corrupt_skipped"]
+        assert len(ev) == 1
+        assert ev[0]["file"] == "k_99999999T999999Z_dead.json"
+        assert ev[0]["kind"] == "k" and "Error" in ev[0]["error"]
+        telemetry.reset()
+
+    def test_latest_record_empty_and_missing_directory(
+            self, tmp_path, monkeypatch):
+        from apex_tpu import records
+
+        # empty directory: no matches, no exception
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        assert records.latest_record("k") is None
+        # directory that does not exist at all: same contract
+        monkeypatch.setattr(records, "RECORDS_DIR",
+                            str(tmp_path / "never_made"))
+        assert records.latest_record("k") is None
+
+    def test_latest_record_mixed_kind_files(self, tmp_path, monkeypatch):
+        """A directory holding several kinds (+ non-record files): each
+        kind resolves to ITS newest record, others never cross-match."""
+        from apex_tpu import records
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        records.write_record("headline", {"v": 1}, backend="tpu")
+        records.write_record("attn", {"v": 2}, backend="tpu")
+        records.write_record("attn", {"v": 3}, backend="tpu")
+        records.write_record("resilience", {"v": 4}, backend="tpu")
+        (tmp_path / "notes.txt").write_text("not a record")
+        (tmp_path / "attn_README.json").write_text(
+            json.dumps({"kind": "other", "utc": "99990101T000000Z",
+                        "backend": "tpu", "payload": {"v": "imposter"}}))
+        assert records.latest_record("headline")["payload"] == {"v": 1}
+        assert records.latest_record("attn")["payload"] == {"v": 3}
+        assert records.latest_record("resilience")["payload"] == {"v": 4}
+        assert records.latest_record("notes") is None
+
     def test_seeded_round3_records_parse(self):
         """The transcribed round-3 evidence must stay loadable and
         clearly marked as transcribed at top level. Loaded by explicit

@@ -1,0 +1,109 @@
+#!/usr/bin/env bash
+# Telemetry smoke (CI / pre-merge, next to check_resilience.sh): the
+# telemetry unit tier, then a 20-step smoke train loop run twice —
+# once with telemetry disabled (must add <1% host-loop overhead vs the
+# raw step: the disabled path IS the raw step object) and once with a
+# StepTimeline attached (must export well-formed Chrome-trace/perfetto
+# JSON with the expected phases). Extra args pass through to pytest.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+rc=0
+
+python -m pytest tests/test_telemetry.py tests/test_profiler.py "$@" -q \
+    -p no:cacheprovider || rc=1
+
+echo "== 20-step smoke loop: disabled-telemetry overhead + trace export =="
+python - <<'PY' || rc=1
+import json
+import os
+import tempfile
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import telemetry
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.train_step import make_train_step
+
+rng = np.random.RandomState(0)
+params = {f"p{i}": jnp.asarray(rng.randn(512).astype(np.float32) * 0.02)
+          for i in range(12)}
+opt = FusedAdam(lr=1e-3)
+state = opt.init(params)
+g = jnp.asarray(rng.randn(state.space.total).astype(np.float32) * 1e-3)
+host_g = np.asarray(g)
+
+step = make_train_step(opt)
+disabled = make_train_step(
+    opt, telemetry=telemetry.StepTimeline(enabled=False))
+# the structural guarantee behind the <1% budget: None and a disabled
+# timeline return the SAME cached object — there is no instrumented
+# code on the disabled path to be slow
+assert disabled is step, "disabled telemetry must be the raw step object"
+assert make_train_step(opt, telemetry=None) is step
+
+STEPS = 20
+
+def loop(s, st):
+    for _ in range(STEPS):
+        st, _aux = s(st, g)
+    jax.block_until_ready(st.master)
+    return st
+
+state = loop(step, state)                     # compile + warm
+t_raw = t_off = float("inf")
+for _ in range(11):                           # interleaved best-of
+    t0 = time.perf_counter()
+    state = loop(step, state)
+    t_raw = min(t_raw, time.perf_counter() - t0)
+    t0 = time.perf_counter()
+    state = loop(disabled, state)
+    t_off = min(t_off, time.perf_counter() - t0)
+overhead = t_off / t_raw - 1.0
+print(f"raw={t_raw * 1e3:.3f}ms disabled={t_off * 1e3:.3f}ms "
+      f"overhead={overhead * 100:+.3f}%")
+assert overhead < 0.01, (
+    f"disabled-telemetry host-loop overhead {overhead * 100:.3f}% >= 1%")
+
+# enabled path: phase spans + a loadable Chrome-trace export
+tl = telemetry.StepTimeline(capacity=1024, sync=True)
+inst = make_train_step(opt, telemetry=tl)
+assert inst is not step and inst._jitted is step._jitted
+for _ in range(STEPS):
+    with tl.step_scope():
+        with tl.phase("h2d"):
+            gd = jax.device_put(host_g)
+            jax.block_until_ready(gd)
+        state, _aux = inst(state, gd)
+summ = tl.summary()
+assert summ["phases"]["step"]["count"] == STEPS, summ
+assert summ["phases"]["h2d"]["count"] == STEPS, summ
+
+path = os.path.join(tempfile.mkdtemp(prefix="apex_tpu_tele_"),
+                    "trace.json")
+tl.export_trace(path)
+with open(path) as f:
+    trace = json.load(f)                      # well-formed JSON
+events = trace["traceEvents"]
+complete = [e for e in events if e.get("ph") == "X"]
+assert {e["name"] for e in complete} >= {"h2d", "step", "host_step"}
+for e in complete:
+    assert isinstance(e["ts"], (int, float)) and e["ts"] >= 0
+    assert isinstance(e["dur"], (int, float)) and e["dur"] >= 0
+    assert isinstance(e["pid"], int) and isinstance(e["tid"], int)
+assert len(complete) == 3 * STEPS, len(complete)
+print(f"perfetto trace OK: {len(complete)} complete events, "
+      f"{len(events) - len(complete)} metadata rows -> {path}")
+print("20-step smoke loop: OK")
+PY
+
+if [ "$rc" -eq 0 ]; then
+    echo "check_telemetry: OK"
+else
+    echo "check_telemetry: FAILED (rc=$rc)" >&2
+fi
+exit $rc
