@@ -122,17 +122,34 @@ class TrainStep:
         else:
             args = (state, flat_grads, lr)
         tl = self._telemetry
-        if tl is None:
-            return self._jitted(*args)
-        # host-side only: the jitted program (and its argument list) is
-        # byte-identical with telemetry on or off. sync=True blocks on
-        # the outputs so the span covers device execution, not dispatch.
-        t0 = tl.clock()
-        outs = self._jitted(*args)
-        if tl.sync:
-            jax.block_until_ready(outs)
-        tl.record_span("step", t0, tl.clock() - t0, category="train_step")
-        return outs
+        try:
+            if tl is None:
+                return self._jitted(*args)
+            # host-side only: the jitted program (and its argument list)
+            # is byte-identical with telemetry on or off. sync=True
+            # blocks on the outputs so the span covers device execution,
+            # not dispatch.
+            t0 = tl.clock()
+            outs = self._jitted(*args)
+            if tl.sync:
+                jax.block_until_ready(outs)
+            tl.record_span("step", t0, tl.clock() - t0,
+                           category="train_step")
+            return outs
+        except Exception as e:
+            # flight recorder: an exception escaping the fused-step
+            # dispatch is the canonical "the run just died" moment —
+            # dump the black box before re-raising. The armed-recorder
+            # check is one module-global read; with nothing armed this
+            # except block costs one try frame on the happy path and
+            # nothing else. Host-local trigger: the peers may be
+            # mid-step, so no collective is issued.
+            from apex_tpu.telemetry import flight as _flight
+
+            if _flight.get_recorder() is not None:
+                _flight.notify("train_step_exception", error=e,
+                               fleet=False)
+            raise
 
     def with_telemetry(self, telemetry) -> "TrainStep":
         """A view of this step whose dispatches are timed into the

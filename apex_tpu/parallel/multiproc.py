@@ -70,14 +70,43 @@ def process_collective():
                                 n_processes=col.n_replicas)
         guard = ConsistencyGuard(step.with_options(fingerprint_every=N),
                                  collective=col, manager=mgr)
+
+    On a multi-process CPU cluster (the two-process drills,
+    ``tools/fleet_drill.py``) device collectives don't exist
+    ("Multiprocess computations aren't implemented on the CPU
+    backend"), so the pick is the
+    :class:`~apex_tpu.resilience.guard.KVStoreCollective` riding the
+    same ``jax.distributed`` coordination service — identical
+    protocol, host-side transport.
     """
     import jax
 
-    from apex_tpu.resilience.guard import NullCollective, ProcessCollective
+    from apex_tpu.resilience.guard import (KVStoreCollective,
+                                           NullCollective,
+                                           ProcessCollective)
 
     if jax.process_count() > 1:
+        if jax.default_backend() == "cpu":
+            return KVStoreCollective()
         return ProcessCollective()
     return NullCollective()
+
+
+def fleet_aggregator(**kwargs):
+    """A :class:`~apex_tpu.telemetry.fleet.FleetAggregator` over this
+    runtime's :func:`process_collective` — the one-liner a training
+    loop calls at its aggregation boundaries::
+
+        agg = multiproc.fleet_aggregator(straggler_factor=2.0)
+        ...
+        if (i + 1) % aggregate_every == 0:
+            fleet = agg.aggregate()       # all hosts call it (collective)
+
+    kwargs pass through to ``FleetAggregator``.
+    """
+    from apex_tpu.telemetry.fleet import FleetAggregator
+
+    return FleetAggregator(process_collective(), **kwargs)
 
 
 def local_rank() -> int:
@@ -100,5 +129,6 @@ def world_size() -> int:
     return jax.process_count()
 
 
-__all__ = ["initialize_distributed", "is_coordinator", "local_rank",
-           "process_collective", "process_index", "world_size"]
+__all__ = ["fleet_aggregator", "initialize_distributed", "is_coordinator",
+           "local_rank", "process_collective", "process_index",
+           "world_size"]

@@ -154,6 +154,65 @@ def is_transcribed(rec: Dict[str, Any]) -> bool:
             or str(rec.get("backend", "")).endswith("-transcribed"))
 
 
+def prune_records(kind: str, keep: int) -> list:
+    """Keep only the newest ``keep`` records of ``kind``; returns the
+    removed paths. Never raises.
+
+    Retention for record kinds that a failure loop can write without
+    bound — the flight recorder's ``flightrec`` bundles are the
+    motivating case (a crash-looping process dumps one black box per
+    crash; without pruning it fills the disk that the NEXT checkpoint
+    needs). Ordering matches :func:`latest_record`'s recency rule
+    (record ``utc``, filename uniquifier as the same-second tiebreak),
+    kind-matching matches its ``kind``-field-first semantics, and
+    corrupt files are left in place (``latest_record`` already names
+    them via ``record_corrupt_skipped`` — deleting evidence of disk
+    trouble during disk trouble helps nobody). ``keep <= 0`` prunes
+    nothing (the checkpoint manager's retention convention).
+
+    Records stamped in the CURRENT second are never pruned: deleting
+    one frees its ``O_CREAT|O_EXCL`` claim name, and a same-second
+    writer would re-claim it with the bare (uniquifier-0) name —
+    sorting BELOW its older same-second siblings and breaking
+    ``latest_record``'s write-order tiebreak. One second later the
+    stamp is unreachable and the record prunable, so a crash loop is
+    still bounded at ``keep`` plus the current second's writes.
+    """
+    removed: list = []
+    if keep <= 0:
+        return removed
+    now_stamp = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    try:
+        names = [n for n in os.listdir(RECORDS_DIR)
+                 if n.startswith(f"{kind}_") and n.endswith(".json")]
+    except OSError:
+        return removed
+    matches = []
+    for name in names:
+        path = os.path.join(RECORDS_DIR, name)
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if "kind" in rec:
+            if rec["kind"] != kind:
+                continue
+        elif not _STAMP_RE.match(name[len(kind) + 1:]):
+            continue
+        matches.append((str(rec.get("utc", "")), _uniquifier(name), path))
+    matches.sort()
+    for utc, _, path in matches[:-keep]:
+        if utc == now_stamp:
+            continue
+        try:
+            os.unlink(path)
+        except OSError:
+            continue
+        removed.append(path)
+    return removed
+
+
 def latest_record(kind: str,
                   require_backend: Optional[str] = "tpu",
                   allow_transcribed: bool = True
@@ -225,5 +284,5 @@ def latest_record(kind: str,
     return max(matches, key=lambda t: t[:3])[3]
 
 
-__all__ = ["write_record", "latest_record", "is_transcribed",
-           "RECORDS_DIR"]
+__all__ = ["write_record", "latest_record", "prune_records",
+           "is_transcribed", "RECORDS_DIR"]

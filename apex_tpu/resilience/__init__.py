@@ -42,6 +42,7 @@ from apex_tpu.resilience.guard import (
     ConsistencyGuard,
     DivergenceError,
     DivergenceReport,
+    KVStoreCollective,
     LocalCollective,
     NullCollective,
     PreemptionHandler,
@@ -74,6 +75,7 @@ __all__ = [
     "DivergenceReport",
     "FaultError",
     "FaultInjector",
+    "KVStoreCollective",
     "LocalCollective",
     "NON_RETRYABLE",
     "NonfiniteWatchdog",

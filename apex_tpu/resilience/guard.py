@@ -246,6 +246,84 @@ class ProcessCollective(Collective):
         multihost_utils.sync_global_devices("apex_tpu_guard_barrier")
 
 
+class KVStoreCollective(Collective):
+    """Replica set over the ``jax.distributed`` coordination service's
+    key-value store (the same service ``initialize_distributed``
+    brings up) instead of device collectives.
+
+    ``ProcessCollective`` rides ``multihost_utils``, whose gathers are
+    device computations — unavailable on a multi-process CPU cluster
+    ("Multiprocess computations aren't implemented on the CPU
+    backend"), which is exactly where the two-process drills run. The
+    guard's payloads are tiny host arrays (fingerprints, flags,
+    repaired buffers), so the coordination service is the right
+    transport: each op uses a fresh monotonic key namespace (every
+    replica issues collectives in lockstep — the Collective contract —
+    so sequence numbers agree), values travel as raw ``.npy`` bytes,
+    and barriers are the service's own.
+    ``parallel.multiproc.process_collective()`` picks this class
+    automatically when the cluster's backend is CPU."""
+
+    def __init__(self, *, timeout: float = 60.0,
+                 prefix: str = "apex_tpu_kvc"):
+        import jax
+        from jax._src import distributed
+
+        client = getattr(distributed.global_state, "client", None)
+        if client is None:
+            raise RuntimeError(
+                "jax.distributed is not initialized (no coordination "
+                "client); call initialize_distributed() first")
+        self._client = client
+        self.n_replicas = jax.process_count()
+        self.replica_id = jax.process_index()
+        self.timeout_ms = int(timeout * 1000)
+        self._prefix = prefix
+        self._seq = 0
+
+    def _op(self) -> str:
+        self._seq += 1
+        return f"{self._prefix}/{self._seq}"
+
+    @staticmethod
+    def _encode(arr: np.ndarray) -> bytes:
+        import io
+
+        buf = io.BytesIO()
+        np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+        return buf.getvalue()
+
+    @staticmethod
+    def _decode(data: bytes) -> np.ndarray:
+        import io
+
+        return np.load(io.BytesIO(data), allow_pickle=False)
+
+    def all_gather(self, arr: np.ndarray) -> np.ndarray:
+        op = self._op()
+        self._client.key_value_set_bytes(
+            f"{op}/{self.replica_id}", self._encode(np.asarray(arr)))
+        out = [self._decode(self._client.blocking_key_value_get_bytes(
+            f"{op}/{r}", self.timeout_ms))
+            for r in range(self.n_replicas)]
+        return np.stack(out)
+
+    def broadcast_from(self, src, arrays):
+        op = self._op()
+        if self.replica_id == src:
+            for i, a in enumerate(arrays):
+                self._client.key_value_set_bytes(
+                    f"{op}/{i}", self._encode(np.asarray(a)))
+            return [np.asarray(a) for a in arrays]
+        return [self._decode(self._client.blocking_key_value_get_bytes(
+            f"{op}/{i}", self.timeout_ms))
+            for i in range(len(arrays))]
+
+    def barrier(self) -> None:
+        self._client.wait_at_barrier(self._op().replace("/", "_"),
+                                     self.timeout_ms)
+
+
 class LocalCollective:
     """An in-process replica set: ``handles(n)`` returns one
     :class:`Collective` per simulated host, synchronized with barriers.
@@ -331,9 +409,17 @@ class ConsistencyGuard:
 
     def __init__(self, step, *, collective: Optional[Collective] = None,
                  fingerprint_every: Optional[int] = None, manager=None,
-                 record_kind: str = "resilience", on_event=None):
+                 record_kind: str = "resilience", on_event=None,
+                 flight_recorder=None):
         self.step = step
         self.collective = collective or NullCollective()
+        # the black box this guard's triggers dump to; None -> the
+        # process-global recorder (telemetry.flight). A per-guard
+        # recorder matters in the LocalCollective sim, where every
+        # simulated host needs its own ring + dump (one shared global
+        # recorder would serialize its dump lock across the very
+        # threads whose collectives must run concurrently)
+        self.flight_recorder = flight_recorder
         every = (fingerprint_every if fingerprint_every is not None
                  else step.options.get("fingerprint_every"))
         if not every or int(every) <= 0:
@@ -377,10 +463,17 @@ class ConsistencyGuard:
         return state_fingerprint(state).sums
 
     def _check(self, state, aux):
+        from apex_tpu.telemetry import flight as _flight
+
         col = self.collective
         if col.n_replicas <= 1:
             return state
         sums = self._local_sums(state, aux)
+        # the flight recorder's state-digest ring rides the checksum
+        # the boundary already computed — a postmortem bundle then
+        # shows WHEN the state last verified, at zero extra reductions
+        _flight.record_digest(int(state.count), sums,
+                              recorder=self.flight_recorder)
         # one payload: [count | flattened sums] so step agreement and
         # state agreement ride a single gather
         payload = np.concatenate(
@@ -388,11 +481,17 @@ class ConsistencyGuard:
         gathered = col.all_gather(payload)
         counts = gathered[:, 0].astype(np.int64)
         if len(set(counts.tolist())) != 1:
-            raise DivergenceError(
+            err = DivergenceError(
                 f"replicas are at different step counts {counts.tolist()} "
                 "— the fleet lost lockstep (check data sharding and "
                 "skipped-step divergence) and fingerprints cannot be "
                 "compared")
+            # every replica computes this from the identical gather, so
+            # the fleet-level dump is collective-safe even here
+            _flight.notify("divergence_error", recorder=self.flight_recorder,
+                           error=err, collective=col,
+                           extra={"counts": counts.tolist()})
+            raise err
         report = compare_fingerprints(
             gathered[:, 1:].reshape((col.n_replicas,) + sums.shape))
         self.last_report = report
@@ -435,6 +534,14 @@ class ConsistencyGuard:
         reg.event("replica_divergence", action=action,
                   has_quorum=report.has_quorum,
                   n_sites=len(sites), count=int(state.count))
+        # the black box: every replica reaches this boundary with the
+        # identical report, so the dump may gather the FLEET snapshot
+        # over the same collective — the bundle shows every host's
+        # counters/timeline next to the divergence it explains
+        from apex_tpu.telemetry import flight as _flight
+
+        _flight.notify("replica_divergence", recorder=self.flight_recorder,
+                       collective=col, extra=event)
         if self.on_event is not None:
             self.on_event(event)
 
@@ -450,11 +557,14 @@ class ConsistencyGuard:
             col.barrier()          # nobody restores while a peer still saves
             restored = self.manager.restore(template=state)
             return restored.opt_state
-        raise DivergenceError(
+        err = DivergenceError(
             f"replica state diverged with no agreeing majority "
             f"({col.n_replicas} replicas, sites: "
             f"{[s['name'] for s in sites] or 'unlocalized'}) and no "
             "checkpoint manager to roll back with", report=report)
+        _flight.notify("divergence_error", recorder=self.flight_recorder,
+                       error=err, collective=col, extra=event)
+        raise err
 
     def _adopt_majority(self, state, src: int):
         """Broadcast the majority replica's buffers; every replica
@@ -546,7 +656,8 @@ def graceful_shutdown(manager, step: int, state, *, scaler_state=None,
                       rng_state=None, extra=None,
                       collective: Optional[Collective] = None,
                       handler: Optional[PreemptionHandler] = None,
-                      record_kind: str = "resilience") -> str:
+                      record_kind: str = "resilience",
+                      flight_recorder=None) -> str:
     """The drain action: cross-host barrier, priority final checkpoint,
     structured record. Returns the checkpoint path; the caller exits
     its loop afterwards and a fresh process auto-resumes from
@@ -571,14 +682,23 @@ def graceful_shutdown(manager, step: int, state, *, scaler_state=None,
                             rng_state=rng_state, extra=extra)
     finally:
         manager.async_save = was_async
-    records.write_record(record_kind, {
+    event = {
         "event": "preemption_checkpoint",
         "step": int(step),
         "signum": handler.signum if handler is not None else None,
         "path": path,
         "n_replicas": col.n_replicas,
         "replica_id": col.replica_id,
-    })
+    }
+    records.write_record(record_kind, event)
+    # flight bundle AFTER the final checkpoint is durable: the black
+    # box names the checkpoint a fresh process will resume from. Every
+    # host runs graceful_shutdown (should_stop is an agreement
+    # reduction), so the fleet gather is collective-safe here
+    from apex_tpu.telemetry import flight as _flight
+
+    _flight.notify("preemption_shutdown", recorder=flight_recorder,
+                   collective=col, extra=event)
     return path
 
 
@@ -588,6 +708,7 @@ __all__ = [
     "DivergenceError",
     "DivergenceReport",
     "Fingerprint",
+    "KVStoreCollective",
     "LocalCollective",
     "NullCollective",
     "PreemptionHandler",

@@ -207,6 +207,13 @@ class NonfiniteWatchdog:
                   action=action,
                   suspects=[s["name"] for s in suspects],
                   restored_step=event["restored_step"])
+        # flight recorder: an escalation is a postmortem moment even
+        # when the rollback succeeds — the bundle catches the timeline
+        # and event tail that led here. Host-local trigger (found_inf
+        # is this host's view): no collective is issued.
+        from apex_tpu.telemetry import flight as _flight
+
+        _flight.notify("watchdog_rollback", fleet=False, extra=event)
         if self.on_event is not None:
             self.on_event(event)
 

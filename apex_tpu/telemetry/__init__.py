@@ -1,7 +1,8 @@
-"""Telemetry subsystem: metrics registry + step timeline + cost model.
+"""Telemetry subsystem: metrics registry + step timeline + cost model
++ fleet aggregation + crash flight recorder.
 
 The observability layer the rest of the runtime reports through
-(docs/observability.md). Three parts:
+(docs/observability.md). Five parts:
 
 - :mod:`~apex_tpu.telemetry.metrics` — process-global registry of
   counters / gauges / fixed-bucket histograms with labeled series,
@@ -17,6 +18,15 @@ The observability layer the rest of the runtime reports through
   ``jit(...).lower().compile().cost_analysis()`` and the MFU / HBM-
   bandwidth estimates bench records carry (``None`` **with a reason**
   when the backend has no cost model or the chip no peak entry).
+- :mod:`~apex_tpu.telemetry.fleet` — cross-host snapshot aggregation
+  over the guard's ``Collective`` abstraction (counters summed, gauges
+  per-host, histograms bucket-merged, timelines side by side) with
+  EWMA straggler detection (``fleet_straggler`` events + gauges).
+- :mod:`~apex_tpu.telemetry.flight` — the crash flight recorder:
+  bounded rings of recent events / timeline spans / state digests,
+  dumped as a self-contained ``flightrec_*.json`` postmortem bundle on
+  watchdog escalation, replica divergence, preemption shutdown, or an
+  exception escaping the fused step (keep-last-k pruned).
 
 Who publishes here (the instrumentation pass):
 
@@ -39,7 +49,13 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from apex_tpu.telemetry import cost, metrics, timeline
+from apex_tpu.telemetry import cost, fleet, flight, metrics, timeline
+from apex_tpu.telemetry.fleet import (
+    FleetAggregator,
+    gather_snapshots,
+    merge_snapshots,
+)
+from apex_tpu.telemetry.flight import FlightRecorder
 from apex_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -49,6 +65,7 @@ from apex_tpu.telemetry.metrics import (
     MetricsRegistry,
     StdoutSink,
     registry,
+    to_prometheus_text,
 )
 from apex_tpu.telemetry.timeline import (
     PHASES,
@@ -87,13 +104,17 @@ def snapshot_detail() -> Dict[str, Any]:
 
 
 def reset() -> None:
-    """Fresh registry + disabled global timeline (tests)."""
+    """Fresh registry + disabled global timeline + disarmed flight
+    recorder (tests)."""
+    flight.disable()
     metrics.reset()
     timeline.disable()
 
 
 __all__ = [
     "Counter",
+    "FleetAggregator",
+    "FlightRecorder",
     "Gauge",
     "Histogram",
     "InMemorySink",
@@ -106,12 +127,17 @@ __all__ = [
     "cost",
     "disable",
     "enable",
+    "fleet",
+    "flight",
+    "gather_snapshots",
     "get_timeline",
     "global_enabled",
+    "merge_snapshots",
     "metrics",
     "registry",
     "reset",
     "snapshot",
     "snapshot_detail",
     "timeline",
+    "to_prometheus_text",
 ]

@@ -1,0 +1,322 @@
+"""Fleet telemetry: cross-host snapshot aggregation + straggler
+detection.
+
+PR 4's telemetry spine (registry, StepTimeline, cost model) is
+process-local: every host holds its own registry, and when a host dies
+its snapshot dies with it. But since the distributed guard (PR 3) the
+interesting failures are fleet-level — divergence repair, quorum
+checkpoints, and preemption all happen ACROSS hosts. The reference's
+distributed wrapper only ever offered per-rank NVTX ranges (ref
+apex/parallel/distributed.py:360-561); the production-stack answer
+(TorchTitan, PAPERS.md) is one fleet view. This module is that view:
+
+- :func:`gather_snapshots` collects every host's
+  ``telemetry.snapshot_detail()`` over the SAME 4-method
+  :class:`~apex_tpu.resilience.guard.Collective` abstraction the guard
+  rides (ProcessCollective on a real ``jax.distributed`` cluster, the
+  threaded LocalCollective sim in tests and ``bench.py fleet``,
+  NullCollective for one host). Snapshots are variable-length JSON, so
+  the gather is two fixed-shape collectives: lengths first, then the
+  right-padded utf-8 payloads.
+- :func:`merge_snapshots` folds the per-host snapshots into ONE fleet
+  snapshot: counters summed across hosts, gauges kept per-host plus
+  min/max/mean, histograms bucket-merged (same fixed ``le`` grid on
+  every host, so cumulative counts add), and the per-host step-phase
+  summaries side by side — a dead host's phase breakdown next to its
+  survivors'.
+- :class:`FleetAggregator` derives **straggler detection** on top: a
+  per-host EWMA of each watched phase's mean step time (``step`` and
+  ``data_wait`` by default), the slowest/fastest spread, and a
+  ``fleet_straggler`` event + gauges whenever one host's EWMA exceeds
+  a configurable multiple of the fleet median — the host that is
+  quietly gating every collective gets named while it is still alive.
+
+Every collective here must be called by ALL replicas (the Collective
+contract); single-replica collectives short-circuit to the local
+snapshot so the same loop runs unchanged at both scales.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# phases watched for stragglers by default: the fused-step dispatch and
+# the input-pipeline wait — the two that gate a lockstep fleet
+DEFAULT_STRAGGLER_PHASES: Tuple[str, ...] = ("step", "data_wait")
+
+
+def local_snapshot() -> Dict[str, Any]:
+    """This process's ``telemetry.snapshot_detail()`` (one JSON-able
+    dict: registry + step-timeline summary + mfu-or-null)."""
+    from apex_tpu import telemetry
+
+    return telemetry.snapshot_detail()
+
+
+def gather_snapshots(collective,
+                     snapshot: Optional[Dict[str, Any]] = None
+                     ) -> List[Dict[str, Any]]:
+    """Every host's telemetry snapshot, by replica id, on EVERY host.
+
+    ``snapshot`` overrides the local ``telemetry.snapshot_detail()``
+    (the LocalCollective sim passes one per simulated host — the
+    process-global registry can't be three hosts at once). A collective
+    op: all replicas must call it; with no collective (or one replica)
+    it degrades to ``[snapshot]`` with zero collectives issued.
+    """
+    if snapshot is None:
+        snapshot = local_snapshot()
+    if collective is None or collective.n_replicas <= 1:
+        return [dict(snapshot)]
+    data = json.dumps(snapshot, sort_keys=True).encode("utf-8")
+    # two fixed-shape gathers carry the variable-length payloads:
+    # every replica must present the same array shape, so lengths go
+    # first and the payloads ride right-padded to the fleet max
+    lens = collective.all_gather(np.asarray([len(data)], np.int64))
+    max_len = int(lens.max())
+    buf = np.zeros((max_len,), np.uint8)
+    buf[: len(data)] = np.frombuffer(data, np.uint8)
+    gathered = collective.all_gather(buf)
+    out = []
+    for r in range(collective.n_replicas):
+        n = int(np.asarray(lens)[r, 0])
+        out.append(json.loads(bytes(bytearray(
+            np.asarray(gathered)[r, :n])).decode("utf-8")))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Merge
+# ---------------------------------------------------------------------------
+
+
+def _merge_histograms(series: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Bucket-merge one histogram series across hosts. Buckets are the
+    fixed ``le`` grids from metrics.Histogram — cumulative counts at
+    the same upper bound simply add; a bound only some hosts carry
+    (different bucket config) sums over the hosts that have it."""
+    buckets: Dict[str, float] = {}
+    total_sum = 0.0
+    total_count = 0
+    for s in series:
+        for le, c in (s.get("buckets") or {}).items():
+            buckets[le] = buckets.get(le, 0) + c
+        total_sum += s.get("sum", 0.0)
+        total_count += s.get("count", 0)
+
+    def _le_key(le: str) -> float:
+        return float("inf") if le == "+Inf" else float(le)
+
+    return {"buckets": {le: buckets[le]
+                        for le in sorted(buckets, key=_le_key)},
+            "sum": total_sum, "count": total_count}
+
+
+def merge_snapshots(per_host: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Fold per-host ``snapshot_detail`` dicts into one fleet snapshot.
+
+    Counters sum (a fleet-total event count is meaningful); gauges are
+    last-write-wins per host so summing would lie — they stay per-host
+    with min/max/mean derived; histograms bucket-merge; the step-phase
+    summaries (and mfu) sit side by side keyed by replica id. Hosts
+    whose timeline was disabled contribute ``None`` — the merge never
+    demands telemetry a host didn't collect.
+    """
+    counters: Dict[str, float] = {}
+    gauges: Dict[str, Dict[str, Any]] = {}
+    hist_series: Dict[str, List[Dict[str, Any]]] = {}
+    timelines: Dict[str, Any] = {}
+    mfu: Dict[str, Any] = {}
+    info: Dict[str, Any] = {}
+    for r, snap in enumerate(per_host):
+        reg = snap.get("registry") or {}
+        for name, v in (reg.get("counters") or {}).items():
+            counters[name] = counters.get(name, 0.0) + v
+        for name, v in (reg.get("gauges") or {}).items():
+            gauges.setdefault(name, {"per_host": {}})["per_host"][
+                str(r)] = v
+        for name, v in (reg.get("histograms") or {}).items():
+            hist_series.setdefault(name, []).append(v)
+        if reg.get("info"):
+            info[str(r)] = reg["info"]
+        timelines[str(r)] = snap.get("step_timeline")
+        mfu[str(r)] = snap.get("mfu")
+    for g in gauges.values():
+        vals = list(g["per_host"].values())
+        g["min"] = min(vals)
+        g["max"] = max(vals)
+        g["mean"] = sum(vals) / len(vals)
+    return {
+        "n_hosts": len(per_host),
+        "counters": counters,
+        "gauges": gauges,
+        "histograms": {name: _merge_histograms(s)
+                       for name, s in hist_series.items()},
+        "step_timelines": timelines,
+        "mfu": mfu,
+        **({"info": info} if info else {}),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Straggler detection
+# ---------------------------------------------------------------------------
+
+
+def phase_means_by_host(per_host: Sequence[Dict[str, Any]],
+                        phase: str) -> Dict[int, float]:
+    """``{replica_id: mean_ms}`` of one timeline phase, over the hosts
+    that actually timed it (disabled timelines drop out silently)."""
+    out: Dict[int, float] = {}
+    for r, snap in enumerate(per_host):
+        tl = snap.get("step_timeline")
+        if not tl:
+            continue
+        p = (tl.get("phases") or {}).get(phase)
+        if p and p.get("count"):
+            out[r] = float(p["mean_ms"])
+    return out
+
+
+class FleetAggregator:
+    """Gather + merge + straggler detection, one call per aggregation
+    boundary (``aggregate()``), over a guard-style collective.
+
+    Per watched phase the aggregator keeps a per-host EWMA of the
+    phase's windowed mean (``ewma_alpha`` — one noisy window doesn't
+    flag a host; a persistently slow one converges fast). A host whose
+    EWMA exceeds ``straggler_factor`` x the fleet MEDIAN EWMA is a
+    straggler: reported in the returned fleet snapshot's
+    ``straggler`` section, published as gauges
+    (``fleet_phase_ms{phase=,host=}``, ``fleet_straggler_spread``
+    slowest/fastest ratio, ``fleet_stragglers`` count) and as one
+    ``fleet_straggler`` event per flagged (host, phase). The median —
+    not the mean — anchors the test so one dying host cannot drag the
+    reference toward itself.
+    """
+
+    def __init__(self, collective=None, *, straggler_factor: float = 2.0,
+                 ewma_alpha: float = 0.25,
+                 phases: Sequence[str] = DEFAULT_STRAGGLER_PHASES,
+                 registry=None):
+        if straggler_factor <= 1.0:
+            raise ValueError(
+                f"straggler_factor must be > 1, got {straggler_factor}")
+        if not (0.0 < ewma_alpha <= 1.0):
+            raise ValueError(
+                f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
+        self.collective = collective
+        self.straggler_factor = float(straggler_factor)
+        self.ewma_alpha = float(ewma_alpha)
+        self.phases = tuple(phases)
+        self._registry = registry
+        self._ewma: Dict[Tuple[str, int], float] = {}
+        self.last_fleet: Optional[Dict[str, Any]] = None
+
+    # -- ewma --------------------------------------------------------------
+
+    def _ewma_update(self, phase: str, host: int, value: float) -> float:
+        key = (phase, host)
+        prev = self._ewma.get(key)
+        cur = (value if prev is None
+               else self.ewma_alpha * value
+               + (1.0 - self.ewma_alpha) * prev)
+        self._ewma[key] = cur
+        return cur
+
+    def straggler_report(self, per_host: Sequence[Dict[str, Any]]
+                         ) -> Dict[str, Any]:
+        """Pure derivation (plus EWMA state update): per-phase EWMAs,
+        median, slowest/fastest spread, and the flagged hosts."""
+        phases: Dict[str, Any] = {}
+        n_stragglers = 0
+        for phase in self.phases:
+            means = phase_means_by_host(per_host, phase)
+            ewmas = {h: self._ewma_update(phase, h, v)
+                     for h, v in sorted(means.items())}
+            entry: Dict[str, Any] = {
+                "per_host_ewma_ms": {str(h): round(v, 4)
+                                     for h, v in ewmas.items()},
+            }
+            if ewmas:
+                vals = list(ewmas.values())
+                median = float(np.median(vals))
+                lo, hi = min(vals), max(vals)
+                entry["median_ms"] = round(median, 4)
+                entry["spread"] = round(hi / lo, 4) if lo > 0 else None
+                flagged = []
+                if median > 0 and len(ewmas) > 1:
+                    for h, v in ewmas.items():
+                        if v > self.straggler_factor * median:
+                            flagged.append({"host": str(h),
+                                            "ewma_ms": round(v, 4),
+                                            "ratio_to_median":
+                                                round(v / median, 4)})
+                entry["stragglers"] = flagged
+                n_stragglers += len(flagged)
+            phases[phase] = entry
+        return {"factor": self.straggler_factor,
+                "ewma_alpha": self.ewma_alpha,
+                "n_stragglers": n_stragglers,
+                "phases": phases}
+
+    # -- publish -----------------------------------------------------------
+
+    def _publish(self, straggler: Dict[str, Any]) -> None:
+        from apex_tpu.telemetry import metrics as _metrics
+
+        reg = (self._registry if self._registry is not None
+               else _metrics.registry())
+        phase_g = reg.gauge("fleet_phase_ms",
+                            "per-host EWMA of a watched phase's mean "
+                            "duration over the fleet")
+        spread_g = reg.gauge("fleet_straggler_spread",
+                             "slowest/fastest per-host EWMA ratio of a "
+                             "watched phase")
+        count_g = reg.gauge("fleet_stragglers",
+                            "hosts currently past the straggler "
+                            "threshold, all watched phases")
+        for phase, entry in straggler["phases"].items():
+            for h, v in entry.get("per_host_ewma_ms", {}).items():
+                phase_g.set(v, phase=phase, host=h)
+            spread_g.set(entry.get("spread") or 1.0, phase=phase)
+            for s in entry.get("stragglers", ()):
+                reg.event("fleet_straggler", phase=phase, host=s["host"],
+                          ewma_ms=s["ewma_ms"],
+                          ratio_to_median=s["ratio_to_median"],
+                          factor=self.straggler_factor)
+        count_g.set(straggler["n_stragglers"])
+
+    # -- the boundary ------------------------------------------------------
+
+    def aggregate(self, snapshot: Optional[Dict[str, Any]] = None, *,
+                  publish: bool = True) -> Dict[str, Any]:
+        """One aggregation boundary: gather every host's snapshot,
+        merge, update straggler EWMAs, publish the fleet gauges/events
+        into the LOCAL registry (every host derives the identical
+        report from the identical gather, so any host can alert), and
+        return the fleet snapshot. Collective: all replicas call it."""
+        t0 = time.perf_counter()
+        per_host = gather_snapshots(self.collective, snapshot)
+        fleet = merge_snapshots(per_host)
+        fleet["straggler"] = self.straggler_report(per_host)
+        fleet["aggregation_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 4)
+        if publish:
+            self._publish(fleet["straggler"])
+        self.last_fleet = fleet
+        return fleet
+
+
+__all__ = [
+    "DEFAULT_STRAGGLER_PHASES",
+    "FleetAggregator",
+    "gather_snapshots",
+    "local_snapshot",
+    "merge_snapshots",
+    "phase_means_by_host",
+]

@@ -1,0 +1,307 @@
+"""Crash flight recorder: a bounded black box dumped on failure.
+
+"A host died at step 40k" is a shrug unless the evidence survives the
+death. This module continuously retains the CHEAP tail of a run — the
+last window of timeline spans, recent structured telemetry events, and
+compact state digests riding the segmented per-leaf checksums from the
+consistency guard — and on a trigger dumps one self-contained
+``flightrec_*.json`` postmortem bundle through the records tmp→fsync→
+rename protocol, so the bundle is on the platter before the process is
+gone.
+
+Triggers (wired across the runtime; see docs/observability.md):
+
+====================== ====================================================
+trigger                fired by
+====================== ====================================================
+``watchdog_rollback``  ``resilience.watchdog`` escalation (rollback /
+                       scaler reset past the skip threshold)
+``replica_divergence`` ``resilience.guard`` divergence boundary (majority
+                       repair or rollback)
+``divergence_error``   unrecoverable divergence / lost lockstep
+                       (``DivergenceError`` about to raise)
+``preemption_shutdown`` ``resilience.guard.graceful_shutdown`` (SIGTERM
+                       drain, final checkpoint written)
+``train_step_exception`` unhandled exception escaping the fused-step
+                       dispatch (``optimizers.train_step``)
+====================== ====================================================
+
+Fleet-level triggers (the guard's, the shutdown's) fire on EVERY
+replica at the same loop point, so the dump may safely run a fleet
+aggregation (:mod:`~apex_tpu.telemetry.fleet`) over the attached
+collective — the bundle then carries the merged fleet snapshot and the
+straggler gauges, not just this host's view. Host-local triggers
+(watchdog, step exception) must never issue a collective (the peers
+are not there) — they dump the local snapshot and say so.
+
+The recorder costs a deque append per retained event/digest; the
+timeline ring is the one the process already keeps. Nothing here runs
+on the step hot path until a trigger fires, and a failing dump never
+takes the run down (``notify`` swallows everything — the flight
+recorder exists to explain failures, not to cause them).
+
+Retention: bundles get their own records ``kind`` (``flightrec``) with
+keep-last-``keep`` pruning (``records.prune_records``) after every
+dump, so a crash-looping process cannot fill the disk with black
+boxes.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+FLIGHT_KIND = "flightrec"
+_CKPT_STEP_RE = re.compile(r"step_(\d+)$")
+
+
+class FlightRecorder:
+    """The black box: bounded rings + the atomic bundle dump.
+
+    Attach it to the metrics registry as a SINK
+    (``registry().add_sink(recorder)`` — :func:`enable` does this) and
+    every structured event lands in the ``recent_events`` ring; feed
+    fingerprint rows to :meth:`record_digest` (the consistency guard
+    does, at every boundary) and the last ``digest_capacity`` state
+    digests ride along.
+
+    - ``last_steps``: how many host-loop steps of timeline spans the
+      bundle's perfetto slice covers.
+    - ``timeline``: a :class:`~apex_tpu.telemetry.StepTimeline`; None
+      means the process-global timeline at dump time.
+    - ``collective`` / ``manager``: the guard's collective (fleet
+      snapshot in the bundle, when the trigger is fleet-safe) and the
+      checkpoint manager (last valid checkpoint identity).
+    - ``keep``: keep-last-k pruning of ``flightrec`` records.
+    """
+
+    def __init__(self, *, last_steps: int = 64,
+                 event_capacity: int = 256, digest_capacity: int = 128,
+                 timeline=None, collective=None, manager=None,
+                 keep: int = 5, straggler_factor: float = 2.0):
+        self.last_steps = int(last_steps)
+        self.keep = int(keep)
+        self.timeline = timeline
+        self.collective = collective
+        self.manager = manager
+        self.events: "deque[Dict[str, Any]]" = deque(
+            maxlen=int(event_capacity))
+        self.digests: "deque[Dict[str, Any]]" = deque(
+            maxlen=int(digest_capacity))
+        self.dumps = 0
+        self.last_dump: Optional[str] = None
+        self.last_trigger: Optional[str] = None
+        self._lock = threading.Lock()
+        self._aggregator = None
+        self._straggler_factor = float(straggler_factor)
+
+    # -- sink protocol (registry.add_sink) ---------------------------------
+
+    def write_event(self, event: Dict[str, Any]) -> None:
+        self.events.append(dict(event))
+
+    def write_snapshot(self, snap: Dict[str, Any]) -> None:
+        pass                                   # rings hold events only
+
+    def close(self) -> None:
+        pass
+
+    # -- state digests ------------------------------------------------------
+
+    def record_digest(self, step: int, sums) -> None:
+        """Retain a compact digest of one fingerprint: per-buffer
+        uint32 checksum rows reduced to one xor word + per-row sums
+        (``sums`` is the guard's (n_buffers, num_leaves) array). Cheap
+        enough to call at every fingerprint boundary; the full
+        per-leaf matrix stays with the guard's divergence record."""
+        import numpy as np
+
+        arr = np.asarray(sums, dtype=np.uint32)
+        self.digests.append({
+            "step": int(step),
+            "xor": int(np.bitwise_xor.reduce(arr, axis=None)),
+            "row_sums": [int(s) for s in
+                         arr.reshape(arr.shape[0], -1)
+                         .astype(np.uint64).sum(axis=1) % (1 << 32)],
+        })
+
+    # -- the dump -----------------------------------------------------------
+
+    def _fleet_snapshot(self, collective):
+        from apex_tpu.telemetry.fleet import FleetAggregator
+
+        if self._aggregator is None or \
+                self._aggregator.collective is not collective:
+            self._aggregator = FleetAggregator(
+                collective, straggler_factor=self._straggler_factor)
+        # publishes the fleet/straggler gauges BEFORE the local
+        # snapshot below is taken, so the bundle's registry carries them
+        return self._aggregator.aggregate()
+
+    def _trace_slice(self, timeline):
+        from apex_tpu.telemetry import timeline as _timeline
+
+        tl = timeline if timeline is not None else _timeline.get_timeline()
+        if tl is None or not tl.enabled:
+            return None
+        return tl.export_trace(last_steps=self.last_steps)
+
+    def _last_checkpoint(self):
+        if self.manager is None:
+            return None
+        try:
+            path = self.manager.latest_valid(record_events=False)
+        except Exception as e:  # noqa: BLE001 — identity is best-effort
+            return {"error": f"{type(e).__name__}: {e}"}
+        if path is None:
+            return {"path": None}
+        m = _CKPT_STEP_RE.search(os.path.basename(path))
+        return {"path": path,
+                "step": int(m.group(1)) if m else None}
+
+    def dump(self, trigger: str, *, error: Optional[BaseException] = None,
+             fleet: bool = True, collective=None,
+             extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+        """Write one postmortem bundle; returns the record path (None
+        when the disk write failed — ``write_record`` never raises).
+
+        ``fleet=True`` gathers + merges the fleet snapshot over the
+        attached (or passed) collective — ONLY safe when every replica
+        reaches this dump (the guard's triggers); host-local triggers
+        pass ``fleet=False`` and the bundle records why the fleet view
+        is absent.
+        """
+        from apex_tpu import records, telemetry
+        from apex_tpu.resilience import faults
+
+        with self._lock:
+            col = collective if collective is not None else self.collective
+            fleet_snap = None
+            fleet_unavailable = None
+            if not fleet:
+                fleet_unavailable = ("host-local trigger: peers not at "
+                                     "this dump point, no collective "
+                                     "issued")
+            elif col is None or col.n_replicas <= 1:
+                fleet_unavailable = ("no multi-replica collective "
+                                     "attached (single-host bundle)")
+            else:
+                try:
+                    fleet_snap = self._fleet_snapshot(col)
+                except Exception as e:  # noqa: BLE001
+                    fleet_unavailable = f"{type(e).__name__}: {e}"
+            bundle = {
+                "trigger": str(trigger),
+                "wall_time": time.time(),
+                "pid": os.getpid(),
+                "replica_id": getattr(col, "replica_id", 0),
+                "n_replicas": getattr(col, "n_replicas", 1),
+                "error": (f"{type(error).__name__}: {error}"
+                          if error is not None else None),
+                # AFTER the fleet aggregation so the straggler gauges
+                # it published are in this registry snapshot
+                "telemetry": telemetry.snapshot_detail(),
+                "fleet": fleet_snap,
+                **({"fleet_unavailable": fleet_unavailable}
+                   if fleet_unavailable else {}),
+                "trace": self._trace_slice(self.timeline),
+                "recent_events": list(self.events),
+                "state_digests": list(self.digests),
+                "last_checkpoint": self._last_checkpoint(),
+                "faults": os.environ.get(faults.ENV_KNOB) or None,
+                "extra": extra,
+            }
+            path = records.write_record(FLIGHT_KIND, bundle)
+            records.prune_records(FLIGHT_KIND, keep=self.keep)
+            self.dumps += 1
+            self.last_dump = path
+            self.last_trigger = str(trigger)
+        # after the bundle is durable: one event names it (lands in the
+        # registry + sinks + this recorder's own ring for the NEXT dump)
+        try:
+            telemetry.registry().event("flight_dump", trigger=str(trigger),
+                                       path=path)
+        except Exception:  # noqa: BLE001
+            pass
+        return path
+
+
+# ---------------------------------------------------------------------------
+# The process-global recorder (what the runtime triggers notify)
+# ---------------------------------------------------------------------------
+
+_GLOBAL: Optional[FlightRecorder] = None
+
+
+def enable(**kwargs) -> FlightRecorder:
+    """Arm the process-global flight recorder (kwargs =
+    :class:`FlightRecorder`); attaches it to the metrics registry as an
+    event sink. Re-arming replaces the previous recorder."""
+    global _GLOBAL
+    from apex_tpu.telemetry import metrics as _metrics
+
+    disable()
+    _GLOBAL = FlightRecorder(**kwargs)
+    _metrics.registry().add_sink(_GLOBAL)
+    return _GLOBAL
+
+
+def disable() -> None:
+    global _GLOBAL
+    if _GLOBAL is not None:
+        try:
+            from apex_tpu.telemetry import metrics as _metrics
+
+            _metrics.registry().remove_sink(_GLOBAL)
+        except Exception:  # noqa: BLE001
+            pass
+        _GLOBAL = None
+
+
+def get_recorder() -> Optional[FlightRecorder]:
+    return _GLOBAL
+
+
+def notify(trigger: str, *, recorder: Optional[FlightRecorder] = None,
+           error: Optional[BaseException] = None, fleet: bool = True,
+           collective=None,
+           extra: Optional[Dict[str, Any]] = None) -> Optional[str]:
+    """Dump a bundle on ``recorder`` (or the global one); a no-op
+    returning None when nothing is armed, and NEVER raises — the
+    trigger sites sit on failure paths that must stay on course."""
+    rec = recorder if recorder is not None else _GLOBAL
+    if rec is None:
+        return None
+    try:
+        return rec.dump(trigger, error=error, fleet=fleet,
+                        collective=collective, extra=extra)
+    except Exception:  # noqa: BLE001 — the black box must not crash the run
+        return None
+
+
+def record_digest(step: int, sums, *,
+                  recorder: Optional[FlightRecorder] = None) -> None:
+    """Feed one fingerprint digest to ``recorder`` (or the global
+    one); no-op when nothing is armed; never raises."""
+    rec = recorder if recorder is not None else _GLOBAL
+    if rec is None:
+        return
+    try:
+        rec.record_digest(step, sums)
+    except Exception:  # noqa: BLE001
+        pass
+
+
+__all__ = [
+    "FLIGHT_KIND",
+    "FlightRecorder",
+    "disable",
+    "enable",
+    "get_recorder",
+    "notify",
+    "record_digest",
+]

@@ -41,6 +41,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import sys
 import threading
 import time
@@ -452,6 +453,16 @@ class MetricsRegistry:
                 pass
         return snap
 
+    def to_prometheus_text(self) -> str:
+        """This registry as the Prometheus text exposition format
+        (``# HELP``/``# TYPE`` with the instruments' live help text,
+        labeled series, histogram ``le`` buckets + ``_sum``/``_count``
+        — see :func:`prometheus_text_from_snapshot`)."""
+        with self._lock:
+            help_map = {m.name: (m.help, m.kind)
+                        for m in self._metrics.values()}
+        return prometheus_text_from_snapshot(self.snapshot(), help_map)
+
     def reset(self) -> None:
         """Drop every metric, info blob, and sink (tests)."""
         with self._lock:
@@ -463,6 +474,94 @@ class MetricsRegistry:
             self._metrics.clear()
             self._info.clear()
             self._sinks.clear()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+# "name" or 'name{k="v",k2="v2"}' — the exact shape _series_name emits,
+# so the label block can be reused verbatim in the output lines
+_SERIES_RE = re.compile(r"^(?P<name>[^{]+?)(?:\{(?P<labels>.*)\})?$")
+
+
+def _split_series(series_name: str) -> Tuple[str, str]:
+    m = _SERIES_RE.match(series_name)
+    return m.group("name"), (m.group("labels") or "")
+
+
+def _prom_escape_help(text: str) -> str:
+    return text.replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _prom_num(v: float) -> str:
+    f = float(v)
+    return str(int(f)) if f == int(f) else repr(f)
+
+
+def _with_label(labels: str, extra: str) -> str:
+    inner = f"{labels},{extra}" if labels else extra
+    return "{" + inner + "}"
+
+
+def prometheus_text_from_snapshot(
+        snap: Dict[str, Any],
+        help_map: Optional[Dict[str, Tuple[str, str]]] = None) -> str:
+    """Render a :meth:`MetricsRegistry.snapshot` dict (live, or loaded
+    back from a bench record / flight-recorder bundle) as the
+    Prometheus text exposition format: ``# HELP``/``# TYPE`` headers,
+    labeled series, histogram ``_bucket{le=...}`` rows (cumulative,
+    ``+Inf`` included) plus ``_sum``/``_count``.
+
+    ``help_map`` is ``{base_name: (help, kind)}``; absent entries get
+    an empty HELP line (a snapshot on disk does not carry help text).
+    Info blobs are not representable in the text format and are
+    skipped.
+    """
+    help_map = help_map or {}
+    lines: List[str] = []
+    seen_header: set = set()
+
+    def header(name: str, default_kind: str) -> None:
+        if name in seen_header:
+            return
+        seen_header.add(name)
+        help_text, kind = help_map.get(name, ("", default_kind))
+        lines.append(f"# HELP {name} {_prom_escape_help(help_text)}")
+        lines.append(f"# TYPE {name} {kind or default_kind}")
+
+    for section, kind in (("counters", "counter"), ("gauges", "gauge")):
+        for series, value in sorted((snap.get(section) or {}).items()):
+            name, labels = _split_series(series)
+            header(name, kind)
+            label_block = "{" + labels + "}" if labels else ""
+            lines.append(f"{name}{label_block} {_prom_num(value)}")
+    for series, h in sorted((snap.get("histograms") or {}).items()):
+        name, labels = _split_series(series)
+        header(name, "histogram")
+        buckets = h.get("buckets") or {}
+
+        def _le_key(le: str) -> float:
+            return float("inf") if le == "+Inf" else float(le)
+
+        for le in sorted(buckets, key=_le_key):
+            le_label = 'le="' + le + '"'
+            lines.append(f"{name}_bucket{_with_label(labels, le_label)} "
+                         f"{_prom_num(buckets[le])}")
+        label_block = "{" + labels + "}" if labels else ""
+        lines.append(f"{name}_sum{label_block} {_prom_num(h.get('sum', 0.0))}")
+        lines.append(f"{name}_count{label_block} "
+                     f"{_prom_num(h.get('count', 0))}")
+    return "\n".join(lines) + ("\n" if lines else "")
+
+
+def to_prometheus_text(snapshot: Optional[Dict[str, Any]] = None) -> str:
+    """Prometheus text exposition of ``snapshot`` (or the process-global
+    registry, with live HELP text) — what ``tools/telemetry_dump.py``
+    prints and a node-exporter-style scrape endpoint would serve."""
+    if snapshot is None:
+        return _REGISTRY.to_prometheus_text()
+    return prometheus_text_from_snapshot(snapshot)
 
 
 _REGISTRY = MetricsRegistry()
@@ -490,7 +589,9 @@ __all__ = [
     "JsonlSink",
     "MetricsRegistry",
     "StdoutSink",
+    "prometheus_text_from_snapshot",
     "registry",
     "reset",
     "snapshot",
+    "to_prometheus_text",
 ]

@@ -200,16 +200,27 @@ class StepTimeline:
                 "spans": len(spans), "dropped_spans": dropped,
                 "phases": phases}
 
-    def export_trace(self, path: Optional[str] = None) -> Dict[str, Any]:
+    def export_trace(self, path: Optional[str] = None, *,
+                     last_steps: Optional[int] = None) -> Dict[str, Any]:
         """The retained window as Chrome-trace JSON (the "JSON Array
         Format" chrome://tracing and ui.perfetto.dev load): complete
         ``"ph": "X"`` events with microsecond ``ts``/``dur`` relative
         to the timeline origin, one tid per category. Writes to
-        ``path`` when given; always returns the dict."""
+        ``path`` when given; always returns the dict.
+
+        ``last_steps=N`` slices to the newest ``N`` host-loop steps —
+        the flight recorder's bundle window. Spans recorded outside any
+        step scope (``step == -1``) are kept: they cannot be dated by
+        step, and the ring already bounds them."""
+        spans = self.spans()
+        if last_steps is not None and spans:
+            newest = max(s.step for s in spans)
+            cutoff = newest - int(last_steps) + 1
+            spans = [s for s in spans if s.step < 0 or s.step >= cutoff]
         pid = os.getpid()
         tids: Dict[str, int] = {}
         events = []
-        for s in self.spans():
+        for s in spans:
             tid = tids.setdefault(s.category, len(tids))
             events.append({
                 "name": s.name,

@@ -36,6 +36,17 @@ the driver's no-arg invocation prints only the headline metric):
                            # latency + bandwidth, async-save submit
                            # cost, and watchdog steps-to-recover under
                            # an injected NaN burst (docs/resilience.md)
+    python bench.py fleet  # cross-host telemetry aggregation latency +
+                           # straggler detection on the 4-host
+                           # LocalCollective sim (docs/observability.md)
+
+Records whose bench computed no in-run baseline no longer carry
+``"vs_baseline": null``: emit() compares the value against the newest
+PRIOR run of the same metric (bench_records entry, else the repo-root
+``BENCH_r*.json`` round artifacts), stamps the ratio + prior run id
+into the record, and fires a ``bench_regression`` telemetry event when
+the headline worsened past APEX_TPU_BENCH_REGRESSION_THRESHOLD
+(default 1.1).
 
 Accelerator modes emit absolute accounting (model_flops / tflops_per_sec
 / mfu, or HBM GB/s for the bandwidth-bound optimizer step) alongside the
@@ -79,6 +90,114 @@ def backend_detail():
     return {"backend": jax.default_backend()}
 
 
+def prior_measurement(metric, kind, root=None):
+    """The newest PRIOR measurement of ``metric``: scans the persisted
+    ``bench_records/`` entries of ``kind`` (payload ``metric`` must
+    match — error records share the kind) and the driver round
+    artifacts ``BENCH_r*.json`` at the repo root (their ``tail`` holds
+    the emitted JSON lines). Returns ``{"value", "run", "utc"?}`` or
+    None. bench_records win when present (they carry a UTC stamp and
+    provenance); the round artifacts are the fallback for metrics the
+    records dir has never seen."""
+    import glob
+    import os
+
+    from apex_tpu import records as _records
+
+    # 1) bench_records: newest record of this kind whose payload is a
+    # real measurement of this metric
+    best = None
+    try:
+        names = [n for n in os.listdir(_records.RECORDS_DIR)
+                 if n.startswith(f"{kind}_") and n.endswith(".json")]
+    except OSError:
+        names = []
+    for name in names:
+        try:
+            with open(os.path.join(_records.RECORDS_DIR, name)) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        payload = rec.get("payload")
+        if not isinstance(payload, dict):
+            continue
+        if payload.get("metric") != metric or payload.get("value") is None:
+            continue
+        key = (str(rec.get("utc", "")), name)
+        if best is None or key > best[0]:
+            best = (key, {"value": float(payload["value"]),
+                          "run": name, "utc": rec.get("utc")})
+    if best is not None:
+        return best[1]
+    # 2) BENCH_r*.json round artifacts: highest round number wins
+    root = root if root is not None else os.path.dirname(
+        os.path.abspath(__file__))
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json")),
+                       reverse=True):
+        try:
+            with open(path) as f:
+                art = json.load(f)
+        except (OSError, ValueError):
+            continue
+        for line in reversed(str(art.get("tail", "")).splitlines()):
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if rec.get("metric") == metric and rec.get("value") is not None:
+                return {"value": float(rec["value"]),
+                        "run": os.path.basename(path)}
+    return None
+
+
+def _fill_vs_baseline(rec, kind, root=None):
+    """No more ``"vs_baseline": null``: when a bench didn't compute an
+    in-run baseline ratio, compare against the newest PRIOR run of the
+    same metric (``prior_measurement``) — ratio plus the prior run's
+    id land in the record, and a ``bench_regression`` telemetry event
+    fires when the headline worsened past the threshold
+    (``APEX_TPU_BENCH_REGRESSION_THRESHOLD``, default 1.1 = 10%).
+    Direction comes from the unit string ("lower is better" means a
+    ratio > threshold regresses; otherwise < 1/threshold does).
+    Never fails a record."""
+    import os
+
+    if rec.get("vs_baseline") is not None or rec.get("value") is None:
+        return
+    detail = rec.setdefault("detail", {})
+    try:
+        prior = prior_measurement(rec.get("metric"), kind, root=root)
+    except Exception:  # noqa: BLE001 — comparison must not kill a record
+        prior = None
+    if prior is None or not prior.get("value"):
+        detail.setdefault(
+            "vs_baseline_note",
+            "no prior measurement of this metric to compare against")
+        return
+    ratio = float(rec["value"]) / prior["value"]
+    rec["vs_baseline"] = round(ratio, 4)
+    detail["baseline_source"] = prior
+    thr = float(os.environ.get(
+        "APEX_TPU_BENCH_REGRESSION_THRESHOLD", 1.1))
+    lower_better = "lower is better" in str(rec.get("unit", ""))
+    worsened = ratio > thr if lower_better else ratio < 1.0 / thr
+    if worsened:
+        detail["regression"] = True
+        try:
+            from apex_tpu import telemetry
+
+            telemetry.registry().event(
+                "bench_regression", metric=rec.get("metric"),
+                value=rec["value"], prior_value=prior["value"],
+                prior_run=prior.get("run"), ratio=round(ratio, 4),
+                threshold=thr, lower_is_better=lower_better)
+        except Exception:  # noqa: BLE001
+            pass
+
+
 def emit(rec, kind):
     """Print the ONE-line JSON record; persist it to bench_records/ when
     it was measured on real hardware, and when it was NOT, mark it
@@ -89,6 +208,7 @@ def emit(rec, kind):
     from apex_tpu.records import is_transcribed, latest_record, write_record
 
     detail = rec.setdefault("detail", {})
+    _fill_vs_baseline(rec, kind)
     _fold_telemetry(detail)
     on_tpu = detail.get("backend") == "tpu"
     measured = rec.get("value") is not None
@@ -904,6 +1024,99 @@ def bench_resilience():
     }, "resilience")
 
 
+def bench_fleet():
+    """Fleet-observability accounting (docs/observability.md): the
+    cross-host telemetry aggregation path — gather + merge + straggler
+    detection (telemetry/fleet.py) — timed on the threaded
+    LocalCollective sim (the same 4-host protocol a real
+    ``jax.distributed`` fleet runs over ProcessCollective), with one
+    deterministic straggler injected so the detection path, not just
+    the merge, is on the clock. Reports the per-boundary aggregation
+    latency — the price a training loop pays each time it takes the
+    fleet view — and the detected straggler spread."""
+    import threading
+
+    from apex_tpu.resilience.guard import LocalCollective
+    from apex_tpu.telemetry import StepTimeline
+    from apex_tpu.telemetry import metrics as _tmetrics
+    from apex_tpu.telemetry.fleet import FleetAggregator
+
+    n_hosts = 4
+    sim_steps = 32
+    straggler_host = n_hosts - 1
+    straggle_factor = 2.5
+
+    def host_snapshot(r):
+        # one synthetic host: a private registry + timeline the way a
+        # real host's process-global ones would look after sim_steps,
+        # with the last host deterministically slow
+        reg = _tmetrics.MetricsRegistry()
+        reg.counter("fleet_bench_steps").inc(sim_steps)
+        reg.gauge("prefetch_queue_depth").set(2 + r)
+        h = reg.histogram("step_seconds")
+        tl = StepTimeline(capacity=4 * sim_steps)
+        base = 0.010 * (straggle_factor if r == straggler_host else 1.0)
+        for i in range(sim_steps):
+            tl.record_span("step", i * 0.02, base, step=i)
+            tl.record_span("data_wait", i * 0.02, 0.002, step=i)
+            h.observe(base)
+        return {"registry": reg.snapshot(),
+                "step_timeline": tl.summary(), "mfu": None}
+
+    group = LocalCollective(n_hosts)
+    handles = group.handles()
+    reps = 20
+    fleet_out = [None] * n_hosts
+    lat_out = [None] * n_hosts
+    err_out = [None] * n_hosts
+
+    def loop(r):
+        try:
+            agg = FleetAggregator(handles[r])
+            snap = host_snapshot(r)
+            agg.aggregate(snap, publish=False)          # warm
+            t0 = time.perf_counter()
+            for _ in range(reps):
+                fleet = agg.aggregate(snap, publish=False)
+            lat_out[r] = (time.perf_counter() - t0) / reps
+            fleet_out[r] = fleet
+        except BaseException as e:  # noqa: BLE001 — surfaced below
+            err_out[r] = e
+
+    ts = [threading.Thread(target=loop, args=(r,), daemon=True)
+          for r in range(n_hosts)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(120)
+    for e in err_out:
+        if e is not None:
+            raise e
+    fleet = fleet_out[0]
+    strag = fleet["straggler"]["phases"]["step"]
+    counters_ok = (fleet["counters"]["fleet_bench_steps"]
+                   == n_hosts * sim_steps)
+    emit({
+        "metric": "fleet_snapshot_aggregation_ms",
+        "value": round(lat_out[0] * 1e3, 3),
+        "unit": ("ms per aggregation boundary (gather + merge + "
+                 "straggler detection; lower is better)"),
+        "vs_baseline": None,     # filled from the prior run by emit()
+        "detail": {
+            "n_hosts": n_hosts,
+            "reps": reps,
+            "sim_steps_per_host": sim_steps,
+            "per_host_latency_ms": [round(v * 1e3, 3) for v in lat_out],
+            "straggler_spread_step": strag.get("spread"),
+            "stragglers_detected": strag.get("stragglers"),
+            "injected_straggler": {"host": str(straggler_host),
+                                   "factor": straggle_factor},
+            "fleet_counters_sum_ok": bool(counters_ok),
+            **backend_detail(),
+        },
+    }, "fleet")
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -1266,7 +1479,7 @@ if __name__ == "__main__":
 
         modes = {"moe": bench_moe, "gpt": bench_gpt, "attn": bench_attn,
                  "resnet": bench_resnet, "bert": bench_bert,
-                 "resilience": bench_resilience}
+                 "resilience": bench_resilience, "fleet": bench_fleet}
         sweep = [("headline", main)] + list(modes.items())
 
         def run_all():

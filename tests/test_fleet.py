@@ -1,0 +1,226 @@
+"""Fleet telemetry aggregation (apex_tpu/telemetry/fleet.py): the
+variable-length snapshot gather over the Collective abstraction, the
+merge semantics (counters summed, gauges per-host + stats, histograms
+bucket-merged, timelines side by side), and EWMA straggler detection.
+
+Replica sets are simulated with ``LocalCollective`` threads, exactly
+like tests/test_guard.py; the real-process analog is
+``tools/fleet_drill.py`` (driven by tools/check_observability.sh).
+"""
+
+import json
+import threading
+
+import numpy as np
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.resilience.guard import LocalCollective, NullCollective
+from apex_tpu.telemetry import metrics as tmetrics
+from apex_tpu.telemetry.fleet import (
+    FleetAggregator,
+    gather_snapshots,
+    merge_snapshots,
+    phase_means_by_host,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+def host_snapshot(r, *, steps=4, step_ms=10.0, timeline=True):
+    """One synthetic host's ``snapshot_detail``: private registry +
+    timeline the way a real host's process-global ones would look."""
+    reg = tmetrics.MetricsRegistry()
+    reg.counter("steps").inc(steps)
+    reg.counter("skips").inc(r, kind="nonfinite")
+    reg.gauge("queue_depth").set(float(r))
+    reg.histogram("save_s", buckets=(0.1, 1.0)).observe(0.05 + r)
+    tl_summary = None
+    if timeline:
+        tl = telemetry.StepTimeline(capacity=64)
+        for i in range(steps):
+            tl.record_span("step", i * 0.02, step_ms / 1e3, step=i)
+            tl.record_span("data_wait", i * 0.02, 0.002, step=i)
+        tl_summary = tl.summary()
+    return {"registry": reg.snapshot(), "step_timeline": tl_summary,
+            "mfu": None}
+
+
+def run_fleet(n, fn):
+    """Run ``fn(rid, handle)`` on one thread per simulated host;
+    returns the per-host results, surfacing any thread's error."""
+    group = LocalCollective(n)
+    handles = group.handles()
+    out = [None] * n
+    errs = [None] * n
+
+    def loop(r):
+        try:
+            out[r] = fn(r, handles[r])
+        except BaseException as e:  # noqa: BLE001
+            errs[r] = e
+
+    ts = [threading.Thread(target=loop, args=(r,), daemon=True)
+          for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    for e in errs:
+        if e is not None:
+            raise e
+    return out
+
+
+class TestGather:
+    def test_every_host_sees_all_snapshots_in_order(self):
+        outs = run_fleet(3, lambda r, h: gather_snapshots(
+            h, {"host": r, "blob": "x" * (10 * (r + 1))}))
+        for got in outs:
+            assert [s["host"] for s in got] == [0, 1, 2]
+            # variable-length payloads survive the padded transport
+            assert [len(s["blob"]) for s in got] == [10, 20, 30]
+
+    def test_null_collective_and_none_are_local(self):
+        snap = {"host": 0}
+        assert gather_snapshots(NullCollective(), snap) == [snap]
+        assert gather_snapshots(None, snap) == [snap]
+
+    def test_default_snapshot_is_process_detail(self):
+        telemetry.registry().counter("c").inc(5)
+        [got] = gather_snapshots(None)
+        assert got["registry"]["counters"]["c"] == 5.0
+
+
+class TestMerge:
+    def test_counters_sum_gauges_stat_histograms_bucket_merge(self):
+        fleet = merge_snapshots([host_snapshot(r) for r in range(3)])
+        assert fleet["n_hosts"] == 3
+        # counters (incl. labeled series) SUM across hosts
+        assert fleet["counters"]["steps"] == 12.0
+        assert fleet["counters"]['skips{kind="nonfinite"}'] == 3.0
+        # gauges stay per-host with min/max/mean — summing a
+        # last-write-wins value would lie
+        g = fleet["gauges"]["queue_depth"]
+        assert g["per_host"] == {"0": 0.0, "1": 1.0, "2": 2.0}
+        assert g["min"] == 0.0 and g["max"] == 2.0 and g["mean"] == 1.0
+        # histograms: cumulative counts at the same le add
+        h = fleet["histograms"]["save_s"]
+        assert h["count"] == 3
+        assert h["buckets"]["0.1"] == 1          # only host 0's 0.05
+        assert h["buckets"]["+Inf"] == 3
+        assert h["sum"] == pytest.approx(0.15 + 1 + 2)
+        # per-host step-phase summaries side by side
+        assert set(fleet["step_timelines"]) == {"0", "1", "2"}
+        assert fleet["step_timelines"]["1"]["phases"]["step"]["count"] == 4
+        json.dumps(fleet)                        # one JSON-able dict
+
+    def test_disabled_timeline_host_merges_as_none(self):
+        fleet = merge_snapshots([host_snapshot(0),
+                                 host_snapshot(1, timeline=False)])
+        assert fleet["step_timelines"]["1"] is None
+        assert fleet["counters"]["steps"] == 8.0
+        # and the straggler derivation skips the blind host
+        means = phase_means_by_host(
+            [host_snapshot(0), host_snapshot(1, timeline=False)], "step")
+        assert list(means) == [0]
+
+    def test_empty_registry_host(self):
+        fleet = merge_snapshots([
+            {"registry": {"counters": {}, "gauges": {}, "histograms": {}},
+             "step_timeline": None, "mfu": None},
+            host_snapshot(1)])
+        assert fleet["counters"]["steps"] == 4.0
+
+
+class TestStraggler:
+    def test_slow_host_flagged_and_published(self):
+        agg = FleetAggregator(None, straggler_factor=2.0)
+        per_host = [host_snapshot(0), host_snapshot(1),
+                    host_snapshot(2, step_ms=50.0)]
+        rep = agg.straggler_report(per_host)
+        step = rep["phases"]["step"]
+        assert step["median_ms"] == pytest.approx(10.0)
+        assert step["spread"] == pytest.approx(5.0)
+        assert [s["host"] for s in step["stragglers"]] == ["2"]
+        assert step["stragglers"][0]["ratio_to_median"] == pytest.approx(5.0)
+        # publish path: gauges + one event per flagged (host, phase)
+        agg._publish(rep)
+        reg = telemetry.registry()
+        assert reg.gauge("fleet_straggler_spread").value(
+            phase="step") == pytest.approx(5.0)
+        assert reg.gauge("fleet_stragglers").value() == 1.0
+        assert reg.gauge("fleet_phase_ms").value(
+            phase="step", host="2") == pytest.approx(50.0)
+        assert reg.counter("telemetry_events").value(
+            event="fleet_straggler") == 1.0
+
+    def test_clean_fleet_flags_nobody(self):
+        agg = FleetAggregator(None)
+        rep = agg.straggler_report([host_snapshot(r) for r in range(3)])
+        assert rep["n_stragglers"] == 0
+        assert rep["phases"]["step"]["stragglers"] == []
+        assert rep["phases"]["step"]["spread"] == pytest.approx(1.0)
+
+    def test_ewma_converges_not_jumps(self):
+        # one noisy window must not flag a host; a persistent slowdown
+        # converges toward the new level
+        agg = FleetAggregator(None, straggler_factor=3.0, ewma_alpha=0.5)
+        agg.straggler_report([host_snapshot(r) for r in range(2)])
+        rep = agg.straggler_report([host_snapshot(0),
+                                    host_snapshot(1, step_ms=90.0)])
+        e1 = float(rep["phases"]["step"]["per_host_ewma_ms"]["1"])
+        assert e1 == pytest.approx(0.5 * 10 + 0.5 * 90)     # not 90
+        rep = agg.straggler_report([host_snapshot(0),
+                                    host_snapshot(1, step_ms=90.0)])
+        e2 = float(rep["phases"]["step"]["per_host_ewma_ms"]["1"])
+        assert e2 > e1                                      # converging
+
+    def test_single_host_never_flags(self):
+        agg = FleetAggregator(None)
+        rep = agg.straggler_report([host_snapshot(0, step_ms=500.0)])
+        assert rep["n_stragglers"] == 0
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError, match="straggler_factor"):
+            FleetAggregator(None, straggler_factor=1.0)
+        with pytest.raises(ValueError, match="ewma_alpha"):
+            FleetAggregator(None, ewma_alpha=0.0)
+
+
+class TestAggregate:
+    def test_threaded_fleet_aggregates_identically(self):
+        def host(r, handle):
+            agg = FleetAggregator(handle)
+            return agg.aggregate(host_snapshot(r, step_ms=10.0 * (r + 1)),
+                                 publish=False)
+
+        outs = run_fleet(3, host)
+        # every host derived the identical fleet view from the
+        # identical gather
+        for fleet in outs:
+            assert fleet["counters"]["steps"] == 12.0
+            strag = fleet["straggler"]["phases"]["step"]
+            assert strag["spread"] == pytest.approx(3.0)
+            assert fleet["aggregation_ms"] >= 0.0
+        a, b = (dict(o, aggregation_ms=None) for o in outs[:2])
+        assert json.dumps(a, sort_keys=True) == json.dumps(b,
+                                                           sort_keys=True)
+
+    def test_single_host_aggregate_uses_local_snapshot(self):
+        telemetry.registry().counter("c").inc(2)
+        fleet = FleetAggregator(NullCollective()).aggregate()
+        assert fleet["n_hosts"] == 1
+        assert fleet["counters"]["c"] == 2.0
+
+    def test_multiproc_fleet_aggregator_single_host(self):
+        from apex_tpu.parallel import multiproc
+
+        agg = multiproc.fleet_aggregator(straggler_factor=4.0)
+        assert isinstance(agg.collective, NullCollective)
+        assert agg.straggler_factor == 4.0

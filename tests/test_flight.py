@@ -1,0 +1,362 @@
+"""Crash flight recorder (apex_tpu/telemetry/flight.py): bounded
+retention rings, the atomic ``flightrec_*.json`` postmortem bundle,
+keep-last-k pruning, and the trigger wiring across the runtime
+(watchdog escalation, guard divergence, preemption shutdown, fused-step
+exception). The two-process real-cluster analog is
+``tools/fleet_drill.py`` via tools/check_observability.sh.
+"""
+
+import json
+import os
+import signal
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import records, telemetry
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.train_step import make_train_step
+from apex_tpu.resilience import (
+    CheckpointManager,
+    ConsistencyGuard,
+    FaultInjector,
+    LocalCollective,
+    NonfiniteWatchdog,
+    graceful_shutdown,
+)
+from apex_tpu.telemetry import flight
+from apex_tpu.telemetry.flight import FLIGHT_KIND, FlightRecorder
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+@pytest.fixture(autouse=True)
+def records_dir(tmp_path, monkeypatch):
+    path = tmp_path / "records"
+    monkeypatch.setattr(records, "RECORDS_DIR", str(path))
+    return path
+
+
+def _params(seed=0):
+    r = np.random.RandomState(seed)
+    return {"b": jnp.zeros((6,), jnp.float32),
+            "w1": jnp.asarray(r.randn(32, 6), jnp.float32),
+            "w2": jnp.asarray(r.randn(6, 6), jnp.float32)}
+
+
+def _small_step(**kw):
+    opt = FusedAdam(lr=1e-2, impl="xla")
+    state = opt.init(_params())
+    r = np.random.RandomState(0)
+    g = jnp.asarray(r.randn(state.space.total).astype(np.float32) * 0.01)
+    return make_train_step(opt, **kw), state, g
+
+
+def latest_bundle():
+    rec = records.latest_record(FLIGHT_KIND, require_backend=None)
+    return None if rec is None else rec["payload"]
+
+
+class TestRecorder:
+    def test_event_ring_is_bounded(self):
+        rec = flight.enable(event_capacity=3)
+        reg = telemetry.registry()
+        for i in range(10):
+            reg.event("e", n=i)
+        assert [e["n"] for e in rec.events] == [7, 8, 9]
+
+    def test_digest_ring_is_bounded_and_compact(self):
+        rec = FlightRecorder(digest_capacity=2)
+        for step in range(5):
+            rec.record_digest(step, np.arange(6, dtype=np.uint32)
+                              .reshape(2, 3) + step)
+        assert [d["step"] for d in rec.digests] == [3, 4]
+        d = rec.digests[-1]
+        assert isinstance(d["xor"], int) and len(d["row_sums"]) == 2
+        json.dumps(d)
+
+    def test_dump_bundle_is_self_contained(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_FAULTS", "bit_flip=3")
+        tl = telemetry.enable(capacity=64)
+        for i in range(5):
+            with tl.step_scope():
+                with tl.phase("h2d"):
+                    pass
+        telemetry.registry().counter("steps").inc(5)
+        rec = flight.enable(last_steps=2, keep=3)
+        telemetry.registry().event("something", n=1)
+        rec.record_digest(4, np.ones((2, 3), np.uint32))
+        path = rec.dump("watchdog_rollback",
+                        error=RuntimeError("boom"), fleet=False,
+                        extra={"k": "v"})
+        assert path is not None and os.path.exists(path)
+        b = latest_bundle()
+        assert b["trigger"] == "watchdog_rollback"
+        assert b["error"] == "RuntimeError: boom"
+        assert b["extra"] == {"k": "v"}
+        assert b["faults"] == "bit_flip=3"
+        assert b["telemetry"]["registry"]["counters"]["steps"] == 5.0
+        assert b["fleet"] is None and "host-local" in b["fleet_unavailable"]
+        assert [e["event"] for e in b["recent_events"]] == ["something"]
+        assert b["state_digests"][0]["step"] == 4
+        # the trace slice honors last_steps: only the 2 newest steps
+        steps = {e["args"]["step"] for e in b["trace"]["traceEvents"]
+                 if e.get("ph") == "X"}
+        assert steps == {3, 4}
+        json.dumps(b)
+
+    def test_dump_without_timeline_or_manager(self):
+        rec = FlightRecorder()
+        path = rec.dump("train_step_exception", fleet=False)
+        b = latest_bundle()
+        assert path is not None
+        assert b["trace"] is None and b["last_checkpoint"] is None
+
+    def test_dump_names_last_checkpoint(self, tmp_path):
+        step, state, g = _small_step()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=3)
+        mgr.save(7, state)
+        rec = FlightRecorder(manager=mgr)
+        rec.dump("watchdog_rollback", fleet=False)
+        lc = latest_bundle()["last_checkpoint"]
+        assert lc["step"] == 7 and lc["path"] == mgr.path_for(7)
+
+    def test_keep_last_k_pruning(self, records_dir, monkeypatch):
+        # distinct (fake) second stamps per dump: pruning never touches
+        # the CURRENT second (deleting a same-second record would free
+        # its O_EXCL claim name for re-claim with a lower uniquifier)
+        tick = iter(range(100))
+        monkeypatch.setattr(
+            records.time, "strftime",
+            lambda *a: f"20260101T0000{next(tick):02d}Z")
+        rec = flight.enable(keep=3)
+        paths = [rec.dump("watchdog_rollback", fleet=False, extra={"n": i})
+                 for i in range(7)]
+        on_disk = sorted(n for n in os.listdir(records_dir)
+                         if n.startswith(f"{FLIGHT_KIND}_"))
+        assert len(on_disk) == 3
+        # latest_record finds the newest bundle (the last dump)
+        assert latest_bundle()["extra"] == {"n": 6}
+        assert os.path.basename(paths[-1]) in on_disk
+        assert os.path.basename(paths[0]) not in on_disk
+
+    def test_pruning_skips_current_second(self, records_dir):
+        # real clock, all dumps inside (at most) a couple of seconds:
+        # nothing stamped "now" is deleted, so a burst can exceed keep
+        # transiently, but the newest bundle is always the one
+        # latest_record answers with
+        rec = flight.enable(keep=2)
+        for i in range(5):
+            rec.dump("watchdog_rollback", fleet=False, extra={"n": i})
+        assert latest_bundle()["extra"] == {"n": 4}
+
+    def test_reset_disarms_global_recorder(self):
+        flight.enable(keep=1)
+        assert flight.get_recorder() is not None
+        telemetry.reset()
+        assert flight.get_recorder() is None
+        # and notify with nothing armed is a silent no-op
+        assert flight.notify("watchdog_rollback", fleet=False) is None
+
+    def test_notify_never_raises(self):
+        class Broken(FlightRecorder):
+            def dump(self, *a, **kw):
+                raise RuntimeError("recorder on fire")
+
+        assert flight.notify("x", recorder=Broken(), fleet=False) is None
+        flight.record_digest(1, np.ones((1, 1), np.uint32),
+                             recorder=Broken())
+
+
+class TestTriggers:
+    def test_watchdog_escalation_dumps(self):
+        from apex_tpu.amp.scaler import LossScaler
+
+        scaler = LossScaler(init_scale=2.0 ** 10)
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        state = opt.init(_params())
+        step = make_train_step(opt, scaler=scaler)
+        sstate = scaler.init()
+        flight.enable(keep=2)
+        wd = NonfiniteWatchdog(step, manager=None, threshold=2)
+        bad = jnp.full((state.space.total,), jnp.nan, jnp.float32)
+        state, sstate, _ = wd(state, bad, sstate)
+        state, sstate, _ = wd(state, bad, sstate)
+        b = latest_bundle()
+        assert b["trigger"] == "watchdog_rollback"
+        assert b["extra"]["event"] == "nonfinite_escalation"
+        assert b["extra"]["action"] == "scaler_reset"
+        # the escalation's own telemetry event made it into the ring
+        assert "nonfinite_escalation" in [e["event"]
+                                          for e in b["recent_events"]]
+
+    def test_train_step_exception_dumps_and_reraises(self):
+        step, state, g = _small_step()
+        flight.enable(keep=2)
+        with pytest.raises(Exception):
+            step(state, g[: 8])                  # wrong-shaped grads
+        b = latest_bundle()
+        assert b["trigger"] == "train_step_exception"
+        assert b["error"]
+        assert "fleet_unavailable" in b
+
+    def test_train_step_without_recorder_raises_plainly(self):
+        step, state, g = _small_step()
+        with pytest.raises(Exception):
+            step(state, g[: 8])
+        assert latest_bundle() is None           # nothing armed, no dump
+
+    def test_graceful_shutdown_dumps(self, tmp_path):
+        step, state, g = _small_step()
+        mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=3)
+        rec = FlightRecorder(manager=mgr)
+        graceful_shutdown(mgr, 5, state, flight_recorder=rec)
+        b = latest_bundle()
+        assert b["trigger"] == "preemption_shutdown"
+        assert b["extra"]["event"] == "preemption_checkpoint"
+        assert b["extra"]["step"] == 5
+        # dumped AFTER the final checkpoint: the bundle names it
+        assert b["last_checkpoint"]["step"] == 5
+
+    def test_guard_divergence_dumps_fleet_bundle(self):
+        """The acceptance scenario in-process: a one-replica bit flip
+        -> every simulated host's own recorder dumps a
+        replica_divergence bundle whose FLEET snapshot sums the hosts'
+        counters and carries the straggler gauges, and whose digest
+        ring rode the boundary checksums."""
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        step = make_train_step(opt, fingerprint_every=2)
+        inj = FaultInjector(bit_flip_steps=frozenset({1}),
+                            bit_flip_replica=1, bit_flip_leaf=0)
+        n = 3
+        group = LocalCollective(n)
+        handles = group.handles()
+        recs = [FlightRecorder(collective=handles[r]) for r in range(n)]
+        errs = [None] * n
+
+        def loop(rid):
+            try:
+                st = opt.init(_params())
+                guard = ConsistencyGuard(step, collective=handles[rid],
+                                         flight_recorder=recs[rid])
+                r = np.random.RandomState(0)
+                g = jnp.asarray(
+                    r.randn(st.space.total).astype(np.float32) * 0.01)
+                for i in range(4):
+                    st = st._replace(master=inj.flip_bits(
+                        st.master, i, replica=rid, space=st.space))
+                    st, _ = guard(st, g)
+            except BaseException as e:  # noqa: BLE001
+                errs[rid] = e
+
+        ts = [threading.Thread(target=loop, args=(r,), daemon=True)
+              for r in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        assert errs == [None, None, None]
+        for rid, rec in enumerate(recs):
+            assert rec.dumps == 1
+            assert rec.last_trigger == "replica_divergence"
+            # boundary checksums fed the digest ring: the divergent
+            # count=2 boundary and the post-repair clean count=4 one
+            assert [d["step"] for d in rec.digests] == [2, 4]
+        # the bundles themselves: pruning kept them all (keep=5 > 3)
+        names = [nme for nme in os.listdir(records.RECORDS_DIR)
+                 if nme.startswith(f"{FLIGHT_KIND}_")]
+        assert len(names) == n
+        b = latest_bundle()
+        assert b["trigger"] == "replica_divergence"
+        assert b["extra"]["event"] == "replica_divergence"
+        assert b["extra"]["action"] == "majority_repair"
+        fleet = b["fleet"]
+        assert fleet is not None and fleet["n_hosts"] == n
+        # counters summed across the simulated hosts. The threads here
+        # share ONE process-global registry, so each "host" snapshot
+        # catches the shared counter mid-flight (each thread sees at
+        # least its own increment, at most all n) — the sum is bounded,
+        # not pinned; the exact 2-process pin is tools/fleet_drill.py,
+        # where every host owns a real private registry
+        key = 'resilience_divergence_events{action="majority_repair"}'
+        assert n * 1.0 <= fleet["counters"][key] <= n * float(n)
+        # straggler gauges present in the bundle's registry snapshot
+        gauges = b["telemetry"]["registry"]["gauges"]
+        assert any(k.startswith("fleet_straggler_spread")
+                   for k in gauges)
+
+    def test_guard_divergence_error_dumps(self):
+        opt = FusedAdam(lr=1e-2, impl="xla")
+        step = make_train_step(opt, fingerprint_every=2)
+        inj = FaultInjector(bit_flip_steps=frozenset({1}),
+                            bit_flip_replica=1, bit_flip_leaf=0)
+        n = 2                                    # 1v1: no quorum
+        group = LocalCollective(n)
+        handles = group.handles()
+        recs = [FlightRecorder(collective=handles[r]) for r in range(n)]
+        errs = [None] * n
+
+        def loop(rid):
+            try:
+                st = opt.init(_params())
+                guard = ConsistencyGuard(step, collective=handles[rid],
+                                         flight_recorder=recs[rid])
+                r = np.random.RandomState(0)
+                g = jnp.asarray(
+                    r.randn(st.space.total).astype(np.float32) * 0.01)
+                for i in range(4):
+                    st = st._replace(master=inj.flip_bits(
+                        st.master, i, replica=rid, space=st.space))
+                    st, _ = guard(st, g)
+            except BaseException as e:  # noqa: BLE001
+                errs[rid] = e
+
+        ts = [threading.Thread(target=loop, args=(r,), daemon=True)
+              for r in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(120)
+        from apex_tpu.resilience import DivergenceError
+
+        for e in errs:
+            assert isinstance(e, DivergenceError)
+        for rec in recs:
+            # replica_divergence first, then the unrecoverable dump
+            assert rec.dumps == 2
+            assert rec.last_trigger == "divergence_error"
+
+
+class TestTelemetryDumpCLI:
+    def test_prom_and_json_from_flight_bundle(self, capsys):
+        from tools import telemetry_dump
+
+        telemetry.registry().counter("demo_total").inc(3, kind="x")
+        rec = flight.enable(keep=1)
+        path = rec.dump("watchdog_rollback", fleet=False)
+        assert telemetry_dump.main([path]) == 0
+        out = capsys.readouterr().out
+        assert 'demo_total{kind="x"} 3' in out
+        assert "# TYPE demo_total counter" in out
+        assert telemetry_dump.main([path, "--format", "json"]) == 0
+        snap = json.loads(capsys.readouterr().out)
+        assert snap["counters"]['demo_total{kind="x"}'] == 3.0
+
+    def test_live_registry_and_bad_file(self, tmp_path, capsys):
+        from tools import telemetry_dump
+
+        telemetry.registry().counter("live_total", "help!").inc()
+        assert telemetry_dump.main([]) == 0
+        out = capsys.readouterr().out
+        assert "# HELP live_total help!" in out
+        assert "live_total 1" in out
+        bad = tmp_path / "nope.json"
+        bad.write_text('{"no": "registry"}')
+        assert telemetry_dump.main([str(bad)]) == 2

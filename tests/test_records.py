@@ -313,6 +313,94 @@ class TestRecords:
         assert records.latest_record("unit_kind3") is None
 
 
+class TestPruneRecords:
+    """``records.prune_records`` — keep-last-k retention for record
+    kinds a failure loop can write without bound (flight bundles)."""
+
+    def _stamped_writer(self, monkeypatch):
+        from apex_tpu import records
+
+        tick = iter(range(100))
+        monkeypatch.setattr(
+            records.time, "strftime",
+            lambda *a: f"20260101T0000{next(tick):02d}Z")
+
+    def test_keeps_newest_k_by_recency(self, tmp_path, monkeypatch):
+        from apex_tpu import records
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        self._stamped_writer(monkeypatch)
+        paths = [records.write_record("flightrec", {"n": i})
+                 for i in range(6)]
+        removed = records.prune_records("flightrec", keep=2)
+        assert sorted(removed) == sorted(paths[:4])
+        # latest_record still finds the newest bundle
+        assert records.latest_record(
+            "flightrec", require_backend=None)["payload"] == {"n": 5}
+
+    def test_other_kinds_and_prefix_kinds_untouched(self, tmp_path,
+                                                    monkeypatch):
+        from apex_tpu import records
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        self._stamped_writer(monkeypatch)
+        for i in range(3):
+            records.write_record("flight", {"n": i})        # prefix kind
+            records.write_record("flightrec", {"n": i})
+            records.write_record("resilience", {"n": i})
+        records.prune_records("flightrec", keep=1)
+        names = os.listdir(tmp_path)
+        assert sum(n.startswith("flightrec_") for n in names) == 1
+        assert sum(n.startswith("flight_") for n in names) == 3
+        assert sum(n.startswith("resilience_") for n in names) == 3
+        assert records.latest_record(
+            "flight", require_backend=None)["payload"] == {"n": 2}
+
+    def test_keep_nonpositive_and_missing_dir_are_noops(self, tmp_path,
+                                                        monkeypatch):
+        from apex_tpu import records
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        self._stamped_writer(monkeypatch)
+        for i in range(3):
+            records.write_record("flightrec", {"n": i})
+        assert records.prune_records("flightrec", keep=0) == []
+        assert len(os.listdir(tmp_path)) == 3
+        monkeypatch.setattr(records, "RECORDS_DIR",
+                            str(tmp_path / "nonexistent"))
+        assert records.prune_records("flightrec", keep=1) == []
+
+    def test_corrupt_files_left_in_place(self, tmp_path, monkeypatch):
+        from apex_tpu import records
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        self._stamped_writer(monkeypatch)
+        records.write_record("flightrec", {"n": 0})
+        records.write_record("flightrec", {"n": 1})
+        corrupt = tmp_path / "flightrec_20251231T000000Z_dead.json"
+        corrupt.write_text("{not json")
+        records.prune_records("flightrec", keep=1)
+        assert corrupt.exists()                  # evidence stays
+        assert records.latest_record(
+            "flightrec", require_backend=None)["payload"] == {"n": 1}
+
+    def test_current_second_is_never_pruned(self, tmp_path, monkeypatch):
+        # deleting a record stamped "now" would free its O_EXCL claim
+        # name for a same-second re-claim with a lower uniquifier,
+        # breaking latest_record's write-order tiebreak
+        from apex_tpu import records
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        monkeypatch.setattr(records.time, "strftime",
+                            lambda *a: "20260101T000000Z")
+        paths = [records.write_record("flightrec", {"n": i})
+                 for i in range(4)]
+        assert records.prune_records("flightrec", keep=1) == []
+        assert all(os.path.exists(p) for p in paths)
+        assert records.latest_record(
+            "flightrec", require_backend=None)["payload"] == {"n": 3}
+
+
 class TestMosaicLimits:
     def test_known_crash_shapes_rejected(self):
         # the three round-3 crashers (docs/HARDWARE_NOTES.md)

@@ -310,6 +310,146 @@ class TestStepTimeline:
         assert ttimeline.get_timeline().enabled
 
 
+class TestTimelineEdgeCases:
+    """The ring/span behaviors the fleet merge and flight-recorder
+    trace slice lean on, pinned (ISSUE 5 satellite)."""
+
+    def test_wraparound_at_exact_capacity(self):
+        tl = telemetry.StepTimeline(capacity=6)
+        for _ in range(3):                       # 3 steps x 2 spans = 6
+            with tl.step_scope():
+                with tl.phase("step"):
+                    pass
+        summ = tl.summary()
+        assert summ["dropped_spans"] == 0 and summ["spans"] == 6
+        with tl.step_scope():                    # one more step wraps
+            with tl.phase("step"):
+                pass
+        summ = tl.summary()
+        assert summ["spans"] == 6 and summ["dropped_spans"] == 2
+        # the summary's step counter keeps counting past the wrap
+        assert summ["steps"] == 4
+        # oldest spans fell off, newest survived
+        assert {s.step for s in tl.spans()} == {1, 2, 3}
+
+    def test_phase_exiting_via_exception_still_records(self):
+        tl = telemetry.StepTimeline()
+        with pytest.raises(RuntimeError, match="boom"):
+            with tl.phase("h2d"):
+                raise RuntimeError("boom")
+        p = tl.summary()["phases"]["h2d"]
+        assert p["count"] == 1 and p["mean_ms"] >= 0.0
+
+    def test_step_scope_exiting_via_exception_closes_step(self):
+        tl = telemetry.StepTimeline()
+        with pytest.raises(RuntimeError):
+            with tl.step_scope():
+                raise RuntimeError("mid-step death")
+        assert tl.summary()["phases"]["host_step"]["count"] == 1
+        # the next scope opens a FRESH step, not a nested one
+        with tl.step_scope() as step:
+            pass
+        assert step == 1
+
+    def test_nested_phases_both_recorded_and_contained(self):
+        tl = telemetry.StepTimeline()
+        with tl.step_scope():
+            with tl.phase("outer"):
+                with tl.phase("inner"):
+                    pass
+        spans = {s.name: s for s in tl.spans()}
+        assert {"outer", "inner", "host_step"} <= set(spans)
+        # inner exits first (appended first) and nests inside outer
+        names = [s.name for s in tl.spans()]
+        assert names.index("inner") < names.index("outer")
+        inner, outer = spans["inner"], spans["outer"]
+        assert outer.t0 <= inner.t0
+        assert inner.t0 + inner.dur <= outer.t0 + outer.dur + 1e-9
+
+    def test_export_trace_on_empty_timeline(self, tmp_path):
+        tl = telemetry.StepTimeline()
+        path = str(tmp_path / "empty.json")
+        trace = tl.export_trace(path)
+        assert trace["traceEvents"] == []
+        with open(path) as f:
+            assert json.load(f)["traceEvents"] == []
+        # disabled timeline exports empty too (never crashes)
+        off = telemetry.StepTimeline(enabled=False)
+        assert off.export_trace()["traceEvents"] == []
+
+    def test_export_trace_last_steps_slices(self):
+        tl = telemetry.StepTimeline()
+        tl.record_span("setup", 0.0, 0.1)        # step -1: kept
+        for _ in range(5):
+            with tl.step_scope():
+                with tl.phase("step"):
+                    pass
+        trace = tl.export_trace(last_steps=2)
+        complete = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        steps = {e["args"]["step"] for e in complete}
+        assert steps == {-1, 3, 4}
+        full = [e for e in tl.export_trace()["traceEvents"]
+                if e["ph"] == "X"]
+        assert len(full) == 11
+
+    def test_zero_capacity_ring_never_crashes(self):
+        tl = telemetry.StepTimeline(capacity=0)
+        with tl.step_scope():
+            with tl.phase("step"):
+                pass
+        assert tl.spans() == []
+        assert tl.summary()["dropped_spans"] == 2
+        assert tl.export_trace()["traceEvents"] == []
+
+    def test_end_step_without_begin_is_a_noop(self):
+        tl = telemetry.StepTimeline()
+        tl.end_step()
+        assert tl.spans() == []
+
+
+class TestPrometheusText:
+    def test_round_trip_with_labels_and_histograms(self):
+        reg = telemetry.registry()
+        reg.counter("req_total", "requests").inc(3, code="200")
+        reg.counter("req_total").inc(1, code="500")
+        reg.gauge("depth", "queue depth").set(2.5)
+        h = reg.histogram("lat_s", "latency", buckets=(0.1, 1.0))
+        h.observe(0.05, op="save")
+        h.observe(5.0, op="save")
+        text = reg.to_prometheus_text()
+        lines = text.splitlines()
+        assert "# HELP req_total requests" in lines
+        assert "# TYPE req_total counter" in lines
+        assert 'req_total{code="200"} 3' in lines
+        assert 'req_total{code="500"} 1' in lines
+        assert "# TYPE depth gauge" in lines and "depth 2.5" in lines
+        assert "# TYPE lat_s histogram" in lines
+        assert 'lat_s_bucket{op="save",le="0.1"} 1' in lines
+        assert 'lat_s_bucket{op="save",le="1.0"} 1' in lines
+        assert 'lat_s_bucket{op="save",le="+Inf"} 2' in lines
+        assert 'lat_s_sum{op="save"} 5.05' in lines
+        assert 'lat_s_count{op="save"} 2' in lines
+        # one header per metric name even with several series
+        assert sum(1 for ln in lines
+                   if ln == "# TYPE req_total counter") == 1
+        # the snapshot-based renderer (what the dump CLI uses on a
+        # bundle from disk) emits the same series lines, empty HELP
+        snap_text = tmetrics.prometheus_text_from_snapshot(
+            json.loads(json.dumps(reg.snapshot())))
+        assert 'req_total{code="200"} 3' in snap_text
+        assert 'lat_s_bucket{op="save",le="+Inf"} 2' in snap_text
+        assert "# HELP req_total \n# TYPE req_total counter" in snap_text
+
+    def test_module_level_entrypoint(self):
+        telemetry.registry().counter("c", "help").inc()
+        assert "# HELP c help" in telemetry.to_prometheus_text()
+        assert "c 1" in tmetrics.to_prometheus_text(
+            {"counters": {"c": 1.0}, "gauges": {}, "histograms": {}})
+
+    def test_empty_registry_renders_empty(self):
+        assert telemetry.to_prometheus_text() == ""
+
+
 class TestCost:
     def test_jitted_cost_on_cpu(self):
         f = jax.jit(lambda x: x @ x)

@@ -1,0 +1,85 @@
+#!/usr/bin/env bash
+# Observability smoke (CI / pre-merge, next to check_telemetry.sh and
+# check_resilience.sh): the fleet-aggregation / flight-recorder /
+# bench-baseline unit tier, the disabled-telemetry structural guarantee
+# (the disabled path IS the cached raw step object), and the
+# two-process jax.distributed FLEET DRILL (tools/fleet_drill.py): a
+# one-replica bit_flip injected via APEX_TPU_FAULTS must produce a
+# committed flightrec_*.json black box on every host — trigger
+# replica_divergence, fleet snapshot summing both hosts' counters,
+# straggler gauges present, perfetto slice well-formed. Extra args
+# pass through to pytest.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+rc=0
+
+python -m pytest tests/test_telemetry.py tests/test_fleet.py \
+    tests/test_flight.py tests/test_bench_baseline.py \
+    tests/test_records.py "$@" -q -p no:cacheprovider || rc=1
+
+echo "== disabled-telemetry structural guarantee =="
+python - <<'PY' || rc=1
+from apex_tpu import telemetry
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.train_step import make_train_step
+
+import jax.numpy as jnp
+import numpy as np
+
+rng = np.random.RandomState(0)
+params = {"w": jnp.asarray(rng.randn(256).astype(np.float32))}
+opt = FusedAdam(lr=1e-3)
+step = make_train_step(opt)
+disabled = make_train_step(
+    opt, telemetry=telemetry.StepTimeline(enabled=False))
+# the <1% overhead budget of check_telemetry.sh rests on this identity:
+# with telemetry disabled there is NO instrumented code to be slow —
+# the flight-recorder / fleet wiring must not have broken it
+assert disabled is step, "disabled telemetry must be the raw step object"
+assert make_train_step(opt, telemetry=None) is step
+# and an armed-then-disarmed flight recorder leaves it intact
+telemetry.flight.enable(keep=1)
+telemetry.flight.disable()
+assert make_train_step(opt, telemetry=None) is step
+print("disabled-is-step: OK")
+PY
+
+# Two-process jax.distributed fleet drill: rank 1 carries the bit_flip
+# fault; both hosts must leave a committed flight bundle (see
+# tools/fleet_drill.py for every asserted property).
+echo "== two-process fleet drill =="
+drill_dir="$(mktemp -d)"
+drill_port=$(( 20000 + RANDOM % 20000 ))
+drill_env=(MASTER_ADDR=127.0.0.1 "MASTER_PORT=$drill_port" WORLD_SIZE=2)
+env "${drill_env[@]}" RANK=0 python tools/fleet_drill.py "$drill_dir" &
+h0=$!
+env "${drill_env[@]}" RANK=1 \
+    APEX_TPU_FAULTS="bit_flip=3;bit_flip_replica=1;bit_flip_leaf=0" \
+    python tools/fleet_drill.py "$drill_dir" &
+h1=$!
+wait $h0; rc0=$?
+wait $h1; rc1=$?
+if [ "$rc0" -ne 0 ] || [ "$rc1" -ne 0 ]; then
+    echo "fleet drill FAILED (host0 rc=$rc0, host1 rc=$rc1)" >&2
+    rc=1
+else
+    # the bundle's perfetto slice + registry snapshot feed the dump CLI
+    bundle="$(ls "$drill_dir"/records_0/flightrec_*.json | head -1)"
+    if python tools/telemetry_dump.py "$bundle" | grep -q "drill_steps"; then
+        echo "two-process fleet drill: OK"
+    else
+        echo "fleet drill FAILED: telemetry_dump found no drill_steps" \
+             "in $bundle" >&2
+        rc=1
+    fi
+fi
+rm -rf "$drill_dir"
+
+if [ "$rc" -eq 0 ]; then
+    echo "check_observability: OK"
+else
+    echo "check_observability: FAILED (rc=$rc)" >&2
+fi
+exit $rc

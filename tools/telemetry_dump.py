@@ -1,0 +1,98 @@
+"""Print a telemetry snapshot — Prometheus text or JSON — from the
+live process registry, a flight-recorder bundle, or a bench record.
+
+The scrape-shaped view of the observability layer
+(docs/observability.md): the same ``to_prometheus_text()`` rendering a
+node-exporter-style endpoint would serve, runnable against the black
+box a dead run left behind::
+
+    python tools/telemetry_dump.py                      # live registry
+    python tools/telemetry_dump.py --format json
+    python tools/telemetry_dump.py bench_records/flightrec_*.json
+    python tools/telemetry_dump.py --format json some_headline.json
+
+File arguments are resolved by shape, not by name: a flight-recorder
+bundle (``payload.telemetry.registry``), a bench record
+(``payload.detail.telemetry.registry``), a raw emitted bench line
+(``detail.telemetry.registry``), or a bare registry snapshot all work.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def extract_registry_snapshot(obj):
+    """The registry snapshot inside any of the JSON shapes this repo
+    writes (flight bundle, bench record, emitted line, bare snapshot);
+    None when the object holds no registry."""
+    if not isinstance(obj, dict):
+        return None
+    # bare snapshot: has the three section keys
+    if {"counters", "gauges", "histograms"} <= set(obj):
+        return obj
+    for path in (("payload", "telemetry", "registry"),
+                 ("payload", "detail", "telemetry", "registry"),
+                 ("detail", "telemetry", "registry"),
+                 ("telemetry", "registry"),
+                 ("registry",)):
+        node = obj
+        for key in path:
+            node = node.get(key) if isinstance(node, dict) else None
+            if node is None:
+                break
+        if isinstance(node, dict) and {"counters", "gauges",
+                                       "histograms"} <= set(node):
+            return node
+    return None
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="print a telemetry snapshot (live registry, "
+                    "flight-recorder bundle, or bench record)")
+    parser.add_argument("path", nargs="?", default=None,
+                        help="JSON file holding a registry snapshot "
+                             "(flightrec bundle / bench record); "
+                             "default: the live process registry")
+    parser.add_argument("--format", choices=("prom", "json"),
+                        default="prom",
+                        help="prom = Prometheus text exposition "
+                             "(default), json = the snapshot dict")
+    args = parser.parse_args(argv)
+
+    from apex_tpu.telemetry import metrics
+
+    if args.path is None:
+        snap = metrics.registry().snapshot()
+        if args.format == "json":
+            print(json.dumps(snap, indent=1, sort_keys=True))
+        else:
+            # live path: the registry renders with its HELP text
+            sys.stdout.write(metrics.registry().to_prometheus_text())
+        return 0
+
+    try:
+        with open(args.path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+        return 2
+    snap = extract_registry_snapshot(obj)
+    if snap is None:
+        print(f"error: no telemetry registry snapshot found in "
+              f"{args.path}", file=sys.stderr)
+        return 2
+    if args.format == "json":
+        print(json.dumps(snap, indent=1, sort_keys=True))
+    else:
+        sys.stdout.write(metrics.prometheus_text_from_snapshot(snap))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
