@@ -149,6 +149,19 @@ def fused_elementwise(
 
     if tile_rows is None:
         tile_rows = DEFAULT_TILE_ROWS
+
+    # compile-plane: publish this sweep's abstract signature so shape/
+    # impl churn across engine calls shows up as recompile events (one
+    # module-global read when no tracker is armed — the common case)
+    from apex_tpu.telemetry import compiled as _compiled
+
+    if _compiled.get_tracker() is not None:
+        _compiled.observe("fused_elementwise", {
+            "n": int(n), "inputs": len(inputs),
+            "dtypes": [str(b.dtype) for b in inputs],
+            "outputs": num_outputs, "impl": impl,
+            "tile_rows": int(tile_rows),
+            "per_tensor": len(per_tensor), "sr": bool(sr_outputs)})
     if impl in ("pallas", "interpret"):
         # 2048x128 engine tiles CRASH the Mosaic compiler (round-3
         # chip evidence); refuse before the shape reaches it
@@ -379,14 +392,19 @@ def fused_elementwise(
                     f"({out_dtypes[out_idx]}) skipped: dtype mismatch "
                     f"prevents in-place buffer reuse", stacklevel=3)
 
-    results = pl.pallas_call(
-        kernel,
-        grid_spec=grid_spec,
-        out_shape=out_shapes,
-        input_output_aliases=io_aliases,
-        interpret=interpret_flag(impl),
-    )(*prefetch, *[b.reshape(padded_n // LANES, LANES) for b in bufs],
-      *pt_rows)
+    # label the dispatch so an eager call's Mosaic/XLA compile is
+    # attributed to the engine (inside an outer jit the enclosing entry
+    # point's label — e.g. "train_step" — wins, which is the right
+    # attribution for the program that actually compiles)
+    with _compiled.label("fused_elementwise"):
+        results = pl.pallas_call(
+            kernel,
+            grid_spec=grid_spec,
+            out_shape=out_shapes,
+            input_output_aliases=io_aliases,
+            interpret=interpret_flag(impl),
+        )(*prefetch, *[b.reshape(padded_n // LANES, LANES) for b in bufs],
+          *pt_rows)
 
     outs = [r.reshape(padded_n)[:n] for r in results[:num_outputs]]
     if sr_post:

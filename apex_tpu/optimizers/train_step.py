@@ -33,7 +33,12 @@ Compiled steps are cached in an eviction-free dict keyed on the
 optimizer + options (jax.jit then specializes per static FlatSpace
 layout); `step_cache_stats` — also surfaced through
 ``apex_tpu.profiler`` — reports factory and per-layout hit/miss
-counts.
+counts. With the compile tracker armed
+(``telemetry.compiled.enable()``), every NEW layout additionally
+publishes its abstract signature — a second distinct signature is a
+re-trace and emits a ``recompile`` event with the signature diff; the
+XLA compile duration lands in ``compile_ms{fn="train_step"}`` (see
+docs/observability.md "compile & memory plane").
 
 HBM-accesses-per-element budget this path targets (see
 docs/train_step.md): optax per-leaf fusion ~7, the classic two-stage
@@ -99,17 +104,40 @@ class TrainStep:
         self._layouts = set()
         self._telemetry = None          # host-side StepTimeline, or None
 
-    def _track(self, state: FlatOptState):
+    def _track(self, state: FlatOptState) -> bool:
+        """Record the static layout; True when it is NEW on this step
+        (the dispatch about to run will trace+compile)."""
         key = (state.space, state.seg_meta)
         if key in self._layouts:
             _STATS["layout_hits"] += 1
-        else:
-            self._layouts.add(key)
-            _STATS["layout_misses"] += 1
+            return False
+        self._layouts.add(key)
+        _STATS["layout_misses"] += 1
+        return True
+
+    def _signature(self, state: FlatOptState) -> Dict[str, Any]:
+        """JSON-able abstract signature of this dispatch — what the
+        compile tracker diffs to name a re-trace (a changed static
+        option, a new flat-space layout)."""
+        import hashlib
+
+        space = state.space
+        sig: Dict[str, Any] = dict(self.options)
+        # the padded total alone can collide across layouts (alignment
+        # rounds small leaves up to the same quantum): a digest of the
+        # per-leaf shapes/dtypes pins the layout exactly
+        sig.update(space_total=int(space.total),
+                   num_leaves=int(space.num_leaves),
+                   space_digest=hashlib.sha256(
+                       repr((space.shapes, tuple(map(str, space.dtypes)),
+                             space.offsets)).encode()).hexdigest()[:12],
+                   segmented=state.seg_meta is not None,
+                   scaler=self.scaler is not None)
+        return sig
 
     def __call__(self, state: FlatOptState, flat_grads: jax.Array,
                  scaler_state: Optional[ScalerState] = None, *, lr=None):
-        self._track(state)
+        new_layout = self._track(state)
         if self.scaler is not None:
             if scaler_state is None:
                 raise ValueError(
@@ -121,6 +149,24 @@ class TrainStep:
                 "or rebuild with make_train_step(opt, scaler=...)")
         else:
             args = (state, flat_grads, lr)
+        if new_layout:
+            # compile-plane cold path: this dispatch traces+compiles a
+            # new static layout. Publish the signature (recompile
+            # detection — a second distinct signature of "train_step"
+            # is a re-trace) and label the dispatch so the monitoring
+            # bridge attributes the XLA compile duration. Both are
+            # no-ops (one module-global read) with no tracker armed;
+            # layout HITS never reach this branch, so the hot loop —
+            # and the `disabled is step` / <1%-overhead contracts —
+            # are untouched.
+            from apex_tpu.telemetry import compiled as _compiled
+
+            _compiled.observe("train_step", self._signature(state))
+            with _compiled.label("train_step"):
+                return self._dispatch(args)
+        return self._dispatch(args)
+
+    def _dispatch(self, args):
         tl = self._telemetry
         try:
             if tl is None:

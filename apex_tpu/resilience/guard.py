@@ -122,7 +122,19 @@ def state_fingerprint(state) -> Fingerprint:
         import jax
 
         _FP_JITTED = jax.jit(state_fingerprint_array)
-    sums = np.asarray(_FP_JITTED(state))
+    # compile-plane: fingerprint boundaries are cold, so the observe +
+    # label cost nothing measurable; a layout change mid-run (the
+    # checksum program re-tracing) surfaces as a recompile event
+    from apex_tpu.telemetry import compiled as _compiled
+
+    if _compiled.get_tracker() is not None:
+        _compiled.observe("state_fingerprint", {
+            "total": int(state.space.total),
+            "num_leaves": int(state.space.num_leaves),
+            "n_buffers": 1 + len(state.slots),
+            "segmented": state.seg_meta is not None})
+    with _compiled.label("state_fingerprint"):
+        sums = np.asarray(_FP_JITTED(state))
     return Fingerprint(names=fingerprint_buffer_names(state),
                        sums=sums, count=int(state.count))
 

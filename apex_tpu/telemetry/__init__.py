@@ -1,8 +1,9 @@
 """Telemetry subsystem: metrics registry + step timeline + cost model
-+ fleet aggregation + crash flight recorder.
++ compile tracker + device-memory ledger + fleet aggregation + crash
+flight recorder.
 
 The observability layer the rest of the runtime reports through
-(docs/observability.md). Five parts:
+(docs/observability.md). Seven parts:
 
 - :mod:`~apex_tpu.telemetry.metrics` — process-global registry of
   counters / gauges / fixed-bucket histograms with labeled series,
@@ -18,6 +19,15 @@ The observability layer the rest of the runtime reports through
   ``jit(...).lower().compile().cost_analysis()`` and the MFU / HBM-
   bandwidth estimates bench records carry (``None`` **with a reason**
   when the backend has no cost model or the chip no peak entry).
+- :mod:`~apex_tpu.telemetry.compiled` — the compile plane: XLA
+  backend-compile timing via the ``jax.monitoring`` bridge
+  (``compile_ms``/``compile_count{fn=}`` + ``compile`` spans),
+  re-trace detection (``recompile`` events carrying a signature diff),
+  and recompile-storm escalation.
+- :mod:`~apex_tpu.telemetry.devmem` — the memory plane: normalized
+  ``compiled.memory_analysis()`` next to the cost model, plus a polled
+  ``devmem_*`` gauge set with watermark tracking that degrades to an
+  explicit null WITH ``devmem_reason`` on backends without stats.
 - :mod:`~apex_tpu.telemetry.fleet` — cross-host snapshot aggregation
   over the guard's ``Collective`` abstraction (counters summed, gauges
   per-host, histograms bucket-merged, timelines side by side) with
@@ -49,7 +59,17 @@ from __future__ import annotations
 
 from typing import Any, Dict
 
-from apex_tpu.telemetry import cost, fleet, flight, metrics, timeline
+from apex_tpu.telemetry import (
+    compiled,
+    cost,
+    devmem,
+    fleet,
+    flight,
+    metrics,
+    timeline,
+)
+from apex_tpu.telemetry.compiled import CompileTracker
+from apex_tpu.telemetry.devmem import DeviceMemoryLedger
 from apex_tpu.telemetry.fleet import (
     FleetAggregator,
     gather_snapshots,
@@ -100,19 +120,37 @@ def snapshot_detail() -> Dict[str, Any]:
     if mfu is None:
         out["mfu_reason"] = (reg.get_info("mfu_reason")
                              or "no step cost published in this process")
+    # devmem rides the same value-or-null-WITH-reason contract as mfu:
+    # a poll on a stats-bearing backend filled the gauges; anything
+    # else carries the reason the section is null
+    gauges = snap.get("gauges", {})
+    if gauges.get("devmem_bytes_in_use") is not None:
+        out["devmem"] = {
+            k: gauges.get(f"devmem_{k}")
+            for k in ("bytes_in_use", "peak_bytes", "bytes_limit",
+                      "watermark_bytes")}
+    else:
+        out["devmem"] = None
+        out["devmem_reason"] = (
+            reg.get_info("devmem_reason")
+            or "no device-memory poll in this process")
     return out
 
 
 def reset() -> None:
     """Fresh registry + disabled global timeline + disarmed flight
-    recorder (tests)."""
+    recorder / compile tracker / devmem ledger (tests)."""
     flight.disable()
+    compiled.disable()
+    devmem.disable()
     metrics.reset()
     timeline.disable()
 
 
 __all__ = [
+    "CompileTracker",
     "Counter",
+    "DeviceMemoryLedger",
     "FleetAggregator",
     "FlightRecorder",
     "Gauge",
@@ -124,7 +162,9 @@ __all__ = [
     "Span",
     "StdoutSink",
     "StepTimeline",
+    "compiled",
     "cost",
+    "devmem",
     "disable",
     "enable",
     "fleet",

@@ -1,0 +1,389 @@
+"""Compile-plane observability: compile timing, re-trace detection,
+and recompile-storm escalation.
+
+PRs 4-5 lit up the host loop and the fleet; the COMPILER plane stayed
+dark — nothing said how long XLA compiles took, or that a training
+loop had quietly fallen into a re-trace storm (a shape-polymorphic
+input or a drifting static option recompiling the train step every few
+steps, each one a multi-second stall that looks like "the chip got
+slow"). This module is that plane:
+
+- **Compile timing** rides jax's own ``jax.monitoring`` duration
+  events: :func:`enable` registers a listener for XLA backend-compile
+  durations, so EVERY real compile in the process — the fused train
+  step's per-layout specialization, a guard fingerprint program, a
+  Pallas engine sweep — publishes ``compile_count{fn=}`` /
+  ``compile_ms{fn=}`` / a ``compile_seconds{fn=}`` histogram into the
+  global registry and a ``"compile"`` span into the global timeline.
+  Attribution comes from :func:`label` scopes the instrumented entry
+  points (``optimizers.train_step``, ``multi_tensor.engine``,
+  ``resilience.guard``, ``telemetry.cost``) push around their
+  dispatches; unlabeled compiles land under ``fn="unattributed"``.
+- **Re-trace detection**: :meth:`CompileTracker.observe` registers the
+  abstract signature (static options + aval summary) each jit entry
+  point is about to compile under. The first signature of a fn is a
+  ``compile``; a signature already seen is a ``hit`` and publishes
+  NOTHING (cache hits are free, and must read as free); a NEW
+  signature on a previously-compiled fn is a **recompile** — a
+  ``recompile`` event carrying the structured signature diff
+  (changed/added/removed keys, old -> new) so the log names exactly
+  which static option or shape moved.
+- **Storm escalation**: more than ``storm_threshold`` recompiles of
+  one fn within ``storm_window`` steps emits one ``recompile_storm``
+  event (and resets the count, so a persisting storm escalates once
+  per threshold-full, not once per recompile). Knobs:
+  ``APEX_TPU_RECOMPILE_STORM_N`` (default 3) and
+  ``APEX_TPU_RECOMPILE_STORM_WINDOW`` (default 100 steps).
+
+Everything is host-side and disarmed by default: with no tracker
+enabled, :func:`observe` is one module-global read and :func:`label`
+returns a shared null context — the instrumented entry points only
+reach them on their COLD paths (a new layout, a fingerprint boundary),
+never per hot-loop dispatch, and the ``disabled is step`` /
+<1%-overhead contracts of docs/observability.md hold unchanged
+(tools/check_observability.sh re-asserts both with the tracker armed).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Any, Dict, Optional
+
+_STORM_N_ENV = "APEX_TPU_RECOMPILE_STORM_N"
+_STORM_WINDOW_ENV = "APEX_TPU_RECOMPILE_STORM_WINDOW"
+_DEFAULT_STORM_N = 3
+_DEFAULT_STORM_WINDOW = 100
+
+# the jax.monitoring duration key fired once per actual XLA backend
+# compile (trace/lowering have their own keys; the backend compile is
+# the multi-second one worth a span)
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+
+_LOCAL = threading.local()
+_NULL_CM = contextlib.nullcontext()
+
+
+def _label_stack():
+    st = getattr(_LOCAL, "stack", None)
+    if st is None:
+        st = _LOCAL.stack = []
+    return st
+
+
+def current_label() -> Optional[str]:
+    """The innermost :func:`label` scope on this thread, or None."""
+    st = getattr(_LOCAL, "stack", None)
+    return st[-1] if st else None
+
+
+@contextlib.contextmanager
+def _labeled(fn: str):
+    st = _label_stack()
+    st.append(str(fn))
+    try:
+        yield
+    finally:
+        st.pop()
+
+
+def label(fn: str):
+    """Attribution scope: backend compiles fired inside the block are
+    credited to ``fn`` by the monitoring bridge. A shared null context
+    (no allocation, no state) when no tracker is armed — entry points
+    may wrap their cold-path dispatches unconditionally."""
+    if _TRACKER is None:
+        return _NULL_CM
+    return _labeled(fn)
+
+
+def signature_diff(old: Dict[str, Any],
+                   new: Dict[str, Any]) -> Dict[str, Any]:
+    """Structured top-level diff between two abstract signatures:
+    ``{"changed": {k: [old, new]}, "added": {...}, "removed": {...}}``
+    with empty sections dropped — what a ``recompile`` event carries so
+    the log names exactly which static option or shape moved."""
+    changed, added, removed = {}, {}, {}
+    for k in sorted(set(old) | set(new)):
+        if k not in old:
+            added[k] = new[k]
+        elif k not in new:
+            removed[k] = old[k]
+        elif old[k] != new[k]:
+            changed[k] = [old[k], new[k]]
+    out: Dict[str, Any] = {}
+    if changed:
+        out["changed"] = changed
+    if added:
+        out["added"] = added
+    if removed:
+        out["removed"] = removed
+    return out
+
+
+def abstract_signature(tree=None, **static) -> Dict[str, Any]:
+    """A JSON-able abstract signature: the ``static`` kwargs verbatim
+    plus, when a pytree is given, a compact aval summary (leaf count,
+    total elements, digest of every leaf's shape/dtype string) — big
+    trees never inline thousands of shapes into an event."""
+    sig: Dict[str, Any] = dict(static)
+    if tree is not None:
+        import jax
+
+        leaves = jax.tree.leaves(tree)
+        avals = [f"{getattr(l, 'dtype', type(l).__name__)}"
+                 f"[{','.join(str(d) for d in getattr(l, 'shape', ()))}]"
+                 for l in leaves]
+        sig["leaves"] = len(leaves)
+        sig["total_elements"] = int(sum(
+            int(getattr(l, "size", 1)) for l in leaves))
+        sig["aval_digest"] = hashlib.sha256(
+            "|".join(avals).encode()).hexdigest()[:12]
+    return sig
+
+
+def _env_int(name: str, default: int) -> int:
+    try:
+        return int(os.environ.get(name, default))
+    except ValueError:
+        return default
+
+
+class CompileTracker:
+    """Signature registry + recompile/storm detection + the metric
+    surface the monitoring bridge publishes through.
+
+    - ``storm_threshold`` (N) / ``storm_window`` (M): escalate past N
+      recompiles of one fn within M steps. Step indices come from the
+      explicit ``step=`` argument, else the global timeline's current
+      step, else an internal observation counter.
+    - ``registry``: defaults to the process-global metrics registry.
+    """
+
+    def __init__(self, registry=None, *, storm_threshold: Optional[int] = None,
+                 storm_window: Optional[int] = None):
+        from apex_tpu.telemetry import metrics as _metrics
+
+        self.registry = (registry if registry is not None
+                         else _metrics.registry())
+        self.storm_threshold = int(
+            storm_threshold if storm_threshold is not None
+            else _env_int(_STORM_N_ENV, _DEFAULT_STORM_N))
+        self.storm_window = int(
+            storm_window if storm_window is not None
+            else _env_int(_STORM_WINDOW_ENV, _DEFAULT_STORM_WINDOW))
+        self._lock = threading.Lock()
+        self._signatures: Dict[str, Dict[str, Dict[str, Any]]] = {}
+        self._last_key: Dict[str, str] = {}
+        self._recompile_steps: Dict[str, deque] = {}
+        self._observations = 0
+        self.compiles = 0
+        self.recompiles = 0
+        self.storms = 0
+
+    # -- steps ---------------------------------------------------------------
+
+    def _step_now(self, step: Optional[int]) -> int:
+        if step is not None:
+            return int(step)
+        from apex_tpu.telemetry import timeline as _timeline
+
+        tl = _timeline._GLOBAL          # never CREATE the global here
+        if tl is not None and tl.enabled and tl._step >= 0:
+            return tl._step
+        return self._observations
+
+    # -- signature observation ----------------------------------------------
+
+    def observe(self, fn: str, signature: Dict[str, Any], *,
+                step: Optional[int] = None) -> str:
+        """Register that ``fn`` is being dispatched under ``signature``.
+
+        Returns ``"hit"`` (seen before — publishes NOTHING),
+        ``"compile"`` (first signature of this fn), or ``"recompile"``
+        (new signature on a previously-compiled fn: ``recompile`` event
+        with the signature diff, ``recompile_count{fn=}`` bump, and a
+        ``recompile_storm`` escalation past the threshold).
+        """
+        fn = str(fn)
+        key = json.dumps(signature, sort_keys=True, default=str)
+        with self._lock:
+            self._observations += 1
+            sigs = self._signatures.setdefault(fn, {})
+            if key in sigs:
+                return "hit"
+            prev_key = self._last_key.get(fn)
+            prev_sig = sigs.get(prev_key) if prev_key is not None else None
+            sigs[key] = dict(signature)
+            self._last_key[fn] = key
+            now = self._step_now(step)
+        self.registry.counter(
+            "compiled_signatures",
+            "distinct (fn, abstract signature) pairs observed by the "
+            "compile tracker").inc(fn=fn)
+        if prev_sig is None:
+            self.compiles += 1
+            return "compile"
+        self.recompiles += 1
+        diff = signature_diff(prev_sig, signature)
+        self.registry.counter(
+            "recompile_count",
+            "re-traces: a NEW abstract signature on a previously-"
+            "compiled fn").inc(fn=fn)
+        self.registry.event("recompile", fn=fn, step=now,
+                            signature_diff=diff,
+                            signatures=len(self._signatures[fn]))
+        with self._lock:
+            ring = self._recompile_steps.setdefault(fn, deque())
+            ring.append(now)
+            while ring and ring[0] <= now - self.storm_window:
+                ring.popleft()
+            storm = len(ring) >= self.storm_threshold
+            count = len(ring)
+            if storm:
+                # escalate once per threshold-full: a persisting storm
+                # re-escalates after N MORE recompiles, not per recompile
+                ring.clear()
+        if storm:
+            self.storms += 1
+            self.registry.counter(
+                "recompile_storms",
+                "recompile-storm escalations (> threshold recompiles "
+                "of one fn inside the window)").inc(fn=fn)
+            self.registry.event("recompile_storm", fn=fn, step=now,
+                                count=count,
+                                threshold=self.storm_threshold,
+                                window_steps=self.storm_window)
+        return "recompile"
+
+    # -- compile durations (monitoring bridge) -------------------------------
+
+    def record_compile(self, fn: str, seconds: float) -> None:
+        """One actual XLA backend compile: ``compile_count{fn=}``,
+        ``compile_ms{fn=}`` (most recent), the ``compile_seconds{fn=}``
+        histogram, and a ``"compile"`` span into the global timeline
+        (when it is on)."""
+        seconds = float(seconds)
+        self.registry.counter(
+            "compile_count", "XLA backend compiles observed").inc(fn=fn)
+        self.registry.gauge(
+            "compile_ms",
+            "duration of the most recent XLA backend compile").set(
+            seconds * 1e3, fn=fn)
+        self.registry.histogram(
+            "compile_seconds", "XLA backend compile durations").observe(
+            seconds, fn=fn)
+        from apex_tpu.telemetry.timeline import record_global_span
+
+        record_global_span("compile", time.perf_counter() - seconds,
+                           seconds, category="compile")
+
+    def summary(self) -> Dict[str, Any]:
+        """Compact JSON-able state: per-fn signature counts plus the
+        compile/recompile/storm totals — what dashboards and the
+        flight recorder's ``compile_plane`` block read."""
+        with self._lock:
+            per_fn = {fn: len(sigs)
+                      for fn, sigs in self._signatures.items()}
+        return {"signatures": per_fn, "compiles": self.compiles,
+                "recompiles": self.recompiles, "storms": self.storms,
+                "storm_threshold": self.storm_threshold,
+                "storm_window": self.storm_window}
+
+
+# ---------------------------------------------------------------------------
+# The process-global tracker + jax.monitoring bridge
+# ---------------------------------------------------------------------------
+
+_TRACKER: Optional[CompileTracker] = None
+_LISTENER = None
+
+
+def _on_duration(name: str, secs: float, **kw) -> None:
+    t = _TRACKER
+    if t is None or name != BACKEND_COMPILE_EVENT:
+        return
+    try:
+        t.record_compile(current_label() or "unattributed", secs)
+    except Exception:  # noqa: BLE001 — observability must not kill a compile
+        pass
+
+
+def _register_bridge() -> None:
+    global _LISTENER
+    if _LISTENER is not None:
+        return
+    try:
+        from jax import monitoring as _monitoring
+
+        _monitoring.register_event_duration_secs_listener(_on_duration)
+        _LISTENER = _on_duration
+    except Exception:  # noqa: BLE001 — no monitoring API: signatures still work
+        _LISTENER = None
+
+
+def _unregister_bridge() -> None:
+    global _LISTENER
+    if _LISTENER is None:
+        return
+    try:
+        from jax._src import monitoring as _monitoring
+
+        _monitoring._unregister_event_duration_listener_by_callback(
+            _LISTENER)
+        _LISTENER = None
+    except Exception:  # noqa: BLE001 — listener self-disarms on _TRACKER None
+        _LISTENER = None
+
+
+def enable(**kwargs) -> CompileTracker:
+    """Arm the process-global compile tracker (kwargs =
+    :class:`CompileTracker`) and register the jax.monitoring bridge.
+    Re-arming replaces the previous tracker (fresh signature state)."""
+    global _TRACKER
+    disable()
+    _TRACKER = CompileTracker(**kwargs)
+    _register_bridge()
+    return _TRACKER
+
+
+def disable() -> None:
+    global _TRACKER
+    _TRACKER = None
+    _unregister_bridge()
+
+
+def get_tracker() -> Optional[CompileTracker]:
+    return _TRACKER
+
+
+def observe(fn: str, signature: Dict[str, Any], *,
+            step: Optional[int] = None) -> str:
+    """Observe on the global tracker; ``"disabled"`` (and nothing else
+    — not even an exception) when no tracker is armed."""
+    t = _TRACKER
+    if t is None:
+        return "disabled"
+    try:
+        return t.observe(fn, signature, step=step)
+    except Exception:  # noqa: BLE001
+        return "error"
+
+
+__all__ = [
+    "BACKEND_COMPILE_EVENT",
+    "CompileTracker",
+    "abstract_signature",
+    "current_label",
+    "disable",
+    "enable",
+    "get_tracker",
+    "label",
+    "observe",
+    "signature_diff",
+]

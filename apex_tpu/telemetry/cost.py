@@ -17,6 +17,11 @@ raising: some backends expose no cost model, some device kinds have no
 peak-TFLOPs entry, and a bench record must say *why* its ``mfu`` is
 null rather than silently dropping the field (BENCH_r0x fallback-saga
 rule: records never contradict themselves).
+
+The program's memory FOOTPRINT (``memory_analysis()``) lives next
+door in :mod:`~apex_tpu.telemetry.devmem`; :func:`bytes_per_element`
+below is the measured side of the bench's measured-vs-analytic HBM
+ledger (docs/observability.md "compile & memory plane").
 """
 
 from __future__ import annotations
@@ -59,8 +64,11 @@ def jitted_cost(fn, *args, **kwargs) -> Optional[Dict[str, float]]:
     """Lower+compile ``fn`` (a ``jax.jit`` result) on the given
     arguments and return its static cost; None on any failure — cost
     accounting must never take down the loop it describes."""
+    from apex_tpu.telemetry import compiled as _compiled
+
     try:
-        return compiled_cost(fn.lower(*args, **kwargs).compile())
+        with _compiled.label("jitted_cost"):
+            return compiled_cost(fn.lower(*args, **kwargs).compile())
     except Exception:  # noqa: BLE001
         return None
 
@@ -71,11 +79,28 @@ def train_step_cost(step, state, flat_grads,
     (:class:`~apex_tpu.optimizers.train_step.TrainStep`). Uses the
     step's ``lower`` passthrough, so nothing executes and no buffer is
     donated — safe to call right before the timed run."""
+    from apex_tpu.telemetry import compiled as _compiled
+
     try:
-        return compiled_cost(
-            step.lower(state, flat_grads, scaler_state, lr=lr).compile())
+        with _compiled.label("train_step_cost"):
+            return compiled_cost(
+                step.lower(state, flat_grads, scaler_state,
+                           lr=lr).compile())
     except Exception:  # noqa: BLE001
         return None
+
+
+def bytes_per_element(cost: Optional[Dict[str, float]],
+                      n_elements: int) -> Optional[float]:
+    """Measured HBM bytes per model element from a cost dict — the
+    MEASURED side of the bench's measured-vs-analytic HBM ledger (the
+    analytic side is ``hbm_accesses_per_element``, the fp32
+    accesses/element design numbers of docs/train_step.md). None when
+    the backend has no cost model or the element count is unusable —
+    the record then says null instead of a made-up number."""
+    if not cost or not cost.get("bytes_accessed") or not n_elements:
+        return None
+    return round(float(cost["bytes_accessed"]) / float(n_elements), 3)
 
 
 def device_kind() -> str:
@@ -156,6 +181,7 @@ def publish_mfu(est: Dict[str, Any], registry=None) -> None:
 
 
 __all__ = [
+    "bytes_per_element",
     "compiled_cost",
     "device_kind",
     "jitted_cost",

@@ -1,0 +1,285 @@
+"""Device-memory ledger: XLA ``memory_analysis()`` normalization and
+polled device-memory gauges with explicit-null-with-reason degradation.
+
+The cost model (:mod:`~apex_tpu.telemetry.cost`) accounts for a
+compiled program's TRAFFIC (flops, HBM bytes accessed); this module
+accounts for its FOOTPRINT and for the device's live occupancy:
+
+- :func:`compiled_memory` normalizes
+  ``jit(...).lower(...).compile().memory_analysis()`` — argument /
+  output / temp / alias / generated-code bytes plus the peak when the
+  backend reports one — into one dict with a fixed key set, the exact
+  sibling of ``cost.compiled_cost``. :func:`train_step_memory` is the
+  fused-train-step convenience (the step's ``lower`` passthrough:
+  nothing executes, nothing is donated).
+- :func:`device_memory_stats` reads ``device.memory_stats()`` (bytes
+  in use, device-reported peak, limit). Backends without stats (CPU,
+  some plugins) degrade to the SAME contract as ``mfu_reason``
+  (docs/observability.md): every key present, values null, and
+  ``devmem_reason`` naming exactly why — a record never silently
+  drops the section.
+- :class:`DeviceMemoryLedger` is the polled gauge set: each
+  :meth:`~DeviceMemoryLedger.poll` publishes ``devmem_bytes_in_use`` /
+  ``devmem_peak_bytes`` / ``devmem_bytes_limit`` and tracks its own
+  high-water ``devmem_watermark_bytes`` (the max bytes-in-use THIS
+  ledger has seen — survives a backend whose peak counter resets).
+  ``telemetry.snapshot_detail()`` folds the gauges into every bench
+  record as a ``devmem`` value-or-null-with-reason block, and the
+  flight recorder folds :meth:`~DeviceMemoryLedger.summary` into each
+  ``flightrec_*.json`` bundle.
+
+Everything is host-side; polling costs one runtime call per poll and
+nothing at all between polls.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+# (CompiledMemoryStats attribute, normalized key) — getattr-based so
+# older/newer jaxlibs that drop or add fields degrade to null, not raise
+_MEM_ATTRS = (
+    ("argument_size_in_bytes", "argument_bytes"),
+    ("output_size_in_bytes", "output_bytes"),
+    ("temp_size_in_bytes", "temp_bytes"),
+    ("alias_size_in_bytes", "alias_bytes"),
+    ("generated_code_size_in_bytes", "generated_code_bytes"),
+    ("peak_memory_in_bytes", "peak_bytes"),
+)
+
+
+def normalize_memory_analysis(ma: Any) -> Optional[Dict[str, Any]]:
+    """A ``CompiledMemoryStats`` (or anything shaped like one) as one
+    dict with the fixed key set of ``_MEM_ATTRS`` plus
+    ``total_footprint_bytes`` (args + outputs + temps + generated
+    code — the compiled program's resident claim when the backend
+    reports no peak). None when nothing useful is present."""
+    if ma is None:
+        return None
+    out: Dict[str, Any] = {}
+    for attr, key in _MEM_ATTRS:
+        v = getattr(ma, attr, None)
+        out[key] = int(v) if isinstance(v, (int, float)) else None
+    if all(v is None for v in out.values()):
+        return None
+    out["total_footprint_bytes"] = sum(
+        out[k] or 0 for k in ("argument_bytes", "output_bytes",
+                              "temp_bytes", "generated_code_bytes"))
+    return out
+
+
+def compiled_memory(compiled) -> Optional[Dict[str, Any]]:
+    """``memory_analysis()`` of a compiled computation, normalized;
+    None when the backend exposes none — the sibling of
+    ``cost.compiled_cost`` (traffic there, footprint here)."""
+    try:
+        return normalize_memory_analysis(compiled.memory_analysis())
+    except Exception:  # noqa: BLE001 — "no memory analysis" raises on some backends
+        return None
+
+
+def jitted_memory(fn, *args, **kwargs) -> Optional[Dict[str, Any]]:
+    """Lower+compile ``fn`` (a ``jax.jit`` result) on the given
+    arguments and return its static memory footprint; None on any
+    failure — accounting never takes down the loop it describes."""
+    from apex_tpu.telemetry import compiled as _compiled
+
+    try:
+        with _compiled.label("jitted_memory"):
+            return compiled_memory(fn.lower(*args, **kwargs).compile())
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def train_step_memory(step, state, flat_grads, scaler_state=None,
+                      lr=None) -> Optional[Dict[str, Any]]:
+    """Static memory footprint of one fused train step
+    (:class:`~apex_tpu.optimizers.train_step.TrainStep`), via the
+    step's ``lower`` passthrough — nothing executes, no buffer is
+    donated; safe right before the timed run."""
+    from apex_tpu.telemetry import compiled as _compiled
+
+    try:
+        with _compiled.label("train_step_memory"):
+            return compiled_memory(
+                step.lower(state, flat_grads, scaler_state,
+                           lr=lr).compile())
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def publish_memory(mem: Optional[Dict[str, Any]], registry=None,
+                   fn: str = "train_step") -> None:
+    """Mirror a :func:`compiled_memory` dict into the registry as the
+    labeled ``devmem_compiled_bytes{part=,fn=}`` gauge set (absent
+    parts publish nothing — the dict is the null-carrying record)."""
+    if not mem:
+        return
+    from apex_tpu.telemetry import metrics as _metrics
+
+    reg = registry if registry is not None else _metrics.registry()
+    g = reg.gauge("devmem_compiled_bytes",
+                  "memory_analysis() of a compiled program, by part")
+    for key, v in mem.items():
+        if v is None:
+            continue
+        g.set(v, part=key.replace("_bytes", ""), fn=fn)
+
+
+# ---------------------------------------------------------------------------
+# Live device-memory stats
+# ---------------------------------------------------------------------------
+
+# device.memory_stats() key -> normalized key
+_STATS_KEYS = (
+    ("bytes_in_use", "bytes_in_use"),
+    ("peak_bytes_in_use", "peak_bytes_in_use"),
+    ("bytes_limit", "bytes_limit"),
+    ("largest_alloc_size", "largest_alloc_bytes"),
+    ("num_allocs", "num_allocs"),
+)
+
+
+def device_memory_stats(device=None) -> Dict[str, Any]:
+    """Live allocator stats of ``device`` (default: the first jax
+    device). ALWAYS returns the full key set: values, or nulls with
+    ``devmem_reason`` naming exactly why (no device, no stats on this
+    backend) — the ``mfu_reason`` contract, applied to memory."""
+    out: Dict[str, Any] = {key: None for _, key in _STATS_KEYS}
+    out["device_kind"] = None
+    out["devmem_reason"] = None
+    if device is None:
+        try:
+            import jax
+
+            device = jax.devices()[0]
+        except Exception as e:  # noqa: BLE001
+            out["devmem_reason"] = (f"no jax device available "
+                                    f"({type(e).__name__}: {e})")
+            return out
+    kind = str(getattr(device, "device_kind", "unknown"))
+    out["device_kind"] = kind
+    try:
+        stats = device.memory_stats()
+    except Exception as e:  # noqa: BLE001
+        out["devmem_reason"] = (f"device.memory_stats() raised "
+                                f"{type(e).__name__} on {kind!r}")
+        return out
+    if not stats:
+        out["devmem_reason"] = (f"backend exposes no device "
+                                f"memory_stats (device_kind={kind!r})")
+        return out
+    for src, key in _STATS_KEYS:
+        v = stats.get(src)
+        if v is not None:
+            out[key] = int(v)
+    if out["bytes_in_use"] is None:
+        out["devmem_reason"] = (f"memory_stats() on {kind!r} reports no "
+                                f"bytes_in_use (keys: "
+                                f"{sorted(stats)[:8]})")
+    return out
+
+
+class DeviceMemoryLedger:
+    """Polled device-memory gauge set with high-water tracking.
+
+    Each :meth:`poll` reads :func:`device_memory_stats` and publishes
+    the ``devmem_*`` gauges; on backends without stats it records the
+    reason (``info.devmem_reason``) instead — ``snapshot_detail()``
+    then carries ``devmem: null`` WITH the reason, never a silently
+    missing section. ``watermark_bytes`` is the ledger's own maximum
+    of ``bytes_in_use`` across polls (a high-water mark that survives
+    allocators whose peak counter resets between runs).
+    """
+
+    def __init__(self, device=None, registry=None):
+        self.device = device
+        self._registry = registry
+        self.watermark_bytes: Optional[int] = None
+        self.polls = 0
+        self.last: Optional[Dict[str, Any]] = None
+        self._lock = threading.Lock()
+
+    def _reg(self):
+        if self._registry is not None:
+            return self._registry
+        from apex_tpu.telemetry import metrics as _metrics
+
+        return _metrics.registry()
+
+    def poll(self) -> Dict[str, Any]:
+        """One read -> gauges (or the null reason); returns the stats
+        dict either way."""
+        st = device_memory_stats(self.device)
+        reg = self._reg()
+        with self._lock:
+            self.polls += 1
+            self.last = st
+            if st["bytes_in_use"] is None:
+                reg.set_info("devmem_reason", st["devmem_reason"])
+                return st
+            self.watermark_bytes = max(self.watermark_bytes or 0,
+                                       st["bytes_in_use"])
+            watermark = self.watermark_bytes
+        reg.set_info("devmem_reason", None)
+        reg.gauge("devmem_bytes_in_use",
+                  "device allocator bytes in use at the last poll").set(
+            st["bytes_in_use"])
+        if st["peak_bytes_in_use"] is not None:
+            reg.gauge("devmem_peak_bytes",
+                      "device-reported peak bytes in use").set(
+                st["peak_bytes_in_use"])
+        if st["bytes_limit"] is not None:
+            reg.gauge("devmem_bytes_limit",
+                      "device allocator capacity").set(st["bytes_limit"])
+        reg.gauge("devmem_watermark_bytes",
+                  "ledger high-water mark of bytes in use across "
+                  "polls").set(watermark)
+        return st
+
+    def summary(self) -> Dict[str, Any]:
+        """JSON-able ledger state for bundles/dashboards: poll count,
+        the watermark, and the last stats read (incl. its reason when
+        the backend has none)."""
+        with self._lock:
+            return {"polls": self.polls,
+                    "watermark_bytes": self.watermark_bytes,
+                    "last": dict(self.last) if self.last else None}
+
+
+# ---------------------------------------------------------------------------
+# The process-global ledger (what the flight recorder folds into bundles)
+# ---------------------------------------------------------------------------
+
+_LEDGER: Optional[DeviceMemoryLedger] = None
+
+
+def enable(device=None, registry=None) -> DeviceMemoryLedger:
+    """Arm the process-global ledger (replacing any previous one)."""
+    global _LEDGER
+    _LEDGER = DeviceMemoryLedger(device=device, registry=registry)
+    return _LEDGER
+
+
+def disable() -> None:
+    global _LEDGER
+    _LEDGER = None
+
+
+def get_ledger() -> Optional[DeviceMemoryLedger]:
+    return _LEDGER
+
+
+__all__ = [
+    "DeviceMemoryLedger",
+    "compiled_memory",
+    "device_memory_stats",
+    "disable",
+    "enable",
+    "get_ledger",
+    "jitted_memory",
+    "normalize_memory_analysis",
+    "publish_memory",
+    "train_step_memory",
+]

@@ -150,6 +150,38 @@ class FlightRecorder:
             return None
         return tl.export_trace(last_steps=self.last_steps)
 
+    def _devmem(self):
+        # the ledger's watermark when one is armed; else one direct
+        # poll so every bundle carries the memory plane — values, or
+        # nulls with devmem_reason (the mfu_reason contract)
+        from apex_tpu.telemetry import devmem as _devmem
+
+        try:
+            led = _devmem.get_ledger()
+            if led is not None:
+                return led.summary()
+            return {"polls": 0, "watermark_bytes": None,
+                    "last": _devmem.device_memory_stats()}
+        except Exception as e:  # noqa: BLE001
+            return {"error": f"{type(e).__name__}: {e}"}
+
+    def _compile_plane(self):
+        # recent re-trace evidence from this recorder's own event ring
+        # (recompiles before a crash usually ARE the story) plus the
+        # tracker's totals when one is armed
+        from apex_tpu.telemetry import compiled as _compiled
+
+        try:
+            recent = [dict(e) for e in self.events
+                      if e.get("event") in ("recompile",
+                                            "recompile_storm")]
+            tracker = _compiled.get_tracker()
+            return {"recent_events": recent,
+                    "tracker": (tracker.summary()
+                                if tracker is not None else None)}
+        except Exception as e:  # noqa: BLE001
+            return {"error": f"{type(e).__name__}: {e}"}
+
     def _last_checkpoint(self):
         if self.manager is None:
             return None
@@ -209,6 +241,8 @@ class FlightRecorder:
                 **({"fleet_unavailable": fleet_unavailable}
                    if fleet_unavailable else {}),
                 "trace": self._trace_slice(self.timeline),
+                "devmem": self._devmem(),
+                "compile_plane": self._compile_plane(),
                 "recent_events": list(self.events),
                 "state_digests": list(self.digests),
                 "last_checkpoint": self._last_checkpoint(),

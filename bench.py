@@ -9,16 +9,27 @@ prints ONE JSON line. vs_baseline = fused_time / optax_time (< 1 beats
 the baseline, 1.1 is the target ceiling).
 
 The headline runs through ``make_train_step`` (optimizers/
-train_step.py): one jitted, donation-aware program per step — master +
-slot buffers donated, unscale/nonfinite folded into the update sweep.
-The optimizer step is HBM-bandwidth-bound, so the budget that decides
+train_step.py) over the SEGMENTED one-pass schedule (ROADMAP item 3:
+the measured default is the schedule that can reach parity): one
+jitted, donation-aware program per step — master + slot buffers
+donated, unscale/nonfinite folded into the update sweep. The
+optimizer step is HBM-bandwidth-bound, so the budget that decides
 the ratio is fp32 HBM accesses per element (docs/train_step.md):
 optax's per-leaf fusion pays ~7 (r g,p,m,v + w p,m,v with each leaf
 resident on-chip), the classic two-stage flat schedule ~10 (it
 materializes the update term: +w u, +r p,u), and the segment-resident
 one-pass kernel + fused step path 7 (8 with ``seg_stash_p=False``;
 +1 read when global-grad-norm clipping is on). Every headline record
-carries this accounting in ``detail["hbm_accesses_per_element"]``.
+carries this ANALYTIC accounting in
+``detail["hbm_accesses_per_element"]`` next to the MEASURED
+``detail["measured_bytes_per_element"]`` — each impl's compiled
+``cost_analysis()`` bytes over the model element count — so a ratio
+regression localizes to a schedule paying more traffic than designed
+rather than a vibe (docs/observability.md "compile & memory plane").
+The headline value is the MEDIAN of ``APEX_TPU_BENCH_REPEATS``
+(default 5) timed repeats, with the per-impl spread in detail —
+single-shot numbers could not split code from host/tunnel noise
+(BENCH_r05 shipped ``"repeats": 1``).
 
 Supplementary microbenches (each also ONE JSON line, run explicitly —
 the driver's no-arg invocation prints only the headline metric):
@@ -88,6 +99,19 @@ def backend_detail():
     import jax
 
     return {"backend": jax.default_backend()}
+
+
+def _headline_repeats(default=5):
+    """Headline repeat count: ``APEX_TPU_BENCH_REPEATS`` (>=1), default
+    5 — the headline value is the MEDIAN of the repeats, so one noisy
+    host/tunnel window cannot move a round-over-round comparison."""
+    import os
+
+    try:
+        return max(1, int(os.environ.get("APEX_TPU_BENCH_REPEATS",
+                                         default)))
+    except ValueError:
+        return default
 
 
 def prior_measurement(metric, kind, root=None):
@@ -1179,9 +1203,10 @@ def main():
 
     # Repeats: single measurements cannot attribute a round-over-round
     # delta to code vs tunnel/host noise (the r2->r3 headline moved with
-    # no way to tell why). Median is the headline; min and the spread
-    # ride in detail.
-    R = 1 if jax.default_backend() == "cpu" else 3
+    # no way to tell why, and BENCH_r05 shipped "repeats": 1). Median of
+    # k >= 5 is the headline; the spread rides in detail. Env knob
+    # APEX_TPU_BENCH_REPEATS trims it for quick smokes.
+    R = _headline_repeats()
 
     def measure(fn, carry, *rest):
         ts = []
@@ -1189,6 +1214,27 @@ def main():
             t, carry = time_fn_threaded(fn, carry, *rest)
             ts.append(t / K)
         return sorted(ts), carry
+
+    # Measured HBM ledger: per-impl bytes_accessed/element from each
+    # compiled step's OWN cost_analysis (lower+compile only — nothing
+    # executes, nothing is donated), recorded next to the analytic
+    # hbm_accesses_per_element design numbers so a regression localizes
+    # to a schedule paying more traffic than designed.
+    from apex_tpu import telemetry
+
+    measured_bpe = {}
+
+    def _measured_bpe(jitted, *args):
+        return telemetry.cost.bytes_per_element(
+            telemetry.cost.jitted_cost(jitted, *args), n_params)
+
+    @jax.jit
+    def optax_one_step(params, state, grads):
+        updates, state = tx.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
+    measured_bpe["optax"] = _measured_bpe(optax_one_step, params,
+                                          opt_state, grads)
 
     # device-side copy survives the donation of `params` into the carry
     # (re-uploading 1.3 GB through a tunneled transport is far slower)
@@ -1233,6 +1279,9 @@ def main():
             fstate = out = None     # drop the previous impl's 3x-params
             fstate = fused.init(params)
             flat_g = fstate.space.pack(grads, dtype=jnp.float32)
+            measured_bpe[name] = _measured_bpe(
+                jax.jit(lambda s, g, fused=fused: fused.step_flat(s, g)),
+                fstate, flat_g)
 
             @functools.partial(jax.jit, donate_argnums=(0,))
             def fused_k_steps(state, flat_g, fused=fused):
@@ -1263,12 +1312,15 @@ def main():
         from apex_tpu import telemetry
         from apex_tpu.optimizers.train_step import make_train_step
 
-        # segmented layout only where the one-pass kernel exists: on
-        # the CPU fallback it would just pad the flat space (~40% more
-        # elements at smoke scale) and run the same two-stage math
+        # the headline schedule: the SEGMENTED one-pass layout
+        # everywhere (ROADMAP item 3 — the measured default must be
+        # the schedule that can reach parity). On an accelerator this
+        # resolves to the segment-resident Pallas kernel; on the CPU
+        # smoke the same layout runs the engine's xla math (padded flat
+        # space, same accounting), so the measured record names one
+        # schedule across rounds instead of flip-flopping by backend.
         fused = FusedLAMB(lr=lr, weight_decay=wd, max_grad_norm=0.0,
-                          use_nvlamb=True,
-                          segmented=jax.default_backend() != "cpu")
+                          use_nvlamb=True, segmented=True)
         fstate = fused.init(params)
         if fstate.seg_meta is not None:
             seg_stash_p = bool(fstate.seg_meta.stash_p)
@@ -1276,8 +1328,17 @@ def main():
         step = make_train_step(fused)
         # static XLA accounting of the compiled step BEFORE anything is
         # donated (lower() executes nothing): flops + bytes for the
-        # record's mfu/bandwidth fields
+        # record's mfu/bandwidth fields, the measured HBM ledger, and
+        # the memory_analysis footprint (telemetry/devmem.py)
         step_cost = telemetry.cost.train_step_cost(step, fstate, flat_g)
+        measured_bpe["fused_step"] = telemetry.cost.bytes_per_element(
+            step_cost, n_params)
+        step_mem = telemetry.devmem.train_step_memory(step, fstate, flat_g)
+        telemetry.devmem.publish_memory(step_mem)
+        # one devmem poll: live gauges on stats-bearing backends, the
+        # explicit null-with-reason (same contract as mfu_reason) on
+        # the rest — either way every record says which
+        telemetry.devmem.DeviceMemoryLedger().poll()
         # same K-chained protocol as every other row (TrainStep.chained
         # iterates the identical fused body in one donated fori_loop)
         ts, fstate = measure(step.chained(K), fstate, flat_g)
@@ -1299,7 +1360,8 @@ def main():
                                           fused_times["fused_step"])
         telemetry.cost.publish_mfu(est)
         tl.publish()
-        telemetry_block = {"step_timeline": tl.summary(), **est}
+        telemetry_block = {"step_timeline": tl.summary(),
+                           "memory_analysis": step_mem, **est}
         del fstate
     except Exception as e:  # noqa: BLE001 — keep the record flowing
         msg = str(e).split("\n")[0][:120]
@@ -1381,12 +1443,17 @@ def main():
         "t_fused_ms": round(t_fused * 1e3, 3),
         "impl": impl_used,
         "repeats": R,
+        "headline_stat": f"median of {R}",
         "t_optax_ms_all": [round(t * 1e3, 3) for t in ts_optax],
         "fused_ms_by_impl": {k: round(v * 1e3, 3)
                              for k, v in fused_times.items()},
         "fused_ms_spread": {k: [round(t * 1e3, 3) for t in v]
                             for k, v in fused_spreads.items()},
         "hbm_accesses_per_element": hbm_accesses,
+        # analytic design numbers above; MEASURED cost_analysis bytes
+        # per model element below — when they disagree, the schedule is
+        # paying traffic it wasn't designed to (docs/observability.md)
+        "measured_bytes_per_element": measured_bpe,
         **({"t_fused_sr_bf16_ms": round(t_sr * 1e3, 3)}
            if t_sr is not None else {}),
         "effective_hbm_gb_per_sec_at_7acc": round(

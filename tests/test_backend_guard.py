@@ -153,6 +153,71 @@ class TestEnsureBackend:
         assert d["backend_probe"]["cached"] is True
         assert "age_s" in d["backend_probe"]
 
+    def test_timeout_verdict_suppresses_in_budget_reprobes(
+            self, monkeypatch, tmp_path):
+        # BENCH_r05's failure mode: ONE invocation with a retry budget
+        # re-burned the 120 s probe timeout 4x on a dead tunnel. A
+        # timeout verdict is now honored for the cache TTL inside the
+        # loop too: with the default TTL (300 s) dwarfing this budget,
+        # exactly one probe runs and the note says why
+        import jax._src.xla_bridge as xb
+
+        self._isolate_probe_cache(monkeypatch, tmp_path)
+        calls = []
+        monkeypatch.setattr(
+            bg, "probe_default_backend",
+            lambda timeout=None: (calls.append(1)
+                                  or {"ok": False, "error": "probe timed "
+                                      "out after 120s"}))
+        monkeypatch.setattr(bg, "_RETRY_SLEEP", 0.05)
+        monkeypatch.setattr(xb, "backends_are_initialized", lambda: False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        report = bg.ensure_backend(min_devices=1, retry_budget=2.0)
+        assert report.fallback
+        assert len(calls) == 1          # no in-budget re-burn
+        assert "re-probes suppressed" in report.note
+
+    def test_timeout_verdict_reprobes_after_ttl_expiry(
+            self, monkeypatch, tmp_path):
+        # a budget LONGER than the TTL still re-probes — once the
+        # cached verdict expires, the tunnel may have recovered
+        import jax._src.xla_bridge as xb
+
+        self._isolate_probe_cache(monkeypatch, tmp_path)
+        monkeypatch.setenv("APEX_TPU_BACKEND_PROBE_CACHE_TTL", "0.05")
+        calls = []
+        monkeypatch.setattr(
+            bg, "probe_default_backend",
+            lambda timeout=None: (calls.append(1)
+                                  or {"ok": False, "error": "probe timed "
+                                      "out after 120s"}))
+        monkeypatch.setattr(bg, "_RETRY_SLEEP", 0.05)
+        monkeypatch.setattr(xb, "backends_are_initialized", lambda: False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        report = bg.ensure_backend(min_devices=1, retry_budget=0.5)
+        assert report.fallback
+        assert len(calls) >= 2          # waited out the TTL, then re-probed
+
+    def test_cheap_failures_keep_the_short_retry_cadence(
+            self, monkeypatch, tmp_path):
+        # non-timeout failures (fast rc != 0) cost seconds, not the
+        # probe window — the original retry cadence is right for them
+        import jax._src.xla_bridge as xb
+
+        self._isolate_probe_cache(monkeypatch, tmp_path)
+        calls = []
+        monkeypatch.setattr(
+            bg, "probe_default_backend",
+            lambda timeout=None: (calls.append(1)
+                                  or {"ok": False, "error": "probe rc=1: "
+                                      "plugin exploded"}))
+        monkeypatch.setattr(bg, "_RETRY_SLEEP", 0.05)
+        monkeypatch.setattr(xb, "backends_are_initialized", lambda: False)
+        monkeypatch.delenv("JAX_PLATFORMS", raising=False)
+        report = bg.ensure_backend(min_devices=1, retry_budget=0.3)
+        assert report.fallback and len(calls) >= 2
+        assert "suppressed" not in report.note
+
     def test_cache_ttl_zero_disables(self, monkeypatch, tmp_path):
         import jax._src.xla_bridge as xb
 

@@ -150,6 +150,55 @@ class TestFillVsBaseline:
         assert "regression" not in rec["detail"]
 
 
+class TestHeadlineRepeats:
+    def test_default_is_median_of_at_least_five(self, monkeypatch):
+        monkeypatch.delenv("APEX_TPU_BENCH_REPEATS", raising=False)
+        assert bench._headline_repeats() >= 5
+
+    def test_env_override_and_floor(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_BENCH_REPEATS", "2")
+        assert bench._headline_repeats() == 2
+        monkeypatch.setenv("APEX_TPU_BENCH_REPEATS", "0")
+        assert bench._headline_repeats() == 1          # floor, not zero
+        monkeypatch.setenv("APEX_TPU_BENCH_REPEATS", "bogus")
+        assert bench._headline_repeats() == 5
+
+
+class TestHeadlineLedger:
+    def test_headline_record_measured_vs_analytic(self, monkeypatch,
+                                                  capsys):
+        # a tiny-shape headline run: the record must carry BOTH sides
+        # of the HBM ledger per impl, the repeat spread, and route the
+        # default impl through the segmented one-pass schedule
+        monkeypatch.setenv("APEX_TPU_BENCH_REPEATS", "1")
+        monkeypatch.setattr(
+            bench, "bert_large_shapes",
+            lambda **kw: [(64, 8), (64,), (32, 8), (16,)])
+        bench.main()
+        lines = [l for l in capsys.readouterr().out.splitlines()
+                 if l.startswith("{")]
+        rec = json.loads(lines[-1])
+        d = rec["detail"]
+        assert d["repeats"] == 1
+        assert d["headline_stat"] == "median of 1"
+        assert d["impl"] == "fused_step"
+        mb = d["measured_bytes_per_element"]
+        ana = d["hbm_accesses_per_element"]
+        # measured next to analytic, for the baseline AND every impl
+        assert set(mb) >= {"optax", "fused_step"}
+        assert set(mb) >= set(d["fused_ms_by_impl"])
+        assert set(ana) >= set(d["fused_ms_by_impl"]) | {"optax"}
+        # CPU has a cost model: the measured side is real numbers here
+        assert mb["fused_step"] > 0 and mb["optax"] > 0
+        # spread recorded per impl (one repeat -> one sample each)
+        assert all(len(v) == 1 for v in d["fused_ms_spread"].values())
+        # memory plane: the compiled step's footprint + the devmem
+        # null-with-reason contract on the CPU smoke backend
+        tdet = d["telemetry"]
+        assert tdet["memory_analysis"]["argument_bytes"] > 0
+        assert tdet["devmem"] is None and tdet["devmem_reason"]
+
+
 class TestEmitEndToEnd:
     def test_emit_fills_vs_baseline_from_prior_run(self, capsys):
         write_prior("fleet", "agg_ms", 2.0)

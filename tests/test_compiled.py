@@ -1,0 +1,267 @@
+"""Compile-plane observability (apex_tpu/telemetry/compiled.py):
+signature registry semantics (first = compile, seen = free hit, new =
+recompile with a structured diff), storm escalation and its window,
+the jax.monitoring bridge attribution, and the train-step / guard
+wiring — a changed static option on the fused step is exactly ONE
+recompile event."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.train_step import clear_step_cache, make_train_step
+from apex_tpu.telemetry import compiled
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    telemetry.reset()          # also disarms any leftover tracker
+    clear_step_cache()
+    yield
+    telemetry.reset()
+    clear_step_cache()
+
+
+@pytest.fixture
+def sink():
+    s = telemetry.InMemorySink()
+    telemetry.registry().add_sink(s)
+    return s
+
+
+def events(sink, name):
+    return [e for e in sink.events if e["event"] == name]
+
+
+class TestSignatureDiff:
+    def test_changed_added_removed(self):
+        d = compiled.signature_diff({"a": 1, "b": "x", "gone": 9},
+                                    {"a": 2, "b": "x", "new": 3})
+        assert d == {"changed": {"a": [1, 2]},
+                     "added": {"new": 3},
+                     "removed": {"gone": 9}}
+
+    def test_equal_signatures_diff_empty(self):
+        assert compiled.signature_diff({"a": 1}, {"a": 1}) == {}
+
+
+class TestAbstractSignature:
+    def test_static_plus_aval_summary(self):
+        tree = {"w": jnp.zeros((4, 8), jnp.float32),
+                "b": jnp.zeros((8,), jnp.bfloat16)}
+        sig = compiled.abstract_signature(tree, impl="xla", k=2)
+        assert sig["impl"] == "xla" and sig["k"] == 2
+        assert sig["leaves"] == 2
+        assert sig["total_elements"] == 40
+        assert len(sig["aval_digest"]) == 12
+        # shape change moves the digest
+        tree2 = {"w": jnp.zeros((4, 9), jnp.float32),
+                 "b": jnp.zeros((8,), jnp.bfloat16)}
+        assert (compiled.abstract_signature(tree2)["aval_digest"]
+                != sig["aval_digest"])
+
+
+class TestCompileTracker:
+    def test_first_signature_is_compile(self, sink):
+        tr = compiled.enable()
+        assert tr.observe("f", {"a": 1}) == "compile"
+        assert events(sink, "recompile") == []
+        assert telemetry.registry().counter(
+            "compiled_signatures").value(fn="f") == 1.0
+
+    def test_cache_hit_publishes_nothing(self, sink):
+        tr = compiled.enable()
+        tr.observe("f", {"a": 1})
+        before = telemetry.snapshot()
+        n_events = len(sink.events)
+        assert tr.observe("f", {"a": 1}) == "hit"
+        # no counter, no gauge, no event — a hit must read as free
+        assert telemetry.snapshot() == before
+        assert len(sink.events) == n_events
+
+    def test_retrace_emits_recompile_with_diff(self, sink):
+        tr = compiled.enable()
+        tr.observe("f", {"a": 1, "b": "x"})
+        assert tr.observe("f", {"a": 2, "b": "x", "c": 3}) == "recompile"
+        (ev,) = events(sink, "recompile")
+        assert ev["fn"] == "f"
+        assert ev["signature_diff"]["changed"]["a"] == [1, 2]
+        assert ev["signature_diff"]["added"]["c"] == 3
+        assert telemetry.registry().counter(
+            "recompile_count").value(fn="f") == 1.0
+
+    def test_diff_is_against_the_most_recent_signature(self, sink):
+        tr = compiled.enable()
+        tr.observe("f", {"v": 0})
+        tr.observe("f", {"v": 1})
+        tr.observe("f", {"v": 2})
+        last = events(sink, "recompile")[-1]
+        assert last["signature_diff"]["changed"]["v"] == [1, 2]
+
+    def test_fns_are_independent(self, sink):
+        tr = compiled.enable()
+        tr.observe("f", {"a": 1})
+        # g's FIRST signature is a compile even though f already has one
+        assert tr.observe("g", {"a": 2}) == "compile"
+        assert events(sink, "recompile") == []
+
+    def test_storm_escalation_once_per_threshold_full(self, sink):
+        tr = compiled.enable(storm_threshold=3, storm_window=10)
+        for i in range(4):                  # 1 compile + 3 recompiles
+            tr.observe("f", {"v": i}, step=i)
+        storms = events(sink, "recompile_storm")
+        assert len(storms) == 1
+        assert storms[0]["count"] == 3
+        assert storms[0]["threshold"] == 3
+        assert storms[0]["window_steps"] == 10
+        # the count reset on escalation: one more recompile, no storm
+        tr.observe("f", {"v": 99}, step=5)
+        assert len(events(sink, "recompile_storm")) == 1
+        assert telemetry.registry().counter(
+            "recompile_storms").value(fn="f") == 1.0
+
+    def test_storm_window_ages_out_old_recompiles(self, sink):
+        tr = compiled.enable(storm_threshold=3, storm_window=5)
+        tr.observe("f", {"v": 0}, step=0)
+        tr.observe("f", {"v": 1}, step=1)
+        tr.observe("f", {"v": 2}, step=2)
+        # recompiles at steps 1, 2 have aged out by step 50
+        tr.observe("f", {"v": 3}, step=50)
+        assert events(sink, "recompile_storm") == []
+
+    def test_env_knobs(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_RECOMPILE_STORM_N", "7")
+        monkeypatch.setenv("APEX_TPU_RECOMPILE_STORM_WINDOW", "42")
+        tr = compiled.enable()
+        assert tr.storm_threshold == 7
+        assert tr.storm_window == 42
+
+    def test_disabled_module_observe_is_noop(self, sink):
+        assert compiled.get_tracker() is None
+        assert compiled.observe("f", {"a": 1}) == "disabled"
+        assert sink.events == []
+
+    def test_summary(self):
+        tr = compiled.enable()
+        tr.observe("f", {"v": 0})
+        tr.observe("f", {"v": 1})
+        tr.observe("g", {"v": 0})
+        s = tr.summary()
+        assert s["signatures"] == {"f": 2, "g": 1}
+        assert s["compiles"] == 2 and s["recompiles"] == 1
+
+
+class TestMonitoringBridge:
+    def test_backend_compile_attributed_to_label(self):
+        compiled.enable()
+        with compiled.label("myfn"):
+            jax.jit(lambda x: x * 3 + 1)(jnp.ones((7,)))
+        reg = telemetry.registry()
+        assert reg.counter("compile_count").value(fn="myfn") >= 1.0
+        assert reg.gauge("compile_ms").value(fn="myfn") > 0.0
+        hist = telemetry.snapshot()["histograms"]
+        assert any(k.startswith("compile_seconds") and 'fn="myfn"' in k
+                   for k in hist)
+
+    def test_unlabeled_compile_is_unattributed(self):
+        compiled.enable()
+        jax.jit(lambda x: x * 5 - 2)(jnp.ones((13,)))
+        assert telemetry.registry().counter(
+            "compile_count").value(fn="unattributed") >= 1.0
+
+    def test_compile_span_lands_in_global_timeline(self):
+        tl = telemetry.enable(capacity=64)
+        try:
+            compiled.enable()
+            with compiled.label("spanfn"):
+                jax.jit(lambda x: x - 5)(jnp.ones((9,)))
+            cats = {(s.name, s.category) for s in tl.spans()}
+            assert ("compile", "compile") in cats
+        finally:
+            telemetry.timeline.disable()
+
+    def test_disable_stops_publishing(self):
+        compiled.enable()
+        compiled.disable()
+        jax.jit(lambda x: x + 7)(jnp.ones((11,)))
+        counters = telemetry.snapshot()["counters"]
+        assert not any(k.startswith("compile_count") for k in counters)
+
+    def test_label_is_null_context_when_disarmed(self):
+        cm = compiled.label("whatever")
+        with cm:
+            assert compiled.current_label() is None
+
+
+def _small_step(n=64, **opts):
+    opt = FusedAdam(lr=1e-3, impl="xla")
+    state = opt.init({"w": jnp.zeros((n,), jnp.float32)})
+    g = jnp.zeros((state.space.total,), jnp.float32)
+    return make_train_step(opt, **opts), state, g
+
+
+class TestTrainStepWiring:
+    def test_changed_static_option_is_exactly_one_recompile(self, sink):
+        compiled.enable()
+        step, state, g = _small_step()
+        state, _ = step(state, g)               # first trace: compile
+        assert events(sink, "recompile") == []
+        state, _ = step(state, g)               # layout hit: nothing
+        sib = step.with_options(with_grad_norm=True)
+        state, _ = sib(state, g)                # forced re-trace
+        (ev,) = events(sink, "recompile")
+        assert ev["fn"] == "train_step"
+        assert ev["signature_diff"]["changed"]["with_grad_norm"] == [
+            False, True]
+        state, _ = sib(state, g)                # sibling hit: still one
+        assert len(events(sink, "recompile")) == 1
+
+    def test_compile_duration_attributed_to_train_step(self, sink):
+        compiled.enable()
+        step, state, g = _small_step(n=96)
+        state, _ = step(state, g)
+        reg = telemetry.registry()
+        assert reg.counter("compile_count").value(fn="train_step") >= 1.0
+        assert reg.gauge("compile_ms").value(fn="train_step") > 0.0
+
+    def test_new_layout_is_a_recompile_with_space_diff(self, sink):
+        compiled.enable()
+        step, state, g = _small_step(n=64)
+        state, _ = step(state, g)
+        opt2 = FusedAdam(lr=1e-3, impl="xla")
+        state2 = opt2.init({"w": jnp.zeros((256,), jnp.float32)})
+        g2 = jnp.zeros((state2.space.total,), jnp.float32)
+        step2 = make_train_step(opt2)
+        state2, _ = step2(state2, g2)
+        (ev,) = events(sink, "recompile")
+        # alignment pads both layouts to the same total — the per-leaf
+        # digest is what distinguishes them
+        assert "space_digest" in ev["signature_diff"]["changed"]
+
+    def test_disarmed_train_step_untouched(self, sink):
+        # no tracker: dispatches publish nothing and the factory
+        # identity contract holds (the structural disabled-is-step)
+        step, state, g = _small_step()
+        state, _ = step(state, g)
+        assert sink.events == []
+        assert make_train_step(step.opt, telemetry=None) is step
+
+
+class TestGuardWiring:
+    def test_fingerprint_program_observed(self):
+        from apex_tpu.resilience.guard import state_fingerprint
+
+        compiled.enable()
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        state = opt.init({"w": jnp.asarray(
+            np.random.RandomState(0).randn(64).astype(np.float32))})
+        state_fingerprint(state)
+        assert telemetry.registry().counter(
+            "compiled_signatures").value(fn="state_fingerprint") == 1.0
+        # same layout again: a hit, no new signature
+        state_fingerprint(state)
+        assert telemetry.registry().counter(
+            "compiled_signatures").value(fn="state_fingerprint") == 1.0

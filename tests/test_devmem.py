@@ -1,0 +1,245 @@
+"""Memory-plane observability (apex_tpu/telemetry/devmem.py):
+memory_analysis normalization, the polled device-memory ledger with
+watermark tracking, the explicit null-with-reason degradation on
+backends without stats (the mfu_reason contract), and the
+tools/telemetry_dump.py compile/devmem sections + Prometheus
+coverage."""
+
+import importlib.util
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.train_step import clear_step_cache, make_train_step
+from apex_tpu.telemetry import devmem
+
+
+@pytest.fixture(autouse=True)
+def fresh():
+    telemetry.reset()
+    clear_step_cache()
+    yield
+    telemetry.reset()
+    clear_step_cache()
+
+
+def _load_dump_tool():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "telemetry_dump.py")
+    spec = importlib.util.spec_from_file_location("telemetry_dump", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FakeDevice:
+    device_kind = "TPU v99-fake"
+
+    def __init__(self, in_use=1000, limit=10_000):
+        self.in_use = in_use
+        self.limit = limit
+
+    def memory_stats(self):
+        return {"bytes_in_use": self.in_use,
+                "peak_bytes_in_use": self.in_use + 500,
+                "bytes_limit": self.limit,
+                "num_allocs": 3}
+
+
+class _StatlessDevice:
+    device_kind = "statless"
+
+    def memory_stats(self):
+        return None
+
+
+class TestCompiledMemory:
+    def test_normalizes_real_compiled(self):
+        c = jax.jit(lambda x: x * 2 + 1).lower(
+            jnp.ones((16,), jnp.float32)).compile()
+        mem = devmem.compiled_memory(c)
+        assert mem["argument_bytes"] == 64
+        assert mem["output_bytes"] == 64
+        assert mem["total_footprint_bytes"] >= 128
+        for key in ("temp_bytes", "alias_bytes", "generated_code_bytes",
+                    "peak_bytes"):
+            assert key in mem       # fixed key set, value-or-null
+
+    def test_garbage_object_degrades_to_none(self):
+        assert devmem.compiled_memory(object()) is None
+        assert devmem.normalize_memory_analysis(None) is None
+        assert devmem.normalize_memory_analysis(object()) is None
+
+    def test_train_step_memory(self):
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        state = opt.init({"w": jnp.zeros((64,), jnp.float32)})
+        g = jnp.zeros((state.space.total,), jnp.float32)
+        step = make_train_step(opt)
+        mem = devmem.train_step_memory(step, state, g)
+        assert mem["argument_bytes"] > 0
+        # lower() passthrough: nothing was donated, the state is usable
+        state, _ = step(state, g)
+
+    def test_jitted_memory(self):
+        fn = jax.jit(lambda x: jnp.sum(x * x))
+        mem = devmem.jitted_memory(fn, jnp.ones((32,), jnp.float32))
+        assert mem["argument_bytes"] == 128
+
+    def test_publish_memory_gauges(self):
+        devmem.publish_memory({"argument_bytes": 100, "peak_bytes": None,
+                               "temp_bytes": 7}, fn="f")
+        gauges = telemetry.snapshot()["gauges"]
+        assert gauges['devmem_compiled_bytes{fn="f",part="argument"}'] == 100
+        assert gauges['devmem_compiled_bytes{fn="f",part="temp"}'] == 7
+        # null parts publish nothing
+        assert not any("peak" in k for k in gauges)
+        devmem.publish_memory(None)     # no-op, never raises
+
+
+class TestDeviceMemoryStats:
+    def test_cpu_is_null_with_reason(self):
+        st = devmem.device_memory_stats()       # the test backend: CPU
+        assert st["bytes_in_use"] is None
+        assert st["peak_bytes_in_use"] is None
+        assert "memory_stats" in st["devmem_reason"]
+        assert st["device_kind"]                # named, not guessed
+
+    def test_fake_device_values(self):
+        st = devmem.device_memory_stats(_FakeDevice(in_use=123))
+        assert st["bytes_in_use"] == 123
+        assert st["peak_bytes_in_use"] == 623
+        assert st["bytes_limit"] == 10_000
+        assert st["devmem_reason"] is None
+
+
+class TestLedger:
+    def test_null_reason_path_publishes_info_not_gauges(self):
+        led = devmem.DeviceMemoryLedger(device=_StatlessDevice())
+        st = led.poll()
+        snap = telemetry.snapshot()
+        assert "devmem_bytes_in_use" not in snap["gauges"]
+        assert "statless" in snap["info"]["devmem_reason"]
+        det = telemetry.snapshot_detail()
+        assert det["devmem"] is None
+        assert "statless" in det["devmem_reason"]
+        assert st["devmem_reason"]
+
+    def test_gauges_and_watermark_high_water(self):
+        dev = _FakeDevice(in_use=1000)
+        led = devmem.DeviceMemoryLedger(device=dev)
+        led.poll()
+        dev.in_use = 5000
+        led.poll()
+        dev.in_use = 2000
+        led.poll()
+        gauges = telemetry.snapshot()["gauges"]
+        assert gauges["devmem_bytes_in_use"] == 2000
+        assert gauges["devmem_watermark_bytes"] == 5000    # high-water
+        assert gauges["devmem_bytes_limit"] == 10_000
+        det = telemetry.snapshot_detail()
+        assert det["devmem"]["bytes_in_use"] == 2000
+        assert det["devmem"]["watermark_bytes"] == 5000
+        assert "devmem_reason" not in det
+        s = led.summary()
+        assert s["polls"] == 3 and s["watermark_bytes"] == 5000
+        assert s["last"]["bytes_in_use"] == 2000
+
+    def test_no_poll_detail_says_why(self):
+        det = telemetry.snapshot_detail()
+        assert det["devmem"] is None
+        assert "no device-memory poll" in det["devmem_reason"]
+
+    def test_global_ledger_lifecycle(self):
+        led = devmem.enable(device=_FakeDevice())
+        assert devmem.get_ledger() is led
+        devmem.disable()
+        assert devmem.get_ledger() is None
+        devmem.enable(device=_FakeDevice())
+        telemetry.reset()               # reset disarms the global ledger
+        assert devmem.get_ledger() is None
+
+
+class TestPromCoverage:
+    def test_prometheus_text_covers_both_planes(self):
+        devmem.DeviceMemoryLedger(device=_FakeDevice()).poll()
+        from apex_tpu.telemetry import compiled
+
+        tr = compiled.enable()
+        try:
+            tr.record_compile("x", 0.01)
+            tr.observe("x", {"a": 1})
+            tr.observe("x", {"a": 2})
+        finally:
+            compiled.disable()
+        text = telemetry.to_prometheus_text()
+        assert "devmem_bytes_in_use 1000" in text
+        assert "devmem_watermark_bytes 1000" in text
+        assert 'compile_count{fn="x"} 1' in text
+        assert 'compile_seconds_bucket{fn="x",le="0.01"} 1' in text
+        assert 'recompile_count{fn="x"} 1' in text
+
+
+class TestDumpSections:
+    def _snap(self):
+        devmem.DeviceMemoryLedger(device=_FakeDevice()).poll()
+        from apex_tpu.telemetry import compiled
+
+        tr = compiled.enable()
+        try:
+            tr.record_compile("train_step", 0.02)
+            tr.observe("f", {"a": 1})
+            tr.observe("f", {"a": 2})
+        finally:
+            compiled.disable()
+        return telemetry.snapshot()
+
+    def test_sections_extracted(self):
+        dump = _load_dump_tool()
+        snap = self._snap()
+        comp = dump.compile_section(snap)
+        assert 'compile_count{fn="train_step"}' in comp["counters"]
+        assert 'recompile_count{fn="f"}' in comp["counters"]
+        assert 'compile_ms{fn="train_step"}' in comp["gauges"]
+        dm = dump.devmem_section(snap)
+        assert dm["gauges"]["devmem_bytes_in_use"] == 1000
+        assert "devmem_reason" not in dm
+
+    def test_devmem_section_null_reason(self):
+        dump = _load_dump_tool()
+        snap = telemetry.snapshot()         # nothing polled
+        dm = dump.devmem_section(snap)
+        assert "devmem_reason" in dm
+
+    def test_json_output_carries_sections(self, capsys, tmp_path):
+        dump = _load_dump_tool()
+        rec = {"payload": {"telemetry": {"registry": self._snap()}}}
+        path = tmp_path / "flightrec_x.json"
+        path.write_text(json.dumps(rec))
+        assert dump.main([str(path), "--format", "json"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert 'compile_count{fn="train_step"}' in out["compile"]["counters"]
+        assert out["devmem"]["gauges"]["devmem_bytes_in_use"] == 1000
+        # the registry sections themselves are still in place
+        assert "counters" in out and "gauges" in out
+
+    def test_prom_output_carries_plane_comments(self, capsys, tmp_path):
+        dump = _load_dump_tool()
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(self._snap()))
+        assert dump.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "# compile plane: 1 compiles, 1 recompiles, 0 storms" in out
+        assert "# devmem: bytes_in_use=1000" in out
+
+    def test_prom_comment_names_missing_devmem(self, capsys, tmp_path):
+        dump = _load_dump_tool()
+        path = tmp_path / "snap.json"
+        path.write_text(json.dumps(telemetry.snapshot()))
+        assert dump.main([str(path)]) == 0
+        out = capsys.readouterr().out
+        assert "# devmem: unavailable" in out

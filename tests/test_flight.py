@@ -119,6 +119,57 @@ class TestRecorder:
         assert path is not None
         assert b["trace"] is None and b["last_checkpoint"] is None
 
+    def test_bundle_carries_devmem_watermark_and_compile_plane(self):
+        from apex_tpu.telemetry import compiled, devmem
+
+        class FakeDevice:
+            device_kind = "fake"
+            in_use = 4000
+
+            def memory_stats(self):
+                return {"bytes_in_use": self.in_use,
+                        "peak_bytes_in_use": self.in_use,
+                        "bytes_limit": 8000}
+
+        dev = FakeDevice()
+        led = devmem.enable(device=dev)
+        led.poll()
+        dev.in_use = 1500
+        led.poll()                          # watermark stays at 4000
+        rec = flight.enable(keep=2)
+        tracker = compiled.enable()
+        try:
+            tracker.observe("train_step", {"opt": 1})
+            tracker.observe("train_step", {"opt": 2})   # one recompile
+            path = rec.dump("watchdog_rollback", fleet=False)
+        finally:
+            compiled.disable()
+            devmem.disable()
+        assert path is not None
+        b = latest_bundle()
+        # the devmem watermark survives into the black box
+        assert b["devmem"]["watermark_bytes"] == 4000
+        assert b["devmem"]["polls"] == 2
+        assert b["devmem"]["last"]["bytes_in_use"] == 1500
+        # ...and so do the recent recompile events + tracker totals
+        cp = b["compile_plane"]
+        assert [e["event"] for e in cp["recent_events"]] == ["recompile"]
+        assert cp["recent_events"][0]["signature_diff"]["changed"][
+            "opt"] == [1, 2]
+        assert cp["tracker"]["recompiles"] == 1
+        json.dumps(b)
+
+    def test_bundle_devmem_is_null_with_reason_on_cpu(self):
+        # nothing armed: the dump takes one direct poll and the CPU
+        # backend degrades to the explicit reason, never a missing key
+        rec = FlightRecorder()
+        rec.dump("train_step_exception", fleet=False)
+        b = latest_bundle()
+        assert b["devmem"]["watermark_bytes"] is None
+        assert b["devmem"]["last"]["bytes_in_use"] is None
+        assert "memory_stats" in b["devmem"]["last"]["devmem_reason"]
+        assert b["compile_plane"]["tracker"] is None
+
     def test_dump_names_last_checkpoint(self, tmp_path):
         step, state, g = _small_step()
         mgr = CheckpointManager(str(tmp_path / "ckpt"), keep=3)

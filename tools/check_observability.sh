@@ -1,10 +1,14 @@
 #!/usr/bin/env bash
 # Observability smoke (CI / pre-merge, next to check_telemetry.sh and
 # check_resilience.sh): the fleet-aggregation / flight-recorder /
-# bench-baseline unit tier, the disabled-telemetry structural guarantee
-# (the disabled path IS the cached raw step object), and the
-# two-process jax.distributed FLEET DRILL (tools/fleet_drill.py): a
-# one-replica bit_flip injected via APEX_TPU_FAULTS must produce a
+# compile-tracker / devmem / bench-baseline unit tier, the
+# disabled-telemetry structural guarantee (the disabled path IS the
+# cached raw step object), the COMPILE-TRACKER smoke (one forced
+# re-trace of the train step must emit exactly ONE `recompile` event
+# with a signature diff, cache hits must publish nothing, and the
+# armed tracker must hold the <1% steady-state overhead budget), and
+# the two-process jax.distributed FLEET DRILL (tools/fleet_drill.py):
+# a one-replica bit_flip injected via APEX_TPU_FAULTS must produce a
 # committed flightrec_*.json black box on every host — trigger
 # replica_divergence, fleet snapshot summing both hosts' counters,
 # straggler gauges present, perfetto slice well-formed. Extra args
@@ -17,7 +21,92 @@ rc=0
 
 python -m pytest tests/test_telemetry.py tests/test_fleet.py \
     tests/test_flight.py tests/test_bench_baseline.py \
-    tests/test_records.py "$@" -q -p no:cacheprovider || rc=1
+    tests/test_records.py tests/test_compiled.py tests/test_devmem.py \
+    "$@" -q -p no:cacheprovider || rc=1
+
+echo "== compile-tracker smoke: one forced retrace =="
+python - <<'PY' || rc=1
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import telemetry
+from apex_tpu.telemetry import compiled
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.train_step import make_train_step
+
+telemetry.reset()
+sink = telemetry.InMemorySink()
+telemetry.registry().add_sink(sink)
+compiled.enable()
+
+rng = np.random.RandomState(0)
+params = {f"p{i}": jnp.asarray(rng.randn(512).astype(np.float32) * 0.02)
+          for i in range(12)}
+opt = FusedAdam(lr=1e-3)
+state = opt.init(params)
+g = jnp.asarray(rng.randn(state.space.total).astype(np.float32) * 1e-3)
+
+step = make_train_step(opt)
+state, _ = step(state, g)                 # first trace+compile
+assert not [e for e in sink.events if e["event"] == "recompile"], \
+    "the FIRST signature is a compile, not a recompile"
+compiles = telemetry.registry().counter("compile_count").value(
+    fn="train_step")
+assert compiles >= 1, "labeled compile not recorded"
+state, _ = step(state, g)                 # layout cache hit
+assert telemetry.registry().counter("compile_count").value(
+    fn="train_step") == compiles, "a cache hit must publish no compile"
+
+# forced re-trace: ONE changed static option on the same fn
+sibling = step.with_options(with_grad_norm=True)
+state, _ = sibling(state, g)
+rec = [e for e in sink.events if e["event"] == "recompile"]
+assert len(rec) == 1, f"expected exactly one recompile event, got {rec}"
+assert rec[0]["fn"] == "train_step"
+assert "with_grad_norm" in rec[0]["signature_diff"]["changed"], rec[0]
+state, _ = sibling(state, g)              # hit on the sibling: still one
+assert len([e for e in sink.events if e["event"] == "recompile"]) == 1
+
+# re-assert the structural guarantees with the tracker ARMED: the
+# disabled-telemetry path is still the raw cached step object...
+assert make_train_step(opt, telemetry=None) is step
+assert make_train_step(
+    opt, telemetry=telemetry.StepTimeline(enabled=False)) is step
+
+# ...and the armed tracker adds <1% to the steady-state host loop
+# (layout hits never reach the tracker; this measures exactly that)
+STEPS = 20
+
+def loop(s, st):
+    for _ in range(STEPS):
+        st, _aux = s(st, g)
+    jax.block_until_ready(st.master)
+    return st
+
+state = loop(step, state)                 # warm
+t_on = t_off = float("inf")
+for _ in range(11):                       # interleaved best-of
+    compiled.enable()
+    t0 = time.perf_counter()
+    state = loop(step, state)
+    t_on = min(t_on, time.perf_counter() - t0)
+    compiled.disable()
+    t0 = time.perf_counter()
+    state = loop(step, state)
+    t_off = min(t_off, time.perf_counter() - t0)
+overhead = t_on / t_off - 1.0
+print(f"tracker-armed={t_on * 1e3:.3f}ms disarmed={t_off * 1e3:.3f}ms "
+      f"overhead={overhead * 100:+.3f}%")
+assert overhead < 0.01, (
+    f"armed compile-tracker steady-state overhead "
+    f"{overhead * 100:.3f}% >= 1%")
+compiled.disable()
+telemetry.reset()
+print("compile-tracker smoke: OK")
+PY
 
 echo "== disabled-telemetry structural guarantee =="
 python - <<'PY' || rc=1

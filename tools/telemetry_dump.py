@@ -15,6 +15,14 @@ File arguments are resolved by shape, not by name: a flight-recorder
 bundle (``payload.telemetry.registry``), a bench record
 (``payload.detail.telemetry.registry``), a raw emitted bench line
 (``detail.telemetry.registry``), or a bare registry snapshot all work.
+
+Both formats carry the COMPILE and DEVMEM planes
+(docs/observability.md "compile & memory plane"): JSON output appends
+``compile`` / ``devmem`` sections (the plane's series pulled out of
+the snapshot, with the explicit ``devmem_reason`` when the backend has
+no stats); Prometheus output renders every ``compile_*`` /
+``recompile*`` / ``devmem_*`` series through the standard exposition
+and appends one summary comment line per plane.
 """
 
 import argparse
@@ -51,6 +59,84 @@ def extract_registry_snapshot(obj):
     return None
 
 
+_COMPILE_PREFIXES = ("compile_", "compiled_signatures", "recompile")
+_DEVMEM_PREFIX = "devmem_"
+
+
+def _series_base(series: str) -> str:
+    return series.split("{", 1)[0]
+
+
+def _plane(snap, match):
+    out = {}
+    for kind in ("counters", "gauges", "histograms"):
+        sel = {k: v for k, v in (snap.get(kind) or {}).items()
+               if match(_series_base(k))}
+        if sel:
+            out[kind] = sel
+    return out
+
+
+def compile_section(snap):
+    """The compile plane of a registry snapshot: every ``compile_*`` /
+    ``compiled_signatures`` / ``recompile*`` series, by kind."""
+    return _plane(snap, lambda base: base.startswith(_COMPILE_PREFIXES))
+
+
+def devmem_section(snap):
+    """The memory plane of a registry snapshot: every ``devmem_*``
+    series — or, when no poll ever landed a gauge, the explicit
+    ``devmem_reason`` (the mfu_reason contract: null sections always
+    say why)."""
+    out = _plane(snap, lambda base: base.startswith(_DEVMEM_PREFIX))
+    if not out.get("gauges"):
+        out["devmem_reason"] = ((snap.get("info") or {}).get(
+            "devmem_reason") or "no device-memory poll in this snapshot")
+    return out
+
+
+def plane_comments(snap) -> str:
+    """One summary comment line per plane, appended to the Prometheus
+    text (comments are legal exposition; the series themselves render
+    through the standard format above them)."""
+    comp = compile_section(snap)
+    counters = comp.get("counters", {})
+
+    def _total(prefix):
+        return sum(v for k, v in counters.items()
+                   if _series_base(k) == prefix)
+
+    lines = [f"# compile plane: {int(_total('compile_count'))} "
+             f"compiles, {int(_total('recompile_count'))} recompiles, "
+             f"{int(_total('recompile_storms'))} storms"]
+    dm = devmem_section(snap)
+    gauges = dm.get("gauges", {})
+    if gauges:
+        in_use = gauges.get("devmem_bytes_in_use")
+        mark = gauges.get("devmem_watermark_bytes")
+        lines.append(f"# devmem: bytes_in_use={in_use} "
+                     f"watermark={mark}")
+    else:
+        lines.append(f"# devmem: unavailable ({dm['devmem_reason']})")
+    return "\n".join(lines) + "\n"
+
+
+def _emit(snap, fmt, help_source=None) -> None:
+    from apex_tpu.telemetry import metrics
+
+    if fmt == "json":
+        out = dict(snap)
+        out["compile"] = compile_section(snap)
+        out["devmem"] = devmem_section(snap)
+        print(json.dumps(out, indent=1, sort_keys=True))
+        return
+    if help_source is not None:
+        text = help_source.to_prometheus_text()
+    else:
+        text = metrics.prometheus_text_from_snapshot(snap)
+    sys.stdout.write(text + plane_comments(snap))
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         description="print a telemetry snapshot (live registry, "
@@ -68,12 +154,9 @@ def main(argv=None) -> int:
     from apex_tpu.telemetry import metrics
 
     if args.path is None:
-        snap = metrics.registry().snapshot()
-        if args.format == "json":
-            print(json.dumps(snap, indent=1, sort_keys=True))
-        else:
-            # live path: the registry renders with its HELP text
-            sys.stdout.write(metrics.registry().to_prometheus_text())
+        # live path: the registry renders with its HELP text
+        _emit(metrics.registry().snapshot(), args.format,
+              help_source=metrics.registry())
         return 0
 
     try:
@@ -87,10 +170,7 @@ def main(argv=None) -> int:
         print(f"error: no telemetry registry snapshot found in "
               f"{args.path}", file=sys.stderr)
         return 2
-    if args.format == "json":
-        print(json.dumps(snap, indent=1, sort_keys=True))
-    else:
-        sys.stdout.write(metrics.prometheus_text_from_snapshot(snap))
+    _emit(snap, args.format)
     return 0
 
 
