@@ -92,6 +92,30 @@ def process_collective():
     return NullCollective()
 
 
+def elastic_checkpoint_manager(directory, **kwargs):
+    """An :class:`~apex_tpu.resilience.elastic.ElasticCheckpointManager`
+    sized to THIS runtime's world — the one-liner that makes a training
+    loop's checkpoints survive a topology change (resume on any host
+    count; docs/resilience.md "Elastic resume")::
+
+        multiproc.initialize_distributed()
+        col = multiproc.process_collective()
+        mgr = multiproc.elastic_checkpoint_manager(ckpt_dir, keep=3)
+        ...
+        restored = mgr.restore(template=opt.init(params),
+                               collective=col)
+
+    kwargs pass through to ``ElasticCheckpointManager``.
+    """
+    import jax
+
+    from apex_tpu.resilience.elastic import ElasticCheckpointManager
+
+    return ElasticCheckpointManager(
+        directory, process_id=jax.process_index(),
+        n_processes=jax.process_count(), **kwargs)
+
+
 def fleet_aggregator(**kwargs):
     """A :class:`~apex_tpu.telemetry.fleet.FleetAggregator` over this
     runtime's :func:`process_collective` — the one-liner a training
@@ -129,6 +153,6 @@ def world_size() -> int:
     return jax.process_count()
 
 
-__all__ = ["fleet_aggregator", "initialize_distributed", "is_coordinator",
-           "local_rank", "process_collective", "process_index",
-           "world_size"]
+__all__ = ["elastic_checkpoint_manager", "fleet_aggregator",
+           "initialize_distributed", "is_coordinator", "local_rank",
+           "process_collective", "process_index", "world_size"]

@@ -26,6 +26,13 @@ package makes recovery a native subsystem:
   ``graceful_shutdown`` turn SIGTERM into a cross-host-agreed priority
   final checkpoint. ``checkpoint.py``'s quorum mode gives the fleet
   multi-host checkpoints a partial host-set can never corrupt.
+- :mod:`~apex_tpu.resilience.elastic` — ELASTIC resharding:
+  ``ElasticCheckpointManager`` writes quorum checkpoints as
+  logically-indexed range shards and restores them on ANY host count —
+  ``ElasticRestorePlanner`` re-partitions the committed ranges onto
+  the live world, missing ranges travel over the guard's
+  ``Collective``, and the reassembled state is verified bitwise
+  against the layout manifest's per-leaf fingerprint.
 
 See docs/resilience.md for the recovery story end to end.
 """
@@ -35,6 +42,14 @@ from apex_tpu.resilience.checkpoint import (
     CheckpointError,
     CheckpointManager,
     RestoredState,
+)
+from apex_tpu.resilience.elastic import (
+    ElasticCheckpointManager,
+    ElasticLayoutError,
+    ElasticRestoredState,
+    ElasticRestoreError,
+    ElasticRestorePlanner,
+    partition_ranges,
 )
 from apex_tpu.resilience.faults import FaultError, FaultInjector, SimulatedCrash
 from apex_tpu.resilience.guard import (
@@ -73,6 +88,11 @@ __all__ = [
     "ConsistencyGuard",
     "DivergenceError",
     "DivergenceReport",
+    "ElasticCheckpointManager",
+    "ElasticLayoutError",
+    "ElasticRestoreError",
+    "ElasticRestoredState",
+    "ElasticRestorePlanner",
     "FaultError",
     "FaultInjector",
     "KVStoreCollective",
@@ -93,6 +113,7 @@ __all__ = [
     "install_preemption_handler",
     "leaf_names",
     "localize_nonfinite",
+    "partition_ranges",
     "retry",
     "retry_call",
     "state_fingerprint",

@@ -411,9 +411,18 @@ class CheckpointManager:
             "utc": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
             "hosts": shas,
         }
+        commit.update(self._commit_extra(step, final, shas))
         retry_call(self._write_commit_once, final, commit,
                    retries=3, base_delay=0.05, max_delay=0.5, deadline=5.0,
                    retry_on=(OSError,))
+
+    def _commit_extra(self, step: int, final: str,
+                      shas: Dict[str, str]) -> Dict[str, Any]:
+        """Extra coordinator-side fields merged into ``COMMIT.json``
+        after every shard verified. The elastic manager
+        (resilience/elastic.py) overrides this to record the layout
+        manifest; the base quorum protocol adds nothing."""
+        return {}
 
     def _write_commit_once(self, final: str, commit: Dict[str, Any]) -> None:
         faults.check("quorum_commit")
@@ -559,15 +568,76 @@ class CheckpointManager:
             return False, "sha256 mismatch"
         return True, ""
 
+    def _layout_usable(self, commit: Dict[str, Any]) -> Tuple[bool, str]:
+        """Whether THIS manager's restore path can consume a validated
+        quorum checkpoint with this commit manifest. The base manager
+        restores replicated full-copy shards only; an elastic commit
+        (range-sharded payloads, resilience/elastic.py) verifies fine
+        but cannot be reassembled here."""
+        layout = commit.get("layout")
+        if layout is not None:
+            return False, (
+                f"elastic layout (saved world {layout.get('world')}, "
+                f"{len(layout.get('ranges') or {})} ranges) — "
+                "range-sharded payloads need "
+                "resilience.elastic.ElasticCheckpointManager to "
+                "reassemble")
+        return True, ""
+
+    def _report_elastic_candidate(self, path: str, step: int,
+                                  commit: Dict[str, Any],
+                                  reason: str) -> None:
+        """A checkpoint that VERIFIES but this manager cannot restore
+        (an elastic layout under a legacy manager) is resumable, not
+        corrupt — name it, so the operator sees a
+        resumable-but-mismatched candidate instead of "no checkpoint
+        found"."""
+        if path in self._reported_corrupt:
+            return
+        self._reported_corrupt.add(path)
+        layout = commit.get("layout") or {}
+        from apex_tpu import records
+        from apex_tpu.telemetry import metrics as _metrics
+
+        records.write_record("resilience", {
+            "event": "elastic_candidate",
+            "path": path,
+            "step": step,
+            "reason": reason,
+            "layout": {"world": layout.get("world"),
+                       "total": layout.get("total"),
+                       "ranges": layout.get("ranges")},
+        })
+        reg = _metrics.registry()
+        reg.counter("checkpoint_elastic_candidates",
+                    "valid-but-unrestorable elastic checkpoints seen by "
+                    "a legacy latest_valid scan").inc()
+        reg.event("elastic_candidate", path=path, step=step,
+                  world=layout.get("world"))
+
     def latest_valid(self, *, record_events: bool = True) -> Optional[str]:
-        """Newest checkpoint that passes :meth:`validate`, scanning
-        newest -> oldest. Each corrupt checkpoint found on the way is
-        reported once per process as a structured ``resilience`` record
-        (event ``corrupt_checkpoint``) and skipped."""
+        """Newest checkpoint that passes :meth:`validate` AND this
+        manager can restore, scanning newest -> oldest. Each corrupt
+        checkpoint found on the way is reported once per process as a
+        structured ``resilience`` record (event ``corrupt_checkpoint``)
+        and skipped; a checkpoint that verifies but needs the elastic
+        restore path (and this manager lacks it) is reported as an
+        ``elastic_candidate`` — resumable elsewhere, skipped here."""
         for step in reversed(self.all_steps()):
             path = self.path_for(step)
             ok, reason = self.validate(path)
             if ok:
+                if self._is_multihost_layout(path):
+                    try:
+                        commit = self.read_commit(path)
+                    except (OSError, ValueError):
+                        commit = {}
+                    usable, why = self._layout_usable(commit)
+                    if not usable:
+                        if record_events:
+                            self._report_elastic_candidate(
+                                path, step, commit, why)
+                        continue
                 return path
             if record_events and path not in self._reported_corrupt:
                 self._reported_corrupt.add(path)
@@ -620,6 +690,9 @@ class CheckpointManager:
             raise CheckpointError(f"{path}: {reason}")
         if self._is_multihost_layout(path):
             commit = self.read_commit(path)
+            usable, why = self._layout_usable(commit)
+            if not usable:
+                raise CheckpointError(f"{path}: {why}")
             named = sorted(commit.get("hosts") or {})
             if host is not None:
                 order = [host_dirname(host)]

@@ -58,6 +58,23 @@ Distributed sites (the guard/quorum tier, docs/resilience.md):
 - ``sigterm=<steps>``            deliver a REAL ``SIGTERM`` to this
                                  process at these steps (exercises the
                                  async-signal preemption path)
+
+Elastic-resharding sites (resilience/elastic.py, docs/resilience.md
+"Elastic resume"):
+
+- ``shard_truncate=<steps>``     truncate one host's ELASTIC shard
+                                 payload AFTER the coordinator's
+                                 commit lands — a committed-but-rotten
+                                 range the restore path must refuse
+- ``shard_truncate_host=<h>``    which host's shard the coordinator
+                                 truncates (default: host 0)
+- ``world_mismatch=<steps>``     the coordinator records an
+                                 inconsistent layout manifest (claimed
+                                 world != the committed ranges) — the
+                                 restore planner must detect it
+- ``range_fetch_timeout=<idx>``  the elastic restore's peer fetch at
+                                 these 0-based fetch indices times out;
+                                 the planner must fall back to disk
 """
 
 from __future__ import annotations
@@ -106,6 +123,11 @@ class FaultInjector:
     bit_flip_leaf: Optional[int] = None      # None -> buffer element 0
     crash_before_commit_steps: FrozenSet[int] = frozenset()
     sigterm_steps: FrozenSet[int] = frozenset()
+    # elastic-resharding sites (resilience/elastic.py)
+    shard_truncate_steps: FrozenSet[int] = frozenset()
+    shard_truncate_host: int = 0
+    world_mismatch_steps: FrozenSet[int] = frozenset()
+    range_fetch_timeout: FrozenSet[int] = frozenset()
 
     def __post_init__(self):
         self._counts: Dict[str, int] = {}
@@ -191,6 +213,24 @@ class FaultInjector:
                 f"injected host crash before quorum commit at step "
                 f"{int(step)}")
 
+    # -- elastic-resharding sites ------------------------------------------
+
+    def shard_truncate_target(self, step: int) -> Optional[int]:
+        """Host whose committed elastic shard the coordinator truncates
+        at this step, or None — the deterministic committed-but-rotten
+        range the elastic restore path must refuse."""
+        if int(step) in self.shard_truncate_steps:
+            return int(self.shard_truncate_host)
+        return None
+
+    def should_world_mismatch(self, step: int) -> bool:
+        return int(step) in self.world_mismatch_steps
+
+    def should_range_timeout(self, index: int) -> bool:
+        """True when the elastic restore's peer fetch number ``index``
+        (0-based, per restore) is planned to time out."""
+        return int(index) in self.range_fetch_timeout
+
     def maybe_sigterm(self, step: int) -> None:
         """Deliver a REAL SIGTERM to this process at planned steps —
         the deterministic stand-in for the scheduler's preemption
@@ -232,6 +272,14 @@ class FaultInjector:
                 kw["crash_before_commit_steps"] = _int_set(val)
             elif key == "sigterm":
                 kw["sigterm_steps"] = _int_set(val)
+            elif key == "shard_truncate":
+                kw["shard_truncate_steps"] = _int_set(val)
+            elif key == "shard_truncate_host":
+                kw["shard_truncate_host"] = int(val)
+            elif key == "world_mismatch":
+                kw["world_mismatch_steps"] = _int_set(val)
+            elif key == "range_fetch_timeout":
+                kw["range_fetch_timeout"] = _int_set(val)
             elif key.startswith("io:"):
                 kw["io_errors"][key[len("io:"):]] = _int_set(val)
             elif key.startswith("io_permanent:"):
@@ -324,9 +372,25 @@ def maybe_sigterm(step: int) -> None:
         inj.maybe_sigterm(step)
 
 
+def shard_truncate_target(step: int) -> Optional[int]:
+    inj = active()
+    return None if inj is None else inj.shard_truncate_target(step)
+
+
+def should_world_mismatch(step: int) -> bool:
+    inj = active()
+    return inj is not None and inj.should_world_mismatch(step)
+
+
+def should_range_timeout(index: int) -> bool:
+    inj = active()
+    return inj is not None and inj.should_range_timeout(index)
+
+
 __all__ = [
     "ENV_KNOB", "FaultError", "FaultInjector", "SimulatedCrash",
     "active", "check", "flip_bits", "inject", "install", "maybe_crash",
     "maybe_crash_before_commit", "maybe_sigterm", "poison_grads",
-    "should_truncate",
+    "shard_truncate_target", "should_range_timeout", "should_truncate",
+    "should_world_mismatch",
 ]

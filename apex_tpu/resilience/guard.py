@@ -467,6 +467,77 @@ class ConsistencyGuard:
             return new_state, new_sstate, aux
         return new_state, aux
 
+    # -- post-restore baseline ---------------------------------------------
+
+    def verify_restore(self, state, baseline=None) -> np.ndarray:
+        """Accept a restored state into the guarded run.
+
+        Recomputes the bitwise fingerprint of ``state``, checks it
+        against ``baseline`` (the fingerprint an elastic restore
+        verified on reassembly — ``ElasticRestoredState.fingerprint``
+        — or any saved layout manifest's), and, on a multi-replica
+        collective, all-gathers the fingerprints so the WHOLE world
+        proves it restored identical bits before any training step
+        runs. A collective call: every replica must reach it.
+
+        Returns the verified sums (seeded into the flight recorder's
+        digest ring, and the boundary at this count is marked checked).
+        Raises :class:`DivergenceError` on any mismatch — a bad
+        restore must be rebuilt, never trained on — after dumping a
+        flight bundle (trigger ``elastic_restore_error``).
+        """
+        from apex_tpu import records
+        from apex_tpu.telemetry import flight as _flight
+        from apex_tpu.telemetry import metrics as _metrics
+
+        col = self.collective
+        sums = np.asarray(state_fingerprint(state).sums, np.uint32)
+        count = int(state.count)
+
+        def _fail(msg: str, extra: Dict[str, Any]):
+            event = {"event": "restore_baseline_mismatch",
+                     "count": count, "replica_id": col.replica_id,
+                     "n_replicas": col.n_replicas, **extra}
+            records.write_record(self.record_kind, event)
+            reg = _metrics.registry()
+            reg.counter("resilience_restore_baseline_mismatches",
+                        "post-restore fingerprint baseline "
+                        "failures").inc()
+            reg.event("restore_baseline_mismatch", **extra)
+            err = DivergenceError(msg)
+            _flight.notify("elastic_restore_error",
+                           recorder=self.flight_recorder, error=err,
+                           fleet=False, extra=event)
+            raise err
+
+        if baseline is not None:
+            want = np.asarray(baseline, np.uint32)
+            if sums.shape != want.shape or not np.array_equal(sums, want):
+                _fail(
+                    f"restored state's fingerprint does not match the "
+                    f"checkpoint baseline on replica {col.replica_id} "
+                    "— the restore produced different bits than were "
+                    "saved", {"reason": "baseline"})
+        if col.n_replicas > 1:
+            payload = np.concatenate(
+                [np.asarray([count], np.uint32), sums.reshape(-1)])
+            gathered = col.all_gather(payload)
+            counts = gathered[:, 0].astype(np.int64).tolist()
+            report = compare_fingerprints(
+                gathered[:, 1:].reshape((col.n_replicas,) + sums.shape))
+            if len(set(counts)) != 1 or report.divergent:
+                _fail(
+                    f"replicas restored different state (counts "
+                    f"{counts}, minority {list(report.minority_replicas)})"
+                    " — the world must re-run the restore, not train",
+                    {"reason": "cross_replica", "counts": counts,
+                     "minority": list(report.minority_replicas)})
+        _flight.record_digest(count, sums, recorder=self.flight_recorder)
+        self._last_checked_count = count
+        _metrics.registry().event("restore_baseline_verified",
+                                  count=count, n_replicas=col.n_replicas)
+        return sums
+
     # -- boundary ----------------------------------------------------------
 
     def _local_sums(self, state, aux) -> np.ndarray:

@@ -24,6 +24,12 @@ trigger                fired by
                        drain, final checkpoint written)
 ``train_step_exception`` unhandled exception escaping the fused-step
                        dispatch (``optimizers.train_step``)
+``elastic_restore_error`` any failed elastic restore
+                       (``resilience.elastic`` — plan/fetch/verify
+                       failures, and the guard's post-restore baseline
+                       mismatch); the bundle's ``extra`` carries the
+                       layout manifest, the computed restore plan, and
+                       per-range fetch/verify status
 ====================== ====================================================
 
 Fleet-level triggers (the guard's, the shutdown's) fire on EVERY

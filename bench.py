@@ -1018,6 +1018,35 @@ def bench_resilience():
             fp = state_fingerprint(state2)
         fingerprint_s = (time.perf_counter() - t0) / fp_reps
         fp_state_mb = state2.space.total * 4 * (1 + len(state2.slots)) / 1e6
+
+        # elastic resharding (resilience/elastic.py): a 2-host
+        # range-sharded save (each "host" writes 1/2 the bytes), then a
+        # 1-host restore re-partitions the committed ranges and
+        # verifies the reassembly bitwise — the remap bandwidth of
+        # "resume on whatever quota gives you"
+        import threading as _threading
+
+        from apex_tpu.resilience import ElasticCheckpointManager
+
+        el_dir = os.path.join(workdir, "elastic")
+        emgrs = [ElasticCheckpointManager(el_dir, process_id=h,
+                                          n_processes=2,
+                                          quorum_timeout=60.0)
+                 for h in range(2)]
+        t0 = time.perf_counter()
+        ets = [_threading.Thread(target=emgrs[h].save, args=(1, state2))
+               for h in range(2)]
+        for t in ets:
+            t.start()
+        for t in ets:
+            t.join()
+        elastic_save_s = time.perf_counter() - t0
+        solo = ElasticCheckpointManager(el_dir)
+        t0 = time.perf_counter()
+        er = solo.restore(solo.path_for(1), template=state2)
+        jax.block_until_ready(er.opt_state.master)
+        elastic_restore_s = time.perf_counter() - t0
+        elastic_saved_world = er.plan["saved_world"]
     finally:
         _records.RECORDS_DIR = records_dir_save
         shutil.rmtree(workdir, ignore_errors=True)
@@ -1043,6 +1072,11 @@ def bench_resilience():
             "fingerprint_gb_per_sec": round(
                 fp_state_mb / 1e3 / fingerprint_s, 1),
             "fingerprint_leaves": int(fp.sums.shape[1]),
+            "elastic_save_ms": round(elastic_save_s * 1e3, 1),
+            "elastic_restore_ms": round(elastic_restore_s * 1e3, 1),
+            "elastic_remap_mb_per_sec": round(
+                payload_mb / elastic_restore_s, 1),
+            "elastic_saved_world": elastic_saved_world,
             **backend_detail(),
         },
     }, "resilience")

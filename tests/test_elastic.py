@@ -1,0 +1,519 @@
+"""Elastic resharding (apex_tpu/resilience/elastic.py): quorum
+checkpoints written as logically-indexed range shards, restored on a
+DIFFERENT host count — the planner re-partitions the committed ranges
+onto the live world, missing ranges travel over the Collective, and
+the reassembled state is verified bitwise against the layout
+manifest's per-leaf fingerprint.
+
+Acceptance bar (ISSUE 7): kill an N-process run and resume on N−1 and
+N+1 processes with the restored state bitwise-identical to an
+uninterrupted run — the single-process ``LocalCollective`` simulation
+of the two-process ``tools/elastic_drill.py``.
+"""
+
+import os
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import records
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.optimizers.train_step import make_train_step
+from apex_tpu.resilience import (
+    CheckpointError,
+    CheckpointManager,
+    ConsistencyGuard,
+    DivergenceError,
+    ElasticCheckpointManager,
+    ElasticLayoutError,
+    ElasticRestoreError,
+    ElasticRestorePlanner,
+    LocalCollective,
+    NullCollective,
+    faults,
+    graceful_shutdown,
+    partition_ranges,
+)
+from apex_tpu.resilience.elastic import space_signature
+from apex_tpu.telemetry import flight
+from apex_tpu.telemetry import metrics as telemetry_metrics
+
+
+def _params(seed=0, n=48, d=6):
+    r = np.random.RandomState(seed)
+    return {"w": jnp.asarray(r.randn(n, d), jnp.float32),
+            "b": jnp.zeros((d,), jnp.float32)}
+
+
+def _state(seed=0):
+    opt = FusedAdam(lr=1e-2, impl="xla")
+    return opt, opt.init(_params(seed))
+
+
+def _grad(space, i):
+    r = np.random.RandomState(1000 + i)
+    return jnp.asarray(r.randn(space.total).astype(np.float32) * 0.01)
+
+
+@pytest.fixture
+def records_dir(tmp_path, monkeypatch):
+    path = tmp_path / "records"
+    monkeypatch.setattr(records, "RECORDS_DIR", str(path))
+    return path
+
+
+def _managers(directory, n_hosts, cls=ElasticCheckpointManager, **kw):
+    kw.setdefault("quorum_timeout", 20.0)
+    return [cls(directory, process_id=h, n_processes=n_hosts, **kw)
+            for h in range(n_hosts)]
+
+
+def _save_all(mgrs, step, state, plans=None):
+    """Every host saves concurrently (the real fleet shape)."""
+    errors = {}
+
+    def save(h):
+        try:
+            if plans and h in plans:
+                with faults.inject(**plans[h]):
+                    mgrs[h].save(step, state)
+            else:
+                mgrs[h].save(step, state)
+        except BaseException as e:  # noqa: BLE001 — asserted by callers
+            errors[h] = e
+
+    ts = [threading.Thread(target=save, args=(h,), daemon=True)
+          for h in range(len(mgrs))]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    return errors
+
+
+def _restore_world(directory, n_new, template_fn, **kw):
+    """Every host of a NEW world restores concurrently over a
+    LocalCollective; returns {host: ElasticRestoredState}."""
+    group = LocalCollective(n_new)
+    handles = group.handles()
+    outs, errors = {}, {}
+
+    def restore(h):
+        try:
+            mgr = ElasticCheckpointManager(directory, process_id=h,
+                                           n_processes=n_new)
+            outs[h] = mgr.restore(template=template_fn(),
+                                  collective=handles[h], **kw)
+        except BaseException as e:  # noqa: BLE001
+            errors[h] = e
+
+    ts = [threading.Thread(target=restore, args=(h,), daemon=True)
+          for h in range(n_new)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    assert errors == {}, errors
+    return outs
+
+
+def _assert_bitwise(restored, state):
+    np.testing.assert_array_equal(np.asarray(restored.opt_state.master),
+                                  np.asarray(state.master))
+    for k in state.slots:
+        np.testing.assert_array_equal(
+            np.asarray(restored.opt_state.slots[k]),
+            np.asarray(state.slots[k]))
+    assert int(restored.opt_state.count) == int(state.count)
+
+
+class TestPartitionRanges:
+    def test_tiles_exactly_and_aligned(self):
+        for total, n, align in [(8192, 2, 2048), (10240, 3, 2048),
+                                (4096, 5, 2048), (2048, 1, 2048)]:
+            ranges = partition_ranges(total, n, align)
+            assert len(ranges) == n
+            cur = 0
+            for lo, hi in ranges:
+                assert lo == cur and hi >= lo
+                assert lo % align == 0 and hi % align == 0
+                cur = hi
+            assert cur == total
+
+    def test_more_hosts_than_units_yields_empty_tails(self):
+        ranges = partition_ranges(4096, 5, 2048)
+        assert ranges[0] == (0, 2048) and ranges[1] == (2048, 4096)
+        assert all(lo == hi for lo, hi in ranges[2:])
+
+    def test_unaligned_total_rejected(self):
+        with pytest.raises(ValueError, match="multiple"):
+            partition_ranges(100, 2, 2048)
+
+
+class TestElasticSave:
+    def test_commit_carries_layout_manifest(self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 4, st) == {}
+        commit = mgrs[0].read_commit(mgrs[0].path_for(4))
+        lay = commit["layout"]
+        assert lay["world"] == 2
+        assert lay["total"] == st.space.total
+        assert lay["tree_sig"] == space_signature(st.space)
+        ranges = sorted(lay["ranges"].values())
+        assert ranges[0][0] == 0 and ranges[-1][1] == st.space.total
+        assert [b["name"] for b in lay["buffers"]] == \
+            ["master"] + [f"slot:{k}" for k in sorted(st.slots)]
+        fp = np.asarray(lay["fingerprint"], np.uint32)
+        assert fp.shape == (1 + len(st.slots), st.space.num_leaves)
+
+    def test_shards_hold_ranges_not_copies(self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 2, st) == {}
+        path = mgrs[0].path_for(2)
+        full = CheckpointManager(str(tmp_path / "full"))
+        full.save(2, st)
+        full_bytes = os.path.getsize(
+            os.path.join(full.path_for(2), "payload.bin"))
+        for h in range(2):
+            shard = os.path.getsize(
+                os.path.join(path, f"host_{h:04d}", "payload.bin"))
+            assert shard < full_bytes  # each host writes ~1/N, not 1/1
+
+    def test_compress_master_rejected(self, tmp_path):
+        with pytest.raises(ValueError, match="compress_master"):
+            ElasticCheckpointManager(tmp_path, compress_master=True)
+
+    def test_diverged_replicas_refuse_commit(self, tmp_path, records_dir):
+        # host 1 saves DIFFERENT bits: its save-time fingerprint
+        # disagrees, and the coordinator must abort — diverged replicas
+        # must never become a checkpoint
+        opt, st = _state()
+        _, st_other = _state(seed=9)
+        mgrs = _managers(tmp_path / "ckpt", 2, quorum_timeout=5.0)
+        errors = {}
+
+        def save(h, s):
+            try:
+                mgrs[h].save(2, s)
+            except BaseException as e:  # noqa: BLE001
+                errors[h] = e
+
+        ts = [threading.Thread(target=save, args=(0, st), daemon=True),
+              threading.Thread(target=save, args=(1, st_other),
+                               daemon=True)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert isinstance(errors.get(0), CheckpointError)
+        assert "fingerprint disagrees" in str(errors[0])
+        assert mgrs[0].latest_valid(record_events=False) is None
+
+
+class TestElasticRestore:
+    def test_same_world_roundtrip_bitwise(self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 4, st) == {}
+        outs = _restore_world(tmp_path / "ckpt", 2,
+                              lambda: _state(seed=1)[1])
+        for h in range(2):
+            assert outs[h].step == 4
+            _assert_bitwise(outs[h], st)
+
+    def test_shrink_to_one_reads_all_from_disk(self, tmp_path,
+                                               records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 3)
+        assert _save_all(mgrs, 6, st) == {}
+        solo = ElasticCheckpointManager(tmp_path / "ckpt")
+        r = solo.restore(template=_state(seed=1)[1])
+        _assert_bitwise(r, st)
+        assert r.plan["saved_world"] == 3 and r.plan["new_world"] == 1
+        # nothing to fetch: every range came straight off the platter
+        assert all(s["source"] == "disk" for s in r.plan["ranges"]
+                   if "source" in s)
+
+    def test_grow_fetches_ranges_over_collective(self, tmp_path,
+                                                 records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 4, st) == {}
+        reg = telemetry_metrics.registry()
+        fetched0 = reg.counter("elastic_ranges_fetched").value()
+        outs = _restore_world(tmp_path / "ckpt", 3,
+                              lambda: _state(seed=1)[1])
+        for h in range(3):
+            _assert_bitwise(outs[h], st)
+            np.testing.assert_array_equal(outs[h].fingerprint,
+                                          outs[0].fingerprint)
+        fetched = sum(1 for h in range(3)
+                      for s in outs[h].plan["ranges"]
+                      if str(s.get("source", "")).startswith("peer_"))
+        assert fetched > 0
+        assert reg.counter("elastic_ranges_fetched").value() \
+            == fetched0 + fetched
+        assert reg.counter("elastic_bytes_remapped").value() > 0
+
+    def test_kill_and_resume_on_new_world_matches_golden(
+            self, tmp_path, records_dir):
+        # THE acceptance sim: train on 2, "die" at step 4, resume on 3
+        # (and on 1) — the replayed trajectory is bitwise identical to
+        # an uninterrupted run
+        opt, st0 = _state()
+        step = make_train_step(opt)
+        mgrs = _managers(tmp_path / "ckpt", 2)
+
+        state = st0
+        for i in range(4):
+            state, _ = step(state, _grad(state.space, i))
+        assert _save_all(mgrs, 4, state) == {}
+        golden = state
+        for i in range(4, 8):
+            golden, _ = step(golden, _grad(golden.space, i))
+
+        for n_new in (1, 3):
+            outs = _restore_world(tmp_path / "ckpt", n_new,
+                                  lambda: _state(seed=1)[1])
+            resumed = outs[0].opt_state
+            assert outs[0].step == 4
+            for i in range(4, 8):
+                resumed, _ = step(resumed, _grad(resumed.space, i))
+            np.testing.assert_array_equal(np.asarray(resumed.master),
+                                          np.asarray(golden.master))
+
+    def test_wrong_template_tree_rejected(self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 2, st) == {}
+        other_opt = FusedAdam(lr=1e-2, impl="xla")
+        other = other_opt.init({"w": jnp.zeros((8, 4), jnp.float32)})
+        solo = ElasticCheckpointManager(tmp_path / "ckpt")
+        with pytest.raises(CheckpointError, match="different parameter"):
+            solo.restore(template=other)
+
+
+class TestElasticFaults:
+    def test_world_mismatch_detected_with_flight_bundle(
+            self, tmp_path, records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        errors = _save_all(
+            mgrs, 4, st,
+            plans={0: dict(world_mismatch_steps=frozenset({4}))})
+        assert errors == {}
+        solo = ElasticCheckpointManager(tmp_path / "ckpt")
+        flight.enable()
+        try:
+            with pytest.raises(ElasticLayoutError,
+                               match="world 3 but commits 2"):
+                solo.restore(solo.path_for(4),
+                             template=_state(seed=1)[1])
+            rec = flight.get_recorder()
+            assert rec.dumps == 1
+            assert rec.last_trigger == "elastic_restore_error"
+        finally:
+            flight.disable()
+        bundle = records.latest_record("flightrec", require_backend=None)
+        assert bundle["payload"]["trigger"] == "elastic_restore_error"
+        extra = bundle["payload"]["extra"]
+        assert extra["layout"]["world"] == 3        # the manifest as found
+        assert "ranges" in extra                    # per-range status
+
+    def test_shard_truncate_refused_and_skipped(self, tmp_path,
+                                                records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 2, st) == {}
+        errors = _save_all(
+            mgrs, 4, st,
+            plans={0: dict(shard_truncate_steps=frozenset({4}),
+                           shard_truncate_host=1)})
+        assert errors == {}                 # commit landed, THEN the rot
+        solo = ElasticCheckpointManager(tmp_path / "ckpt")
+        ok, reason = solo.validate(solo.path_for(4))
+        assert not ok and "host_0001" in reason
+        # latest_valid falls back to the previous elastic quorum step
+        assert solo.latest_valid() == solo.path_for(2)
+        with pytest.raises(ElasticRestoreError):
+            solo.restore(solo.path_for(4), template=_state(seed=1)[1])
+
+    def test_range_fetch_timeout_falls_back_to_disk(self, tmp_path,
+                                                    records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 4, st) == {}
+        with faults.inject(range_fetch_timeout=frozenset({0})):
+            outs = _restore_world(tmp_path / "ckpt", 2,
+                                  lambda: _state(seed=1)[1])
+        for h in range(2):
+            _assert_bitwise(outs[h], st)
+            fallbacks = [s for s in outs[h].plan["ranges"]
+                         if s.get("source") == "disk_fallback"]
+            assert len(fallbacks) == 1
+            assert fallbacks[0]["status"] == "range_fetch_timeout"
+
+
+class TestLegacyInterop:
+    def test_legacy_manager_reports_elastic_candidate(self, tmp_path,
+                                                      records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 4, st) == {}
+        legacy = CheckpointManager(tmp_path / "ckpt")
+        # resumable-but-mismatched: named, not silently "not found"
+        assert legacy.latest_valid() is None
+        rec = records.latest_record("resilience", require_backend=None)
+        assert rec["payload"]["event"] == "elastic_candidate"
+        assert rec["payload"]["step"] == 4
+        assert rec["payload"]["layout"]["world"] == 2
+        with pytest.raises(CheckpointError, match="[Ee]lastic"):
+            legacy.restore(legacy.path_for(4),
+                           template=_state(seed=1)[1])
+
+    def test_legacy_scan_still_finds_older_legacy_step(self, tmp_path,
+                                                       records_dir):
+        opt, st = _state()
+        legacy_mgrs = _managers(tmp_path / "ckpt", 2,
+                                cls=CheckpointManager)
+        assert _save_all(legacy_mgrs, 2, st) == {}
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 4, st) == {}
+        legacy = CheckpointManager(tmp_path / "ckpt")
+        assert legacy.latest_valid() == legacy.path_for(2)
+        # the elastic manager prefers the newer elastic bundle
+        elastic = ElasticCheckpointManager(tmp_path / "ckpt")
+        assert elastic.latest_valid() == elastic.path_for(4)
+
+
+class TestGuardBaseline:
+    def test_verify_restore_accepts_matching_baseline(self, tmp_path,
+                                                      records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 4, st) == {}
+        solo = ElasticCheckpointManager(tmp_path / "ckpt")
+        r = solo.restore(template=_state(seed=1)[1])
+        step = make_train_step(opt)
+        guard = ConsistencyGuard(step, collective=NullCollective(),
+                                 fingerprint_every=2)
+        sums = guard.verify_restore(r.opt_state, baseline=r.fingerprint)
+        np.testing.assert_array_equal(sums, np.asarray(r.fingerprint))
+
+    def test_verify_restore_rejects_wrong_baseline(self, tmp_path,
+                                                   records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 4, st) == {}
+        solo = ElasticCheckpointManager(tmp_path / "ckpt")
+        r = solo.restore(template=_state(seed=1)[1])
+        step = make_train_step(opt)
+        guard = ConsistencyGuard(step, collective=NullCollective(),
+                                 fingerprint_every=2)
+        bad = np.array(r.fingerprint, np.uint32)
+        bad[0, 0] ^= 1
+        with pytest.raises(DivergenceError, match="baseline"):
+            guard.verify_restore(r.opt_state, baseline=bad)
+        rec = records.latest_record("resilience", require_backend=None)
+        assert rec["payload"]["event"] == "restore_baseline_mismatch"
+
+    def test_verify_restore_crossreplica_divergence(self, tmp_path,
+                                                    records_dir):
+        # replica 1 restored DIFFERENT bits: the gather must refuse
+        opt, st = _state()
+        _, st_other = _state(seed=9)
+        step = make_train_step(opt)
+        group = LocalCollective(2)
+        handles = group.handles()
+        errors = {}
+
+        def verify(h, s):
+            guard = ConsistencyGuard(step, collective=handles[h],
+                                     fingerprint_every=2)
+            try:
+                guard.verify_restore(s)
+            except BaseException as e:  # noqa: BLE001
+                errors[h] = e
+
+        ts = [threading.Thread(target=verify, args=(0, st), daemon=True),
+              threading.Thread(target=verify, args=(1, st_other),
+                               daemon=True)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert len(errors) == 2
+        assert all(isinstance(e, DivergenceError)
+                   for e in errors.values())
+
+
+class TestGracefulShutdownElastic:
+    def test_graceful_shutdown_commits_elastic_bundle(self, tmp_path,
+                                                      records_dir):
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        group = LocalCollective(2)
+        handles = group.handles()
+        errors = {}
+
+        def drain(h):
+            try:
+                graceful_shutdown(mgrs[h], 7, st,
+                                  collective=handles[h])
+            except BaseException as e:  # noqa: BLE001
+                errors[h] = e
+
+        ts = [threading.Thread(target=drain, args=(h,), daemon=True)
+              for h in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert errors == {}
+        commit = mgrs[0].read_commit(mgrs[0].path_for(7))
+        assert commit["layout"]["world"] == 2
+        # a preemption bundle resumes on a different world
+        outs = _restore_world(tmp_path / "ckpt", 3,
+                              lambda: _state(seed=1)[1])
+        for h in range(3):
+            _assert_bitwise(outs[h], st)
+
+
+class TestPlanner:
+    def test_reads_cover_assignments_minimally(self):
+        layout = {"format": 1, "world": 2, "total": 8192, "align": 2048,
+                  "ranges": {"host_0000": [0, 4096],
+                             "host_0001": [4096, 8192]}}
+        p = ElasticRestorePlanner(layout, 3)
+        seen = []
+        for h in range(3):
+            lo, hi = p.assignments[h]
+            reads = p.reads_for(h)
+            assert sum(b - a for _, _, a, b in reads) == hi - lo
+            seen.extend((a, b) for _, _, a, b in reads)
+        # the union of all hosts' reads is the whole space, no overlap
+        seen.sort()
+        cur = 0
+        for a, b in seen:
+            assert a == cur
+            cur = b
+        assert cur == 8192
+
+    def test_gap_in_ranges_rejected(self):
+        layout = {"format": 1, "world": 2, "total": 8192, "align": 2048,
+                  "ranges": {"host_0000": [0, 2048],
+                             "host_0001": [4096, 8192]}}
+        with pytest.raises(ElasticLayoutError, match="tile"):
+            ElasticRestorePlanner(layout, 2)
+
+    def test_describe_is_json_ready(self, tmp_path):
+        import json
+
+        layout = {"format": 1, "world": 1, "total": 2048, "align": 2048,
+                  "ranges": {"host_0000": [0, 2048]}}
+        p = ElasticRestorePlanner(layout, 2)
+        json.dumps(p.describe(1))

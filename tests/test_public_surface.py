@@ -20,6 +20,9 @@ SURFACE = {
         "NonfiniteWatchdog", "RollbackLimitExceeded", "FaultInjector",
         "SimulatedCrash", "retry", "retry_call", "faults",
         "localize_nonfinite", "leaf_names",
+        "ElasticCheckpointManager", "ElasticRestorePlanner",
+        "ElasticRestoredState", "ElasticRestoreError",
+        "ElasticLayoutError", "partition_ranges",
     ],
     "apex_tpu.amp": [
         "initialize", "state_dict", "load_state_dict", "make_scaler",

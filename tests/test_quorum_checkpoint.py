@@ -226,6 +226,31 @@ class TestPartialHostSet:
         assert not ok and "sha256 differs" in reason
 
 
+class TestPreElasticCompat:
+    def test_pre_elastic_commit_restores_via_legacy_path(
+            self, tmp_path, records_dir):
+        # backward compat (ISSUE 7): a quorum bundle written BEFORE the
+        # elastic layer — COMMIT.json with no layout manifest — still
+        # restores on the same topology through the legacy full-copy
+        # path, under both manager classes
+        from apex_tpu.resilience import ElasticCheckpointManager
+
+        opt, st = _state()
+        mgrs = _managers(tmp_path / "ckpt", 2)
+        assert _save_all(mgrs, 2, st) == {}
+        commit = mgrs[0].read_commit(mgrs[0].path_for(2))
+        assert "layout" not in commit          # the pre-elastic format
+        for h in range(2):
+            el = ElasticCheckpointManager(tmp_path / "ckpt",
+                                          process_id=h, n_processes=2)
+            assert el.latest_valid() == el.path_for(2)
+            r = el.restore(template=_state(seed=1)[1])
+            assert r.step == 2
+            np.testing.assert_array_equal(np.asarray(r.opt_state.master),
+                                          np.asarray(st.master))
+            assert not hasattr(r, "fingerprint")   # legacy RestoredState
+
+
 class TestCommitFaults:
     def test_transient_commit_write_fault_absorbed(self, tmp_path,
                                                    records_dir):

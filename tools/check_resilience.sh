@@ -86,7 +86,7 @@ PY
 # Distributed-site env-knob matrix: the guard/quorum clauses must parse
 # and fire from the environment exactly like the classic ones.
 echo "== distributed env-knob matrix =="
-APEX_TPU_FAULTS="bit_flip=3;bit_flip_replica=1;bit_flip_leaf=0;crash_before_commit=6;sigterm=9" \
+APEX_TPU_FAULTS="bit_flip=3;bit_flip_replica=1;bit_flip_leaf=0;crash_before_commit=6;sigterm=9;shard_truncate=4;shard_truncate_host=1;world_mismatch=8;range_fetch_timeout=0,2" \
 python - <<'PY'
 import signal
 
@@ -112,6 +112,14 @@ try:
     raise SystemExit("crash_before_commit did not fire")
 except faults.SimulatedCrash:
     pass
+
+# elastic clauses: all three parse and fire from the env
+assert faults.shard_truncate_target(4) == 1      # the configured host
+assert faults.shard_truncate_target(3) is None
+assert faults.should_world_mismatch(8)
+assert not faults.should_world_mismatch(7)
+assert faults.should_range_timeout(0) and faults.should_range_timeout(2)
+assert not faults.should_range_timeout(1)
 
 with PreemptionHandler(signals=(signal.SIGTERM,)) as h:
     faults.maybe_sigterm(8)
@@ -158,6 +166,53 @@ else
     fi
 fi
 rm -rf "$drill_dir"
+
+# Elastic resharding drill: save on 2 jax.distributed processes,
+# SIGTERM host 0 (graceful elastic commit), then resume once on 1
+# process and once on 3 — both must reassemble the exact bits
+# (tools/elastic_drill.py; the in-process analog is
+# tests/test_elastic.py).
+echo "== elastic resharding drill =="
+el_dir="$(mktemp -d)"
+el_port=$(( 20000 + RANDOM % 20000 ))
+el_env=(MASTER_ADDR=127.0.0.1 "MASTER_PORT=$el_port" WORLD_SIZE=2)
+env "${el_env[@]}" RANK=0 APEX_TPU_FAULTS="sigterm=5" \
+    python tools/elastic_drill.py train "$el_dir" &
+h0=$!
+env "${el_env[@]}" RANK=1 python tools/elastic_drill.py train "$el_dir" &
+h1=$!
+wait $h0; rc0=$?
+wait $h1; rc1=$?
+if [ "$rc0" -ne 0 ] || [ "$rc1" -ne 0 ]; then
+    echo "elastic drill train phase FAILED (rc=$rc0/$rc1)" >&2
+    rc=1
+else
+    # resume on 1 process (shrink): no cluster, every range from disk
+    if ! python tools/elastic_drill.py resume "$el_dir"; then
+        echo "elastic drill resume-on-1 FAILED" >&2
+        rc=1
+    else
+        # resume on 3 processes (grow): ranges served over the collective
+        el_port=$(( 20000 + RANDOM % 20000 ))
+        el_env=(MASTER_ADDR=127.0.0.1 "MASTER_PORT=$el_port" WORLD_SIZE=3)
+        env "${el_env[@]}" RANK=0 python tools/elastic_drill.py resume "$el_dir" &
+        h0=$!
+        env "${el_env[@]}" RANK=1 python tools/elastic_drill.py resume "$el_dir" &
+        h1=$!
+        env "${el_env[@]}" RANK=2 python tools/elastic_drill.py resume "$el_dir" &
+        h2=$!
+        wait $h0; rc0=$?
+        wait $h1; rc1=$?
+        wait $h2; rc2=$?
+        if [ "$rc0" -ne 0 ] || [ "$rc1" -ne 0 ] || [ "$rc2" -ne 0 ]; then
+            echo "elastic drill resume-on-3 FAILED (rc=$rc0/$rc1/$rc2)" >&2
+            rc=1
+        else
+            echo "elastic resharding drill: OK"
+        fi
+    fi
+fi
+rm -rf "$el_dir"
 
 if [ "$rc" -eq 0 ]; then
     echo "check_resilience: OK"
