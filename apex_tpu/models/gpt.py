@@ -112,12 +112,26 @@ class GPTConfig:
 class ParallelAttention(nn.Module):
     """Self attention: column-parallel fused QKV, causal fused softmax,
     row-parallel output projection (ref standalone_transformer_lm.py
-    ParallelAttention)."""
+    ParallelAttention).
+
+    Serving hooks (apex_tpu/serving, docs/serving.md):
+
+    - ``return_kv=True`` additionally returns this call's K/V in the
+      kernel ``(b, kv_local, s, head_dim)`` layout — what a prefill
+      step writes into the paged cache.
+    - ``kv_ctx=(k_ctx, v_ctx, ctx_mask)`` is the decode path: a
+      single-query (s == 1) forward attends over the gathered cache
+      context ``k_ctx``/``v_ctx`` (b, kv_local, L, head_dim) plus its
+      own K/V, with ``ctx_mask`` (b, L) marking the valid prefix —
+      per-sequence lengths ride the flash kernel's segment-id masking,
+      so no causal geometry is hard-wired to the input shape.
+    """
 
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, positions=None, deterministic=True):
+    def __call__(self, x, *, positions=None, deterministic=True,
+                 kv_ctx=None, return_kv=False):
         cfg = self.config
         h = cfg.hidden_size
         inside = _inside_axis(TENSOR_AXIS)
@@ -153,6 +167,52 @@ class ParallelAttention(nn.Module):
         q = q.reshape(s, b, heads_local, head_dim)
         k = k.reshape(s, b, kv_local, head_dim)
         v = v.reshape(s, b, kv_local, head_dim)
+        # kernel-layout K/V of THIS call's tokens — the cache payload
+        kv_new = (k.transpose(1, 2, 0, 3), v.transpose(1, 2, 0, 3))
+
+        def _out(ctx):
+            out = RowParallelLinear(
+                output_size=h, input_is_parallel=True,
+                sequence_parallel_enabled=cfg.sequence_parallel,
+                param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
+            )(ctx)
+            return (out, kv_new) if return_kv else out
+
+        if kv_ctx is not None:
+            # decode: one query per sequence against the gathered cache
+            # prefix + itself. Validity is data (ctx_mask), not block
+            # geometry, so every sequence in the batch may sit at a
+            # different length; masked-out slots are the trash block's
+            # garbage and padded tail (serving/kv_cache.py).
+            if cfg.attention_backend == "ring":
+                raise ValueError(
+                    "kv_ctx decode is not supported by the ring backend")
+            if cfg.attention_window is not None:
+                raise NotImplementedError(
+                    "kv_ctx decode with attention_window is not supported")
+            if s != 1:
+                raise ValueError(
+                    f"kv_ctx decode expects a single query token, got "
+                    f"seq {s}")
+            from apex_tpu.ops.attention import flash_attention
+
+            k_ctx, v_ctx, ctx_mask = kv_ctx
+            qb = q.transpose(1, 2, 0, 3)                  # (b, h, 1, d)
+            k_all = jnp.concatenate([k_ctx.astype(cfg.dtype), kv_new[0]],
+                                    axis=2)
+            v_all = jnp.concatenate([v_ctx.astype(cfg.dtype), kv_new[1]],
+                                    axis=2)
+            # segment masking: valid prefix + the token itself = 0,
+            # everything else 1 (flash zero-fills q-side segments)
+            kv_seg = jnp.concatenate(
+                [jnp.where(ctx_mask, 0, 1).astype(jnp.int32),
+                 jnp.zeros((b, 1), jnp.int32)], axis=1)
+            ctx = flash_attention(qb, k_all, v_all, causal=False,
+                                  kv_segment_ids=kv_seg,
+                                  impl=cfg.softmax_impl)
+            ctx = ctx.transpose(2, 0, 1, 3).reshape(
+                s, b, heads_local * head_dim)
+            return _out(ctx)
 
         if cfg.attention_backend in ("flash", "ring"):
             # (s, b, heads, d) -> (b, heads, s, d)
@@ -179,11 +239,7 @@ class ParallelAttention(nn.Module):
                     impl=cfg.softmax_impl)
             ctx = ctx.transpose(2, 0, 1, 3).reshape(
                 s, b, heads_local * head_dim)
-            return RowParallelLinear(
-                output_size=h, input_is_parallel=True,
-                sequence_parallel_enabled=cfg.sequence_parallel,
-                param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
-            )(ctx)
+            return _out(ctx)
 
         # softmax backend materializes (s, s) scores; share kv heads by
         # broadcast (the O(S^2) buffer dominates memory here anyway)
@@ -214,12 +270,7 @@ class ParallelAttention(nn.Module):
         ).astype(cfg.dtype)
         # (b, hl, s, d) -> (s, b, hl*d)
         ctx = ctx.transpose(2, 0, 1, 3).reshape(s, b, heads_local * head_dim)
-        out = RowParallelLinear(
-            output_size=h, input_is_parallel=True,
-            sequence_parallel_enabled=cfg.sequence_parallel,
-            param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
-        )(ctx)
-        return out
+        return _out(ctx)
 
 
 class ParallelMLP(nn.Module):
@@ -244,17 +295,25 @@ class ParallelMLP(nn.Module):
 
 
 class GPTLayer(nn.Module):
-    """Pre-LN transformer block (ref ParallelTransformerLayer)."""
+    """Pre-LN transformer block (ref ParallelTransformerLayer).
+
+    ``kv_ctx``/``return_kv`` pass through to
+    :class:`ParallelAttention` (the serving decode/prefill hooks)."""
 
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, x, *, positions=None, deterministic=True):
+    def __call__(self, x, *, positions=None, deterministic=True,
+                 kv_ctx=None, return_kv=False):
         cfg = self.config
         a = ParallelAttention(cfg, name="attention")(
             FusedLayerNorm(cfg.hidden_size, name="input_norm")(x),
             positions=positions, deterministic=deterministic,
+            kv_ctx=kv_ctx, return_kv=return_kv,
         )
+        kv = None
+        if return_kv:
+            a, kv = a
         if cfg.hidden_dropout > 0.0 and not deterministic:
             a = nn.Dropout(rate=cfg.hidden_dropout)(a, deterministic=False)
         x = x + a
@@ -263,7 +322,8 @@ class GPTLayer(nn.Module):
         )
         if cfg.hidden_dropout > 0.0 and not deterministic:
             m = nn.Dropout(rate=cfg.hidden_dropout)(m, deterministic=False)
-        return x + m
+        y = x + m
+        return (y, kv) if return_kv else y
 
 
 class _GPTScanBlock(nn.Module):
@@ -281,6 +341,25 @@ class _GPTScanBlock(nn.Module):
         return y, None
 
 
+class _GPTScanBlockKV(nn.Module):
+    """scan body for the serving paths: same ``layers/layer`` param
+    tree as :class:`_GPTScanBlock` (the two bodies are
+    checkpoint-compatible), but each layer additionally consumes its
+    own slice of the gathered cache (scanned input, or None for
+    prefill) and emits its new K/V as a stacked scan output."""
+
+    config: GPTConfig
+    deterministic: bool = True
+
+    @nn.compact
+    def __call__(self, x, kv_ctx, positions, ctx_mask):
+        ctx = None if kv_ctx is None else (kv_ctx[0], kv_ctx[1], ctx_mask)
+        y, kv = GPTLayer(self.config, name="layer")(
+            x, positions=positions, deterministic=self.deterministic,
+            kv_ctx=ctx, return_kv=True)
+        return y, kv
+
+
 class GPTModel(nn.Module):
     """Full GPT LM. Input token ids (b, s); returns vocab-parallel
     logits in (s, b, vocab[/tp]) layout (Megatron sbh convention)."""
@@ -288,10 +367,20 @@ class GPTModel(nn.Module):
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, tokens, *, positions=None, deterministic=True):
-        """``positions`` (s,) int32 are the *global* token positions of
-        this shard — pass them when the sequence is context-sharded
-        (attention_backend="ring"); defaults to arange(s)."""
+    def __call__(self, tokens, *, positions=None, deterministic=True,
+                 kv_ctx=None, ctx_mask=None, return_kv=False):
+        """``positions`` int32 override the default ``arange(s)``:
+        shape (s,) for one shared schedule (context-sharded sequences,
+        attention_backend="ring") or (b, s) per-sequence (the serving
+        decode path, where every sequence sits at its own offset) — a
+        single-token forward at position t needs only ``positions`` and
+        the cache, never the full prefix.
+
+        ``kv_ctx=(k_ctx, v_ctx)`` (num_layers, b, kv_heads, L, head_dim)
+        + ``ctx_mask`` (b, L) runs the cached decode path;
+        ``return_kv=True`` additionally returns the per-layer K/V of
+        this call, stacked (num_layers, b, kv_heads, s, head_dim) —
+        both are the serving tier's hooks (apex_tpu/serving)."""
         cfg = self.config
         b, s = tokens.shape
         emb = VocabParallelEmbedding(
@@ -305,10 +394,13 @@ class GPTModel(nn.Module):
             (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype,
         )
         if positions is None:
-            pos_emb = pos[:s]
+            pos_emb = pos[None, :s]
         else:
+            positions = jnp.asarray(positions)
             pos_emb = jnp.take(pos, positions, axis=0)
-        x = x + pos_emb[None, :, :].astype(cfg.dtype)
+            if positions.ndim == 1:
+                pos_emb = pos_emb[None]                   # (1, s, h)
+        x = x + pos_emb.astype(cfg.dtype)
         x = x.transpose(1, 0, 2)                          # (s, b, h)
 
         if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
@@ -317,19 +409,43 @@ class GPTModel(nn.Module):
             )
             x = scatter_to_sequence_parallel_region(x)
 
+        serving = return_kv or kv_ctx is not None
+        kvs = None
         if cfg.scan_layers:
-            scan = nn.scan(
-                _GPTScanBlock,
-                variable_axes={"params": 0},
-                split_rngs={"params": True, "dropout": True},
-                length=cfg.num_layers,
-                in_axes=nn.broadcast,
-            )
-            x, _ = scan(cfg, deterministic, name="layers")(x, positions)
+            if serving:
+                scan = nn.scan(
+                    _GPTScanBlockKV,
+                    variable_axes={"params": 0},
+                    split_rngs={"params": True, "dropout": True},
+                    length=cfg.num_layers,
+                    in_axes=((0 if kv_ctx is not None else nn.broadcast),
+                             nn.broadcast, nn.broadcast),
+                )
+                x, kvs = scan(cfg, deterministic, name="layers")(
+                    x, kv_ctx, positions, ctx_mask)
+            else:
+                scan = nn.scan(
+                    _GPTScanBlock,
+                    variable_axes={"params": 0},
+                    split_rngs={"params": True, "dropout": True},
+                    length=cfg.num_layers,
+                    in_axes=nn.broadcast,
+                )
+                x, _ = scan(cfg, deterministic, name="layers")(x, positions)
         else:
+            per_layer = []
             for i in range(cfg.num_layers):
+                ctx = (None if kv_ctx is None else
+                       (kv_ctx[0][i], kv_ctx[1][i], ctx_mask))
                 x = GPTLayer(cfg, name=f"layer_{i}")(
-                    x, positions=positions, deterministic=deterministic)
+                    x, positions=positions, deterministic=deterministic,
+                    kv_ctx=ctx, return_kv=serving)
+                if serving:
+                    x, kv = x
+                    per_layer.append(kv)
+            if serving:
+                kvs = (jnp.stack([kv[0] for kv in per_layer]),
+                       jnp.stack([kv[1] for kv in per_layer]))
         x = FusedLayerNorm(cfg.hidden_size, name="final_norm")(x)
 
         if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
@@ -352,6 +468,8 @@ class GPTModel(nn.Module):
             "sbh,vh->sbv", x.astype(jnp.float32),
             table.astype(jnp.float32),
         )
+        if return_kv:
+            return logits, kvs
         return logits
 
 

@@ -75,6 +75,21 @@ Elastic-resharding sites (resilience/elastic.py, docs/resilience.md
 - ``range_fetch_timeout=<idx>``  the elastic restore's peer fetch at
                                  these 0-based fetch indices times out;
                                  the planner must fall back to disk
+
+Serving sites (apex_tpu/serving/scheduler.py, docs/serving.md):
+
+- ``serving_pool_exhausted=<steps>`` admission control at these engine
+                                 steps behaves as if the KV pool were
+                                 empty — the scheduler must shed load
+                                 to the queue, keep in-flight decodes
+                                 running, and dump a flight bundle
+- ``decode_step_exception=<steps>`` the decode dispatch at these
+                                 engine steps raises ``FaultError`` —
+                                 the scheduler must finish in-flight
+                                 requests with an error, free their
+                                 blocks, dump a bundle, and keep
+                                 serving the queue (``io:decode_step``
+                                 injects by CALL index instead)
 """
 
 from __future__ import annotations
@@ -128,6 +143,9 @@ class FaultInjector:
     shard_truncate_host: int = 0
     world_mismatch_steps: FrozenSet[int] = frozenset()
     range_fetch_timeout: FrozenSet[int] = frozenset()
+    # serving sites (apex_tpu/serving/scheduler.py)
+    pool_exhausted_steps: FrozenSet[int] = frozenset()
+    decode_exception_steps: FrozenSet[int] = frozenset()
 
     def __post_init__(self):
         self._counts: Dict[str, int] = {}
@@ -231,6 +249,23 @@ class FaultInjector:
         (0-based, per restore) is planned to time out."""
         return int(index) in self.range_fetch_timeout
 
+    # -- serving sites -----------------------------------------------------
+
+    def should_pool_exhaust(self, step: int) -> bool:
+        """True when the serving scheduler's admission control at
+        engine step ``step`` must behave as if the KV pool were empty
+        (the deterministic shed-load drill)."""
+        return int(step) in self.pool_exhausted_steps
+
+    def maybe_decode_exception(self, step: int) -> None:
+        """Raise a :class:`FaultError` out of the serving decode
+        dispatch at planned engine steps — the deterministic stand-in
+        for a dead device / crashed compile mid-serve."""
+        if int(step) in self.decode_exception_steps:
+            raise FaultError(
+                f"injected decode-step exception at engine step "
+                f"{int(step)}")
+
     def maybe_sigterm(self, step: int) -> None:
         """Deliver a REAL SIGTERM to this process at planned steps —
         the deterministic stand-in for the scheduler's preemption
@@ -280,6 +315,10 @@ class FaultInjector:
                 kw["world_mismatch_steps"] = _int_set(val)
             elif key == "range_fetch_timeout":
                 kw["range_fetch_timeout"] = _int_set(val)
+            elif key == "serving_pool_exhausted":
+                kw["pool_exhausted_steps"] = _int_set(val)
+            elif key == "decode_step_exception":
+                kw["decode_exception_steps"] = _int_set(val)
             elif key.startswith("io:"):
                 kw["io_errors"][key[len("io:"):]] = _int_set(val)
             elif key.startswith("io_permanent:"):
@@ -387,10 +426,22 @@ def should_range_timeout(index: int) -> bool:
     return inj is not None and inj.should_range_timeout(index)
 
 
+def should_pool_exhaust(step: int) -> bool:
+    inj = active()
+    return inj is not None and inj.should_pool_exhaust(step)
+
+
+def maybe_decode_exception(step: int) -> None:
+    inj = active()
+    if inj is not None:
+        inj.maybe_decode_exception(step)
+
+
 __all__ = [
     "ENV_KNOB", "FaultError", "FaultInjector", "SimulatedCrash",
     "active", "check", "flip_bits", "inject", "install", "maybe_crash",
-    "maybe_crash_before_commit", "maybe_sigterm", "poison_grads",
-    "shard_truncate_target", "should_range_timeout", "should_truncate",
+    "maybe_crash_before_commit", "maybe_decode_exception",
+    "maybe_sigterm", "poison_grads", "shard_truncate_target",
+    "should_pool_exhaust", "should_range_timeout", "should_truncate",
     "should_world_mismatch",
 ]

@@ -1,0 +1,62 @@
+"""Serving tier: paged KV cache, donation-aware decode step, and a
+continuous-batching scheduler (ROADMAP item 1, docs/serving.md).
+
+Opens the inference half of the north star over the existing stack:
+the decode/prefill hot path is jitted and donation-aware in the
+``optimizers/train_step.py`` discipline (cache pools donated, an
+eviction-free per-shape compile cache observed by the PR-6 compile
+tracker), the KV cache is block-paged over one preallocated pool
+(GQA-sized blocks from ``GPTConfig.kv_heads``), and the scheduler is
+instrumented end-to-end with the PR-4/5 telemetry spine plus
+flight-recorder triggers for its degradation paths.
+
+    from apex_tpu.serving import (KVCache, make_decode_step,
+                                  ContinuousBatcher, serve_loop)
+
+    cache = KVCache.for_config(cfg, num_blocks=256)
+    state = cache.init_state()
+    batcher = ContinuousBatcher(model, params, cache)
+    state, results = serve_loop(batcher, state, requests)
+
+``bench.py serving`` drives the same loop under synthetic many-client
+load (Poisson arrivals, mixed lengths) against a static-batch
+baseline.
+"""
+
+from apex_tpu.serving.decode import DecodeStep, StepOut, make_decode_step
+from apex_tpu.serving.kv_cache import (
+    KVCache,
+    KVCacheState,
+    PoolExhausted,
+    TRASH_BLOCK,
+    append_kv,
+    append_kv_prefill,
+    bucket,
+    gather_kv,
+)
+from apex_tpu.serving.scheduler import (
+    ContinuousBatcher,
+    Request,
+    RequestResult,
+    serve_loop,
+    static_batch_generate,
+)
+
+__all__ = [
+    "ContinuousBatcher",
+    "DecodeStep",
+    "KVCache",
+    "KVCacheState",
+    "PoolExhausted",
+    "Request",
+    "RequestResult",
+    "StepOut",
+    "TRASH_BLOCK",
+    "append_kv",
+    "append_kv_prefill",
+    "bucket",
+    "gather_kv",
+    "make_decode_step",
+    "serve_loop",
+    "static_batch_generate",
+]

@@ -1,0 +1,198 @@
+"""Donation-aware jitted prefill + decode steps over the paged cache.
+
+The serving analog of ``optimizers/train_step.py``: each step is ONE
+compiled program with the cache pools DONATED (``donate_argnums``), so
+a decode step appends K/V in place — the pool never holds two copies,
+and the hot loop allocates nothing. The per-shape compile cache is an
+eviction-free dict keyed on the bucketed shapes:
+
+- decode: ``(batch_bucket, table_width)`` — the only dynamic shapes a
+  decode dispatch has;
+- prefill: ``(batch_bucket, seq_bucket, table_width)``.
+
+Every NEW key is observed by the PR-6 compile tracker
+(``telemetry.compiled.observe``) under ``fn="decode_step"`` /
+``fn="prefill_step"`` and the compiling dispatch runs inside a
+``label(...)`` scope, so decode-shape churn shows up as ``recompile``
+events with a signature diff — and a scheduler that buckets properly
+triggers ZERO recompile events after warmup (tools/check_serving.sh
+pins it). Cache hits never reach the tracker: the hot loop is one
+dict lookup.
+
+Fused hot path (PAPERS.md "LLM Inference Acceleration via Efficient
+Operation Fusion" — the prefill/decode analog of PR 1's fused
+optimizer step): prefill runs embed -> L layers -> final norm -> LM
+head -> last-token logit gather -> cache scatter as one program;
+decode runs gather -> single-query attention (per-layer, inside the
+layer scan) -> logits -> greedy argmax -> cache append as one program.
+Nothing round-trips to the host but the (b,) next-token ids and the
+(b, vocab) logits.
+
+Both steps are teacher-forcing-friendly: they return the raw last
+logits next to the argmax ids, so the parity suite replays a known
+sequence through decode and compares against the full-sequence
+forward (tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Tuple
+
+from apex_tpu.serving.kv_cache import (
+    KVCache,
+    KVCacheState,
+    append_kv,
+    append_kv_prefill,
+    gather_kv,
+)
+
+
+class StepOut(NamedTuple):
+    """One prefill/decode dispatch's results (device arrays)."""
+
+    logits: Any        # (batch, vocab) fp32 — the LAST real token's
+    next_token: Any    # (batch,) int32 greedy argmax of ``logits``
+    cache: KVCacheState
+
+
+class DecodeStep:
+    """Compiled prefill + decode dispatchers for one (model, cache).
+
+    Build via :func:`make_decode_step`. The cache state passed to
+    either method is DONATED — rebind it to ``out.cache``; the buffers
+    you passed in are dead after the call.
+    """
+
+    def __init__(self, model, cache: KVCache):
+        import jax
+        import jax.numpy as jnp
+
+        self.model = model
+        self.cache = cache
+        self._compiled: Dict[Tuple, Any] = {}
+        cfg = model.config
+        max_pos = cfg.max_seq_len - 1
+
+        def prefill_fn(params, state, tokens, lengths, tables):
+            b, s = tokens.shape
+            logits, (k_new, v_new) = model.apply(
+                params, tokens, return_kv=True)
+            state = append_kv_prefill(state, k_new, v_new, tables, lengths)
+            last = jnp.clip(lengths - 1, 0, s - 1)
+            out = logits[last, jnp.arange(b)]          # (b, vocab)
+            return StepOut(out, jnp.argmax(out, axis=-1).astype(jnp.int32),
+                           state)
+
+        def decode_fn(params, state, tokens, positions, tables):
+            k_ctx, v_ctx = gather_kv(state, tables)
+            L = k_ctx.shape[3]
+            ctx_mask = (jnp.arange(L, dtype=jnp.int32)[None, :]
+                        < positions[:, None])
+            pos2 = jnp.clip(positions, 0, max_pos)[:, None]   # (b, 1)
+            logits, (k_new, v_new) = model.apply(
+                params, tokens[:, None], positions=pos2,
+                kv_ctx=(k_ctx, v_ctx), ctx_mask=ctx_mask, return_kv=True)
+            state = append_kv(state, k_new[:, :, :, 0], v_new[:, :, :, 0],
+                              tables, positions)
+            out = logits[0]                            # (b, vocab)
+            return StepOut(out, jnp.argmax(out, axis=-1).astype(jnp.int32),
+                           state)
+
+        # cache state donated (argnums 1): appends run in place
+        self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(1,))
+        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
+        self._jnp = jnp
+
+    # -- compile-plane bookkeeping ------------------------------------------
+
+    def _signature(self, fn: str, key: Tuple) -> Dict[str, Any]:
+        cfg = self.model.config
+        sig: Dict[str, Any] = {"fn": fn}
+        if fn == "prefill_step":
+            sig.update(batch=key[1], seq=key[2], table_width=key[3])
+        else:
+            sig.update(batch=key[1], table_width=key[2])
+        sig.update(block_size=self.cache.block_size,
+                   kv_heads=self.cache.kv_heads,
+                   head_dim=self.cache.head_dim,
+                   num_layers=cfg.num_layers)
+        return sig
+
+    def _track(self, fn: str, key: Tuple) -> bool:
+        """True when ``key`` is NEW — the dispatch about to run will
+        trace+compile (the train-step ``_track`` discipline: hits are
+        one dict lookup and never reach the tracker)."""
+        if key in self._compiled:
+            return False
+        self._compiled[key] = True
+        return True
+
+    def _dispatch(self, fn: str, key: Tuple, jitted, *args) -> StepOut:
+        if self._track(fn, key):
+            from apex_tpu.telemetry import compiled as _compiled
+
+            _compiled.observe(fn, self._signature(fn, key))
+            with _compiled.label(fn):
+                return jitted(*args)
+        return jitted(*args)
+
+    def compile_keys(self) -> Dict[str, int]:
+        """Distinct compiled shapes per step kind (the bench/smoke
+        assertion surface: the expected decode-bucket compile count)."""
+        out: Dict[str, int] = {"prefill_step": 0, "decode_step": 0}
+        for key in self._compiled:
+            out[key[0]] += 1
+        return out
+
+    # -- dispatchers ---------------------------------------------------------
+
+    def prefill(self, params, state: KVCacheState, tokens, lengths,
+                tables) -> StepOut:
+        """Run the full (right-padded) prompts, write their K/V into
+        the pool, and return the LAST real token's logits — the first
+        generated token's distribution — in one program.
+
+        ``tokens`` (b, s) int32; ``lengths`` (b,) real prompt lengths;
+        ``tables`` (b, w) block tables (trash-padded). Dummy batch rows
+        use length 0 and an all-trash table.
+        """
+        jnp = self._jnp
+        tokens = jnp.asarray(tokens, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        tables = jnp.asarray(tables, jnp.int32)
+        key = ("prefill_step", tokens.shape[0], tokens.shape[1],
+               tables.shape[1])
+        return self._dispatch("prefill_step", key, self._prefill_jit,
+                              params, state, tokens, lengths, tables)
+
+    def decode(self, params, state: KVCacheState, tokens, positions,
+               tables) -> StepOut:
+        """One token per sequence: gather each sequence's cache view,
+        attend (single query, per-sequence length via the mask), emit
+        logits + greedy ids, and append the new K/V at ``positions`` —
+        one program, cache donated.
+
+        ``tokens`` (b,) int32 current tokens; ``positions`` (b,) their
+        0-based positions (== the cached prefix length). Dummy batch
+        rows use position 0 and an all-trash table.
+        """
+        jnp = self._jnp
+        tokens = jnp.asarray(tokens, jnp.int32)
+        positions = jnp.asarray(positions, jnp.int32)
+        tables = jnp.asarray(tables, jnp.int32)
+        key = ("decode_step", tokens.shape[0], tables.shape[1])
+        return self._dispatch("decode_step", key, self._decode_jit,
+                              params, state, tokens, positions, tables)
+
+
+def make_decode_step(model, cache: KVCache) -> DecodeStep:
+    """Build the compiled serving steps for ``model`` (a
+    :class:`~apex_tpu.models.gpt.GPTModel`) over ``cache``.
+
+    The returned :class:`DecodeStep` donates the cache state on every
+    dispatch and keeps an eviction-free per-shape compile cache
+    observed by the compile tracker (module docstring)."""
+    return DecodeStep(model, cache)
+
+
+__all__ = ["DecodeStep", "StepOut", "make_decode_step"]

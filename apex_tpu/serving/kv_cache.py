@@ -1,0 +1,292 @@
+"""Paged KV cache: fixed-size blocks over one preallocated pool.
+
+The serving tier's memory subsystem (ROADMAP item 1; the vLLM
+PagedAttention layout re-expressed for this stack): instead of one
+contiguous ``(batch, max_seq_len)`` KV buffer per sequence — whose
+reallocation/copy on every growth step is exactly the churn the
+donation-aware train step was built to kill — every layer's K and V
+live in ONE preallocated pool of fixed-size blocks,
+
+    pool: (num_layers, num_blocks, block_size, kv_heads, head_dim)
+
+and each sequence owns an ordered *block table* (a list of pool block
+indices). Appending a token writes one ``(kv_heads, head_dim)`` row at
+``(table[pos // block_size], pos % block_size)``; reading gathers the
+table back into a contiguous ``(kv_heads, padded_len, head_dim)`` view
+for attention. Neither path ever reallocates the pool — the device
+arrays are created once and donated through every decode step.
+
+GQA pays GQA-sized blocks: the pool is sized from the model's
+``kv_heads`` (``GPTConfig.kv_heads``), not ``num_heads``, so a 4x
+grouped-query model holds 4x the sequences in the same HBM.
+
+Block 0 is the **trash block**: writes from padded batch slots or
+padded prompt tails land there (index clamping instead of predication
+keeps the scatter shape static), and unallocated block-table entries
+point at it so a short table gathers garbage that the attention mask
+then drops. No real sequence is ever given block 0.
+
+The allocator is host-side Python (the scheduler's admission control
+runs on the host between steps); only :func:`gather_kv` /
+:func:`append_kv` / :func:`append_kv_prefill` trace into jitted
+programs. Allocation reserves the FULL block span a request can reach
+(prompt + max_new_tokens) up front, so an admitted request can never
+die of pool exhaustion mid-decode — admission control is the one gate
+(docs/serving.md "admission control").
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+TRASH_BLOCK = 0
+
+
+class PoolExhausted(RuntimeError):
+    """Admission refused: the pool cannot reserve the requested span.
+
+    Carries ``needed`` / ``free`` block counts so the scheduler can
+    tell a transient full pool (wait) from an impossible request
+    (``needed > capacity``: reject)."""
+
+    def __init__(self, msg: str, *, needed: int, free: int, capacity: int):
+        super().__init__(msg)
+        self.needed = int(needed)
+        self.free = int(free)
+        self.capacity = int(capacity)
+
+
+class KVCacheState(NamedTuple):
+    """The device-side pools — a pytree the decode step DONATES, so
+    appends run in place and the cache never holds two copies."""
+
+    k: Any    # (num_layers, num_blocks, block_size, kv_heads, head_dim)
+    v: Any
+
+
+class KVCache:
+    """Block allocator + pool factory for one model's KV cache.
+
+    ``num_blocks`` counts usable blocks *excluding* the trash block
+    (the pool array holds ``num_blocks + 1``). Thread-safe: the
+    scheduler's admission thread and a draining finish path may race.
+    """
+
+    def __init__(self, num_layers: int, kv_heads: int, head_dim: int, *,
+                 num_blocks: int, block_size: int = 16,
+                 dtype: Any = None):
+        if num_blocks < 1:
+            raise ValueError(f"num_blocks must be >= 1, got {num_blocks}")
+        if block_size < 1:
+            raise ValueError(f"block_size must be >= 1, got {block_size}")
+        import jax.numpy as jnp
+
+        self.num_layers = int(num_layers)
+        self.kv_heads = int(kv_heads)
+        self.head_dim = int(head_dim)
+        self.num_blocks = int(num_blocks)
+        self.block_size = int(block_size)
+        self.dtype = dtype if dtype is not None else jnp.float32
+        self._lock = threading.Lock()
+        # LIFO free list: a freed sequence's blocks are the next handed
+        # out (reuse-after-free is the common case under steady load,
+        # and LIFO keeps the hot blocks hot)
+        self._free: List[int] = list(range(self.num_blocks, 0, -1))
+        self._tables: Dict[Any, List[int]] = {}
+
+    @classmethod
+    def for_config(cls, cfg, *, num_blocks: int, block_size: int = 16,
+                   dtype: Any = None) -> "KVCache":
+        """Size the cache from a ``GPTConfig``-shaped model config:
+        ``kv_heads`` (the GQA-narrowed count) x ``head_dim`` blocks —
+        GQA pays GQA-sized blocks, never ``num_heads``-sized ones."""
+        return cls(cfg.num_layers, cfg.kv_heads,
+                   cfg.hidden_size // cfg.num_heads,
+                   num_blocks=num_blocks, block_size=block_size,
+                   dtype=dtype if dtype is not None else cfg.dtype)
+
+    # -- pool ---------------------------------------------------------------
+
+    def init_state(self) -> KVCacheState:
+        """Allocate the pools (once; +1 block for the trash block)."""
+        import jax.numpy as jnp
+
+        shape = (self.num_layers, self.num_blocks + 1, self.block_size,
+                 self.kv_heads, self.head_dim)
+        return KVCacheState(k=jnp.zeros(shape, self.dtype),
+                            v=jnp.zeros(shape, self.dtype))
+
+    def pool_bytes(self) -> int:
+        import jax.numpy as jnp
+
+        n = (self.num_layers * (self.num_blocks + 1) * self.block_size
+             * self.kv_heads * self.head_dim)
+        return 2 * n * jnp.dtype(self.dtype).itemsize
+
+    # -- allocator ----------------------------------------------------------
+
+    def blocks_for(self, total_len: int) -> int:
+        """Blocks a sequence of ``total_len`` tokens occupies."""
+        return -(-max(int(total_len), 1) // self.block_size)
+
+    def can_admit(self, total_len: int) -> bool:
+        with self._lock:
+            return self.blocks_for(total_len) <= len(self._free)
+
+    @property
+    def free_blocks(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def blocks_in_use(self) -> int:
+        with self._lock:
+            return self.num_blocks - len(self._free)
+
+    def allocate(self, seq_id, total_len: int) -> List[int]:
+        """Reserve the full block span for a sequence reaching
+        ``total_len`` tokens; raises :class:`PoolExhausted` when the
+        free list can't cover it (the admission-control refusal)."""
+        need = self.blocks_for(total_len)
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id!r} already allocated")
+            if need > len(self._free):
+                raise PoolExhausted(
+                    f"kv pool exhausted: sequence {seq_id!r} needs {need} "
+                    f"blocks, {len(self._free)} free of {self.num_blocks}",
+                    needed=need, free=len(self._free),
+                    capacity=self.num_blocks)
+            blocks = [self._free.pop() for _ in range(need)]
+            self._tables[seq_id] = blocks
+            return list(blocks)
+
+    def free(self, seq_id) -> int:
+        """Return a sequence's blocks to the pool; returns how many."""
+        with self._lock:
+            blocks = self._tables.pop(seq_id, None)
+            if blocks is None:
+                return 0
+            self._free.extend(reversed(blocks))
+            return len(blocks)
+
+    def table(self, seq_id) -> List[int]:
+        with self._lock:
+            return list(self._tables[seq_id])
+
+    @property
+    def sequences(self) -> List[Any]:
+        with self._lock:
+            return list(self._tables)
+
+    def table_array(self, seq_ids: Sequence[Any], width: int,
+                    batch: Optional[int] = None) -> np.ndarray:
+        """The batch's block tables as one right-padded ``(batch,
+        width)`` int32 array — padding (and dummy batch rows past
+        ``len(seq_ids)``) points at the trash block."""
+        b = len(seq_ids) if batch is None else int(batch)
+        out = np.full((b, int(width)), TRASH_BLOCK, np.int32)
+        with self._lock:
+            for i, sid in enumerate(seq_ids):
+                t = self._tables[sid]
+                if len(t) > width:
+                    raise ValueError(
+                        f"table width {width} < {len(t)} blocks of "
+                        f"sequence {sid!r}")
+                out[i, :len(t)] = t
+        return out
+
+
+def bucket(n: int, minimum: int = 1) -> int:
+    """Next power of two >= max(n, minimum) — the shape-bucketing that
+    bounds the decode compile count (docs/serving.md)."""
+    b = max(int(minimum), 1)
+    while b < n:
+        b *= 2
+    return b
+
+
+# ---------------------------------------------------------------------------
+# Traced pool ops (what the jitted prefill/decode programs call)
+# ---------------------------------------------------------------------------
+
+
+def gather_kv(state: KVCacheState, tables):
+    """Gather each sequence's blocks into contiguous per-batch views.
+
+    ``tables`` (batch, width) int32 -> two ``(num_layers, batch,
+    kv_heads, width * block_size, head_dim)`` arrays. Pure data
+    movement — the bytes written by :func:`append_kv` come back
+    bitwise (tests/test_serving.py pins it). Unallocated table entries
+    gather the trash block; the caller's attention mask drops them.
+    """
+    def one(pool):
+        g = pool[:, tables]            # (L, b, w, bs, kv, d)
+        layers, b, w, bs, kv, d = g.shape
+        return g.transpose(0, 1, 4, 2, 3, 5).reshape(layers, b, kv,
+                                                     w * bs, d)
+    return one(state.k), one(state.v)
+
+
+def append_kv(state: KVCacheState, k_new, v_new, tables,
+              positions) -> KVCacheState:
+    """Write one token's K/V per sequence into the pool in place.
+
+    ``k_new``/``v_new`` (num_layers, batch, kv_heads, head_dim);
+    ``positions`` (batch,) the 0-based slot each token lands in. Rows
+    whose table entry is the trash block (dummy batch slots) write
+    harmlessly into it.
+    """
+    import jax.numpy as jnp
+
+    bs = state.k.shape[2]
+    w = tables.shape[1]
+    blk = jnp.take_along_axis(
+        tables, jnp.clip(positions[:, None] // bs, 0, w - 1), axis=1)[:, 0]
+    slot = positions % bs
+    return KVCacheState(k=state.k.at[:, blk, slot].set(k_new),
+                        v=state.v.at[:, blk, slot].set(v_new))
+
+
+def append_kv_prefill(state: KVCacheState, k_new, v_new, tables,
+                      lengths) -> KVCacheState:
+    """Write a whole prompt's K/V per sequence into the pool in place.
+
+    ``k_new``/``v_new`` (num_layers, batch, kv_heads, seq, head_dim)
+    right-padded; positions ``>= lengths`` clamp to the trash block
+    (static scatter shape, no predication), so the pads' garbage K/V
+    never lands in a real block.
+    """
+    import jax.numpy as jnp
+
+    layers = state.k.shape[0]
+    bs = state.k.shape[2]
+    b, w = tables.shape
+    s = k_new.shape[3]
+    pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
+    valid = pos < lengths[:, None]
+    blk = jnp.take_along_axis(tables, jnp.clip(pos // bs, 0, w - 1), axis=1)
+    blk = jnp.where(valid, blk, TRASH_BLOCK)
+    slot = pos % bs
+
+    def one(pool, new):
+        # (L, b, kv, s, d) -> (L, b, s, kv, d) to match pool[:, blk, slot]
+        return pool.at[:, blk, slot].set(new.transpose(0, 1, 3, 2, 4))
+
+    del layers
+    return KVCacheState(k=one(state.k, k_new), v=one(state.v, v_new))
+
+
+__all__ = [
+    "KVCache",
+    "KVCacheState",
+    "PoolExhausted",
+    "TRASH_BLOCK",
+    "append_kv",
+    "append_kv_prefill",
+    "bucket",
+    "gather_kv",
+]

@@ -1,0 +1,537 @@
+"""Continuous-batching scheduler: admission control, per-step join of
+prefills and decodes, eviction, and end-to-end telemetry.
+
+The serving tier's control plane (ROADMAP item 1; TorchTitan's
+production framing — the scheduler is a first-class, observable
+subsystem, not a demo loop). Every engine ``step()``:
+
+1. **Admits** queued requests against the KV pool: a request enters
+   only if :class:`~apex_tpu.serving.kv_cache.KVCache` can reserve its
+   FULL span (prompt + max_new_tokens), so an admitted request can
+   never die of pool exhaustion mid-decode. A request larger than the
+   whole pool is rejected (``serving_request_error``); a transiently
+   full pool defers admission (the request waits, nothing breaks).
+2. **Prefills** the newly admitted as one bucketed batch (batch and
+   seq padded to powers of two — the compile-count bound), emitting
+   each request's FIRST token from the same program that writes the
+   cache (TTFT is one dispatch after admission).
+3. **Decodes** every in-flight sequence as one bucketed batch joined
+   with the step's new arrivals — continuous batching: a finishing
+   sequence's slot (and blocks) are reused by the next admission on
+   the very next step, no static-batch barrier.
+4. **Evicts/finishes**: sequences hitting ``max_new_tokens`` or their
+   EOS free their blocks immediately and land in :meth:`drain`.
+
+Telemetry (the PR-4/5 spine, docs/serving.md metric table):
+``serving_queue_depth`` / ``serving_batch_size`` /
+``serving_kv_blocks_in_use`` gauges per step, per-request TTFT/TPOT
+latency histograms, ``prefill`` / ``decode`` timeline spans (category
+``serving``), ``serving_requests{outcome=}`` / ``serving_tokens``
+counters, and ``serving_request_error`` / ``serving_pool_exhausted``
+structured events that double as flight-recorder triggers — a crash
+mid-serve leaves a postmortem bundle naming the request.
+
+Degradation paths are deterministically drillable via
+``APEX_TPU_FAULTS`` (resilience/faults.py):
+
+- ``serving_pool_exhausted=<steps>``: admission at those engine steps
+  behaves as if the pool were empty — load sheds to the queue,
+  in-flight decodes keep running, one event + bundle fire.
+- ``decode_step_exception=<steps>``: the decode dispatch raises —
+  in-flight requests finish with an error (blocks freed, bundle
+  dumped) and the engine keeps serving the queue.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from collections import deque
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from apex_tpu.serving.decode import DecodeStep, make_decode_step
+from apex_tpu.serving.kv_cache import KVCache, PoolExhausted, bucket
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request."""
+
+    id: Any
+    prompt: Sequence[int]
+    max_new_tokens: int = 16
+    eos_id: Optional[int] = None
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).ravel()
+        if len(self.prompt) < 1:
+            raise ValueError(f"request {self.id!r}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(
+                f"request {self.id!r}: max_new_tokens must be >= 1")
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """A finished request: generated tokens + the latency the serving
+    bench reports (TTFT = submit -> first token; TPOT = mean
+    inter-token interval after the first)."""
+
+    id: Any
+    tokens: List[int]
+    ttft_s: Optional[float]
+    tpot_s: Optional[float]
+    finish_reason: str                  # "length" | "eos" | "error"
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class _InFlight:
+    req: Request
+    seq_id: Any
+    generated: List[int]
+    t_submit: float
+    t_first: Optional[float] = None
+    t_last: Optional[float] = None
+
+    @property
+    def position(self) -> int:
+        """0-based position of the NEXT cache append: the last
+        generated token's slot (prompt is already cached)."""
+        return len(self.req.prompt) + len(self.generated) - 1
+
+
+class ContinuousBatcher:
+    """The continuous-batching engine (module docstring).
+
+    ``max_batch`` bounds in-flight sequences; ``max_prefill_batch``
+    bounds how many admissions one step prefills together (prefill
+    cost scales with batch x seq — decode keeps running next step
+    either way). ``min_width_bucket`` / ``min_seq_bucket`` floor the
+    shape buckets so short bursts don't mint tiny one-off programs.
+    Decode batches always pad to ``max_batch``: ONE decode program per
+    table-width bucket, the compile-count bound check_serving.sh pins.
+    """
+
+    def __init__(self, model, params, cache: KVCache, *,
+                 max_batch: int = 8, max_prefill_batch: int = 4,
+                 min_width_bucket: int = 4, min_seq_bucket: int = 16,
+                 registry=None, timeline=None,
+                 clock: Callable[[], float] = time.perf_counter,
+                 step_fn: Optional[DecodeStep] = None):
+        from apex_tpu import telemetry
+
+        self.params = params
+        self.cache = cache
+        self.step_fn = (step_fn if step_fn is not None
+                        else make_decode_step(model, cache))
+        self.max_batch = int(max_batch)
+        self.max_prefill_batch = int(max_prefill_batch)
+        self.min_width_bucket = int(min_width_bucket)
+        self.min_seq_bucket = int(min_seq_bucket)
+        self.clock = clock
+        self._registry = (registry if registry is not None
+                          else telemetry.registry())
+        self._timeline = timeline
+        self.queue: "deque[Tuple[Request, float]]" = deque()
+        self.running: List[_InFlight] = []
+        self.finished: List[RequestResult] = []
+        self.step_idx = 0
+        self._seq_counter = 0
+        self._pool_exhausted_dumped = False
+
+    # -- telemetry helpers ---------------------------------------------------
+
+    def _tl(self):
+        if self._timeline is not None:
+            return self._timeline
+        from apex_tpu.telemetry import timeline as _timeline
+
+        return _timeline.get_timeline()
+
+    def _publish_gauges(self) -> None:
+        r = self._registry
+        r.gauge("serving_queue_depth",
+                "requests waiting for admission").set(len(self.queue))
+        r.gauge("serving_batch_size",
+                "in-flight sequences this engine step").set(
+            len(self.running))
+        r.gauge("serving_kv_blocks_in_use",
+                "KV pool blocks held by in-flight sequences").set(
+            self.cache.blocks_in_use)
+
+    def _finish(self, fl: _InFlight, reason: str,
+                error: Optional[str] = None) -> None:
+        self.cache.free(fl.seq_id)
+        n = len(fl.generated)
+        ttft = (fl.t_first - fl.t_submit) if fl.t_first is not None else None
+        tpot = None
+        if n > 1 and fl.t_first is not None and fl.t_last is not None:
+            tpot = (fl.t_last - fl.t_first) / (n - 1)
+        r = self._registry
+        r.counter("serving_requests",
+                  "finished requests by outcome").inc(outcome=reason)
+        r.counter("serving_tokens", "generated tokens").inc(n)
+        if ttft is not None:
+            r.histogram("serving_ttft_seconds",
+                        "submit -> first generated token").observe(ttft)
+        if tpot is not None:
+            r.histogram("serving_tpot_seconds",
+                        "mean inter-token interval after the first"
+                        ).observe(tpot)
+        self.finished.append(RequestResult(
+            id=fl.req.id, tokens=list(fl.generated), ttft_s=ttft,
+            tpot_s=tpot, finish_reason=reason, error=error))
+
+    def _reject(self, req: Request, msg: str) -> None:
+        ev = self._registry.event("serving_request_error",
+                                  request=str(req.id), error=msg)
+        from apex_tpu.telemetry import flight as _flight
+
+        _flight.notify("serving_request_error",
+                       error=RuntimeError(msg), fleet=False,
+                       extra={"request": str(req.id), "event": ev})
+        self.finished.append(RequestResult(
+            id=req.id, tokens=[], ttft_s=None, tpot_s=None,
+            finish_reason="error", error=msg))
+
+    # -- API -----------------------------------------------------------------
+
+    def warmup(self, state, seq_buckets: Optional[Sequence[int]] = None,
+               width_buckets: Optional[Sequence[int]] = None):
+        """Compile the engine's programs off the hot path: the decode
+        program per table-width bucket and the prefill programs for
+        every admission batch bucket x seq bucket (admissions trickle,
+        so batches of 1, 2, ... each mint a program). Every write
+        lands in the trash block; returns the threaded cache state.
+        Serving latency after warmup never includes an XLA compile —
+        and the compile tracker sees zero ``recompile`` events from
+        the hot loop (tools/check_serving.sh)."""
+        import jax
+
+        seqs = sorted(set(seq_buckets or [self.min_seq_bucket]))
+        widths = sorted(set(width_buckets or [self.min_width_bucket]))
+        batches = []
+        b = 1
+        while b < self.max_prefill_batch:
+            batches.append(b)
+            b *= 2
+        batches.append(bucket(self.max_prefill_batch))
+        out = None
+        for w in widths:
+            out = self.step_fn.decode(
+                self.params, state, np.zeros(self.max_batch, np.int32),
+                np.zeros(self.max_batch, np.int32),
+                np.zeros((self.max_batch, w), np.int32))
+            state = out.cache
+            for nb in batches:
+                for s in seqs:
+                    out = self.step_fn.prefill(
+                        self.params, state, np.zeros((nb, s), np.int32),
+                        np.zeros((nb,), np.int32),
+                        np.zeros((nb, w), np.int32))
+                    state = out.cache
+        if out is not None:
+            jax.block_until_ready(out.next_token)
+        return state
+
+    def submit(self, request: Request) -> None:
+        self.queue.append((request, self.clock()))
+
+    def idle(self) -> bool:
+        return not self.queue and not self.running
+
+    def drain(self) -> List[RequestResult]:
+        out, self.finished = self.finished, []
+        return out
+
+    # -- one engine step -----------------------------------------------------
+
+    def _admit(self, exhausted: bool) -> List[_InFlight]:
+        admitted: List[_InFlight] = []
+        while (self.queue
+               and len(self.running) + len(admitted) < self.max_batch
+               and len(admitted) < self.max_prefill_batch):
+            req, t_submit = self.queue[0]
+            total = len(req.prompt) + req.max_new_tokens
+            need = self.cache.blocks_for(total)
+            if need > self.cache.num_blocks:
+                self.queue.popleft()
+                self._reject(req, (
+                    f"request needs {need} KV blocks, pool capacity is "
+                    f"{self.cache.num_blocks} — can never be admitted"))
+                continue
+            if exhausted:
+                break                        # shed load: stay queued
+            try:
+                self._seq_counter += 1
+                seq_id = ("s", self._seq_counter, req.id)
+                self.cache.allocate(seq_id, total)
+            except PoolExhausted:
+                self._registry.counter(
+                    "serving_admission_deferred",
+                    "admissions deferred by a transiently full pool"
+                ).inc()
+                break                        # wait for blocks to free
+            self.queue.popleft()
+            admitted.append(_InFlight(req=req, seq_id=seq_id,
+                                      generated=[], t_submit=t_submit))
+        return admitted
+
+    def _tables_for(self, flights: List[_InFlight], batch: int):
+        widths = [len(self.cache.table(f.seq_id)) for f in flights]
+        w = bucket(max(widths), self.min_width_bucket)
+        return self.cache.table_array([f.seq_id for f in flights], w,
+                                      batch=batch)
+
+    def _prefill(self, admitted: List[_InFlight], state):
+        import jax
+
+        b = bucket(len(admitted))
+        s = bucket(max(len(f.req.prompt) for f in admitted),
+                   self.min_seq_bucket)
+        tokens = np.zeros((b, s), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        for i, f in enumerate(admitted):
+            tokens[i, :len(f.req.prompt)] = f.req.prompt
+            lengths[i] = len(f.req.prompt)
+        tables = self._tables_for(admitted, b)
+        with self._tl().phase("prefill", category="serving"):
+            out = self.step_fn.prefill(self.params, state, tokens,
+                                       lengths, tables)
+            jax.block_until_ready(out.next_token)
+        now = self.clock()
+        ids = np.asarray(out.next_token)
+        for i, f in enumerate(admitted):
+            f.generated.append(int(ids[i]))
+            f.t_first = f.t_last = now
+        return out.cache
+
+    def _decode(self, state, idx: int):
+        import jax
+
+        from apex_tpu.resilience import faults
+
+        b = self.max_batch          # fixed: one program per width bucket
+        tokens = np.zeros((b,), np.int32)
+        positions = np.zeros((b,), np.int32)
+        for i, f in enumerate(self.running):
+            tokens[i] = f.generated[-1]
+            positions[i] = f.position
+        tables = self._tables_for(self.running, b)
+        with self._tl().phase("decode", category="serving"):
+            # deterministic drill sites: the named engine-step clause
+            # (decode_step_exception=<steps>) plus the generic
+            # call-indexed io:decode_step grammar
+            faults.maybe_decode_exception(idx)
+            faults.check("decode_step")
+            out = self.step_fn.decode(self.params, state, tokens,
+                                      positions, tables)
+            jax.block_until_ready(out.next_token)
+        now = self.clock()
+        ids = np.asarray(out.next_token)
+        for i, f in enumerate(self.running):
+            f.generated.append(int(ids[i]))
+            f.t_last = now
+        return out.cache, out
+
+    def _reap(self) -> List[Any]:
+        done, keep = [], []
+        for f in self.running:
+            if (f.req.eos_id is not None
+                    and f.generated[-1] == f.req.eos_id):
+                self._finish(f, "eos")
+                done.append(f.req.id)
+            elif len(f.generated) >= f.req.max_new_tokens:
+                self._finish(f, "length")
+                done.append(f.req.id)
+            else:
+                keep.append(f)
+        self.running = keep
+        return done
+
+    def step(self, state) -> Tuple[Any, Dict[str, Any]]:
+        """One engine iteration over the donated cache ``state``;
+        returns ``(new_state, report)`` — the report (admitted /
+        decoded / finished ids, blocks in use) is the golden-sequence
+        surface tests assert against."""
+        from apex_tpu.resilience import faults
+        from apex_tpu.telemetry import flight as _flight
+
+        idx = self.step_idx
+        self.step_idx += 1
+        exhausted = faults.should_pool_exhaust(idx)
+        if exhausted:
+            self._registry.event("serving_pool_exhausted", step=idx,
+                                 injected=True,
+                                 queued=len(self.queue),
+                                 in_flight=len(self.running))
+            if not self._pool_exhausted_dumped:
+                self._pool_exhausted_dumped = True
+                _flight.notify(
+                    "serving_pool_exhausted", fleet=False,
+                    extra={"step": idx, "queued": len(self.queue),
+                           "blocks_in_use": self.cache.blocks_in_use})
+        admitted = self._admit(exhausted)
+        report: Dict[str, Any] = {
+            "step": idx,
+            "admitted": [f.req.id for f in admitted],
+            "decoded": [],
+            "finished": [],
+            "queued": len(self.queue),
+        }
+        if admitted:
+            state = self._prefill(admitted, state)
+            self.running.extend(admitted)
+        # reap BEFORE decoding: a request whose prefill token already
+        # hit max_new/EOS must not buy a decode slot
+        report["finished"].extend(self._reap())
+        if self.running:
+            try:
+                state, _ = self._decode(state, idx)
+                report["decoded"] = [f.req.id for f in self.running]
+            except Exception as e:  # noqa: BLE001 — degrade, keep serving
+                msg = f"{type(e).__name__}: {str(e)[:200]}"
+                self._registry.event("serving_request_error",
+                                     step=idx, error=msg,
+                                     in_flight=len(self.running))
+                _flight.notify("serving_request_error", error=e,
+                               fleet=False,
+                               extra={"step": idx,
+                                      "requests": [str(f.req.id)
+                                                   for f in self.running]})
+                for f in self.running:
+                    self._finish(f, "error", error=msg)
+                    report["finished"].append(f.req.id)
+                self.running = []
+        report["finished"].extend(self._reap())
+        report["blocks_in_use"] = self.cache.blocks_in_use
+        self._publish_gauges()
+        return state, report
+
+
+def serve_loop(batcher: ContinuousBatcher, state, requests:
+               Sequence[Request], *,
+               arrivals: Optional[Sequence[float]] = None,
+               clock: Callable[[], float] = time.perf_counter,
+               sleep: Callable[[float], None] = time.sleep):
+    """Drive ``batcher`` over an arrival schedule until every request
+    finishes; returns ``(final_cache_state, results)``.
+
+    ``arrivals`` are seconds offsets from loop start (default: all at
+    t=0). Submissions happen when the wall clock passes each offset —
+    the serving bench's Poisson schedule goes through here.
+    """
+    order = sorted(range(len(requests)),
+                   key=lambda i: arrivals[i] if arrivals else 0.0)
+    t0 = clock()
+    results: List[RequestResult] = []
+    i = 0
+    while i < len(order) or not batcher.idle():
+        now = clock() - t0
+        while i < len(order) and (
+                not arrivals or arrivals[order[i]] <= now):
+            batcher.submit(requests[order[i]])
+            i += 1
+        if batcher.idle():
+            if i < len(order):
+                sleep(max(0.0, min(arrivals[order[i]] - now, 0.001)))
+            continue
+        state, _ = batcher.step(state)
+        results.extend(batcher.drain())
+    results.extend(batcher.drain())
+    return state, results
+
+
+def static_batch_generate(model, params, cache: KVCache, state,
+                          requests: Sequence[Request], *,
+                          batch_size: int = 8,
+                          arrivals: Optional[Sequence[float]] = None,
+                          clock: Callable[[], float] = time.perf_counter,
+                          sleep: Callable[[float], None] = time.sleep,
+                          step_fn: Optional[DecodeStep] = None,
+                          min_seq_bucket: int = 16,
+                          min_width_bucket: int = 4):
+    """The naive baseline the serving bench compares against: fixed
+    batches in arrival order, each run to the SLOWEST member's last
+    token before the next batch starts — late arrivals wait behind the
+    barrier, early finishers idle inside it. Same jitted steps, same
+    cache machinery; only the scheduling differs. Returns
+    ``(final_cache_state, results)``.
+    """
+    import jax
+
+    step = step_fn if step_fn is not None else make_decode_step(model,
+                                                                cache)
+    t0 = clock()
+    results: List[RequestResult] = []
+    pending = list(requests)
+    submit_at = list(arrivals) if arrivals else [0.0] * len(pending)
+    pos = 0
+    while pos < len(pending):
+        batch = pending[pos:pos + batch_size]
+        t_sub = submit_at[pos:pos + batch_size]
+        pos += len(batch)
+        # the static server cannot start until every member has arrived
+        wait = max(t_sub) - (clock() - t0)
+        if wait > 0:
+            sleep(wait)
+        seqs = []
+        for j, req in enumerate(batch):
+            sid = ("static", pos, j)
+            cache.allocate(sid, len(req.prompt) + req.max_new_tokens)
+            seqs.append(sid)
+        b = bucket(len(batch))
+        s = bucket(max(len(r.prompt) for r in batch), min_seq_bucket)
+        w = bucket(max(len(cache.table(sid)) for sid in seqs),
+                   min_width_bucket)
+        tokens = np.zeros((b, s), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        for j, req in enumerate(batch):
+            tokens[j, :len(req.prompt)] = req.prompt
+            lengths[j] = len(req.prompt)
+        tables = cache.table_array(seqs, w, batch=b)
+        out = step.prefill(params, state, tokens, lengths, tables)
+        jax.block_until_ready(out.next_token)
+        now = clock()
+        state = out.cache
+        gen = [[int(t)] for t in np.asarray(out.next_token)[:len(batch)]]
+        t_first = [now] * len(batch)
+        t_last = [now] * len(batch)
+        # decode until the SLOWEST member is done (no early slot reuse)
+        rounds = max(r.max_new_tokens for r in batch) - 1
+        for _ in range(rounds):
+            toks = np.zeros((b,), np.int32)
+            poss = np.zeros((b,), np.int32)
+            for j, req in enumerate(batch):
+                toks[j] = gen[j][-1]
+                poss[j] = len(req.prompt) + len(gen[j]) - 1
+            out = step.decode(params, state, toks, poss, tables)
+            jax.block_until_ready(out.next_token)
+            now = clock()
+            state = out.cache
+            ids = np.asarray(out.next_token)
+            for j, req in enumerate(batch):
+                if len(gen[j]) < req.max_new_tokens:
+                    gen[j].append(int(ids[j]))
+                    t_last[j] = now
+        for j, req in enumerate(batch):
+            n = len(gen[j])
+            ttft = t_first[j] - (t0 + t_sub[j])
+            tpot = ((t_last[j] - t_first[j]) / (n - 1)) if n > 1 else None
+            results.append(RequestResult(
+                id=req.id, tokens=gen[j], ttft_s=ttft, tpot_s=tpot,
+                finish_reason="length"))
+            cache.free(seqs[j])
+    return state, results
+
+
+__all__ = [
+    "ContinuousBatcher",
+    "Request",
+    "RequestResult",
+    "serve_loop",
+    "static_batch_generate",
+]

@@ -30,6 +30,14 @@ trigger                fired by
                        mismatch); the bundle's ``extra`` carries the
                        layout manifest, the computed restore plan, and
                        per-range fetch/verify status
+``serving_pool_exhausted`` the serving scheduler's admission control hit
+                       an exhausted KV pool (real or injected) and shed
+                       load (``serving.scheduler``, host-local; extra
+                       carries queue depth + blocks in use)
+``serving_request_error`` a serving request failed: rejected as larger
+                       than the whole pool, or an exception escaped the
+                       decode dispatch (host-local; extra names the
+                       request ids)
 ====================== ====================================================
 
 Fleet-level triggers (the guard's, the shutdown's) fire on EVERY

@@ -50,6 +50,11 @@ the driver's no-arg invocation prints only the headline metric):
     python bench.py fleet  # cross-host telemetry aggregation latency +
                            # straggler detection on the 4-host
                            # LocalCollective sim (docs/observability.md)
+    python bench.py serving # continuous-batching serving engine under
+                           # synthetic many-client load (Poisson
+                           # arrivals, mixed lengths): tokens/sec +
+                           # p50/p99 TTFT/TPOT vs the naive
+                           # static-batch loop (docs/serving.md)
 
 Records whose bench computed no in-run baseline no longer carry
 ``"vs_baseline": null``: emit() compares the value against the newest
@@ -1175,6 +1180,162 @@ def bench_fleet():
     }, "fleet")
 
 
+def bench_serving():
+    """Serving-tier accounting (docs/serving.md, ROADMAP item 1):
+    synthetic many-client load — Poisson arrivals, mixed prompt and
+    output lengths — through the continuous-batching engine
+    (apex_tpu/serving) vs the naive static-batch generate loop. Both
+    schedulers share the SAME jitted prefill/decode programs and the
+    same paged KV cache; only the scheduling differs, so the ratio is
+    pure scheduling win (slot backfill vs the slowest-member barrier).
+    Headline: generated tokens/sec under continuous batching; p50/p99
+    TTFT/TPOT for both ride in detail, the in-record static baseline
+    as ``tokens_per_sec_vs_static`` (> 1 = continuous batching wins).
+    ``vs_baseline`` is left to emit()'s prior-run machinery. Knob:
+    ``APEX_TPU_SERVING_REQUESTS`` (default 48 CPU / 128 TPU)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import serving
+    from apex_tpu.models.gpt import GPTConfig, GPTModel
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        cfg = GPTConfig(vocab_size=512, max_seq_len=128, hidden_size=128,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+        n_requests, max_batch = 48, 8
+    else:
+        cfg = GPTConfig(vocab_size=32768, max_seq_len=2048,
+                        hidden_size=1024, num_layers=12, num_heads=16,
+                        num_kv_heads=4, dtype=jnp.bfloat16)
+        n_requests, max_batch = 128, 16
+    n_requests = int(os.environ.get("APEX_TPU_SERVING_REQUESTS",
+                                    n_requests))
+    rng = np.random.RandomState(0)
+    model = GPTModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8)), jnp.int32))
+    cache = serving.KVCache.for_config(
+        cfg, num_blocks=max_batch * 8, block_size=16)
+    step_fn = serving.make_decode_step(model, cache)
+
+    def make_requests(tag):
+        return [serving.Request(
+            id=f"{tag}{i}",
+            prompt=rng.randint(0, cfg.vocab_size,
+                               (int(rng.randint(4, 25)),)),
+            max_new_tokens=int(rng.randint(4, 41)))
+            for i in range(n_requests)]
+
+    # prompts cap at 24 (< 32), so one shared seq bucket serves every
+    # prefill — compile churn stays out of the timed windows
+    seq_bucket = 32
+
+    # warm both paths — every bucketed program (trickle admissions
+    # mint prefill batches of 1, 2, ...; the static loop prefills at
+    # the full batch bucket) compiles off the clock — then calibrate
+    # the decode-step cost so the Poisson offered load sits at ~70% of
+    # engine capacity: queueing happens, collapse doesn't
+    warm_state = cache.init_state()
+    batcher = serving.ContinuousBatcher(
+        model, params, cache, max_batch=max_batch, step_fn=step_fn,
+        min_seq_bucket=seq_bucket)
+    warm_state = batcher.warmup(warm_state)
+    out = step_fn.prefill(
+        params, warm_state,
+        np.zeros((max_batch, seq_bucket), np.int32),
+        np.zeros((max_batch,), np.int32),
+        np.zeros((max_batch, batcher.min_width_bucket), np.int32))
+    warm_state = out.cache
+    jax.block_until_ready(out.next_token)
+    t0 = time.perf_counter()
+    reps = 5
+    tables = np.zeros((max_batch, batcher.min_width_bucket), np.int32)
+    for _ in range(reps):
+        out = step_fn.decode(params, warm_state,
+                             np.zeros(max_batch, np.int32),
+                             np.zeros(max_batch, np.int32), tables)
+        warm_state = out.cache          # the passed-in state is donated
+        jax.block_until_ready(out.next_token)
+    t_decode = (time.perf_counter() - t0) / reps
+    mean_out = (4 + 40) / 2.0
+    capacity_tps = max_batch / t_decode
+    req_rate = 0.7 * capacity_tps / mean_out
+    del warm_state
+
+    def percentiles(vals):
+        if not vals:
+            return {"p50_ms": None, "p99_ms": None}
+        return {"p50_ms": round(float(np.percentile(vals, 50)) * 1e3, 3),
+                "p99_ms": round(float(np.percentile(vals, 99)) * 1e3, 3)}
+
+    def run(kind):
+        reqs = make_requests(kind)
+        arrivals = list(np.cumsum(
+            rng.exponential(1.0 / req_rate, size=n_requests)))
+        state = cache.init_state()
+        t0 = time.perf_counter()
+        if kind == "cb":
+            eng = serving.ContinuousBatcher(
+                model, params, cache, max_batch=max_batch,
+                step_fn=step_fn, min_seq_bucket=seq_bucket)
+            state, results = serving.serve_loop(
+                eng, state, reqs, arrivals=arrivals)
+        else:
+            state, results = serving.static_batch_generate(
+                model, params, cache, state, reqs,
+                batch_size=max_batch, arrivals=arrivals,
+                step_fn=step_fn, min_seq_bucket=seq_bucket)
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in results)
+        del state
+        return {
+            "tokens": toks,
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(toks / wall, 1),
+            "ttft": percentiles([r.ttft_s for r in results
+                                 if r.ttft_s is not None]),
+            "tpot": percentiles([r.tpot_s for r in results
+                                 if r.tpot_s is not None]),
+            "errors": sum(r.finish_reason == "error" for r in results),
+        }
+
+    static = run("static")
+    cb = run("cb")
+    emit({
+        "metric": "serving_continuous_batching_tokens_per_sec",
+        "value": cb["tokens_per_sec"],
+        "unit": ("generated tokens/sec (continuous batching, Poisson "
+                 "arrivals, greedy decode)"),
+        "vs_baseline": None,     # filled from the prior run by emit()
+        "detail": {
+            "n_requests": n_requests,
+            "max_batch": max_batch,
+            "offered_request_rate_per_sec": round(req_rate, 3),
+            "t_decode_step_ms": round(t_decode * 1e3, 3),
+            "continuous": cb,
+            "static_batch": static,
+            "tokens_per_sec_vs_static": round(
+                cb["tokens_per_sec"] / static["tokens_per_sec"], 4),
+            "ttft_p99_vs_static": (
+                round(cb["ttft"]["p99_ms"] / static["ttft"]["p99_ms"], 4)
+                if cb["ttft"]["p99_ms"] and static["ttft"]["p99_ms"]
+                else None),
+            "compile_keys": step_fn.compile_keys(),
+            "kv_pool": {"num_blocks": cache.num_blocks,
+                        "block_size": cache.block_size,
+                        "kv_heads": cache.kv_heads,
+                        "pool_mb": round(cache.pool_bytes() / 1e6, 2)},
+            **backend_detail(),
+        },
+    }, "serving")
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -1580,7 +1741,8 @@ if __name__ == "__main__":
 
         modes = {"moe": bench_moe, "gpt": bench_gpt, "attn": bench_attn,
                  "resnet": bench_resnet, "bert": bench_bert,
-                 "resilience": bench_resilience, "fleet": bench_fleet}
+                 "resilience": bench_resilience, "fleet": bench_fleet,
+                 "serving": bench_serving}
         sweep = [("headline", main)] + list(modes.items())
 
         def run_all():

@@ -13,8 +13,8 @@ import pytest
 SURFACE = {
     "apex_tpu": ["amp", "optimizers", "normalization", "parallel",
                  "transformer", "contrib", "multi_tensor", "moe", "rnn",
-                 "fp16_utils", "runtime", "resilience", "profiler",
-                 "testing"],
+                 "fp16_utils", "runtime", "resilience", "serving",
+                 "profiler", "testing"],
     "apex_tpu.resilience": [
         "CheckpointManager", "CheckpointError", "RestoredState",
         "NonfiniteWatchdog", "RollbackLimitExceeded", "FaultInjector",
@@ -113,6 +113,11 @@ SURFACE = {
     "apex_tpu.models.resnet": None,
     "apex_tpu.models.pretrain": [
         "init_gpt_pretrain_params", "make_gpt_pretrain_step",
+    ],
+    "apex_tpu.serving": [
+        "KVCache", "KVCacheState", "PoolExhausted", "make_decode_step",
+        "DecodeStep", "ContinuousBatcher", "Request", "RequestResult",
+        "serve_loop", "static_batch_generate", "gather_kv", "append_kv",
     ],
     "apex_tpu.runtime": [
         "HostFlatSpace", "PrefetchLoader", "cast_bf16_f32",

@@ -1,0 +1,574 @@
+"""Serving tier (apex_tpu/serving, docs/serving.md): paged KV cache,
+donation-aware prefill/decode steps, and the continuous batcher.
+
+Anchors:
+
+- prefill-then-N-decode-steps matches the full-sequence forward within
+  fp32 tolerance (the decode-parity contract), and the cache
+  write-then-gather path is BITWISE (pure data movement);
+- block-table reuse-after-free correctness and admission-control
+  refusal at pool exhaustion;
+- scheduler join/evict golden sequences, the fault drills
+  (``serving_pool_exhausted`` / ``decode_step_exception``), and the
+  compile-plane contract (bucketed shapes; zero recompiles after
+  warmup).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu import serving, telemetry  # noqa: E402
+from apex_tpu.models.gpt import GPTConfig, GPTModel  # noqa: E402
+from apex_tpu.resilience import faults  # noqa: E402
+from apex_tpu.serving.kv_cache import (  # noqa: E402
+    KVCache,
+    PoolExhausted,
+    append_kv,
+    append_kv_prefill,
+    bucket,
+    gather_kv,
+)
+
+VOCAB, SEQ, HID, LAYERS, HEADS, KV = 64, 64, 32, 2, 4, 2
+BLOCKS, BS = 16, 4
+
+
+def tiny_config(**kw):
+    base = dict(vocab_size=VOCAB, max_seq_len=SEQ, hidden_size=HID,
+                num_layers=LAYERS, num_heads=HEADS, num_kv_heads=KV,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def fresh_cache(num_blocks=BLOCKS, block_size=BS):
+    return KVCache(LAYERS, KV, HID // HEADS, num_blocks=num_blocks,
+                   block_size=block_size, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = GPTModel(tiny_config())
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, VOCAB, (1, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def step_fn(model_and_params):
+    # ONE DecodeStep for the whole module: jax.jit caches by function
+    # identity, so sharing it means each bucketed shape compiles once
+    # across every test below
+    model, _ = model_and_params
+    return serving.make_decode_step(model, fresh_cache())
+
+
+def make_batcher(model, params, step_fn, cache, **kw):
+    reg = telemetry.MetricsRegistry()
+    sink = telemetry.InMemorySink()
+    reg.add_sink(sink)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_prefill_batch", 2)
+    b = serving.ContinuousBatcher(model, params, cache, step_fn=step_fn,
+                                  registry=reg, **kw)
+    return b, reg, sink
+
+
+# ---------------------------------------------------------------------------
+# allocator
+# ---------------------------------------------------------------------------
+
+
+class TestAllocator:
+    def test_blocks_for(self):
+        c = fresh_cache()
+        assert c.blocks_for(1) == 1
+        assert c.blocks_for(BS) == 1
+        assert c.blocks_for(BS + 1) == 2
+        assert c.blocks_for(0) == 1          # a sequence occupies space
+
+    def test_allocate_free_reuse(self):
+        c = fresh_cache()
+        a = c.allocate("a", 2 * BS)
+        b = c.allocate("b", 2 * BS)
+        assert len(a) == 2 and len(b) == 2
+        assert not set(a) & set(b)
+        assert serving.TRASH_BLOCK not in a + b
+        assert c.blocks_in_use == 4
+        c.free("a")
+        assert c.blocks_in_use == 2
+        # reuse-after-free: the freed blocks are handed out again
+        c2 = c.allocate("c", 2 * BS)
+        assert set(c2) == set(a)
+        assert c.blocks_in_use == 4
+
+    def test_admission_refusal_at_exhaustion(self):
+        c = fresh_cache(num_blocks=4)
+        c.allocate("a", 3 * BS)
+        assert not c.can_admit(2 * BS)
+        with pytest.raises(PoolExhausted) as ei:
+            c.allocate("b", 2 * BS)
+        assert ei.value.needed == 2
+        assert ei.value.free == 1
+        assert c.blocks_in_use == 3          # refusal leaks nothing
+        assert c.can_admit(BS)
+        c.allocate("b", BS)
+
+    def test_double_allocate_raises(self):
+        c = fresh_cache()
+        c.allocate("a", BS)
+        with pytest.raises(ValueError, match="already allocated"):
+            c.allocate("a", BS)
+
+    def test_table_array(self):
+        c = fresh_cache()
+        c.allocate("a", 2 * BS)
+        t = c.table_array(["a"], width=4, batch=3)
+        assert t.shape == (3, 4)
+        assert list(t[0, :2]) == c.table("a")
+        assert (t[0, 2:] == serving.TRASH_BLOCK).all()
+        assert (t[1:] == serving.TRASH_BLOCK).all()
+        with pytest.raises(ValueError, match="width"):
+            c.table_array(["a"], width=1)
+
+    def test_free_unknown_is_noop(self):
+        c = fresh_cache()
+        assert c.free("nope") == 0
+
+
+# ---------------------------------------------------------------------------
+# pool ops: append + gather is bitwise
+# ---------------------------------------------------------------------------
+
+
+class TestPoolOps:
+    def test_prefill_append_then_gather_bitwise(self):
+        c = fresh_cache()
+        state = c.init_state()
+        rng = np.random.RandomState(1)
+        s, b, d = 10, 2, HID // HEADS
+        k = jnp.asarray(rng.randn(LAYERS, b, KV, s, d), jnp.float32)
+        v = jnp.asarray(rng.randn(LAYERS, b, KV, s, d), jnp.float32)
+        for i in range(b):
+            c.allocate(i, s)
+        tables = jnp.asarray(c.table_array([0, 1], width=3))
+        lengths = jnp.asarray([s, 7], jnp.int32)
+        state = append_kv_prefill(state, k, v, tables, lengths)
+        gk, gv = gather_kv(state, tables)
+        assert gk.shape == (LAYERS, b, KV, 3 * BS, d)
+        # bitwise: the gathered prefix IS the written bytes
+        np.testing.assert_array_equal(np.asarray(gk)[:, 0, :, :s],
+                                      np.asarray(k)[:, 0])
+        np.testing.assert_array_equal(np.asarray(gv)[:, 1, :, :7],
+                                      np.asarray(v)[:, 1, :, :7])
+
+    def test_prefill_pads_land_in_trash(self):
+        c = fresh_cache()
+        state = c.init_state()
+        rng = np.random.RandomState(2)
+        s, d = 8, HID // HEADS
+        c.allocate("real", 2 * BS)
+        c.allocate("victim", 2 * BS)
+        k = jnp.asarray(rng.randn(LAYERS, 1, KV, s, d), jnp.float32)
+        # write the victim's full 8 slots first
+        vt = jnp.asarray(c.table_array(["victim"], width=2))
+        state = append_kv_prefill(state, k, k, vt,
+                                  jnp.asarray([s], jnp.int32))
+        before = np.asarray(gather_kv(state, vt)[0])
+        # now a short prefill on "real": positions >= length are pads
+        rt = jnp.asarray(c.table_array(["real"], width=2))
+        state = append_kv_prefill(state, k, k, rt,
+                                  jnp.asarray([3], jnp.int32))
+        after = np.asarray(gather_kv(state, vt)[0])
+        np.testing.assert_array_equal(before, after)
+
+    def test_single_token_append_bitwise(self):
+        c = fresh_cache()
+        state = c.init_state()
+        rng = np.random.RandomState(3)
+        d = HID // HEADS
+        c.allocate("a", 3 * BS)
+        tables = jnp.asarray(c.table_array(["a"], width=3))
+        rows = []
+        for t in range(2 * BS + 1):      # crosses a block boundary
+            kt = jnp.asarray(rng.randn(LAYERS, 1, KV, d), jnp.float32)
+            rows.append(np.asarray(kt))
+            state = append_kv(state, kt, kt, tables,
+                              jnp.asarray([t], jnp.int32))
+        gk, _ = gather_kv(state, tables)
+        got = np.asarray(gk)[:, 0]            # (LAYERS, KV, 3*BS, d)
+        for t, row in enumerate(rows):
+            np.testing.assert_array_equal(got[:, :, t], row[:, 0])
+
+
+# ---------------------------------------------------------------------------
+# decode parity vs the full-sequence forward
+# ---------------------------------------------------------------------------
+
+
+class TestDecodeParity:
+    def _parity(self, model, params, step_fn, plens, n_decode, tol=3e-5):
+        rng = np.random.RandomState(7)
+        b = len(plens)
+        s = max(plens) + n_decode
+        toks = rng.randint(0, VOCAB, (b, s)).astype(np.int32)
+        full = np.asarray(model.apply(params, jnp.asarray(toks)))
+        cache = fresh_cache()
+        state = cache.init_state()
+        for i in range(b):
+            cache.allocate(i, s)
+        w = max(len(cache.table(i)) for i in range(b))
+        tables = cache.table_array(list(range(b)), w)
+        out = step_fn.prefill(params, state, toks[:, :max(plens)],
+                              np.asarray(plens, np.int32), tables)
+        state = out.cache
+        got = np.asarray(out.logits)
+        for i in range(b):
+            ref = full[plens[i] - 1, i]
+            np.testing.assert_allclose(got[i], ref, atol=tol, rtol=tol)
+        positions = np.asarray(plens, np.int32)
+        for _ in range(n_decode):
+            cur = toks[np.arange(b), positions]       # teacher forcing
+            out = step_fn.decode(params, state, cur, positions, tables)
+            state = out.cache
+            got = np.asarray(out.logits)
+            ids = np.asarray(out.next_token)
+            for i in range(b):
+                ref = full[positions[i], i]
+                np.testing.assert_allclose(got[i], ref, atol=tol,
+                                           rtol=tol)
+                assert ids[i] == int(np.argmax(got[i]))
+            positions = positions + 1
+
+    def test_prefill_then_decode_matches_full_forward(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        # mixed lengths in one batch: every sequence sits at its own
+        # offset — the per-sequence positions/ctx_mask contract
+        self._parity(model, params, step_fn, plens=[12, 7], n_decode=6)
+
+    def test_parity_unscanned_layers(self):
+        # scan_layers=False takes the python-loop path through the new
+        # kv plumbing; same parity contract
+        model = GPTModel(tiny_config(scan_layers=False))
+        rng = np.random.RandomState(0)
+        toks = jnp.asarray(rng.randint(0, VOCAB, (1, 8)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), toks)
+        cache = fresh_cache()
+        step = serving.make_decode_step(model, cache)
+        self._parity(model, params, step, plens=[6, 9], n_decode=3)
+
+    def test_explicit_positions_match_default(self, model_and_params):
+        # the satellite anchor: positions are an explicit input, not
+        # arange(seq) derived from the input shape — (s,) and (b, s)
+        # forms agree with the default bitwise
+        model, params = model_and_params
+        rng = np.random.RandomState(9)
+        toks = jnp.asarray(rng.randint(0, VOCAB, (2, 10)), jnp.int32)
+        base = model.apply(params, toks)
+        p1 = model.apply(params, toks,
+                         positions=jnp.arange(10, dtype=jnp.int32))
+        p2 = model.apply(params, toks, positions=jnp.broadcast_to(
+            jnp.arange(10, dtype=jnp.int32)[None], (2, 10)))
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(p1))
+        np.testing.assert_array_equal(np.asarray(base), np.asarray(p2))
+
+    def test_single_token_forward_at_offset(self, model_and_params):
+        # a one-token forward at position t (no cache, no prefix) uses
+        # exactly the position-t embedding row
+        model, params = model_and_params
+        tok = jnp.asarray([[5]], jnp.int32)
+        a = model.apply(params, tok,
+                        positions=jnp.asarray([3], jnp.int32))
+        b = model.apply(params, tok,
+                        positions=jnp.asarray([[3]], jnp.int32))
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = model.apply(params, tok,
+                        positions=jnp.asarray([[4]], jnp.int32))
+        assert np.abs(np.asarray(b) - np.asarray(c)).max() > 0
+
+
+# ---------------------------------------------------------------------------
+# scheduler
+# ---------------------------------------------------------------------------
+
+
+class TestScheduler:
+    def test_join_evict_golden(self, model_and_params, step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, reg, _ = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        r = [serving.Request(id=i, prompt=[1 + i] * 5, max_new_tokens=n)
+             for i, n in enumerate([2, 4, 4])]
+        eng.submit(r[0])
+        eng.submit(r[1])
+        eng.submit(r[2])
+        # step 0: two admissions (max_prefill_batch=2), both prefill
+        # (first token) + decode (second); r2 queued
+        state, rep = eng.step(state)
+        assert rep["admitted"] == [0, 1]
+        assert rep["decoded"] == [0, 1]
+        assert rep["queued"] == 1
+        assert rep["finished"] == [0]          # max_new=2: done already
+        # step 1: r2 joins the in-flight r1 — the continuous join
+        state, rep = eng.step(state)
+        assert rep["admitted"] == [2]
+        assert rep["decoded"] == [1, 2]
+        assert rep["finished"] == []
+        blocks_mid = rep["blocks_in_use"]
+        # step 2: r1 finishes (4 tokens) and frees its blocks
+        state, rep = eng.step(state)
+        assert rep["finished"] == [1]
+        assert rep["blocks_in_use"] < blocks_mid
+        # drain to completion
+        while not eng.idle():
+            state, rep = eng.step(state)
+        assert rep["finished"] == [2]
+        assert cache.blocks_in_use == 0
+        res = {x.id: x for x in eng.drain()}
+        assert [len(res[i].tokens) for i in range(3)] == [2, 4, 4]
+        assert all(res[i].finish_reason == "length" for i in range(3))
+        assert reg.gauge("serving_kv_blocks_in_use").value() == 0
+        assert reg.counter("serving_requests").value(
+            outcome="length") == 3
+
+    def test_admission_defers_until_blocks_free(self, model_and_params,
+                                                step_fn):
+        model, params = model_and_params
+        # pool fits ONE request's span (3 blocks of 4 = prompt 5 +
+        # max_new 6 = 11 tokens); the second must wait for the first
+        cache = fresh_cache(num_blocks=3)
+        eng, reg, _ = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        eng.submit(serving.Request(id="a", prompt=[1] * 5,
+                                   max_new_tokens=6))
+        eng.submit(serving.Request(id="b", prompt=[2] * 5,
+                                   max_new_tokens=6))
+        state, rep = eng.step(state)
+        assert rep["admitted"] == ["a"]
+        assert rep["queued"] == 1
+        assert reg.counter("serving_admission_deferred").value() >= 1
+        admitted_b_at = None
+        for i in range(1, 20):
+            state, rep = eng.step(state)
+            if rep["admitted"] == ["b"]:
+                admitted_b_at = i
+            if eng.idle():
+                break
+        assert admitted_b_at is not None
+        res = {x.id: x for x in eng.drain()}
+        assert res["a"].finish_reason == "length"
+        assert res["b"].finish_reason == "length"
+        assert res["b"].ttft_s > res["a"].ttft_s
+
+    def test_oversized_request_rejected(self, model_and_params, step_fn):
+        model, params = model_and_params
+        cache = fresh_cache(num_blocks=2)
+        eng, reg, sink = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        eng.submit(serving.Request(id="big", prompt=[1] * 8,
+                                   max_new_tokens=32))
+        state, rep = eng.step(state)
+        assert rep["admitted"] == []
+        res = eng.drain()
+        assert len(res) == 1 and res[0].finish_reason == "error"
+        assert "can never be admitted" in res[0].error
+        names = [e["event"] for e in sink.events]
+        assert "serving_request_error" in names
+
+    def test_eos_finishes_early(self, model_and_params, step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, _, _ = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        # greedy decode is deterministic: learn the tokens, then rerun
+        # with eos = the 2nd generated token
+        eng.submit(serving.Request(id=0, prompt=[3] * 6,
+                                   max_new_tokens=6))
+        while not eng.idle():
+            state, _ = eng.step(state)
+        ref = eng.drain()[0]
+        assert len(ref.tokens) == 6
+        eos = ref.tokens[1]
+        eng.submit(serving.Request(id=1, prompt=[3] * 6,
+                                   max_new_tokens=6, eos_id=eos))
+        while not eng.idle():
+            state, _ = eng.step(state)
+        out = eng.drain()[0]
+        assert out.finish_reason == "eos"
+        assert out.tokens == ref.tokens[:ref.tokens.index(eos) + 1]
+        assert cache.blocks_in_use == 0
+
+    def test_serve_loop_completes_all(self, model_and_params, step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, _, _ = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        rng = np.random.RandomState(4)
+        reqs = [serving.Request(
+            id=i, prompt=rng.randint(0, VOCAB, (rng.randint(2, 9),)),
+            max_new_tokens=int(rng.randint(1, 6))) for i in range(9)]
+        state, results = serving.serve_loop(eng, state, reqs)
+        assert sorted(r.id for r in results) == list(range(9))
+        for r in results:
+            req = reqs[r.id]
+            assert len(r.tokens) == req.max_new_tokens
+            assert r.ttft_s is not None and r.ttft_s >= 0
+        assert cache.blocks_in_use == 0
+
+    def test_static_batch_generate_same_tokens(self, model_and_params,
+                                               step_fn):
+        # the bench baseline produces the SAME greedy tokens as the
+        # continuous engine — only scheduling differs
+        model, params = model_and_params
+        rng = np.random.RandomState(5)
+        reqs = [serving.Request(
+            id=i, prompt=rng.randint(0, VOCAB, (rng.randint(2, 9),)),
+            max_new_tokens=int(rng.randint(2, 6))) for i in range(5)]
+        cache = fresh_cache()
+        eng, _, _ = make_batcher(model, params, step_fn, cache)
+        state, cb = serving.serve_loop(eng, cache.init_state(), reqs)
+        cache2 = fresh_cache()
+        _, st = serving.static_batch_generate(
+            model, params, cache2, cache2.init_state(), reqs,
+            batch_size=4, step_fn=step_fn)
+        cb = {r.id: r.tokens for r in cb}
+        st = {r.id: r.tokens for r in st}
+        assert cb == st
+
+
+# ---------------------------------------------------------------------------
+# fault drills + flight bundles
+# ---------------------------------------------------------------------------
+
+
+class TestFaultDrills:
+    def test_pool_exhausted_sheds_load(self, model_and_params, step_fn,
+                                       tmp_path, monkeypatch):
+        from apex_tpu import records
+        from apex_tpu.telemetry import flight
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, reg, sink = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        flight.enable()
+        try:
+            with faults.inject(pool_exhausted_steps=frozenset({0})):
+                eng.submit(serving.Request(id=0, prompt=[1] * 4,
+                                           max_new_tokens=2))
+                state, rep = eng.step(state)
+                # shed: stays queued, nothing admitted, event + bundle
+                assert rep["admitted"] == []
+                assert rep["queued"] == 1
+                names = [e["event"] for e in sink.events]
+                assert "serving_pool_exhausted" in names
+                # next step admits normally (the fault names step 0)
+                state, rep = eng.step(state)
+                assert rep["admitted"] == [0]
+        finally:
+            flight.disable()
+        rec = records.latest_record(flight.FLIGHT_KIND,
+                                    require_backend=None)
+        assert rec is not None
+        assert rec["payload"]["trigger"] == "serving_pool_exhausted"
+        while not eng.idle():
+            state, _ = eng.step(state)
+        assert eng.drain()[0].finish_reason == "length"
+
+    def test_decode_exception_fails_in_flight_and_continues(
+            self, model_and_params, step_fn, tmp_path, monkeypatch):
+        from apex_tpu import records
+        from apex_tpu.telemetry import flight
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, reg, sink = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        flight.enable()
+        try:
+            with faults.inject(decode_exception_steps=frozenset({0})):
+                eng.submit(serving.Request(id="dead", prompt=[1] * 4,
+                                           max_new_tokens=4))
+                state, rep = eng.step(state)
+                assert rep["finished"] == ["dead"]
+                # degradation: blocks freed, bundle dumped, error result
+                assert cache.blocks_in_use == 0
+                res = eng.drain()
+                assert res[0].finish_reason == "error"
+                assert "injected decode-step exception" in res[0].error
+            # engine keeps serving after the fault window
+            eng.submit(serving.Request(id="alive", prompt=[2] * 4,
+                                       max_new_tokens=2))
+            while not eng.idle():
+                state, _ = eng.step(state)
+            assert eng.drain()[0].finish_reason == "length"
+        finally:
+            flight.disable()
+        rec = records.latest_record(flight.FLIGHT_KIND,
+                                    require_backend=None)
+        assert rec is not None
+        assert rec["payload"]["trigger"] == "serving_request_error"
+        assert "dead" in str(rec["payload"]["extra"]["requests"])
+
+    def test_env_knob_grammar(self):
+        inj = faults.FaultInjector.from_env(
+            "serving_pool_exhausted=2,5;decode_step_exception=3")
+        assert inj.should_pool_exhaust(2)
+        assert inj.should_pool_exhaust(5)
+        assert not inj.should_pool_exhaust(3)
+        with pytest.raises(faults.FaultError):
+            inj.maybe_decode_exception(3)
+        inj.maybe_decode_exception(2)        # no-op off-plan
+
+
+# ---------------------------------------------------------------------------
+# compile plane: bucketed shapes, zero recompiles after warmup
+# ---------------------------------------------------------------------------
+
+
+class TestCompilePlane:
+    def test_decode_buckets_observed_no_recompiles_after_warmup(
+            self, model_and_params):
+        from apex_tpu.telemetry import compiled as _compiled
+
+        model, params = model_and_params
+        cache = fresh_cache()
+        step = serving.make_decode_step(model, cache)
+        reg = telemetry.MetricsRegistry()
+        sink = telemetry.InMemorySink()
+        reg.add_sink(sink)
+        tracker = _compiled.enable(registry=reg, storm_threshold=100)
+        try:
+            eng = serving.ContinuousBatcher(
+                model, params, cache, step_fn=step, max_batch=4,
+                max_prefill_batch=2, registry=reg)
+            state = eng.warmup(cache.init_state())
+            warm_events = [e["event"] for e in sink.events]
+            n_warm_recompiles = warm_events.count("recompile")
+            keys = step.compile_keys()
+            # decode pads to max_batch with one width bucket: ONE program
+            assert keys["decode_step"] == 1
+            # prefill: batch buckets {1, 2} x one seq bucket
+            assert keys["prefill_step"] == 2
+            assert tracker.summary()["signatures"]["decode_step"] == 1
+            # hot loop: everything is a cache hit — zero NEW events
+            rng = np.random.RandomState(6)
+            reqs = [serving.Request(
+                id=i, prompt=rng.randint(0, VOCAB, (rng.randint(2, 9),)),
+                max_new_tokens=int(rng.randint(1, 5)))
+                for i in range(8)]
+            state, results = serving.serve_loop(eng, state, reqs)
+            assert len(results) == 8
+            hot_events = [e["event"] for e in sink.events]
+            assert hot_events.count("recompile") == n_warm_recompiles
+            assert step.compile_keys() == keys
+        finally:
+            _compiled.disable()

@@ -1,0 +1,136 @@
+#!/usr/bin/env bash
+# Serving smoke (CI / pre-merge, next to check_telemetry.sh): the
+# serving unit tier, then a 200-request continuous-batching run under
+# JAX_PLATFORMS=cpu with the compile tracker ARMED, asserting
+#  - continuous batching beats the naive static-batch baseline on
+#    generated tokens/sec (same jitted programs, same cache — the win
+#    is pure scheduling),
+#  - exactly the expected decode-bucket compile count (ONE program:
+#    decode pads to max_batch over one table-width bucket), and
+#  - ZERO decode recompile events after warmup (no recompile storm in
+#    the hot loop — docs/serving.md "compile plane").
+# Extra args pass through to pytest.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+
+rc=0
+
+python -m pytest tests/test_serving.py "$@" -q \
+    -p no:cacheprovider || rc=1
+
+echo "== 200-request smoke: continuous batching vs static batch =="
+python - <<'PY' || rc=1
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import serving, telemetry
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.telemetry import compiled as _compiled
+
+cfg = GPTConfig(vocab_size=512, max_seq_len=128, hidden_size=128,
+                num_layers=2, num_heads=4, num_kv_heads=2,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+model = GPTModel(cfg)
+rng = np.random.RandomState(0)
+params = model.init(jax.random.PRNGKey(0),
+                    jnp.asarray(rng.randint(0, 512, (1, 8)), jnp.int32))
+MAX_BATCH = 8
+cache = serving.KVCache.for_config(cfg, num_blocks=MAX_BATCH * 8,
+                                   block_size=16)
+step_fn = serving.make_decode_step(model, cache)
+
+N = 200
+def make_requests(tag):
+    return [serving.Request(
+        id=f"{tag}{i}",
+        prompt=rng.randint(0, 512, (int(rng.randint(4, 25)),)),
+        max_new_tokens=int(rng.randint(4, 41))) for i in range(N)]
+
+reg = telemetry.MetricsRegistry()
+sink = telemetry.InMemorySink()
+reg.add_sink(sink)
+tracker = _compiled.enable(registry=reg)
+try:
+    eng = serving.ContinuousBatcher(model, params, cache,
+                                    max_batch=MAX_BATCH, step_fn=step_fn,
+                                    min_seq_bucket=32, registry=reg)
+    state = eng.warmup(cache.init_state())
+    out = step_fn.prefill(        # the static loop's full-batch bucket
+        params, state, np.zeros((MAX_BATCH, 32), np.int32),
+        np.zeros((MAX_BATCH,), np.int32),
+        np.zeros((MAX_BATCH, eng.min_width_bucket), np.int32))
+    state = out.cache
+    jax.block_until_ready(out.next_token)
+    del state
+
+    warm_decode_sigs = tracker.summary()["signatures"]["decode_step"]
+    warm_events = [e["event"] for e in sink.events
+                   if "decode_step" in str(e.get("fn", ""))]
+    # warmup deliberately mints every bucketed program back-to-back —
+    # storms there are by construction; the contract is the HOT LOOP
+    n_warm_storms = sum(e["event"] == "recompile_storm"
+                        for e in sink.events)
+
+    # static baseline first (burst arrivals: the barrier cost is the
+    # whole story), then continuous batching on the same workload
+    state = cache.init_state()
+    t0 = time.perf_counter()
+    state, st_res = serving.static_batch_generate(
+        model, params, cache, state, make_requests("s"),
+        batch_size=MAX_BATCH, step_fn=step_fn, min_seq_bucket=32)
+    st_wall = time.perf_counter() - t0
+    st_toks = sum(len(r.tokens) for r in st_res)
+    del state
+
+    state = cache.init_state()
+    t0 = time.perf_counter()
+    state, cb_res = serving.serve_loop(eng, state, make_requests("c"))
+    cb_wall = time.perf_counter() - t0
+    cb_toks = sum(len(r.tokens) for r in cb_res)
+
+    st_tps = st_toks / st_wall
+    cb_tps = cb_toks / cb_wall
+    ttft = sorted(r.ttft_s for r in cb_res)
+    print(f"static : {st_toks} tokens in {st_wall:.2f}s = {st_tps:.0f} tok/s")
+    print(f"contin.: {cb_toks} tokens in {cb_wall:.2f}s = {cb_tps:.0f} tok/s "
+          f"({cb_tps / st_tps:.2f}x)  ttft p50 "
+          f"{ttft[len(ttft)//2]*1e3:.1f}ms")
+    assert len(cb_res) == N and len(st_res) == N
+    assert all(r.finish_reason == "length" for r in cb_res), \
+        "continuous run had non-length finishes"
+    assert cb_tps > st_tps, (
+        f"continuous batching ({cb_tps:.0f} tok/s) must beat the "
+        f"static-batch baseline ({st_tps:.0f} tok/s)")
+
+    # compile plane: decode = exactly ONE bucketed program, and the
+    # 200-request hot loop minted no new decode signatures (zero
+    # recompile events after warmup — no storm)
+    keys = step_fn.compile_keys()
+    assert keys["decode_step"] == 1, keys
+    sigs = tracker.summary()["signatures"]["decode_step"]
+    assert sigs == warm_decode_sigs == 1, (sigs, warm_decode_sigs)
+    hot_decode_events = [
+        e["event"] for e in sink.events
+        if "decode_step" in str(e.get("fn", ""))]
+    assert hot_decode_events == warm_events, (
+        f"decode recompile events after warmup: {hot_decode_events}")
+    storms = [e for e in sink.events if e["event"] == "recompile_storm"]
+    assert len(storms) == n_warm_storms, (
+        f"recompile storm escalated in the hot loop: "
+        f"{storms[n_warm_storms:]}")
+    print(f"compile plane OK: {keys}, decode signatures={sigs}, "
+          f"zero hot-loop recompiles, no storms")
+finally:
+    _compiled.disable()
+PY
+
+if [ "$rc" -ne 0 ]; then
+    echo "check_serving: FAILED" >&2
+else
+    echo "check_serving: OK"
+fi
+exit "$rc"
