@@ -102,6 +102,50 @@ def host_dirname(process_id: int) -> str:
     return f"host_{int(process_id):04d}"
 
 
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of a directory entry (the rename is not
+    durable until its parent directory is)."""
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def atomic_write_files(final: str, files: Dict[str, bytes], *,
+                       fsync: bool = True) -> None:
+    """The checkpoint write discipline as a reusable primitive: stage
+    ``files`` (name -> bytes) into ``<final>.tmp-<pid>-<ns>``, fsync
+    each file and the temp dir, then ``os.rename`` the directory into
+    place (replacing any previous ``final``) and fsync the parent. A
+    crash at any point leaves either the previous ``final`` untouched
+    or a stale ``*.tmp-*`` directory no reader ever considers — the
+    serving drain snapshot (serving/resilience.py) commits through
+    here."""
+    tmp = f"{final}.tmp-{os.getpid()}-{time.monotonic_ns()}"
+    os.makedirs(tmp)
+    try:
+        for name, data in files.items():
+            with open(os.path.join(tmp, name), "wb") as f:
+                f.write(data)
+                f.flush()
+                if fsync:
+                    os.fsync(f.fileno())
+        if fsync:
+            fsync_dir(tmp)
+        if os.path.exists(final):
+            shutil.rmtree(final)
+        os.rename(tmp, final)
+        if fsync:
+            fsync_dir(os.path.dirname(final) or ".")
+    except BaseException:
+        shutil.rmtree(tmp, ignore_errors=True)
+        raise
+
+
 class CheckpointError(RuntimeError):
     """Unusable checkpoint (missing, corrupt, or layout-mismatched)."""
 
@@ -477,16 +521,8 @@ class CheckpointManager:
             shutil.rmtree(tmp, ignore_errors=True)
             raise
 
-    @staticmethod
-    def _fsync_dir(path: str) -> None:
-        try:
-            fd = os.open(path, os.O_RDONLY)
-        except OSError:
-            return
-        try:
-            os.fsync(fd)
-        finally:
-            os.close(fd)
+    # module-level fsync_dir, kept as a method for existing callers
+    _fsync_dir = staticmethod(fsync_dir)
 
     def _prune(self) -> None:
         steps = self.all_steps()
@@ -766,4 +802,4 @@ class CheckpointManager:
 
 __all__ = ["CheckpointError", "CheckpointManager", "RestoredState",
            "FORMAT_VERSION", "MANIFEST", "PAYLOAD", "COMMIT",
-           "host_dirname"]
+           "atomic_write_files", "fsync_dir", "host_dirname"]

@@ -85,11 +85,32 @@ Serving sites (apex_tpu/serving/scheduler.py, docs/serving.md):
                                  running, and dump a flight bundle
 - ``decode_step_exception=<steps>`` the decode dispatch at these
                                  engine steps raises ``FaultError`` —
-                                 the scheduler must finish in-flight
-                                 requests with an error, free their
-                                 blocks, dump a bundle, and keep
-                                 serving the queue (``io:decode_step``
-                                 injects by CALL index instead)
+                                 the scheduler's binary-split isolation
+                                 retries the batch; a step-level fault
+                                 fails every sub-dispatch too, so the
+                                 whole batch quarantines (blocks freed,
+                                 ``serving_quarantine`` bundle, queue
+                                 keeps serving). ``io:decode_step``
+                                 injects by CALL index instead — a
+                                 single transient index is absorbed by
+                                 the retry with ZERO quarantines
+- ``decode_nonfinite=<steps>``   poison ONE batch lane's cached K/V
+                                 with NaN before the decode dispatch at
+                                 these engine steps — the lane's logits
+                                 come out nonfinite through the REAL
+                                 attention path and the engine must
+                                 quarantine only that sequence
+- ``decode_nonfinite_lane=<i>``  which in-flight lane takes the NaN
+                                 (default: lane 0)
+- ``serving_snapshot_corrupt=<idx>`` truncate the serving drain
+                                 snapshot payload AFTER it is finalized
+                                 at these 0-based save indices — the
+                                 committed-but-rotten snapshot
+                                 ``latest_snapshot`` must refuse
+- ``weight_swap_mismatch=<idx>`` force ``swap_weights`` validation to
+                                 report a signature mismatch at these
+                                 0-based swap indices — drills the
+                                 structured-rejection path end to end
 """
 
 from __future__ import annotations
@@ -143,9 +164,13 @@ class FaultInjector:
     shard_truncate_host: int = 0
     world_mismatch_steps: FrozenSet[int] = frozenset()
     range_fetch_timeout: FrozenSet[int] = frozenset()
-    # serving sites (apex_tpu/serving/scheduler.py)
+    # serving sites (apex_tpu/serving/scheduler.py, serving/resilience.py)
     pool_exhausted_steps: FrozenSet[int] = frozenset()
     decode_exception_steps: FrozenSet[int] = frozenset()
+    decode_nonfinite_steps: FrozenSet[int] = frozenset()
+    decode_nonfinite_lane: int = 0
+    snapshot_corrupt_indices: FrozenSet[int] = frozenset()
+    weight_swap_mismatch_indices: FrozenSet[int] = frozenset()
 
     def __post_init__(self):
         self._counts: Dict[str, int] = {}
@@ -266,6 +291,26 @@ class FaultInjector:
                 f"injected decode-step exception at engine step "
                 f"{int(step)}")
 
+    def nonfinite_lane_at(self, step: int) -> Optional[int]:
+        """In-flight lane whose cached K/V the serving engine poisons
+        with NaN before the decode dispatch at ``step`` (the lane's
+        logits then come out nonfinite through the real attention
+        path), or None off-plan."""
+        if int(step) in self.decode_nonfinite_steps:
+            return int(self.decode_nonfinite_lane)
+        return None
+
+    def should_snapshot_corrupt(self, index: int) -> bool:
+        """True when the serving drain snapshot save number ``index``
+        (0-based, per engine) must be truncated AFTER finalize — the
+        committed-but-rotten snapshot the loader must refuse."""
+        return int(index) in self.snapshot_corrupt_indices
+
+    def should_weight_swap_mismatch(self, index: int) -> bool:
+        """True when ``swap_weights`` call number ``index`` (0-based,
+        per engine) must report a forced signature mismatch."""
+        return int(index) in self.weight_swap_mismatch_indices
+
     def maybe_sigterm(self, step: int) -> None:
         """Deliver a REAL SIGTERM to this process at planned steps —
         the deterministic stand-in for the scheduler's preemption
@@ -319,6 +364,14 @@ class FaultInjector:
                 kw["pool_exhausted_steps"] = _int_set(val)
             elif key == "decode_step_exception":
                 kw["decode_exception_steps"] = _int_set(val)
+            elif key == "decode_nonfinite":
+                kw["decode_nonfinite_steps"] = _int_set(val)
+            elif key == "decode_nonfinite_lane":
+                kw["decode_nonfinite_lane"] = int(val)
+            elif key == "serving_snapshot_corrupt":
+                kw["snapshot_corrupt_indices"] = _int_set(val)
+            elif key == "weight_swap_mismatch":
+                kw["weight_swap_mismatch_indices"] = _int_set(val)
             elif key.startswith("io:"):
                 kw["io_errors"][key[len("io:"):]] = _int_set(val)
             elif key.startswith("io_permanent:"):
@@ -437,11 +490,28 @@ def maybe_decode_exception(step: int) -> None:
         inj.maybe_decode_exception(step)
 
 
+def nonfinite_lane_at(step: int) -> Optional[int]:
+    inj = active()
+    return None if inj is None else inj.nonfinite_lane_at(step)
+
+
+def should_snapshot_corrupt(index: int) -> bool:
+    inj = active()
+    return inj is not None and inj.should_snapshot_corrupt(index)
+
+
+def should_weight_swap_mismatch(index: int) -> bool:
+    inj = active()
+    return inj is not None and inj.should_weight_swap_mismatch(index)
+
+
 __all__ = [
     "ENV_KNOB", "FaultError", "FaultInjector", "SimulatedCrash",
     "active", "check", "flip_bits", "inject", "install", "maybe_crash",
     "maybe_crash_before_commit", "maybe_decode_exception",
-    "maybe_sigterm", "poison_grads", "shard_truncate_target",
-    "should_pool_exhaust", "should_range_timeout", "should_truncate",
+    "maybe_sigterm", "nonfinite_lane_at", "poison_grads",
+    "shard_truncate_target", "should_pool_exhaust",
+    "should_range_timeout", "should_snapshot_corrupt",
+    "should_truncate", "should_weight_swap_mismatch",
     "should_world_mismatch",
 ]

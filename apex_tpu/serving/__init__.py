@@ -21,6 +21,13 @@ flight-recorder triggers for its degradation paths.
 ``bench.py serving`` drives the same loop under synthetic many-client
 load (Poisson arrivals, mixed lengths) against a static-batch
 baseline.
+
+The resilience plane (``serving/resilience.py``, docs/serving.md
+"Failure modes & recovery") makes the engine degrade per-request:
+deadlines (``Request.deadline_ms``), per-request fault isolation
+(binary-split quarantine + in-jit nonfinite localization),
+preemption-safe drain snapshots a fresh engine resumes bitwise, and
+live weight hot-swap (``swap_weights``) at step boundaries.
 """
 
 from apex_tpu.serving.decode import DecodeStep, StepOut, make_decode_step
@@ -33,6 +40,20 @@ from apex_tpu.serving.kv_cache import (
     append_kv_prefill,
     bucket,
     gather_kv,
+)
+from apex_tpu.serving.resilience import (
+    SnapshotError,
+    WeightSwapError,
+    latest_snapshot,
+    load_snapshot,
+    merge_results,
+    params_digest,
+    params_fingerprint,
+    params_signature,
+    resume_requests,
+    save_snapshot,
+    swap_weights,
+    validate_snapshot,
 )
 from apex_tpu.serving.scheduler import (
     ContinuousBatcher,
@@ -50,13 +71,25 @@ __all__ = [
     "PoolExhausted",
     "Request",
     "RequestResult",
+    "SnapshotError",
     "StepOut",
     "TRASH_BLOCK",
+    "WeightSwapError",
     "append_kv",
     "append_kv_prefill",
     "bucket",
     "gather_kv",
+    "latest_snapshot",
+    "load_snapshot",
     "make_decode_step",
+    "merge_results",
+    "params_digest",
+    "params_fingerprint",
+    "params_signature",
+    "resume_requests",
+    "save_snapshot",
     "serve_loop",
     "static_batch_generate",
+    "swap_weights",
+    "validate_snapshot",
 ]

@@ -53,6 +53,12 @@ class StepOut(NamedTuple):
     logits: Any        # (batch, vocab) fp32 — the LAST real token's
     next_token: Any    # (batch,) int32 greedy argmax of ``logits``
     cache: KVCacheState
+    # (batch,) bool — every logit of the lane is finite. Computed
+    # IN-JIT (one fused reduction over logits the program already
+    # holds), so per-request fault isolation costs the host a (b,)
+    # bool pull instead of the full (b, vocab) logits
+    # (serving/resilience.py quarantine path). None on older callers.
+    finite: Any = None
 
 
 class DecodeStep:
@@ -81,7 +87,7 @@ class DecodeStep:
             last = jnp.clip(lengths - 1, 0, s - 1)
             out = logits[last, jnp.arange(b)]          # (b, vocab)
             return StepOut(out, jnp.argmax(out, axis=-1).astype(jnp.int32),
-                           state)
+                           state, jnp.all(jnp.isfinite(out), axis=-1))
 
         def decode_fn(params, state, tokens, positions, tables):
             k_ctx, v_ctx = gather_kv(state, tables)
@@ -96,7 +102,7 @@ class DecodeStep:
                               tables, positions)
             out = logits[0]                            # (b, vocab)
             return StepOut(out, jnp.argmax(out, axis=-1).astype(jnp.int32),
-                           state)
+                           state, jnp.all(jnp.isfinite(out), axis=-1))
 
         # cache state donated (argnums 1): appends run in place
         self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(1,))

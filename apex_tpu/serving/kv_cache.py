@@ -71,8 +71,16 @@ class KVCache:
     """Block allocator + pool factory for one model's KV cache.
 
     ``num_blocks`` counts usable blocks *excluding* the trash block
-    (the pool array holds ``num_blocks + 1``). Thread-safe: the
-    scheduler's admission thread and a draining finish path may race.
+    (the pool array holds ``num_blocks + 1``).
+
+    Thread-safety contract: every ALLOCATOR method (allocate / free /
+    table / table_array / can_admit and the counters) takes this
+    cache's internal lock, so a client thread calling
+    ``ContinuousBatcher.submit()`` and the engine thread admitting,
+    finishing, or draining can interleave freely. The device POOLS
+    (``init_state()``'s arrays) are not covered: they are owned by the
+    engine thread and donated through each prefill/decode dispatch —
+    nothing else may touch them mid-step.
     """
 
     def __init__(self, num_layers: int, kv_heads: int, head_dim: int, *,

@@ -31,20 +31,52 @@ counters, and ``serving_request_error`` / ``serving_pool_exhausted``
 structured events that double as flight-recorder triggers — a crash
 mid-serve leaves a postmortem bundle naming the request.
 
+Resilience (serving/resilience.py, docs/serving.md "Failure modes &
+recovery") — the engine degrades per-REQUEST, never per-process:
+
+- **deadlines**: ``Request.deadline_ms`` is a TTL from submission;
+  expired requests (queued or in-flight) reap at the top of every
+  step, BEFORE admission and decode, with outcome
+  ``deadline_exceeded`` (counter + event of the same name).
+- **quarantine**: a decode dispatch that raises is retried by binary
+  split — halves that succeed keep their tokens, offenders bottom out
+  as singletons and finish with outcome ``error``. Nonfinite logits
+  localize directly via the decode program's in-jit per-lane finite
+  flag. Either way the ``serving_quarantine`` trigger fires and the
+  engine keeps serving; quarantined sequences' pool blocks are
+  scrubbed before reuse (a NaN row must not haunt the next tenant).
+- **preemption drain**: with a ``preemption`` handler attached,
+  ``should_stop()`` flips the engine to drain mode — no new
+  admissions; with a ``snapshot_dir``, every queued + in-flight
+  request persists to an atomic serving snapshot a fresh engine
+  resumes from (``resilience.resume_requests``); without one,
+  in-flight work finishes and the queue errors out loudly.
+- **weight hot-swap**: ``resilience.swap_weights`` stages validated
+  params; the engine installs them here, at a step boundary between
+  decode dispatches, so no request is dropped
+  (``serving_weight_swap`` event with old/new digests).
+
 Degradation paths are deterministically drillable via
 ``APEX_TPU_FAULTS`` (resilience/faults.py):
 
 - ``serving_pool_exhausted=<steps>``: admission at those engine steps
   behaves as if the pool were empty — load sheds to the queue,
   in-flight decodes keep running, one event + bundle fire.
-- ``decode_step_exception=<steps>``: the decode dispatch raises —
-  in-flight requests finish with an error (blocks freed, bundle
-  dumped) and the engine keeps serving the queue.
+- ``decode_step_exception=<steps>``: the decode dispatch raises at
+  those engine steps — a step-level fault fails every binary-split
+  retry too, so the whole batch quarantines (blocks freed, bundle
+  dumped) and the engine keeps serving the queue. ``io:decode_step``
+  injects by CALL index instead: one transient index is absorbed by
+  the retry with zero quarantines.
+- ``decode_nonfinite=<steps>`` (+ ``decode_nonfinite_lane``): one
+  lane's cached K/V is poisoned with NaN — only that sequence
+  quarantines; the rest of the batch keeps its tokens.
 """
 
 from __future__ import annotations
 
 import dataclasses
+import threading
 import time
 from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
@@ -57,12 +89,17 @@ from apex_tpu.serving.kv_cache import KVCache, PoolExhausted, bucket
 
 @dataclasses.dataclass
 class Request:
-    """One generation request."""
+    """One generation request. ``deadline_ms`` is a TTL measured from
+    submission: a request still queued or decoding when it elapses is
+    reaped with outcome ``deadline_exceeded`` (its generated-so-far
+    tokens are returned; its blocks free immediately). ``None`` means
+    no deadline."""
 
     id: Any
     prompt: Sequence[int]
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
+    deadline_ms: Optional[float] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).ravel()
@@ -71,6 +108,9 @@ class Request:
         if self.max_new_tokens < 1:
             raise ValueError(
                 f"request {self.id!r}: max_new_tokens must be >= 1")
+        if self.deadline_ms is not None and self.deadline_ms <= 0:
+            raise ValueError(
+                f"request {self.id!r}: deadline_ms must be > 0 or None")
 
 
 @dataclasses.dataclass
@@ -83,7 +123,8 @@ class RequestResult:
     tokens: List[int]
     ttft_s: Optional[float]
     tpot_s: Optional[float]
-    finish_reason: str                  # "length" | "eos" | "error"
+    # "length" | "eos" | "error" | "deadline_exceeded"
+    finish_reason: str
     error: Optional[str] = None
 
 
@@ -120,7 +161,8 @@ class ContinuousBatcher:
                  min_width_bucket: int = 4, min_seq_bucket: int = 16,
                  registry=None, timeline=None,
                  clock: Callable[[], float] = time.perf_counter,
-                 step_fn: Optional[DecodeStep] = None):
+                 step_fn: Optional[DecodeStep] = None,
+                 preemption=None, snapshot_dir: Optional[str] = None):
         from apex_tpu import telemetry
 
         self.params = params
@@ -135,12 +177,25 @@ class ContinuousBatcher:
         self._registry = (registry if registry is not None
                           else telemetry.registry())
         self._timeline = timeline
+        # guards queue mutation + pool reservation (submit() may run on
+        # a client thread while the engine thread admits), the finished
+        # list, and the staged weight swap — the engine-owned state
+        # (running, cache pools) stays single-threaded
+        self._lock = threading.Lock()
         self.queue: "deque[Tuple[Request, float]]" = deque()
         self.running: List[_InFlight] = []
         self.finished: List[RequestResult] = []
         self.step_idx = 0
         self._seq_counter = 0
         self._pool_exhausted_dumped = False
+        # resilience plane (serving/resilience.py)
+        self.preemption = preemption          # guard.PreemptionHandler
+        self.snapshot_dir = snapshot_dir
+        self.draining = False
+        self.drained_snapshot: Optional[str] = None
+        self._pending_swap = None             # (params, info) staged
+        self._snapshot_count = 0
+        self._swap_count = 0
 
     # -- telemetry helpers ---------------------------------------------------
 
@@ -162,6 +217,10 @@ class ContinuousBatcher:
                 "KV pool blocks held by in-flight sequences").set(
             self.cache.blocks_in_use)
 
+    def _push_result(self, res: RequestResult) -> None:
+        with self._lock:
+            self.finished.append(res)
+
     def _finish(self, fl: _InFlight, reason: str,
                 error: Optional[str] = None) -> None:
         self.cache.free(fl.seq_id)
@@ -181,7 +240,7 @@ class ContinuousBatcher:
             r.histogram("serving_tpot_seconds",
                         "mean inter-token interval after the first"
                         ).observe(tpot)
-        self.finished.append(RequestResult(
+        self._push_result(RequestResult(
             id=fl.req.id, tokens=list(fl.generated), ttft_s=ttft,
             tpot_s=tpot, finish_reason=reason, error=error))
 
@@ -193,7 +252,7 @@ class ContinuousBatcher:
         _flight.notify("serving_request_error",
                        error=RuntimeError(msg), fleet=False,
                        extra={"request": str(req.id), "event": ev})
-        self.finished.append(RequestResult(
+        self._push_result(RequestResult(
             id=req.id, tokens=[], ttft_s=None, tpot_s=None,
             finish_reason="error", error=msg))
 
@@ -238,46 +297,270 @@ class ContinuousBatcher:
         return state
 
     def submit(self, request: Request) -> None:
-        self.queue.append((request, self.clock()))
+        """Enqueue one request (thread-safe: clients may submit while
+        the engine thread is admitting). A draining engine refuses
+        loudly — its snapshot is already committed, so a late request
+        must go to the resumed engine, never silently vanish."""
+        if self.draining:
+            self._push_result(RequestResult(
+                id=request.id, tokens=[], ttft_s=None, tpot_s=None,
+                finish_reason="error",
+                error="engine draining (preemption): resubmit to the "
+                      "resumed engine"))
+            return
+        with self._lock:
+            self.queue.append((request, self.clock()))
 
     def idle(self) -> bool:
-        return not self.queue and not self.running
+        with self._lock:
+            return not self.queue and not self.running
 
     def drain(self) -> List[RequestResult]:
-        out, self.finished = self.finished, []
+        with self._lock:
+            out, self.finished = self.finished, []
         return out
+
+    # -- resilience plane (serving/resilience.py) ----------------------------
+
+    def _snapshot_entries(self) -> List[Dict[str, Any]]:
+        """Every queued + in-flight request as JSON-ready entries (the
+        drain snapshot payload): prompt, generated-so-far tokens, and
+        the admission-relevant knobs. Queue order then running order —
+        the resumed engine re-admits in the same order."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            queued = list(self.queue)
+        for req, _ in queued:
+            out.append({"id": req.id, "prompt": [int(t) for t in req.prompt],
+                        "max_new_tokens": int(req.max_new_tokens),
+                        "eos_id": req.eos_id,
+                        "deadline_ms": req.deadline_ms,
+                        "generated": [], "state": "queued"})
+        for f in self.running:
+            out.append({"id": f.req.id,
+                        "prompt": [int(t) for t in f.req.prompt],
+                        "max_new_tokens": int(f.req.max_new_tokens),
+                        "eos_id": f.req.eos_id,
+                        "deadline_ms": f.req.deadline_ms,
+                        "generated": [int(t) for t in f.generated],
+                        "state": "in_flight"})
+        return out
+
+    def _stage_params(self, params, info: Dict[str, Any]) -> None:
+        """Stage a validated weight swap (``resilience.swap_weights``);
+        the engine installs it at the top of its next step — between
+        decode dispatches, so no request is dropped."""
+        with self._lock:
+            self._pending_swap = (params, info)
+
+    def _install_pending_params(self, idx: int) -> None:
+        with self._lock:
+            pend, self._pending_swap = self._pending_swap, None
+        if pend is None:
+            return
+        params, info = pend
+        self.params = params
+        r = self._registry
+        r.counter("serving_weight_swaps",
+                  "live weight hot-swaps installed").inc()
+        r.event("serving_weight_swap", step=idx,
+                old_digest=info["old_digest"],
+                new_digest=info["new_digest"])
+
+    def _reap_deadlines(self, idx: int, now: float) -> List[Any]:
+        """Reap every queued + in-flight request whose TTL elapsed —
+        BEFORE admission and decode, so an expired request never buys
+        a prefill or decode slot. Returns the reaped ids."""
+        def expired(req: Request, t_submit: float) -> bool:
+            return (req.deadline_ms is not None
+                    and (now - t_submit) * 1000.0 >= req.deadline_ms)
+
+        expired_q: List[Tuple[Request, float]] = []
+        with self._lock:
+            if any(expired(req, t) for req, t in self.queue):
+                keep: "deque[Tuple[Request, float]]" = deque()
+                for req, t in self.queue:
+                    (expired_q if expired(req, t) else keep).append(
+                        (req, t))
+                self.queue = keep
+        expired_run = [f for f in self.running
+                       if expired(f.req, f.t_submit)]
+        if not expired_q and not expired_run:
+            return []
+        r = self._registry
+        ids: List[Any] = []
+        for req, _ in expired_q:
+            r.counter("serving_deadline_exceeded",
+                      "requests reaped past their TTL").inc(where="queued")
+            self._push_result(RequestResult(
+                id=req.id, tokens=[], ttft_s=None, tpot_s=None,
+                finish_reason="deadline_exceeded",
+                error=f"deadline {req.deadline_ms:g}ms elapsed before "
+                      "admission"))
+            ids.append(req.id)
+        if expired_run:
+            gone = {id(f) for f in expired_run}
+            self.running = [f for f in self.running
+                            if id(f) not in gone]
+            for f in expired_run:
+                r.counter("serving_deadline_exceeded",
+                          "requests reaped past their TTL").inc(
+                    where="in_flight")
+                self._finish(f, "deadline_exceeded",
+                             error=f"deadline {f.req.deadline_ms:g}ms "
+                                   "elapsed mid-decode")
+                ids.append(f.req.id)
+        # flight-safe: the event rides the recorder's ring via the
+        # registry sink — no bundle per expiry (deadlines are routine)
+        r.event("serving_deadline_exceeded", step=idx,
+                requests=[str(i) for i in ids])
+        return ids
+
+    def _scrub_blocks(self, state, flights: List[_InFlight]):
+        """Zero the pool blocks of sequences about to be quarantined.
+        A nonfinite lane APPENDED NaN K/V into its own blocks during
+        the dispatch that exposed it; masked attention zeroes masked
+        *scores*, not masked V rows (0 x NaN = NaN), so a freed block
+        must never hand NaN to its next tenant."""
+        import jax.numpy as jnp
+
+        blocks = sorted({b for f in flights
+                         for b in self.cache.table(f.seq_id)})
+        if not blocks:
+            return state
+        b = jnp.asarray(blocks, jnp.int32)
+        return state._replace(k=state.k.at[:, b].set(0),
+                              v=state.v.at[:, b].set(0))
+
+    def _quarantine(self, state, quarantined, idx: int,
+                    report: Dict[str, Any]):
+        """Finish the named (flight, reason) pairs with outcome
+        ``error`` — blocks scrubbed then freed, counters/events/bundle
+        emitted — while the rest of the engine keeps serving. The
+        ``serving_quarantine`` trigger replaces the old engine-fatal
+        decode-exception path."""
+        from apex_tpu.telemetry import flight as _flight
+
+        state = self._scrub_blocks(state, [f for f, _ in quarantined])
+        r = self._registry
+        ids = [str(f.req.id) for f, _ in quarantined]
+        reasons = [msg for _, msg in quarantined]
+        gone = {id(f) for f, _ in quarantined}
+        self.running = [f for f in self.running if id(f) not in gone]
+        for f, msg in quarantined:
+            kind = ("nonfinite" if "nonfinite" in msg else "exception")
+            r.counter("serving_quarantined",
+                      "sequences quarantined by per-request fault "
+                      "isolation").inc(reason=kind)
+            self._finish(f, "error", error=f"quarantined: {msg}")
+            report["finished"].append(f.req.id)
+        report.setdefault("quarantined", []).extend(
+            f.req.id for f, _ in quarantined)
+        ev = r.event("serving_quarantine", step=idx, requests=ids,
+                     reasons=reasons, in_flight=len(self.running))
+        _flight.notify("serving_quarantine", fleet=False,
+                       extra={"step": idx, "requests": ids,
+                              "reasons": reasons, "event": ev})
+        return state
+
+    def _enter_drain(self, idx: int, report: Dict[str, Any]) -> None:
+        """Flip to drain mode on a preemption flag: no new admissions
+        ever again on this engine. With a ``snapshot_dir``, queued +
+        in-flight work persists to an atomic serving snapshot
+        (in-flight blocks free; a fresh engine resumes the snapshot);
+        without one (or on a failed save), in-flight work keeps
+        decoding to completion and the queue errors out loudly —
+        either way nothing is silently dropped."""
+        from apex_tpu.telemetry import flight as _flight
+
+        self.draining = True
+        signum = getattr(self.preemption, "signum", None)
+        n_queued, n_running = len(self.queue), len(self.running)
+        path = None
+        save_error: Optional[str] = None
+        if self.snapshot_dir is not None:
+            from apex_tpu.serving import resilience as _sresil
+
+            try:
+                path = _sresil.save_snapshot(
+                    self, self.snapshot_dir, step=idx,
+                    reason=f"preemption (signal {signum})")
+            except Exception as e:  # noqa: BLE001 — degrade, don't drop
+                save_error = f"{type(e).__name__}: {str(e)[:200]}"
+        if path is not None:
+            self.drained_snapshot = path
+            for f in self.running:
+                self.cache.free(f.seq_id)
+            self.running = []
+            with self._lock:
+                self.queue.clear()
+        else:
+            # finish mode: keep decoding the in-flight work; the queue
+            # cannot be admitted any more, so fail it loudly
+            with self._lock:
+                dropped, self.queue = list(self.queue), deque()
+            for req, _ in dropped:
+                self._reject(req, (
+                    "preempted before admission and no drain snapshot "
+                    + (f"(save failed: {save_error})" if save_error
+                       else "(no snapshot_dir configured)")))
+        report["drained"] = True
+        report["snapshot"] = path
+        r = self._registry
+        r.counter("serving_drains", "preemption drains entered").inc(
+            mode="snapshot" if path is not None else "finish")
+        ev = r.event("serving_drain", step=idx, signum=signum,
+                     snapshot=path, save_error=save_error,
+                     queued=n_queued, in_flight=n_running)
+        _flight.notify("serving_drain", fleet=False,
+                       extra={"step": idx, "snapshot": path,
+                              "save_error": save_error,
+                              "queued": n_queued,
+                              "in_flight": n_running, "event": ev})
 
     # -- one engine step -----------------------------------------------------
 
     def _admit(self, exhausted: bool) -> List[_InFlight]:
+        if self.draining:
+            return []                        # drain mode: queue frozen
         admitted: List[_InFlight] = []
-        while (self.queue
-               and len(self.running) + len(admitted) < self.max_batch
-               and len(admitted) < self.max_prefill_batch):
-            req, t_submit = self.queue[0]
-            total = len(req.prompt) + req.max_new_tokens
-            need = self.cache.blocks_for(total)
-            if need > self.cache.num_blocks:
+        rejects: List[Tuple[Request, str]] = []
+        deferred = False
+        # queue pop + pool reservation under ONE lock: a submit() on a
+        # client thread can never interleave with the reservation
+        with self._lock:
+            while (self.queue
+                   and len(self.running) + len(admitted) < self.max_batch
+                   and len(admitted) < self.max_prefill_batch):
+                req, t_submit = self.queue[0]
+                total = len(req.prompt) + req.max_new_tokens
+                need = self.cache.blocks_for(total)
+                if need > self.cache.num_blocks:
+                    self.queue.popleft()
+                    rejects.append((req, (
+                        f"request needs {need} KV blocks, pool capacity "
+                        f"is {self.cache.num_blocks} — can never be "
+                        "admitted")))
+                    continue
+                if exhausted:
+                    break                    # shed load: stay queued
+                try:
+                    self._seq_counter += 1
+                    seq_id = ("s", self._seq_counter, req.id)
+                    self.cache.allocate(seq_id, total)
+                except PoolExhausted:
+                    deferred = True
+                    break                    # wait for blocks to free
                 self.queue.popleft()
-                self._reject(req, (
-                    f"request needs {need} KV blocks, pool capacity is "
-                    f"{self.cache.num_blocks} — can never be admitted"))
-                continue
-            if exhausted:
-                break                        # shed load: stay queued
-            try:
-                self._seq_counter += 1
-                seq_id = ("s", self._seq_counter, req.id)
-                self.cache.allocate(seq_id, total)
-            except PoolExhausted:
-                self._registry.counter(
-                    "serving_admission_deferred",
-                    "admissions deferred by a transiently full pool"
-                ).inc()
-                break                        # wait for blocks to free
-            self.queue.popleft()
-            admitted.append(_InFlight(req=req, seq_id=seq_id,
-                                      generated=[], t_submit=t_submit))
+                admitted.append(_InFlight(req=req, seq_id=seq_id,
+                                          generated=[],
+                                          t_submit=t_submit))
+        if deferred:
+            self._registry.counter(
+                "serving_admission_deferred",
+                "admissions deferred by a transiently full pool").inc()
+        for req, msg in rejects:
+            self._reject(req, msg)
         return admitted
 
     def _tables_for(self, flights: List[_InFlight], batch: int):
@@ -287,6 +570,11 @@ class ContinuousBatcher:
                                       batch=batch)
 
     def _prefill(self, admitted: List[_InFlight], state):
+        """Prefill the admissions as one bucketed batch; returns
+        ``(cache_state, finite)`` where ``finite[i]`` is the in-jit
+        all-finite flag of lane ``i``'s first-token logits. Only
+        finite lanes get their first token recorded — a nonfinite lane
+        is quarantined by the caller before it joins ``running``."""
         import jax
 
         b = bucket(len(admitted))
@@ -304,12 +592,24 @@ class ContinuousBatcher:
             jax.block_until_ready(out.next_token)
         now = self.clock()
         ids = np.asarray(out.next_token)
+        finite = (np.asarray(out.finite)[:len(admitted)]
+                  if out.finite is not None
+                  else np.ones(len(admitted), bool))
         for i, f in enumerate(admitted):
-            f.generated.append(int(ids[i]))
-            f.t_first = f.t_last = now
-        return out.cache
+            if finite[i]:
+                f.generated.append(int(ids[i]))
+                f.t_first = f.t_last = now
+        return out.cache, finite
 
-    def _decode(self, state, idx: int):
+    def _decode_batch(self, state, flights: List[_InFlight], idx: int,
+                      width: int):
+        """ONE decode dispatch over ``flights`` (padded to
+        ``max_batch`` x the step's shared ``width`` bucket, so
+        binary-split retries reuse the very same compiled program);
+        returns ``(cache_state, token_ids, finite, now)``. The fault
+        sites live here, so the split retries re-traverse them —
+        step-indexed clauses fail every sub-dispatch, call-indexed
+        ``io:decode_step`` faults are absorbed by the retry."""
         import jax
 
         from apex_tpu.resilience import faults
@@ -317,14 +617,12 @@ class ContinuousBatcher:
         b = self.max_batch          # fixed: one program per width bucket
         tokens = np.zeros((b,), np.int32)
         positions = np.zeros((b,), np.int32)
-        for i, f in enumerate(self.running):
+        for i, f in enumerate(flights):
             tokens[i] = f.generated[-1]
             positions[i] = f.position
-        tables = self._tables_for(self.running, b)
+        tables = self.cache.table_array([f.seq_id for f in flights],
+                                        width, batch=b)
         with self._tl().phase("decode", category="serving"):
-            # deterministic drill sites: the named engine-step clause
-            # (decode_step_exception=<steps>) plus the generic
-            # call-indexed io:decode_step grammar
             faults.maybe_decode_exception(idx)
             faults.check("decode_step")
             out = self.step_fn.decode(self.params, state, tokens,
@@ -332,10 +630,43 @@ class ContinuousBatcher:
             jax.block_until_ready(out.next_token)
         now = self.clock()
         ids = np.asarray(out.next_token)
-        for i, f in enumerate(self.running):
-            f.generated.append(int(ids[i]))
-            f.t_last = now
-        return out.cache, out
+        finite = (np.asarray(out.finite)[:len(flights)]
+                  if out.finite is not None
+                  else np.ones(len(flights), bool))
+        return out.cache, ids, finite, now
+
+    def _isolate(self, state, flights: List[_InFlight], idx: int,
+                 width: int):
+        """Decode ``flights`` with per-request fault isolation; returns
+        ``(state, accepted, quarantined)`` — ``accepted`` is
+        ``[(flight, token, t)]``, ``quarantined`` ``[(flight, msg)]``.
+
+        A dispatch exception triggers the binary split (the watchdog's
+        localization idiom on the batch axis): each half retries as its
+        own dispatch — the fault sites raise BEFORE the jitted call, so
+        the donated cache state is still live — and offenders bottom
+        out as singletons. Nonfinite logits need no split: the in-jit
+        per-lane finite flag names them directly."""
+        try:
+            state, ids, finite, now = self._decode_batch(
+                state, flights, idx, width)
+        except Exception as e:  # noqa: BLE001 — isolate, keep serving
+            if len(flights) == 1:
+                msg = f"{type(e).__name__}: {str(e)[:200]}"
+                return state, [], [(flights[0], msg)]
+            mid = len(flights) // 2
+            state, acc_lo, q_lo = self._isolate(state, flights[:mid],
+                                                idx, width)
+            state, acc_hi, q_hi = self._isolate(state, flights[mid:],
+                                                idx, width)
+            return state, acc_lo + acc_hi, q_lo + q_hi
+        accepted, quarantined = [], []
+        for i, f in enumerate(flights):
+            if finite[i]:
+                accepted.append((f, int(ids[i]), now))
+            else:
+                quarantined.append((f, "nonfinite logits"))
+        return state, accepted, quarantined
 
     def _reap(self) -> List[Any]:
         done, keep = [], []
@@ -355,13 +686,40 @@ class ContinuousBatcher:
     def step(self, state) -> Tuple[Any, Dict[str, Any]]:
         """One engine iteration over the donated cache ``state``;
         returns ``(new_state, report)`` — the report (admitted /
-        decoded / finished ids, blocks in use) is the golden-sequence
-        surface tests assert against."""
+        decoded / finished ids, blocks in use, plus the resilience
+        keys ``expired`` / ``quarantined`` / ``drained`` /
+        ``snapshot``) is the golden-sequence surface tests assert
+        against.
+
+        Ordering is the resilience contract: staged weight swaps
+        install FIRST (the step boundary between decode dispatches),
+        deadline-expired requests reap BEFORE admission and decode,
+        the preemption flag is drained before any new work starts, and
+        decode runs under per-request fault isolation."""
         from apex_tpu.resilience import faults
         from apex_tpu.telemetry import flight as _flight
 
         idx = self.step_idx
         self.step_idx += 1
+        self._install_pending_params(idx)
+        faults.maybe_sigterm(idx)       # the preemption drill site
+        report: Dict[str, Any] = {
+            "step": idx,
+            "admitted": [],
+            "decoded": [],
+            "finished": [],
+            "expired": self._reap_deadlines(idx, self.clock()),
+        }
+        if (not self.draining and self.preemption is not None
+                and self.preemption.should_stop()):
+            self._enter_drain(idx, report)
+            if self.drained_snapshot is not None:
+                # snapshot mode: queued + in-flight are persisted, the
+                # engine is done — nothing left to prefill or decode
+                report["queued"] = 0
+                report["blocks_in_use"] = self.cache.blocks_in_use
+                self._publish_gauges()
+                return state, report
         exhausted = faults.should_pool_exhaust(idx)
         if exhausted:
             self._registry.event("serving_pool_exhausted", step=idx,
@@ -375,37 +733,39 @@ class ContinuousBatcher:
                     extra={"step": idx, "queued": len(self.queue),
                            "blocks_in_use": self.cache.blocks_in_use})
         admitted = self._admit(exhausted)
-        report: Dict[str, Any] = {
-            "step": idx,
-            "admitted": [f.req.id for f in admitted],
-            "decoded": [],
-            "finished": [],
-            "queued": len(self.queue),
-        }
+        report["admitted"] = [f.req.id for f in admitted]
+        report["queued"] = len(self.queue)
         if admitted:
-            state = self._prefill(admitted, state)
-            self.running.extend(admitted)
+            state, finite = self._prefill(admitted, state)
+            good = [f for i, f in enumerate(admitted) if finite[i]]
+            bad = [(f, "nonfinite logits (prefill)")
+                   for i, f in enumerate(admitted) if not finite[i]]
+            self.running.extend(good)
+            if bad:
+                state = self._quarantine(state, bad, idx, report)
         # reap BEFORE decoding: a request whose prefill token already
         # hit max_new/EOS must not buy a decode slot
         report["finished"].extend(self._reap())
         if self.running:
-            try:
-                state, _ = self._decode(state, idx)
-                report["decoded"] = [f.req.id for f in self.running]
-            except Exception as e:  # noqa: BLE001 — degrade, keep serving
-                msg = f"{type(e).__name__}: {str(e)[:200]}"
-                self._registry.event("serving_request_error",
-                                     step=idx, error=msg,
-                                     in_flight=len(self.running))
-                _flight.notify("serving_request_error", error=e,
-                               fleet=False,
-                               extra={"step": idx,
-                                      "requests": [str(f.req.id)
-                                                   for f in self.running]})
-                for f in self.running:
-                    self._finish(f, "error", error=msg)
-                    report["finished"].append(f.req.id)
-                self.running = []
+            widths = [len(self.cache.table(f.seq_id))
+                      for f in self.running]
+            width = bucket(max(widths), self.min_width_bucket)
+            lane = faults.nonfinite_lane_at(idx)
+            if lane is not None and lane < len(self.running):
+                from apex_tpu.serving import resilience as _sresil
+
+                f = self.running[lane]
+                state = _sresil.poison_lane_kv(
+                    state, self.cache, f.seq_id, f.position - 1)
+            state, accepted, quarantined = self._isolate(
+                state, self.running, idx, width)
+            for f, tok, now in accepted:
+                f.generated.append(tok)
+                f.t_last = now
+            report["decoded"] = [f.req.id for f, _, _ in accepted]
+            if quarantined:
+                state = self._quarantine(state, quarantined, idx,
+                                         report)
         report["finished"].extend(self._reap())
         report["blocks_in_use"] = self.cache.blocks_in_use
         self._publish_gauges()
@@ -423,6 +783,12 @@ def serve_loop(batcher: ContinuousBatcher, state, requests:
     ``arrivals`` are seconds offsets from loop start (default: all at
     t=0). Submissions happen when the wall clock passes each offset —
     the serving bench's Poisson schedule goes through here.
+
+    A draining engine ends the loop early: once the batcher flags
+    ``draining`` (preemption), un-submitted arrivals stay with the
+    caller and the loop returns as soon as the in-flight work is
+    finished or snapshotted (``batcher.drained_snapshot`` names the
+    snapshot a fresh engine resumes from).
     """
     order = sorted(range(len(requests)),
                    key=lambda i: arrivals[i] if arrivals else 0.0)
@@ -430,12 +796,16 @@ def serve_loop(batcher: ContinuousBatcher, state, requests:
     results: List[RequestResult] = []
     i = 0
     while i < len(order) or not batcher.idle():
+        if batcher.draining and not batcher.running:
+            break
         now = clock() - t0
-        while i < len(order) and (
-                not arrivals or arrivals[order[i]] <= now):
+        while (i < len(order) and not batcher.draining
+               and (not arrivals or arrivals[order[i]] <= now)):
             batcher.submit(requests[order[i]])
             i += 1
         if batcher.idle():
+            if batcher.draining:
+                break
             if i < len(order):
                 sleep(max(0.0, min(arrivals[order[i]] - now, 0.001)))
             continue

@@ -35,9 +35,24 @@ trigger                fired by
                        load (``serving.scheduler``, host-local; extra
                        carries queue depth + blocks in use)
 ``serving_request_error`` a serving request failed: rejected as larger
-                       than the whole pool, or an exception escaped the
-                       decode dispatch (host-local; extra names the
-                       request ids)
+                       than the whole pool (host-local; extra names the
+                       request id)
+``serving_quarantine`` per-request fault isolation quarantined one or
+                       more sequences — a decode exception localized by
+                       binary-split retry, or nonfinite logits named by
+                       the in-jit per-lane finite flag
+                       (``serving.scheduler``, host-local; extra names
+                       the request ids + reasons). Replaces the old
+                       engine-fatal decode-exception path.
+``serving_drain``      the serving engine entered preemption drain —
+                       extra carries the committed snapshot path (or
+                       the save error) and the queued/in-flight counts
+                       at the drain point (host-local)
+``serving_weight_swap`` a live weight hot-swap was REJECTED by
+                       signature/fingerprint validation (extra carries
+                       the structured mismatch list; successful swaps
+                       emit only the ``serving_weight_swap`` event,
+                       which rides this ring into the next bundle)
 ====================== ====================================================
 
 Fleet-level triggers (the guard's, the shutdown's) fire on EVERY

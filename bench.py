@@ -1191,8 +1191,15 @@ def bench_serving():
     Headline: generated tokens/sec under continuous batching; p50/p99
     TTFT/TPOT for both ride in detail, the in-record static baseline
     as ``tokens_per_sec_vs_static`` (> 1 = continuous batching wins).
-    ``vs_baseline`` is left to emit()'s prior-run machinery. Knob:
-    ``APEX_TPU_SERVING_REQUESTS`` (default 48 CPU / 128 TPU)."""
+    Robustness detail (docs/serving.md "Failure modes & recovery"): a
+    third run repeats the continuous workload with ``decode_nonfinite``
+    injected at several engine steps and records ``availability`` (the
+    fraction of admitted requests that still finished ok — quarantine
+    must stay per-request) and ``p99_ttft_under_faults_ms``, so a
+    regression in fault isolation shows up in BENCH records, not just
+    in the chaos smoke. ``vs_baseline`` is left to emit()'s prior-run
+    machinery. Knob: ``APEX_TPU_SERVING_REQUESTS`` (default 48 CPU /
+    128 TPU)."""
     import os
 
     import jax
@@ -1201,6 +1208,7 @@ def bench_serving():
 
     from apex_tpu import serving
     from apex_tpu.models.gpt import GPTConfig, GPTModel
+    from apex_tpu.resilience import faults
 
     on_cpu = jax.default_backend() == "cpu"
     if on_cpu:
@@ -1280,19 +1288,20 @@ def bench_serving():
             rng.exponential(1.0 / req_rate, size=n_requests)))
         state = cache.init_state()
         t0 = time.perf_counter()
-        if kind == "cb":
+        if kind == "static":
+            state, results = serving.static_batch_generate(
+                model, params, cache, state, reqs,
+                batch_size=max_batch, arrivals=arrivals,
+                step_fn=step_fn, min_seq_bucket=seq_bucket)
+        else:
             eng = serving.ContinuousBatcher(
                 model, params, cache, max_batch=max_batch,
                 step_fn=step_fn, min_seq_bucket=seq_bucket)
             state, results = serving.serve_loop(
                 eng, state, reqs, arrivals=arrivals)
-        else:
-            state, results = serving.static_batch_generate(
-                model, params, cache, state, reqs,
-                batch_size=max_batch, arrivals=arrivals,
-                step_fn=step_fn, min_seq_bucket=seq_bucket)
         wall = time.perf_counter() - t0
         toks = sum(len(r.tokens) for r in results)
+        ok = sum(r.finish_reason in ("length", "eos") for r in results)
         del state
         return {
             "tokens": toks,
@@ -1303,10 +1312,17 @@ def bench_serving():
             "tpot": percentiles([r.tpot_s for r in results
                                  if r.tpot_s is not None]),
             "errors": sum(r.finish_reason == "error" for r in results),
+            "availability": round(ok / max(len(results), 1), 4),
         }
 
     static = run("static")
     cb = run("cb")
+    # robustness pass: same continuous workload with one lane's cached
+    # K/V NaN-poisoned at several engine steps — quarantine must stay
+    # per-request, so availability stays near 1 and TTFT stays sane
+    with faults.inject(
+            decode_nonfinite_steps=frozenset({5, 25, 50})):
+        faulted = run("cbf")
     emit({
         "metric": "serving_continuous_batching_tokens_per_sec",
         "value": cb["tokens_per_sec"],
@@ -1326,6 +1342,10 @@ def bench_serving():
                 round(cb["ttft"]["p99_ms"] / static["ttft"]["p99_ms"], 4)
                 if cb["ttft"]["p99_ms"] and static["ttft"]["p99_ms"]
                 else None),
+            "availability": cb["availability"],
+            "availability_under_faults": faulted["availability"],
+            "p99_ttft_under_faults_ms": faulted["ttft"]["p99_ms"],
+            "under_faults": faulted,
             "compile_keys": step_fn.compile_keys(),
             "kv_pool": {"num_blocks": cache.num_blocks,
                         "block_size": cache.block_size,

@@ -118,6 +118,9 @@ SURFACE = {
         "KVCache", "KVCacheState", "PoolExhausted", "make_decode_step",
         "DecodeStep", "ContinuousBatcher", "Request", "RequestResult",
         "serve_loop", "static_batch_generate", "gather_kv", "append_kv",
+        "save_snapshot", "latest_snapshot", "load_snapshot",
+        "resume_requests", "merge_results", "swap_weights",
+        "SnapshotError", "WeightSwapError",
     ],
     "apex_tpu.runtime": [
         "HostFlatSpace", "PrefetchLoader", "cast_bf16_f32",

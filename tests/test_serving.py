@@ -482,8 +482,12 @@ class TestFaultDrills:
             state, _ = eng.step(state)
         assert eng.drain()[0].finish_reason == "length"
 
-    def test_decode_exception_fails_in_flight_and_continues(
+    def test_decode_exception_quarantines_and_continues(
             self, model_and_params, step_fn, tmp_path, monkeypatch):
+        # a STEP-indexed injected exception fails every binary-split
+        # retry too, so the whole (single-member) batch quarantines —
+        # under the serving_quarantine trigger, not the old
+        # engine-fatal serving_request_error path
         from apex_tpu import records
         from apex_tpu.telemetry import flight
 
@@ -499,6 +503,7 @@ class TestFaultDrills:
                                            max_new_tokens=4))
                 state, rep = eng.step(state)
                 assert rep["finished"] == ["dead"]
+                assert rep["quarantined"] == ["dead"]
                 # degradation: blocks freed, bundle dumped, error result
                 assert cache.blocks_in_use == 0
                 res = eng.drain()
@@ -515,8 +520,10 @@ class TestFaultDrills:
         rec = records.latest_record(flight.FLIGHT_KIND,
                                     require_backend=None)
         assert rec is not None
-        assert rec["payload"]["trigger"] == "serving_request_error"
+        assert rec["payload"]["trigger"] == "serving_quarantine"
         assert "dead" in str(rec["payload"]["extra"]["requests"])
+        assert reg.counter("serving_quarantined").value(
+            reason="exception") == 1
 
     def test_env_knob_grammar(self):
         inj = faults.FaultInjector.from_env(

@@ -1,0 +1,586 @@
+"""Serving-tier resilience (apex_tpu/serving/resilience.py +
+scheduler integration, docs/serving.md "Failure modes & recovery").
+
+Anchors:
+
+- deadlines: queued + in-flight TTL reap at the top of the step —
+  BEFORE admission and decode — with outcome ``deadline_exceeded``;
+- quarantine: ``decode_nonfinite`` isolates exactly the poisoned lane
+  (the rest of the batch keeps its tokens, compared against a clean
+  run); a sequence-bound exception localizes by binary split; a
+  transient ``io:decode_step`` index is absorbed with ZERO quarantines;
+- drain: a preemption flag commits an atomic serving snapshot, a fresh
+  engine resumes it, and the merged token streams match the
+  uninterrupted run exactly; corrupt snapshots are refused;
+- hot swap: staged install at a step boundary with old/new digests,
+  structured rejection on signature mismatch (and the
+  ``weight_swap_mismatch`` clause), fingerprint-manifest validation;
+- ``submit()`` is thread-safe under concurrent stepping.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu import serving, telemetry  # noqa: E402
+from apex_tpu.models.gpt import GPTConfig, GPTModel  # noqa: E402
+from apex_tpu.resilience import faults  # noqa: E402
+from apex_tpu.resilience.guard import PreemptionHandler  # noqa: E402
+from apex_tpu.serving import resilience as sresil  # noqa: E402
+from apex_tpu.serving.kv_cache import KVCache  # noqa: E402
+
+VOCAB, SEQ, HID, LAYERS, HEADS, KV = 64, 64, 32, 2, 4, 2
+BLOCKS, BS = 24, 4
+
+
+def tiny_config(**kw):
+    base = dict(vocab_size=VOCAB, max_seq_len=SEQ, hidden_size=HID,
+                num_layers=LAYERS, num_heads=HEADS, num_kv_heads=KV,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def fresh_cache(num_blocks=BLOCKS, block_size=BS):
+    return KVCache(LAYERS, KV, HID // HEADS, num_blocks=num_blocks,
+                   block_size=block_size, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = GPTModel(tiny_config())
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, VOCAB, (1, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def step_fn(model_and_params):
+    model, _ = model_and_params
+    return serving.make_decode_step(model, fresh_cache())
+
+
+def make_batcher(model, params, step_fn, cache, **kw):
+    reg = telemetry.MetricsRegistry()
+    sink = telemetry.InMemorySink()
+    reg.add_sink(sink)
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_prefill_batch", 4)
+    b = serving.ContinuousBatcher(model, params, cache, step_fn=step_fn,
+                                  registry=reg, **kw)
+    return b, reg, sink
+
+
+def run_clean(model, params, step_fn, requests):
+    """Token streams per id from an uninterrupted, fault-free run."""
+    cache = fresh_cache()
+    eng, _, _ = make_batcher(model, params, step_fn, cache)
+    _, results = serving.serve_loop(eng, cache.init_state(), requests)
+    return {r.id: r.tokens for r in results}
+
+
+def mk_requests(n, rng, **kw):
+    return [serving.Request(
+        id=i, prompt=rng.randint(0, VOCAB, (int(rng.randint(2, 9)),)),
+        max_new_tokens=int(rng.randint(3, 7)), **kw) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# deadlines
+# ---------------------------------------------------------------------------
+
+
+class TestDeadlines:
+    def test_queued_deadline_reaps_before_admission(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        t = [0.0]
+        eng, reg, sink = make_batcher(model, params, step_fn, cache,
+                                      clock=lambda: t[0])
+        state = cache.init_state()
+        eng.submit(serving.Request(id="late", prompt=[1] * 4,
+                                   max_new_tokens=4, deadline_ms=50.0))
+        t[0] = 0.2                       # 200ms later: TTL long gone
+        state, rep = eng.step(state)
+        assert rep["expired"] == ["late"]
+        assert rep["admitted"] == []
+        res = eng.drain()
+        assert len(res) == 1
+        assert res[0].finish_reason == "deadline_exceeded"
+        assert res[0].tokens == []
+        assert reg.counter("serving_deadline_exceeded").value(
+            where="queued") == 1
+        assert "serving_deadline_exceeded" in [
+            e["event"] for e in sink.events]
+        assert cache.blocks_in_use == 0
+
+    def test_inflight_deadline_reaps_before_decode(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        t = [0.0]
+        eng, reg, _ = make_batcher(model, params, step_fn, cache,
+                                   clock=lambda: t[0])
+        state = cache.init_state()
+        eng.submit(serving.Request(id="ttl", prompt=[1] * 4,
+                                   max_new_tokens=8, deadline_ms=100.0))
+        eng.submit(serving.Request(id="ok", prompt=[2] * 4,
+                                   max_new_tokens=8))
+        state, rep = eng.step(state)     # both admitted, 2 tokens each
+        assert rep["decoded"] == ["ttl", "ok"]
+        n_before = len(eng.running[0].generated)
+        t[0] = 0.5                       # past ttl's deadline
+        state, rep = eng.step(state)
+        # the reap happened BEFORE decode: ttl never bought this
+        # step's decode slot and its token count did not grow
+        assert rep["expired"] == ["ttl"]
+        assert "ttl" not in rep["decoded"]
+        assert rep["decoded"] == ["ok"]
+        res = {r.id: r for r in eng.drain()}
+        assert res["ttl"].finish_reason == "deadline_exceeded"
+        assert len(res["ttl"].tokens) == n_before
+        assert reg.counter("serving_deadline_exceeded").value(
+            where="in_flight") == 1
+        # the survivor runs to completion; its blocks were untouched
+        while not eng.idle():
+            state, _ = eng.step(state)
+        out = eng.drain()
+        assert out[0].id == "ok" and out[0].finish_reason == "length"
+        assert cache.blocks_in_use == 0
+
+    def test_no_deadline_never_expires(self, model_and_params, step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        t = [0.0]
+        eng, _, _ = make_batcher(model, params, step_fn, cache,
+                                 clock=lambda: t[0])
+        state = cache.init_state()
+        eng.submit(serving.Request(id=0, prompt=[3] * 4,
+                                   max_new_tokens=3))
+        t[0] = 1e6
+        while not eng.idle():
+            state, _ = eng.step(state)
+        assert eng.drain()[0].finish_reason == "length"
+
+
+# ---------------------------------------------------------------------------
+# quarantine: nonfinite localization + binary-split isolation
+# ---------------------------------------------------------------------------
+
+
+class _PoisonDecode:
+    """step_fn wrapper whose decode raises whenever the batch's block
+    tables touch a poisoned sequence's blocks — a SEQUENCE-bound fault
+    (unlike the step-indexed clause), which is exactly what the binary
+    split must localize."""
+
+    def __init__(self, inner):
+        self.inner = inner
+        self.poison_blocks = set()
+        self.decode_calls = 0
+
+    def prefill(self, *a):
+        return self.inner.prefill(*a)
+
+    def decode(self, params, state, tokens, positions, tables):
+        self.decode_calls += 1
+        if self.poison_blocks & set(np.asarray(tables).ravel().tolist()):
+            raise faults.FaultError("poisoned sequence in batch")
+        return self.inner.decode(params, state, tokens, positions,
+                                 tables)
+
+
+class TestQuarantine:
+    def test_nonfinite_lane_quarantined_others_bitwise(
+            self, model_and_params, step_fn, tmp_path, monkeypatch):
+        from apex_tpu import records
+        from apex_tpu.telemetry import flight
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        model, params = model_and_params
+        rng = np.random.RandomState(11)
+        reqs = mk_requests(3, rng)
+        clean = run_clean(model, params, step_fn, reqs)
+        cache = fresh_cache()
+        eng, reg, sink = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        flight.enable()
+        try:
+            with faults.inject(decode_nonfinite_steps=frozenset({1}),
+                               decode_nonfinite_lane=1):
+                for r in mk_requests(3, np.random.RandomState(11)):
+                    eng.submit(r)
+                state, rep0 = eng.step(state)
+                assert rep0["decoded"] == [0, 1, 2]
+                state, rep1 = eng.step(state)
+                # ONLY lane 1 quarantined; the others kept this step's
+                # tokens
+                assert rep1["quarantined"] == [1]
+                assert rep1["decoded"] == [0, 2]
+            while not eng.idle():
+                state, _ = eng.step(state)
+        finally:
+            flight.disable()
+        res = {r.id: r for r in eng.drain()}
+        assert res[1].finish_reason == "error"
+        assert "nonfinite" in res[1].error
+        assert res[1].tokens == clean[1][:len(res[1].tokens)]
+        # the survivors' full streams match the fault-free run exactly
+        assert res[0].tokens == clean[0]
+        assert res[2].tokens == clean[2]
+        assert reg.counter("serving_quarantined").value(
+            reason="nonfinite") == 1
+        assert cache.blocks_in_use == 0
+        rec = records.latest_record(flight.FLIGHT_KIND,
+                                    require_backend=None)
+        assert rec["payload"]["trigger"] == "serving_quarantine"
+        assert "1" in str(rec["payload"]["extra"]["requests"])
+
+    def test_binary_split_isolates_raising_sequence(
+            self, model_and_params):
+        model, params = model_and_params
+        rng = np.random.RandomState(12)
+        reqs = mk_requests(4, rng)
+        cache0 = fresh_cache()
+        base_step = serving.make_decode_step(model, cache0)
+        clean = run_clean(model, params, base_step, reqs)
+
+        cache = fresh_cache()
+        wrapped = _PoisonDecode(serving.make_decode_step(model, cache))
+        eng, reg, _ = make_batcher(model, params, wrapped, cache)
+        state = cache.init_state()
+        for r in mk_requests(4, np.random.RandomState(12)):
+            eng.submit(r)
+        state, rep = eng.step(state)     # all admitted, first decode ok
+        assert rep["decoded"] == [0, 1, 2, 3]
+        # poison request 1 by its block table, then keep stepping: the
+        # full-batch dispatch fails, the split exonerates everyone else
+        victim = next(f for f in eng.running if f.req.id == 1)
+        wrapped.poison_blocks = set(cache.table(victim.seq_id))
+        calls_before = wrapped.decode_calls
+        state, rep = eng.step(state)
+        assert rep["quarantined"] == [1]
+        assert sorted(rep["decoded"]) == [0, 2, 3]
+        # the split really retried: full batch + halves + singletons
+        assert wrapped.decode_calls > calls_before + 1
+        while not eng.idle():
+            state, _ = eng.step(state)
+        res = {r.id: r for r in eng.drain()}
+        assert res[1].finish_reason == "error"
+        assert "poisoned sequence" in res[1].error
+        for i in (0, 2, 3):
+            assert res[i].finish_reason == "length"
+            assert res[i].tokens == clean[i]
+        assert reg.counter("serving_quarantined").value(
+            reason="exception") == 1
+        assert cache.blocks_in_use == 0
+
+    def test_transient_decode_fault_absorbed_zero_quarantines(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        rng = np.random.RandomState(13)
+        reqs = mk_requests(2, rng)
+        clean = run_clean(model, params, step_fn, reqs)
+        cache = fresh_cache()
+        eng, reg, _ = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        # call index 1 = engine step 1's FULL-batch dispatch; the
+        # binary-split halves (indices 2, 3) succeed
+        with faults.inject(io_errors={"decode_step": frozenset({1})}):
+            for r in mk_requests(2, np.random.RandomState(13)):
+                eng.submit(r)
+            while not eng.idle():
+                state, _ = eng.step(state)
+        res = {r.id: r for r in eng.drain()}
+        assert {r.finish_reason for r in res.values()} == {"length"}
+        assert res[0].tokens == clean[0]
+        assert res[1].tokens == clean[1]
+        assert reg.counter("serving_quarantined").value() == 0
+
+
+# ---------------------------------------------------------------------------
+# drain snapshots + resume
+# ---------------------------------------------------------------------------
+
+
+class TestDrainResume:
+    def test_snapshot_resume_replays_bitwise(self, model_and_params,
+                                             step_fn, tmp_path):
+        model, params = model_and_params
+        rng = np.random.RandomState(21)
+        reqs = mk_requests(6, rng)
+        clean = run_clean(model, params, step_fn, reqs)
+
+        handler = PreemptionHandler()        # not installed: flag only
+        cache = fresh_cache()
+        eng, _, sink = make_batcher(
+            model, params, step_fn, cache, max_batch=3,
+            preemption=handler, snapshot_dir=str(tmp_path))
+        state = cache.init_state()
+        for r in mk_requests(6, np.random.RandomState(21)):
+            eng.submit(r)
+        state, _ = eng.step(state)
+        state, _ = eng.step(state)           # some tokens in flight
+        handler.requested = True             # the SIGTERM flag
+        state, rep = eng.step(state)
+        assert rep["drained"] is True
+        assert rep["snapshot"] is not None
+        assert eng.draining and not eng.running
+        assert cache.blocks_in_use == 0
+        phase1 = eng.drain()
+        done_ids = {r.id for r in phase1}
+        # a draining engine refuses new work loudly
+        eng.submit(serving.Request(id="late", prompt=[1], max_new_tokens=1))
+        late = eng.drain()
+        assert late[0].finish_reason == "error"
+        assert "draining" in late[0].error
+
+        path = sresil.latest_snapshot(str(tmp_path))
+        assert path == rep["snapshot"]
+        snap = sresil.load_snapshot(path)
+        snap_ids = {e["id"] for e in snap["requests"]}
+        # zero silently dropped: finished + snapshotted == submitted
+        assert done_ids | snap_ids == set(range(6))
+        assert done_ids.isdisjoint(snap_ids)
+        assert any(e["state"] == "in_flight" and e["generated"]
+                   for e in snap["requests"])
+
+        resumed, prior = sresil.resume_requests(snap)
+        cache2 = fresh_cache()
+        eng2, _, _ = make_batcher(model, params, step_fn, cache2,
+                                  max_batch=3)
+        _, results = serving.serve_loop(eng2, cache2.init_state(),
+                                        resumed)
+        merged = sresil.merge_results(results, prior)
+        got = {r.id: r.tokens for r in merged}
+        got.update({r.id: r.tokens for r in phase1})
+        # the replayed streams are identical to the uninterrupted run
+        assert got == clean
+        assert "serving_drain" in [e["event"] for e in sink.events]
+
+    def test_drain_without_snapshot_dir_finishes_inflight(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        handler = PreemptionHandler()
+        cache = fresh_cache()
+        eng, reg, _ = make_batcher(model, params, step_fn, cache,
+                                   max_batch=2, preemption=handler)
+        state = cache.init_state()
+        for i in range(4):
+            eng.submit(serving.Request(id=i, prompt=[1 + i] * 4,
+                                       max_new_tokens=4))
+        state, _ = eng.step(state)           # 0, 1 in flight; 2, 3 queued
+        handler.requested = True
+        state, rep = eng.step(state)
+        assert rep["drained"] is True and rep["snapshot"] is None
+        # queued work fails LOUDLY, in-flight work keeps decoding
+        res = {r.id: r for r in eng.drain()}
+        assert {2, 3} <= set(res)
+        assert all("preempted" in res[i].error for i in (2, 3))
+        while eng.running:
+            state, _ = eng.step(state)
+        res = {r.id: r for r in eng.drain()}
+        assert res[0].finish_reason == "length"
+        assert res[1].finish_reason == "length"
+        assert cache.blocks_in_use == 0
+
+    def test_drain_results_in_completion_order(self, model_and_params,
+                                               step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, _, _ = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        for i, n in enumerate([3, 1, 2]):
+            eng.submit(serving.Request(id=i, prompt=[1 + i] * 4,
+                                       max_new_tokens=n))
+        while not eng.idle():
+            state, _ = eng.step(state)
+        assert [r.id for r in eng.drain()] == [1, 2, 0]
+
+    def test_corrupt_snapshot_refused(self, model_and_params, step_fn,
+                                      tmp_path):
+        model, params = model_and_params
+        handler = PreemptionHandler()
+        cache = fresh_cache()
+        eng, _, _ = make_batcher(model, params, step_fn, cache,
+                                 preemption=handler,
+                                 snapshot_dir=str(tmp_path))
+        state = cache.init_state()
+        eng.submit(serving.Request(id=0, prompt=[5] * 4,
+                                   max_new_tokens=8))
+        state, _ = eng.step(state)
+        with faults.inject(snapshot_corrupt_indices=frozenset({0})):
+            handler.requested = True
+            state, rep = eng.step(state)
+        path = rep["snapshot"]
+        assert path is not None
+        ok, reason = sresil.validate_snapshot(path)
+        assert not ok and "truncated" in reason
+        with pytest.raises(sresil.SnapshotError, match="truncated"):
+            sresil.load_snapshot(path)
+        # latest_snapshot skips the rotten one
+        assert sresil.latest_snapshot(str(tmp_path)) is None
+
+    def test_latest_snapshot_falls_back_to_older_valid(
+            self, model_and_params, step_fn, tmp_path):
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, _, _ = make_batcher(model, params, step_fn, cache)
+        eng.submit(serving.Request(id="q", prompt=[2] * 4,
+                                   max_new_tokens=2))
+        good = sresil.save_snapshot(eng, str(tmp_path), step=5)
+        with faults.inject(snapshot_corrupt_indices=frozenset({1})):
+            sresil.save_snapshot(eng, str(tmp_path), step=9)
+        assert sresil.latest_snapshot(str(tmp_path)) == good
+        snap = sresil.load_snapshot(good)
+        assert snap["requests"][0]["id"] == "q"
+        assert snap["requests"][0]["state"] == "queued"
+
+
+# ---------------------------------------------------------------------------
+# live weight hot-swap
+# ---------------------------------------------------------------------------
+
+
+class TestWeightSwap:
+    def test_swap_installs_at_step_boundary(self, model_and_params,
+                                            step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, reg, sink = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        eng.submit(serving.Request(id=0, prompt=[7] * 5,
+                                   max_new_tokens=6))
+        state, _ = eng.step(state)
+        new_params = jax.tree_util.tree_map(lambda x: x * 1.5, params)
+        info = serving.swap_weights(eng, new_params)
+        assert info["old_digest"] != info["new_digest"]
+        assert eng.params is params      # staged, not yet installed
+        state, _ = eng.step(state)       # the boundary installs it
+        assert eng.params is new_params
+        while not eng.idle():
+            state, _ = eng.step(state)
+        # no request dropped across the swap
+        res = eng.drain()[0]
+        assert res.finish_reason == "length" and len(res.tokens) == 6
+        events = [e for e in sink.events
+                  if e["event"] == "serving_weight_swap"]
+        assert events and events[0]["new_digest"] == info["new_digest"]
+        assert reg.counter("serving_weight_swaps").value() == 1
+        assert cache.blocks_in_use == 0
+
+    def test_swap_rejects_shape_mismatch_structured(
+            self, model_and_params, step_fn, tmp_path, monkeypatch):
+        from apex_tpu import records
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, reg, _ = make_batcher(model, params, step_fn, cache)
+        bad = jax.tree_util.tree_map(lambda x: x, params)
+        leaves, treedef = jax.tree_util.tree_flatten(bad)
+        leaves[0] = jnp.zeros(np.asarray(leaves[0]).shape + (2,))
+        bad = jax.tree_util.tree_unflatten(treedef, leaves)
+        with pytest.raises(serving.WeightSwapError) as ei:
+            serving.swap_weights(eng, bad)
+        assert ei.value.mismatches
+        assert any("expected" in m for m in ei.value.mismatches)
+        assert eng.params is params
+        assert eng._pending_swap is None
+        assert reg.counter("serving_weight_swap_rejected").value() == 1
+
+    def test_weight_swap_mismatch_clause(self, model_and_params,
+                                         step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, _, _ = make_batcher(model, params, step_fn, cache)
+        with faults.inject(weight_swap_mismatch_indices=frozenset({0})):
+            with pytest.raises(serving.WeightSwapError,
+                               match="signature mismatch"):
+                serving.swap_weights(eng, params)
+        # the next swap (index 1) is off-plan and goes through
+        serving.swap_weights(eng, params)
+        assert eng._pending_swap is not None
+
+    def test_fingerprint_manifest_validation(self, model_and_params,
+                                             step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, _, _ = make_batcher(model, params, step_fn, cache)
+        fp = serving.params_fingerprint(params)
+        serving.swap_weights(eng, params, expect_fingerprint=fp)
+        wrong = fp.copy()
+        wrong[0] ^= 1
+        with pytest.raises(serving.WeightSwapError,
+                           match="signature mismatch"):
+            serving.swap_weights(eng, params, expect_fingerprint=wrong)
+
+
+# ---------------------------------------------------------------------------
+# thread-safe submission
+# ---------------------------------------------------------------------------
+
+
+class TestThreadSafety:
+    def test_concurrent_submit_loses_nothing(self, model_and_params,
+                                             step_fn):
+        model, params = model_and_params
+        cache = fresh_cache(num_blocks=32)
+        eng, _, _ = make_batcher(model, params, step_fn, cache)
+        state = cache.init_state()
+        n_threads, per = 4, 8
+
+        def client(t):
+            for i in range(per):
+                eng.submit(serving.Request(
+                    id=(t, i), prompt=[1 + t] * 3, max_new_tokens=2))
+
+        threads = [threading.Thread(target=client, args=(t,))
+                   for t in range(n_threads)]
+        for th in threads:
+            th.start()
+        results = []
+        for _ in range(500):
+            for th in threads:
+                th.join(timeout=0.001)
+            state, _ = eng.step(state)
+            results.extend(eng.drain())
+            if (all(not th.is_alive() for th in threads)
+                    and eng.idle()):
+                break
+        results.extend(eng.drain())
+        assert len(results) == n_threads * per
+        assert {tuple(r.id) for r in results} == {
+            (t, i) for t in range(n_threads) for i in range(per)}
+        assert all(r.finish_reason == "length" for r in results)
+        assert cache.blocks_in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# grammar
+# ---------------------------------------------------------------------------
+
+
+class TestGrammar:
+    def test_new_serving_clauses(self):
+        inj = faults.FaultInjector.from_env(
+            "decode_nonfinite=2,4;decode_nonfinite_lane=1;"
+            "serving_snapshot_corrupt=0;weight_swap_mismatch=3")
+        assert inj.nonfinite_lane_at(2) == 1
+        assert inj.nonfinite_lane_at(4) == 1
+        assert inj.nonfinite_lane_at(3) is None
+        assert inj.should_snapshot_corrupt(0)
+        assert not inj.should_snapshot_corrupt(1)
+        assert inj.should_weight_swap_mismatch(3)
+        assert not inj.should_weight_swap_mismatch(0)
+
+    def test_deadline_validation(self):
+        with pytest.raises(ValueError, match="deadline_ms"):
+            serving.Request(id=0, prompt=[1], deadline_ms=0)
+        serving.Request(id=0, prompt=[1], deadline_ms=5.0)

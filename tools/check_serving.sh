@@ -8,7 +8,20 @@
 #  - exactly the expected decode-bucket compile count (ONE program:
 #    decode pads to max_batch over one table-width bucket), and
 #  - ZERO decode recompile events after warmup (no recompile storm in
-#    the hot loop — docs/serving.md "compile plane").
+#    the hot loop — docs/serving.md "compile plane"),
+# then the resilience tier (docs/serving.md "Failure modes &
+# recovery"):
+#  - the APEX_TPU_FAULTS env-knob matrix: every serving clause parses
+#    from the env grammar and forces its degradation path
+#    (serving_pool_exhausted / decode_step_exception /
+#    decode_nonfinite / serving_snapshot_corrupt /
+#    weight_swap_mismatch), and
+#  - the CHAOS smoke: 200 requests with decode_nonfinite injected AND
+#    a real mid-run SIGTERM — the engine must quarantine ONLY the
+#    poisoned sequence, drain with a committed serving snapshot (zero
+#    admitted requests silently dropped), resume on a fresh engine
+#    with bitwise-identical token streams, and land >= 90% of the
+#    fault-free goodput.
 # Extra args pass through to pytest.
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -16,8 +29,8 @@ export JAX_PLATFORMS=cpu
 
 rc=0
 
-python -m pytest tests/test_serving.py "$@" -q \
-    -p no:cacheprovider || rc=1
+python -m pytest tests/test_serving.py tests/test_serving_resilience.py \
+    "$@" -q -p no:cacheprovider || rc=1
 
 echo "== 200-request smoke: continuous batching vs static batch =="
 python - <<'PY' || rc=1
@@ -126,6 +139,217 @@ try:
           f"zero hot-loop recompiles, no storms")
 finally:
     _compiled.disable()
+PY
+
+echo "== env-knob matrix: every serving fault clause, via APEX_TPU_FAULTS =="
+python - <<'PY' || rc=1
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import serving, telemetry
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.resilience import faults
+from apex_tpu.serving import resilience as sresil
+
+cfg = GPTConfig(vocab_size=64, max_seq_len=64, hidden_size=32,
+                num_layers=2, num_heads=4, num_kv_heads=2,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+model = GPTModel(cfg)
+rng = np.random.RandomState(0)
+params = model.init(jax.random.PRNGKey(0),
+                    jnp.asarray(rng.randint(0, 64, (1, 8)), jnp.int32))
+cache = serving.KVCache(2, 2, 8, num_blocks=16, block_size=4)
+step_fn = serving.make_decode_step(model, cache)
+
+
+def engine(**kw):
+    reg = telemetry.MetricsRegistry()
+    eng = serving.ContinuousBatcher(model, params, cache, step_fn=step_fn,
+                                    max_batch=4, registry=reg, **kw)
+    return eng, reg
+
+
+def drill(knob, fn):
+    os.environ[faults.ENV_KNOB] = knob
+    try:
+        fn()
+    finally:
+        os.environ.pop(faults.ENV_KNOB, None)
+    print(f"  clause OK: {knob}")
+
+
+def d_pool():
+    eng, reg = engine()
+    eng.submit(serving.Request(id=0, prompt=[1] * 4, max_new_tokens=2))
+    state, rep = eng.step(cache.init_state())
+    assert rep["admitted"] == [] and rep["queued"] == 1, rep
+    while not eng.idle():
+        state, _ = eng.step(state)
+    assert eng.drain()[0].finish_reason == "length"
+
+
+def d_exc():
+    eng, reg = engine()
+    eng.submit(serving.Request(id=0, prompt=[1] * 4, max_new_tokens=4))
+    state, rep = eng.step(cache.init_state())
+    assert rep["quarantined"] == [0], rep
+    assert reg.counter("serving_quarantined").value(reason="exception") == 1
+
+
+def d_nonfinite():
+    eng, reg = engine()
+    for i in range(2):
+        eng.submit(serving.Request(id=i, prompt=[1 + i] * 4,
+                                   max_new_tokens=4))
+    state, _ = eng.step(cache.init_state())
+    state, rep = eng.step(state)           # decode_nonfinite=1, lane 1
+    assert rep["quarantined"] == [1], rep
+    assert rep["decoded"] == [0], rep
+    while not eng.idle():
+        state, _ = eng.step(state)
+    res = {r.id: r for r in eng.drain()}
+    assert res[0].finish_reason == "length"
+    assert "nonfinite" in res[1].error
+    assert reg.counter("serving_quarantined").value(reason="nonfinite") == 1
+
+
+def d_snap(tmp="/tmp/apex_tpu_check_serving_snap"):
+    import shutil
+    shutil.rmtree(tmp, ignore_errors=True)
+    eng, reg = engine()
+    eng.submit(serving.Request(id=0, prompt=[2] * 4, max_new_tokens=2))
+    path = sresil.save_snapshot(eng, tmp, step=0)
+    ok, reason = sresil.validate_snapshot(path)
+    assert not ok and "truncated" in reason, (ok, reason)
+    assert sresil.latest_snapshot(tmp) is None
+    shutil.rmtree(tmp, ignore_errors=True)
+
+
+def d_swap():
+    eng, reg = engine()
+    try:
+        serving.swap_weights(eng, params)
+    except serving.WeightSwapError as e:
+        assert e.mismatches
+    else:
+        raise AssertionError("injected weight_swap_mismatch not raised")
+    assert reg.counter("serving_weight_swap_rejected").value() == 1
+
+
+drill("serving_pool_exhausted=0", d_pool)
+drill("decode_step_exception=0", d_exc)
+drill("decode_nonfinite=1;decode_nonfinite_lane=1", d_nonfinite)
+drill("serving_snapshot_corrupt=0", d_snap)
+drill("weight_swap_mismatch=0", d_swap)
+print("env-knob matrix OK: 5 serving clauses")
+PY
+
+echo "== chaos smoke: 200 requests, decode_nonfinite + mid-run SIGTERM =="
+python - <<'PY' || rc=1
+import os
+import shutil
+import tempfile
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import serving
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.resilience import faults
+from apex_tpu.resilience.guard import PreemptionHandler
+from apex_tpu.serving import resilience as sresil
+
+cfg = GPTConfig(vocab_size=512, max_seq_len=128, hidden_size=128,
+                num_layers=2, num_heads=4, num_kv_heads=2,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+model = GPTModel(cfg)
+rng = np.random.RandomState(0)
+params = model.init(jax.random.PRNGKey(0),
+                    jnp.asarray(rng.randint(0, 512, (1, 8)), jnp.int32))
+MAX_BATCH = 8
+N = 200
+
+
+def make_requests():
+    r = np.random.RandomState(7)
+    return [serving.Request(
+        id=i, prompt=r.randint(0, 512, (int(r.randint(4, 25)),)),
+        max_new_tokens=int(r.randint(4, 41))) for i in range(N)]
+
+
+def fresh():
+    cache = serving.KVCache.for_config(cfg, num_blocks=MAX_BATCH * 8,
+                                       block_size=16)
+    return cache, serving.make_decode_step(model, cache)
+
+
+# fault-free baseline: the bitwise reference and the goodput bar
+cache, step_fn = fresh()
+eng = serving.ContinuousBatcher(model, params, cache, step_fn=step_fn,
+                                max_batch=MAX_BATCH, min_seq_bucket=32)
+_, base = serving.serve_loop(eng, cache.init_state(), make_requests())
+baseline = {r.id: r.tokens for r in base}
+base_toks = sum(len(t) for t in baseline.values())
+assert len(baseline) == N
+
+# chaos run: NaN-poison one lane at step 40, REAL SIGTERM at step 80
+snapdir = tempfile.mkdtemp(prefix="apex_tpu_chaos_")
+os.environ[faults.ENV_KNOB] = "decode_nonfinite=40;sigterm=80"
+handler = PreemptionHandler().install()
+try:
+    cache, step_fn = fresh()
+    eng = serving.ContinuousBatcher(
+        model, params, cache, step_fn=step_fn, max_batch=MAX_BATCH,
+        min_seq_bucket=32, preemption=handler, snapshot_dir=snapdir)
+    _, phase1 = serving.serve_loop(eng, cache.init_state(),
+                                   make_requests())
+finally:
+    handler.uninstall()
+    os.environ.pop(faults.ENV_KNOB, None)
+
+assert eng.draining and eng.drained_snapshot, "engine did not drain"
+assert handler.requested, "SIGTERM was not delivered/latched"
+quarantined = [r for r in phase1 if r.finish_reason == "error"]
+assert len(quarantined) == 1, (
+    f"expected exactly the poisoned sequence quarantined, got "
+    f"{[(r.id, r.error) for r in quarantined]}")
+assert "nonfinite" in quarantined[0].error
+
+# zero silently dropped: finished + snapshotted == admitted/submitted
+snap = sresil.load_snapshot(eng.drained_snapshot)
+snap_ids = {e["id"] for e in snap["requests"]}
+done_ids = {r.id for r in phase1}
+assert done_ids | snap_ids == set(range(N)), "requests vanished"
+assert done_ids.isdisjoint(snap_ids)
+
+# resume on a fresh engine; merged streams must be bitwise identical
+resumed, prior = sresil.resume_requests(snap)
+cache2, step2 = fresh()
+eng2 = serving.ContinuousBatcher(model, params, cache2, step_fn=step2,
+                                 max_batch=MAX_BATCH, min_seq_bucket=32)
+_, phase2 = serving.serve_loop(eng2, cache2.init_state(), resumed)
+merged = sresil.merge_results(phase2, prior)
+got = {r.id: r.tokens for r in merged}
+got.update({r.id: r.tokens for r in phase1
+            if r.finish_reason != "error"})
+bad_id = quarantined[0].id
+mismatch = [i for i in got if i != bad_id and got[i] != baseline[i]]
+assert not mismatch, f"non-bitwise replay for ids {mismatch[:5]}"
+assert len(got) == N - 1 + (1 if bad_id in got else 0)
+
+ok_toks = sum(len(t) for i, t in got.items() if i != bad_id)
+goodput = ok_toks / base_toks
+n_resumed_inflight = sum(1 for e in snap["requests"]
+                         if e["state"] == "in_flight")
+print(f"chaos OK: quarantined only id {bad_id}, snapshot carried "
+      f"{len(snap_ids)} requests ({n_resumed_inflight} in-flight), "
+      f"resume bitwise, goodput {goodput:.3f} of fault-free")
+assert goodput >= 0.90, f"goodput {goodput:.3f} < 0.90"
+shutil.rmtree(snapdir, ignore_errors=True)
 PY
 
 if [ "$rc" -ne 0 ]; then
