@@ -125,6 +125,13 @@ class ParallelAttention(nn.Module):
       own K/V, with ``ctx_mask`` (b, L) marking the valid prefix —
       per-sequence lengths ride the flash kernel's segment-id masking,
       so no causal geometry is hard-wired to the input shape.
+    - With ``s > 1`` the same hook is the chunk-resumable prefill
+      path (chunked prefill, docs/serving.md): the s chunk tokens
+      attend the gathered context (``ctx_mask`` marks the
+      already-written prefix) PLUS themselves causally, via the flash
+      kernel's ``sk > sq`` causal offset — key layout
+      ``[ctx | chunk]``, query i sees key slot j iff ``j <= i + L``,
+      and the per-lane segment ids drop the unwritten context tail.
     """
 
     config: GPTConfig
@@ -190,26 +197,40 @@ class ParallelAttention(nn.Module):
             if cfg.attention_window is not None:
                 raise NotImplementedError(
                     "kv_ctx decode with attention_window is not supported")
-            if s != 1:
-                raise ValueError(
-                    f"kv_ctx decode expects a single query token, got "
-                    f"seq {s}")
             from apex_tpu.ops.attention import flash_attention
 
             k_ctx, v_ctx, ctx_mask = kv_ctx
-            qb = q.transpose(1, 2, 0, 3)                  # (b, h, 1, d)
+            qb = q.transpose(1, 2, 0, 3)                  # (b, h, s, d)
             k_all = jnp.concatenate([k_ctx.astype(cfg.dtype), kv_new[0]],
                                     axis=2)
             v_all = jnp.concatenate([v_ctx.astype(cfg.dtype), kv_new[1]],
                                     axis=2)
-            # segment masking: valid prefix + the token itself = 0,
-            # everything else 1 (flash zero-fills q-side segments)
-            kv_seg = jnp.concatenate(
-                [jnp.where(ctx_mask, 0, 1).astype(jnp.int32),
-                 jnp.zeros((b, 1), jnp.int32)], axis=1)
-            ctx = flash_attention(qb, k_all, v_all, causal=False,
-                                  kv_segment_ids=kv_seg,
-                                  impl=cfg.softmax_impl)
+            if s == 1:
+                # decode: one query per sequence. Segment masking only:
+                # valid prefix + the token itself = 0, everything else
+                # 1 (flash zero-fills q-side segments). Kept exactly as
+                # the pre-chunking program — greedy decode stays
+                # bitwise-identical.
+                kv_seg = jnp.concatenate(
+                    [jnp.where(ctx_mask, 0, 1).astype(jnp.int32),
+                     jnp.zeros((b, 1), jnp.int32)], axis=1)
+                ctx = flash_attention(qb, k_all, v_all, causal=False,
+                                      kv_segment_ids=kv_seg,
+                                      impl=cfg.softmax_impl)
+            else:
+                # chunk-resumable prefill: s chunk queries over the
+                # [ctx | chunk] key layout. causal=True with sk > sq
+                # gives query i the keys j <= i + L (all of ctx + the
+                # chunk's own causal prefix); the per-lane segment ids
+                # drop ctx slots past the written prefix (ctx_mask) —
+                # chunk padding keys sit AFTER every real query, so
+                # the causal offset already masks them.
+                kv_seg = jnp.concatenate(
+                    [jnp.where(ctx_mask, 0, 1).astype(jnp.int32),
+                     jnp.zeros((b, s), jnp.int32)], axis=1)
+                ctx = flash_attention(qb, k_all, v_all, causal=True,
+                                      kv_segment_ids=kv_seg,
+                                      impl=cfg.softmax_impl)
             ctx = ctx.transpose(2, 0, 1, 3).reshape(
                 s, b, heads_local * head_dim)
             return _out(ctx)

@@ -102,6 +102,16 @@ Serving sites (apex_tpu/serving/scheduler.py, docs/serving.md):
                                  quarantine only that sequence
 - ``decode_nonfinite_lane=<i>``  which in-flight lane takes the NaN
                                  (default: lane 0)
+- ``prefill_chunk_exception=<idx>`` the chunk-prefill dispatch number
+                                 ``idx`` (0-based, per engine; the
+                                 binary-split retries re-check the
+                                 SAME index) raises ``FaultError`` —
+                                 the whole chunk batch quarantines
+                                 and the engine keeps serving.
+                                 ``io:prefill_chunk`` injects by CALL
+                                 index instead: one transient index
+                                 is absorbed by the split retry with
+                                 zero quarantines
 - ``serving_snapshot_corrupt=<idx>`` truncate the serving drain
                                  snapshot payload AFTER it is finalized
                                  at these 0-based save indices — the
@@ -167,6 +177,7 @@ class FaultInjector:
     # serving sites (apex_tpu/serving/scheduler.py, serving/resilience.py)
     pool_exhausted_steps: FrozenSet[int] = frozenset()
     decode_exception_steps: FrozenSet[int] = frozenset()
+    prefill_chunk_exception_indices: FrozenSet[int] = frozenset()
     decode_nonfinite_steps: FrozenSet[int] = frozenset()
     decode_nonfinite_lane: int = 0
     snapshot_corrupt_indices: FrozenSet[int] = frozenset()
@@ -291,6 +302,18 @@ class FaultInjector:
                 f"injected decode-step exception at engine step "
                 f"{int(step)}")
 
+    def maybe_prefill_chunk_exception(self, index: int) -> None:
+        """Raise a :class:`FaultError` out of the serving chunk-prefill
+        dispatch number ``index`` (0-based, per engine). The scheduler
+        passes the TOP-LEVEL dispatch index down through its
+        binary-split retries, so a planned index fails every
+        sub-dispatch — the whole chunk batch quarantines, mirroring
+        ``decode_step_exception``."""
+        if int(index) in self.prefill_chunk_exception_indices:
+            raise FaultError(
+                f"injected prefill-chunk exception at dispatch "
+                f"{int(index)}")
+
     def nonfinite_lane_at(self, step: int) -> Optional[int]:
         """In-flight lane whose cached K/V the serving engine poisons
         with NaN before the decode dispatch at ``step`` (the lane's
@@ -364,6 +387,8 @@ class FaultInjector:
                 kw["pool_exhausted_steps"] = _int_set(val)
             elif key == "decode_step_exception":
                 kw["decode_exception_steps"] = _int_set(val)
+            elif key == "prefill_chunk_exception":
+                kw["prefill_chunk_exception_indices"] = _int_set(val)
             elif key == "decode_nonfinite":
                 kw["decode_nonfinite_steps"] = _int_set(val)
             elif key == "decode_nonfinite_lane":
@@ -490,6 +515,12 @@ def maybe_decode_exception(step: int) -> None:
         inj.maybe_decode_exception(step)
 
 
+def maybe_prefill_chunk_exception(index: int) -> None:
+    inj = active()
+    if inj is not None:
+        inj.maybe_prefill_chunk_exception(index)
+
+
 def nonfinite_lane_at(step: int) -> Optional[int]:
     inj = active()
     return None if inj is None else inj.nonfinite_lane_at(step)
@@ -509,6 +540,7 @@ __all__ = [
     "ENV_KNOB", "FaultError", "FaultInjector", "SimulatedCrash",
     "active", "check", "flip_bits", "inject", "install", "maybe_crash",
     "maybe_crash_before_commit", "maybe_decode_exception",
+    "maybe_prefill_chunk_exception",
     "maybe_sigterm", "nonfinite_lane_at", "poison_grads",
     "shard_truncate_target", "should_pool_exhaust",
     "should_range_timeout", "should_snapshot_corrupt",

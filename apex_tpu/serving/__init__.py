@@ -28,18 +28,36 @@ deadlines (``Request.deadline_ms``), per-request fault isolation
 (binary-split quarantine + in-jit nonfinite localization),
 preemption-safe drain snapshots a fresh engine resumes bitwise, and
 live weight hot-swap (``swap_weights``) at step boundaries.
+
+The hot-path plane (docs/serving.md "Chunked prefill" / "Prefix
+cache"): chunked prefill (``ContinuousBatcher(prefill_chunk=...)``)
+advances long prompts one bucketed chunk per step co-scheduled with
+decode, prefix-sharing KV reuse hands repeated prompt prefixes out as
+refcounted read-only blocks with copy-on-write at the divergence
+block, and token selection (temperature/top-k/top-p, per-request
+counter-based PRNG) is fused inside the decode program —
+``temperature=0`` stays bitwise-greedy.
 """
 
-from apex_tpu.serving.decode import DecodeStep, StepOut, make_decode_step
+from apex_tpu.serving.decode import (
+    DecodeStep,
+    StepOut,
+    greedy_sampling,
+    make_decode_step,
+)
 from apex_tpu.serving.kv_cache import (
     KVCache,
     KVCacheState,
     PoolExhausted,
+    PrefixMatch,
     TRASH_BLOCK,
     append_kv,
+    append_kv_chunk,
     append_kv_prefill,
+    apply_copies,
     bucket,
     gather_kv,
+    scrub_blocks,
 )
 from apex_tpu.serving.resilience import (
     SnapshotError,
@@ -69,6 +87,7 @@ __all__ = [
     "KVCache",
     "KVCacheState",
     "PoolExhausted",
+    "PrefixMatch",
     "Request",
     "RequestResult",
     "SnapshotError",
@@ -76,9 +95,12 @@ __all__ = [
     "TRASH_BLOCK",
     "WeightSwapError",
     "append_kv",
+    "append_kv_chunk",
     "append_kv_prefill",
+    "apply_copies",
     "bucket",
     "gather_kv",
+    "greedy_sampling",
     "latest_snapshot",
     "load_snapshot",
     "make_decode_step",
@@ -88,6 +110,7 @@ __all__ = [
     "params_signature",
     "resume_requests",
     "save_snapshot",
+    "scrub_blocks",
     "serve_loop",
     "static_batch_generate",
     "swap_weights",

@@ -8,28 +8,38 @@ eviction-free dict keyed on the bucketed shapes:
 
 - decode: ``(batch_bucket, table_width)`` — the only dynamic shapes a
   decode dispatch has;
-- prefill: ``(batch_bucket, seq_bucket, table_width)``.
+- prefill: ``(batch_bucket, seq_bucket, table_width)``;
+- prefill_chunk: ``(batch_bucket, chunk_bucket, table_width)`` — the
+  chunk-resumable prefill (chunked prefill / prefix-cache resume),
+  which gathers the already-written context and appends the chunk.
 
 Every NEW key is observed by the PR-6 compile tracker
 (``telemetry.compiled.observe``) under ``fn="decode_step"`` /
-``fn="prefill_step"`` and the compiling dispatch runs inside a
-``label(...)`` scope, so decode-shape churn shows up as ``recompile``
-events with a signature diff — and a scheduler that buckets properly
-triggers ZERO recompile events after warmup (tools/check_serving.sh
-pins it). Cache hits never reach the tracker: the hot loop is one
-dict lookup.
+``fn="prefill_step"`` / ``fn="prefill_chunk"`` and the compiling
+dispatch runs inside a ``label(...)`` scope, so decode-shape churn
+shows up as ``recompile`` events with a signature diff — and a
+scheduler that buckets properly triggers ZERO recompile events after
+warmup (tools/check_serving.sh pins it). Cache hits never reach the
+tracker: the hot loop is one dict lookup.
 
 Fused hot path (PAPERS.md "LLM Inference Acceleration via Efficient
 Operation Fusion" — the prefill/decode analog of PR 1's fused
 optimizer step): prefill runs embed -> L layers -> final norm -> LM
 head -> last-token logit gather -> cache scatter as one program;
 decode runs gather -> single-query attention (per-layer, inside the
-layer scan) -> logits -> greedy argmax -> cache append as one program.
-Nothing round-trips to the host but the (b,) next-token ids and the
-(b, vocab) logits.
+layer scan) -> logits -> token selection -> cache append as one
+program. Token selection is FUSED in-program too: a per-lane
+temperature / top-k / top-p sampler draws from a counter-based PRNG
+key (``fold_in(PRNGKey(seed), emitted_token_index)`` — pure function
+of the request seed and the token's sequence index, so a drain/resume
+replay regenerates the identical stream), gated by ``lax.cond`` so an
+all-greedy batch never pays the sort. ``temperature == 0`` lanes take
+the greedy argmax — bitwise the pre-sampling behavior. Nothing
+round-trips to the host but the (b,) next-token ids, the (b,) finite
+flags, and the (b, vocab) logits.
 
 Both steps are teacher-forcing-friendly: they return the raw last
-logits next to the argmax ids, so the parity suite replays a known
+logits next to the selected ids, so the parity suite replays a known
 sequence through decode and compares against the full-sequence
 forward (tests/test_serving.py).
 """
@@ -38,10 +48,13 @@ from __future__ import annotations
 
 from typing import Any, Dict, NamedTuple, Tuple
 
+import numpy as np
+
 from apex_tpu.serving.kv_cache import (
     KVCache,
     KVCacheState,
     append_kv,
+    append_kv_chunk,
     append_kv_prefill,
     gather_kv,
 )
@@ -51,7 +64,10 @@ class StepOut(NamedTuple):
     """One prefill/decode dispatch's results (device arrays)."""
 
     logits: Any        # (batch, vocab) fp32 — the LAST real token's
-    next_token: Any    # (batch,) int32 greedy argmax of ``logits``
+    # (batch,) int32 — the selected next token: per-lane fused
+    # temperature/top-k/top-p sample, or the greedy argmax for
+    # temperature == 0 lanes (bitwise the pre-sampling behavior)
+    next_token: Any
     cache: KVCacheState
     # (batch,) bool — every logit of the lane is finite. Computed
     # IN-JIT (one fused reduction over logits the program already
@@ -59,6 +75,14 @@ class StepOut(NamedTuple):
     # bool pull instead of the full (b, vocab) logits
     # (serving/resilience.py quarantine path). None on older callers.
     finite: Any = None
+
+
+def greedy_sampling(b: int) -> Tuple[np.ndarray, ...]:
+    """The all-greedy sampling arrays for a batch of ``b`` lanes —
+    what every dispatch uses when the caller passes ``sampling=None``
+    (temperature 0, no top-k, top-p 1, seed 0)."""
+    return (np.zeros(b, np.float32), np.zeros(b, np.int32),
+            np.ones(b, np.float32), np.zeros(b, np.uint32))
 
 
 class DecodeStep:
@@ -79,17 +103,97 @@ class DecodeStep:
         cfg = model.config
         max_pos = cfg.max_seq_len - 1
 
-        def prefill_fn(params, state, tokens, lengths, tables):
+        def select_token(out, sampling, fold_pos):
+            """Fused in-program token selection over the (b, vocab)
+            fp32 logits ``out``: greedy argmax for temperature-0
+            lanes (bitwise the pre-sampling path), a per-lane
+            temperature/top-k/top-p gumbel-max draw otherwise. The
+            PRNG key is counter-based — ``fold_in(PRNGKey(seed),
+            fold_pos)`` with ``fold_pos`` the emitted token's global
+            sequence index — so replaying a prefix regenerates the
+            identical stream (the drain/resume contract)."""
+            temps, top_ks, top_ps, seeds = sampling
+            greedy = jnp.argmax(out, axis=-1).astype(jnp.int32)
+
+            def sample(_):
+                b, v = out.shape
+                t = jnp.where(temps > 0, temps, 1.0).astype(jnp.float32)
+                scaled = out.astype(jnp.float32) / t[:, None]
+                sdesc = -jnp.sort(-scaled, axis=-1)     # descending
+                kk = jnp.where(top_ks > 0, jnp.clip(top_ks, 1, v),
+                               v).astype(jnp.int32)
+                kth = jnp.take_along_axis(sdesc, (kk - 1)[:, None],
+                                          axis=1)
+                probs = jax.nn.softmax(sdesc, axis=-1)
+                cum = jnp.cumsum(probs, axis=-1)
+                # nucleus: keep the smallest prefix whose mass >= p
+                # (entry i survives iff the mass BEFORE it is < p)
+                keep = jnp.concatenate(
+                    [jnp.ones((b, 1), bool),
+                     cum[:, :-1] < top_ps[:, None]], axis=1)
+                n_keep = jnp.sum(keep, axis=-1).astype(jnp.int32)
+                pth = jnp.take_along_axis(sdesc, (n_keep - 1)[:, None],
+                                          axis=1)
+                thresh = jnp.maximum(kth, pth)
+                masked = jnp.where(scaled >= thresh, scaled, -jnp.inf)
+
+                def one(seed, pos, row):
+                    key = jax.random.fold_in(
+                        jax.random.PRNGKey(seed), pos)
+                    g = jax.random.gumbel(key, row.shape, jnp.float32)
+                    return jnp.argmax(row + g)
+
+                drawn = jax.vmap(one)(seeds, fold_pos,
+                                      masked).astype(jnp.int32)
+                return jnp.where(temps > 0, drawn, greedy)
+
+            # an all-greedy batch never pays the sort/softmax/cumsum
+            return jax.lax.cond(jnp.any(temps > 0), sample,
+                                lambda _: greedy, None)
+
+        def prefill_fn(params, state, tokens, lengths, tables, temps,
+                       top_ks, top_ps, seeds):
             b, s = tokens.shape
             logits, (k_new, v_new) = model.apply(
                 params, tokens, return_kv=True)
             state = append_kv_prefill(state, k_new, v_new, tables, lengths)
             last = jnp.clip(lengths - 1, 0, s - 1)
             out = logits[last, jnp.arange(b)]          # (b, vocab)
-            return StepOut(out, jnp.argmax(out, axis=-1).astype(jnp.int32),
-                           state, jnp.all(jnp.isfinite(out), axis=-1))
+            # the emitted token lands at sequence index == prompt len
+            nxt = select_token(out, (temps, top_ks, top_ps, seeds),
+                               lengths)
+            return StepOut(out, nxt, state,
+                           jnp.all(jnp.isfinite(out), axis=-1))
 
-        def decode_fn(params, state, tokens, positions, tables):
+        def prefill_chunk_fn(params, state, tokens, starts, lengths,
+                             tables, temps, top_ks, top_ps, seeds):
+            b, s = tokens.shape
+            # gather BEFORE the chunk's writes: the context is every
+            # previously-written position (< starts); the chunk's own
+            # K/V rides kv_new inside the attention
+            k_ctx, v_ctx = gather_kv(state, tables)
+            L = k_ctx.shape[3]
+            ctx_mask = (jnp.arange(L, dtype=jnp.int32)[None, :]
+                        < starts[:, None])
+            pos = jnp.clip(
+                starts[:, None] + jnp.arange(s, dtype=jnp.int32)[None, :],
+                0, max_pos)
+            logits, (k_new, v_new) = model.apply(
+                params, tokens, positions=pos,
+                kv_ctx=(k_ctx, v_ctx), ctx_mask=ctx_mask, return_kv=True)
+            state = append_kv_chunk(state, k_new, v_new, tables, starts,
+                                    lengths)
+            last = jnp.clip(lengths - 1, 0, s - 1)
+            out = logits[last, jnp.arange(b)]          # (b, vocab)
+            # only meaningful on a prompt-completing chunk: the
+            # emitted token's index is starts + chunk length
+            nxt = select_token(out, (temps, top_ks, top_ps, seeds),
+                               starts + lengths)
+            return StepOut(out, nxt, state,
+                           jnp.all(jnp.isfinite(out), axis=-1))
+
+        def decode_fn(params, state, tokens, positions, tables, temps,
+                      top_ks, top_ps, seeds):
             k_ctx, v_ctx = gather_kv(state, tables)
             L = k_ctx.shape[3]
             ctx_mask = (jnp.arange(L, dtype=jnp.int32)[None, :]
@@ -101,11 +205,16 @@ class DecodeStep:
             state = append_kv(state, k_new[:, :, :, 0], v_new[:, :, :, 0],
                               tables, positions)
             out = logits[0]                            # (b, vocab)
-            return StepOut(out, jnp.argmax(out, axis=-1).astype(jnp.int32),
-                           state, jnp.all(jnp.isfinite(out), axis=-1))
+            # the emitted token lands at positions + 1
+            nxt = select_token(out, (temps, top_ks, top_ps, seeds),
+                               positions + 1)
+            return StepOut(out, nxt, state,
+                           jnp.all(jnp.isfinite(out), axis=-1))
 
         # cache state donated (argnums 1): appends run in place
         self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(1,))
+        self._prefill_chunk_jit = jax.jit(prefill_chunk_fn,
+                                          donate_argnums=(1,))
         self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
         self._jnp = jnp
 
@@ -114,7 +223,7 @@ class DecodeStep:
     def _signature(self, fn: str, key: Tuple) -> Dict[str, Any]:
         cfg = self.model.config
         sig: Dict[str, Any] = {"fn": fn}
-        if fn == "prefill_step":
+        if fn in ("prefill_step", "prefill_chunk"):
             sig.update(batch=key[1], seq=key[2], table_width=key[3])
         else:
             sig.update(batch=key[1], table_width=key[2])
@@ -145,22 +254,35 @@ class DecodeStep:
     def compile_keys(self) -> Dict[str, int]:
         """Distinct compiled shapes per step kind (the bench/smoke
         assertion surface: the expected decode-bucket compile count)."""
-        out: Dict[str, int] = {"prefill_step": 0, "decode_step": 0}
+        out: Dict[str, int] = {"prefill_step": 0, "prefill_chunk": 0,
+                               "decode_step": 0}
         for key in self._compiled:
             out[key[0]] += 1
         return out
 
     # -- dispatchers ---------------------------------------------------------
 
+    def _sampling_arrays(self, b: int, sampling):
+        jnp = self._jnp
+        if sampling is None:
+            sampling = greedy_sampling(b)
+        temps, top_ks, top_ps, seeds = sampling
+        return (jnp.asarray(temps, jnp.float32),
+                jnp.asarray(top_ks, jnp.int32),
+                jnp.asarray(top_ps, jnp.float32),
+                jnp.asarray(seeds, jnp.uint32))
+
     def prefill(self, params, state: KVCacheState, tokens, lengths,
-                tables) -> StepOut:
+                tables, sampling=None) -> StepOut:
         """Run the full (right-padded) prompts, write their K/V into
         the pool, and return the LAST real token's logits — the first
         generated token's distribution — in one program.
 
         ``tokens`` (b, s) int32; ``lengths`` (b,) real prompt lengths;
-        ``tables`` (b, w) block tables (trash-padded). Dummy batch rows
-        use length 0 and an all-trash table.
+        ``tables`` (b, w) block tables (trash-padded); ``sampling``
+        optional ``(temps, top_ks, top_ps, seeds)`` per-lane arrays
+        (None = all-greedy). Dummy batch rows use length 0 and an
+        all-trash table.
         """
         jnp = self._jnp
         tokens = jnp.asarray(tokens, jnp.int32)
@@ -168,27 +290,58 @@ class DecodeStep:
         tables = jnp.asarray(tables, jnp.int32)
         key = ("prefill_step", tokens.shape[0], tokens.shape[1],
                tables.shape[1])
-        return self._dispatch("prefill_step", key, self._prefill_jit,
-                              params, state, tokens, lengths, tables)
+        return self._dispatch(
+            "prefill_step", key, self._prefill_jit, params, state,
+            tokens, lengths, tables,
+            *self._sampling_arrays(tokens.shape[0], sampling))
+
+    def prefill_chunk(self, params, state: KVCacheState, tokens,
+                      starts, lengths, tables,
+                      sampling=None) -> StepOut:
+        """Resume prefill with one CHUNK per sequence: row ``i`` of
+        lane ``b`` is the prompt token at global position
+        ``starts[b] + i`` (``lengths[b]`` real rows, the rest pad).
+        The chunk attends the already-written cache prefix (gathered
+        in-program) plus itself causally, writes its K/V at the
+        offset positions, and emits the last real row's logits — the
+        first-token distribution when the chunk completes the prompt.
+        One program, cache donated; the chunked-prefill hot path
+        (docs/serving.md "Chunked prefill").
+        """
+        jnp = self._jnp
+        tokens = jnp.asarray(tokens, jnp.int32)
+        starts = jnp.asarray(starts, jnp.int32)
+        lengths = jnp.asarray(lengths, jnp.int32)
+        tables = jnp.asarray(tables, jnp.int32)
+        key = ("prefill_chunk", tokens.shape[0], tokens.shape[1],
+               tables.shape[1])
+        return self._dispatch(
+            "prefill_chunk", key, self._prefill_chunk_jit, params,
+            state, tokens, starts, lengths, tables,
+            *self._sampling_arrays(tokens.shape[0], sampling))
 
     def decode(self, params, state: KVCacheState, tokens, positions,
-               tables) -> StepOut:
+               tables, sampling=None) -> StepOut:
         """One token per sequence: gather each sequence's cache view,
         attend (single query, per-sequence length via the mask), emit
-        logits + greedy ids, and append the new K/V at ``positions`` —
-        one program, cache donated.
+        logits + the selected next token, and append the new K/V at
+        ``positions`` — one program, cache donated.
 
         ``tokens`` (b,) int32 current tokens; ``positions`` (b,) their
-        0-based positions (== the cached prefix length). Dummy batch
-        rows use position 0 and an all-trash table.
+        0-based positions (== the cached prefix length); ``sampling``
+        optional per-lane ``(temps, top_ks, top_ps, seeds)`` (None =
+        all-greedy). Dummy batch rows use position 0 and an all-trash
+        table.
         """
         jnp = self._jnp
         tokens = jnp.asarray(tokens, jnp.int32)
         positions = jnp.asarray(positions, jnp.int32)
         tables = jnp.asarray(tables, jnp.int32)
         key = ("decode_step", tokens.shape[0], tables.shape[1])
-        return self._dispatch("decode_step", key, self._decode_jit,
-                              params, state, tokens, positions, tables)
+        return self._dispatch(
+            "decode_step", key, self._decode_jit, params, state,
+            tokens, positions, tables,
+            *self._sampling_arrays(tokens.shape[0], sampling))
 
 
 def make_decode_step(model, cache: KVCache) -> DecodeStep:
@@ -201,4 +354,4 @@ def make_decode_step(model, cache: KVCache) -> DecodeStep:
     return DecodeStep(model, cache)
 
 
-__all__ = ["DecodeStep", "StepOut", "make_decode_step"]
+__all__ = ["DecodeStep", "StepOut", "greedy_sampling", "make_decode_step"]

@@ -28,17 +28,38 @@ then drops. No real sequence is ever given block 0.
 
 The allocator is host-side Python (the scheduler's admission control
 runs on the host between steps); only :func:`gather_kv` /
-:func:`append_kv` / :func:`append_kv_prefill` trace into jitted
-programs. Allocation reserves the FULL block span a request can reach
-(prompt + max_new_tokens) up front, so an admitted request can never
-die of pool exhaustion mid-decode — admission control is the one gate
+:func:`append_kv` / :func:`append_kv_prefill` / :func:`append_kv_chunk`
+trace into jitted programs.
+
+Prefix sharing (docs/serving.md "Prefix cache"): every allocated block
+carries a refcount, and blocks that hold a FULL block of prompt tokens
+are published into a hash-chain index (``h_i = sha256(h_{i-1} ||
+tokens[i*bs:(i+1)*bs])``) once their owner finishes prefill. A later
+request whose prompt starts with the same token blocks takes shared
+read-only references instead of re-paying prefill compute and KV
+memory; at the divergence block a copy-on-write fork copies the common
+row prefix into a private block, so the writer never mutates shared
+state. Zero-ref published blocks stay resident as an LRU *prefix
+cache* (reclaimed on demand — they count as free for admission);
+blocks a quarantined tenant dirtied are scrubbed before any reuse
+(the PR-9 NaN-scrub rule lifted to refcounted blocks: refcount zero →
+scrub → free list).
+
+Reservation is staged: :meth:`KVCache.allocate_prefix` reserves only
+the span the caller names (a prefill chunk, or the full prompt +
+max_new span), and :meth:`KVCache.extend` grows the reservation
+chunk-by-chunk — the scheduler reserves the decode span (prompt +
+max_new) together with the LAST chunk, so a request that reaches
+DECODING still can never die of pool exhaustion mid-decode
 (docs/serving.md "admission control").
 """
 
 from __future__ import annotations
 
+import hashlib
 import threading
-from typing import Any, Dict, List, NamedTuple, Optional, Sequence
+from collections import OrderedDict
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Set, Tuple
 
 import numpy as np
 
@@ -65,6 +86,24 @@ class KVCacheState(NamedTuple):
 
     k: Any    # (num_layers, num_blocks, block_size, kv_heads, head_dim)
     v: Any
+
+
+class PrefixMatch(NamedTuple):
+    """What :meth:`KVCache.allocate_prefix` matched for a prompt.
+
+    ``matched`` tokens of the prompt are already resident (shared
+    full blocks + ``fork_rows`` copied rows of the divergence block) —
+    prefill resumes at position ``matched``. ``copies`` are the pending
+    COW row copies ``(src_block, dst_block, rows)`` the engine must
+    execute on the device state BEFORE the sequence's first chunk
+    (``apply_copies``); until :meth:`KVCache.fork_copied` runs, the
+    source blocks hold an extra reference so they cannot be evicted or
+    scrubbed out from under the copy."""
+
+    matched: int
+    shared_blocks: int
+    fork_rows: int
+    copies: Tuple[Tuple[int, int, int], ...]
 
 
 class KVCache:
@@ -104,6 +143,21 @@ class KVCache:
         # and LIFO keeps the hot blocks hot)
         self._free: List[int] = list(range(self.num_blocks, 0, -1))
         self._tables: Dict[Any, List[int]] = {}
+        # -- prefix-sharing plane (module docstring) -------------------
+        self._refs: Dict[int, int] = {}          # block -> refcount
+        # published block -> (chain hash, parent hash, block tokens)
+        self._meta: Dict[int, Tuple[bytes, bytes, Tuple[int, ...]]] = {}
+        self._index: Dict[bytes, int] = {}       # chain hash -> block
+        self._children: Dict[bytes, List[int]] = {}
+        # zero-ref published blocks, LRU order (prefix cache — these
+        # count as reclaimable for admission)
+        self._cached: "OrderedDict[int, bytes]" = OrderedDict()
+        self._dirty: Set[int] = set()
+        self._pending_scrub: List[int] = []      # zero-ref dirty blocks
+        self._fork_refs: Dict[Any, List[int]] = {}
+        self.prefix_hits = 0
+        self.prefix_misses = 0
+        self.prefix_tokens_saved = 0
 
     @classmethod
     def for_config(cls, cfg, *, num_blocks: int, block_size: int = 16,
@@ -142,44 +196,331 @@ class KVCache:
 
     def can_admit(self, total_len: int) -> bool:
         with self._lock:
-            return self.blocks_for(total_len) <= len(self._free)
+            return self.blocks_for(total_len) <= self._reclaimable()
+
+    def _reclaimable(self) -> int:
+        # free list + the zero-ref prefix cache (evictable on demand)
+        return len(self._free) + len(self._cached)
 
     @property
     def free_blocks(self) -> int:
         with self._lock:
-            return len(self._free)
+            return self._reclaimable()
 
     @property
     def blocks_in_use(self) -> int:
+        """Blocks referenced by live sequences (cached prefix blocks
+        and pending-scrub blocks are reclaimable, not in use)."""
         with self._lock:
-            return self.num_blocks - len(self._free)
+            return len(self._refs)
+
+    def _take_private(self, need: int, seq_id) -> List[int]:
+        """Pop ``need`` fresh private blocks — free list first, then
+        evict the LRU tail of the prefix cache. Caller holds the
+        lock."""
+        if need > self._reclaimable():
+            raise PoolExhausted(
+                f"kv pool exhausted: sequence {seq_id!r} needs {need} "
+                f"blocks, {self._reclaimable()} free of "
+                f"{self.num_blocks}",
+                needed=need, free=self._reclaimable(),
+                capacity=self.num_blocks)
+        out: List[int] = []
+        for _ in range(need):
+            if self._free:
+                out.append(self._free.pop())
+            else:
+                blk, _h = self._cached.popitem(last=False)   # LRU evict
+                self._unpublish(blk)
+                out.append(blk)
+        for b in out:
+            self._refs[b] = 1
+        return out
 
     def allocate(self, seq_id, total_len: int) -> List[int]:
         """Reserve the full block span for a sequence reaching
         ``total_len`` tokens; raises :class:`PoolExhausted` when the
-        free list can't cover it (the admission-control refusal)."""
+        free list can't cover it (the admission-control refusal).
+        Private blocks only — the prefix-aware admit path is
+        :meth:`allocate_prefix`."""
         need = self.blocks_for(total_len)
         with self._lock:
             if seq_id in self._tables:
                 raise ValueError(f"sequence {seq_id!r} already allocated")
-            if need > len(self._free):
-                raise PoolExhausted(
-                    f"kv pool exhausted: sequence {seq_id!r} needs {need} "
-                    f"blocks, {len(self._free)} free of {self.num_blocks}",
-                    needed=need, free=len(self._free),
-                    capacity=self.num_blocks)
-            blocks = [self._free.pop() for _ in range(need)]
+            blocks = self._take_private(need, seq_id)
             self._tables[seq_id] = blocks
             return list(blocks)
 
-    def free(self, seq_id) -> int:
-        """Return a sequence's blocks to the pool; returns how many."""
+    def allocate_prefix(self, seq_id, prompt: Sequence[int],
+                        total_len: int,
+                        chunk: Optional[int] = None) -> PrefixMatch:
+        """Reserve blocks for a sequence whose prompt is ``prompt``,
+        reusing published prefix blocks by reference and COW-forking
+        the divergence block.
+
+        ``total_len`` is the full span (prompt + max_new). With
+        ``chunk=None`` the whole span is reserved up front (the
+        monolithic-admit contract); with a chunk size, reservation is
+        STAGED — only ``matched + chunk`` tokens are covered now (the
+        full span when that already reaches the end of the prompt),
+        and the scheduler grows it via :meth:`extend` chunk by chunk.
+
+        At most ``len(prompt) - 1`` tokens ever match (the last prompt
+        token always prefills, so the first-token logits exist).
+        Raises :class:`PoolExhausted` (leaking nothing) when the
+        private remainder cannot be reserved.
+        """
+        prompt = tuple(int(t) for t in prompt)
+        bs = self.block_size
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id!r} already allocated")
+            hashes = self._chain_hashes(prompt)
+            max_full = (len(prompt) - 1) // bs
+            shared: List[int] = []
+            parent = b""
+            for i in range(min(len(hashes), max_full)):
+                blk = self._index.get(hashes[i])
+                if blk is None or blk in self._dirty:
+                    break
+                shared.append(blk)
+                parent = hashes[i]
+            m = len(shared)
+            # COW fork: longest common row prefix with a published
+            # child of the matched chain (leave >= 1 token to prefill)
+            fork_src, fork_rows = None, 0
+            budget = len(prompt) - 1 - m * bs
+            if budget > 0:
+                want = prompt[m * bs: (m + 1) * bs]
+                for cand in self._children.get(parent, ()):
+                    if cand in self._dirty or cand not in self._meta:
+                        continue
+                    toks = self._meta[cand][2]
+                    f = 0
+                    for a, c in zip(toks, want):
+                        if a != c:
+                            break
+                        f += 1
+                    f = min(f, budget)
+                    if f > fork_rows:
+                        fork_src, fork_rows = cand, f
+            matched = m * bs + fork_rows
+            if chunk is None or matched + chunk >= len(prompt):
+                reserve_len = total_len
+            else:
+                reserve_len = matched + chunk      # staged: first chunk
+            need = self.blocks_for(reserve_len) - m
+            if need < 0:
+                need = 0
+            if fork_rows and need < 1:
+                need = 1                     # the fork's private block
+            # reference the matched blocks FIRST: _take_private evicts
+            # the cached LRU, and a matched-but-unreferenced block
+            # must not be evicted out from under this admission
+            for blk in shared:
+                self._ref_locked(blk)
+            if fork_rows:
+                self._ref_locked(fork_src)   # pin src until the copy
+            try:
+                priv = self._take_private(need, seq_id)
+            except PoolExhausted:
+                for blk in shared:           # leak nothing on refusal
+                    self._unref_locked(blk, dirty=False)
+                if fork_rows:
+                    self._unref_locked(fork_src, dirty=False)
+                raise
+            copies: Tuple[Tuple[int, int, int], ...] = ()
+            if fork_rows:
+                self._fork_refs[seq_id] = [fork_src]
+                copies = ((fork_src, priv[0], fork_rows),)
+            self._tables[seq_id] = shared + priv
+            if matched > 0:
+                self.prefix_hits += 1
+                self.prefix_tokens_saved += matched
+            else:
+                self.prefix_misses += 1
+            return PrefixMatch(matched=matched, shared_blocks=m,
+                               fork_rows=fork_rows, copies=copies)
+
+    def extend(self, seq_id, total_len: int) -> int:
+        """Grow a sequence's reservation to cover ``total_len`` tokens
+        (staged per-chunk reservation); returns how many NEW private
+        blocks were appended. Raises :class:`PoolExhausted` leaving
+        the existing reservation intact."""
+        with self._lock:
+            table = self._tables[seq_id]
+            need = self.blocks_for(total_len) - len(table)
+            if need <= 0:
+                return 0
+            table.extend(self._take_private(need, seq_id))
+            return need
+
+    def fork_copied(self, seq_id) -> None:
+        """Drop the pin on a COW fork's source blocks (the engine has
+        executed the row copies on the device state)."""
+        with self._lock:
+            for blk in self._fork_refs.pop(seq_id, []):
+                self._unref_locked(blk, dirty=False)
+
+    def free(self, seq_id, *, dirty: bool = False,
+             clean_blocks: Sequence[int] = ()) -> int:
+        """Return a sequence's block references to the pool; returns
+        how many blocks were released.
+
+        ``dirty=True`` (the quarantine path) marks every released
+        block — except ``clean_blocks``, which the caller already
+        scrubbed device-side — as poisoned: it is unpublished at once
+        (never matched again) and, when its refcount reaches zero,
+        parked on the pending-scrub list instead of the free list
+        until :meth:`scrub_done` confirms the device rows were zeroed
+        (refcount zero -> scrub -> reuse)."""
+        clean = set(int(b) for b in clean_blocks)
         with self._lock:
             blocks = self._tables.pop(seq_id, None)
             if blocks is None:
                 return 0
-            self._free.extend(reversed(blocks))
+            for blk in self._fork_refs.pop(seq_id, []):
+                self._unref_locked(blk, dirty=False)
+            for b in blocks:
+                self._unref_locked(b, dirty=dirty and b not in clean)
             return len(blocks)
+
+    def _ref_locked(self, blk: int) -> None:
+        if blk in self._refs:
+            self._refs[blk] += 1
+            return
+        # revive a zero-ref cached prefix block
+        self._cached.pop(blk, None)
+        self._refs[blk] = 1
+
+    def _unref_locked(self, blk: int, *, dirty: bool) -> None:
+        if dirty and blk not in self._dirty:
+            self._dirty.add(blk)
+            self._unpublish(blk)             # never matched again
+        self._refs[blk] -= 1
+        if self._refs[blk] > 0:
+            return
+        del self._refs[blk]
+        if blk in self._dirty:
+            self._pending_scrub.append(blk)
+        elif blk in self._meta:
+            self._cached[blk] = self._meta[blk][0]
+            self._cached.move_to_end(blk)
+        else:
+            self._free.append(blk)
+
+    def _unpublish(self, blk: int) -> None:
+        meta = self._meta.pop(blk, None)
+        if meta is None:
+            return
+        h, parent, _toks = meta
+        if self._index.get(h) == blk:
+            del self._index[h]
+        kids = self._children.get(parent)
+        if kids and blk in kids:
+            kids.remove(blk)
+            if not kids:
+                del self._children[parent]
+        self._cached.pop(blk, None)
+
+    def _chain_hashes(self, prompt: Tuple[int, ...]) -> List[bytes]:
+        bs = self.block_size
+        out: List[bytes] = []
+        h = b""
+        for i in range(len(prompt) // bs):
+            blk = np.asarray(prompt[i * bs:(i + 1) * bs],
+                             np.int64).tobytes()
+            h = hashlib.sha256(h + blk).digest()
+            out.append(h)
+        return out
+
+    def publish_prefix(self, seq_id, prompt: Sequence[int]) -> int:
+        """Publish a fully-prefilled sequence's full prompt blocks into
+        the prefix index (later prompts with the same token blocks
+        share them by reference); returns how many blocks were newly
+        published. First publisher wins — blocks whose chain hash is
+        already indexed are left alone."""
+        prompt = tuple(int(t) for t in prompt)
+        bs = self.block_size
+        published = 0
+        with self._lock:
+            table = self._tables.get(seq_id)
+            if table is None:
+                return 0
+            hashes = self._chain_hashes(prompt)
+            parent = b""
+            for i, h in enumerate(hashes):
+                blk = table[i]
+                if blk in self._dirty:
+                    break
+                if h in self._index:
+                    parent = h
+                    continue                 # first publisher wins
+                if blk in self._meta:        # published under another
+                    parent = h               # chain (shared-in block)
+                    continue
+                self._meta[blk] = (h, parent,
+                                   prompt[i * bs:(i + 1) * bs])
+                self._index[h] = blk
+                self._children.setdefault(parent, []).append(blk)
+                parent = h
+                published += 1
+            return published
+
+    def take_pending_scrub(self) -> List[int]:
+        """Pop the zero-ref dirty blocks awaiting a device scrub; the
+        engine must zero their pool rows and call :meth:`scrub_done`
+        before they can be reused."""
+        with self._lock:
+            out, self._pending_scrub = self._pending_scrub, []
+            return out
+
+    def scrub_done(self, blocks: Sequence[int]) -> None:
+        """Return device-scrubbed blocks to the free list."""
+        with self._lock:
+            for b in blocks:
+                self._dirty.discard(b)
+                self._free.append(b)
+
+    def reset_prefix_cache(self) -> int:
+        """Drop every zero-ref cached prefix block back to the free
+        list and clear the index (bench runs isolate workloads this
+        way); returns how many blocks were reclaimed."""
+        with self._lock:
+            n = len(self._cached)
+            for blk in list(self._cached):
+                self._unpublish(blk)
+                self._free.append(blk)
+            self._cached.clear()
+            self.prefix_hits = 0
+            self.prefix_misses = 0
+            self.prefix_tokens_saved = 0
+            return n
+
+    def prefix_stats(self) -> Dict[str, int]:
+        """Prefix-cache accounting for gauges/flight bundles."""
+        with self._lock:
+            shared = sum(1 for r in self._refs.values() if r > 1)
+            return {
+                "cached_blocks": len(self._cached),
+                "shared_blocks": shared,
+                "published_blocks": len(self._meta),
+                "pending_scrub": len(self._pending_scrub),
+                "hits": self.prefix_hits,
+                "misses": self.prefix_misses,
+                "tokens_saved": self.prefix_tokens_saved,
+            }
+
+    def block_ref(self, blk: int) -> int:
+        with self._lock:
+            return self._refs.get(int(blk), 0)
+
+    def exclusive_blocks(self, seq_id) -> List[int]:
+        """Blocks only this sequence references and nobody can match
+        from the index — safe to scrub immediately on quarantine."""
+        with self._lock:
+            return [b for b in self._tables.get(seq_id, [])
+                    if self._refs.get(b) == 1 and b not in self._meta]
 
     def table(self, seq_id) -> List[int]:
         with self._lock:
@@ -268,14 +609,28 @@ def append_kv_prefill(state: KVCacheState, k_new, v_new, tables,
     (static scatter shape, no predication), so the pads' garbage K/V
     never lands in a real block.
     """
+    return append_kv_chunk(state, k_new, v_new, tables, None, lengths)
+
+
+def append_kv_chunk(state: KVCacheState, k_new, v_new, tables, starts,
+                    lengths) -> KVCacheState:
+    """Write one prefill CHUNK's K/V per sequence into the pool.
+
+    The chunk-resumable generalization of :func:`append_kv_prefill`:
+    chunk row ``i`` of sequence ``b`` lands at global position
+    ``starts[b] + i`` (``starts=None`` means 0 — the monolithic
+    prefill). Rows ``i >= lengths[b]`` (chunk padding) clamp to the
+    trash block; the scatter shape stays static.
+    """
     import jax.numpy as jnp
 
-    layers = state.k.shape[0]
     bs = state.k.shape[2]
     b, w = tables.shape
     s = k_new.shape[3]
     pos = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None, :], (b, s))
     valid = pos < lengths[:, None]
+    if starts is not None:
+        pos = pos + starts[:, None]
     blk = jnp.take_along_axis(tables, jnp.clip(pos // bs, 0, w - 1), axis=1)
     blk = jnp.where(valid, blk, TRASH_BLOCK)
     slot = pos % bs
@@ -284,17 +639,47 @@ def append_kv_prefill(state: KVCacheState, k_new, v_new, tables,
         # (L, b, kv, s, d) -> (L, b, s, kv, d) to match pool[:, blk, slot]
         return pool.at[:, blk, slot].set(new.transpose(0, 1, 3, 2, 4))
 
-    del layers
     return KVCacheState(k=one(state.k, k_new), v=one(state.v, v_new))
+
+
+def apply_copies(state: KVCacheState,
+                 copies: Sequence[Tuple[int, int, int]]) -> KVCacheState:
+    """Execute COW fork row copies ``(src_block, dst_block, rows)`` on
+    the device pools (host-issued between dispatches): the first
+    ``rows`` rows of ``src`` — the common token prefix with the
+    divergence block — are copied into the fresh private ``dst``; the
+    shared source is never written."""
+    k, v = state.k, state.v
+    for src, dst, rows in copies:
+        rows = int(rows)
+        k = k.at[:, int(dst), :rows].set(k[:, int(src), :rows])
+        v = v.at[:, int(dst), :rows].set(v[:, int(src), :rows])
+    return KVCacheState(k=k, v=v)
+
+
+def scrub_blocks(state: KVCacheState, blocks) -> KVCacheState:
+    """Zero the named pool blocks (the quarantine / pending-scrub
+    device op — a freed NaN row must never haunt the next tenant)."""
+    import jax.numpy as jnp
+
+    if len(blocks) == 0:
+        return state
+    b = jnp.asarray(sorted(int(x) for x in blocks), jnp.int32)
+    return KVCacheState(k=state.k.at[:, b].set(0),
+                        v=state.v.at[:, b].set(0))
 
 
 __all__ = [
     "KVCache",
     "KVCacheState",
     "PoolExhausted",
+    "PrefixMatch",
     "TRASH_BLOCK",
     "append_kv",
+    "append_kv_chunk",
     "append_kv_prefill",
+    "apply_copies",
     "bucket",
     "gather_kv",
+    "scrub_blocks",
 ]

@@ -230,7 +230,11 @@ def resume_requests(snapshot: Dict[str, Any]):
     the dead engine held, bit-for-bit the same K/V the prefill scatter
     writes) and ``max_new_tokens`` shrinks by what was already
     generated, so the resumed engine's first emitted token is exactly
-    the next one the uninterrupted run would have produced. ``prior``
+    the next one the uninterrupted run would have produced — for
+    SAMPLED streams too: the per-request RNG is counter-based
+    (``fold_in(seed, token index)``, serving/decode.py), and the
+    snapshot carries the sampling knobs + seed, so the resumed
+    engine's draws continue the stream token for token. ``prior``
     maps request id -> the already-generated prefix;
     :func:`merge_results` folds it back so callers see full token
     streams.
@@ -250,7 +254,11 @@ def resume_requests(snapshot: Dict[str, Any]):
             continue
         requests.append(Request(
             id=e["id"], prompt=prompt, max_new_tokens=remaining,
-            eos_id=e.get("eos_id"), deadline_ms=e.get("deadline_ms")))
+            eos_id=e.get("eos_id"), deadline_ms=e.get("deadline_ms"),
+            temperature=float(e.get("temperature", 0.0)),
+            top_k=int(e.get("top_k", 0)),
+            top_p=float(e.get("top_p", 1.0)),
+            seed=int(e.get("seed", 0))))
         prior[e["id"]] = generated
     return requests, prior
 

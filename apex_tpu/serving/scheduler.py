@@ -5,22 +5,34 @@ The serving tier's control plane (ROADMAP item 1; TorchTitan's
 production framing — the scheduler is a first-class, observable
 subsystem, not a demo loop). Every engine ``step()``:
 
-1. **Admits** queued requests against the KV pool: a request enters
-   only if :class:`~apex_tpu.serving.kv_cache.KVCache` can reserve its
-   FULL span (prompt + max_new_tokens), so an admitted request can
+1. **Admits** queued requests against the KV pool, reusing published
+   prompt-prefix blocks by reference (the prefix cache,
+   serving/kv_cache.py): matched tokens skip prefill entirely; the
+   private remainder is reserved — the FULL span (prompt +
+   max_new_tokens) for short prompts, or STAGED per-chunk for long
+   ones (chunked prefill), with the decode span reserved together
+   with the last chunk so a request that reaches DECODING still can
    never die of pool exhaustion mid-decode. A request larger than the
    whole pool is rejected (``serving_request_error``); a transiently
    full pool defers admission (the request waits, nothing breaks).
-2. **Prefills** the newly admitted as one bucketed batch (batch and
-   seq padded to powers of two — the compile-count bound), emitting
-   each request's FIRST token from the same program that writes the
-   cache (TTFT is one dispatch after admission).
+2. **Prefills**: fresh short prompts as one bucketed monolithic batch
+   (batch and seq padded to powers of two — the compile-count bound),
+   emitting each request's FIRST token from the same program that
+   writes the cache (TTFT is one dispatch after admission). Long or
+   prefix-resumed prompts live in the ``PREFILLING`` state and
+   advance ONE bucketed chunk per step under the per-step
+   ``prefill_token_budget`` — a 4k-token prompt never stalls the
+   step's decode dispatch behind one monolithic prefill
+   (Sarathi-style chunked prefill, docs/serving.md).
 3. **Decodes** every in-flight sequence as one bucketed batch joined
    with the step's new arrivals — continuous batching: a finishing
    sequence's slot (and blocks) are reused by the next admission on
-   the very next step, no static-batch barrier.
+   the very next step, no static-batch barrier. Token selection
+   (greedy or fused temperature/top-k/top-p sampling) happens inside
+   the decode program (serving/decode.py).
 4. **Evicts/finishes**: sequences hitting ``max_new_tokens`` or their
-   EOS free their blocks immediately and land in :meth:`drain`.
+   EOS free their block references immediately (shared prefix blocks
+   stay resident in the prefix cache) and land in :meth:`drain`.
 
 Telemetry (the PR-4/5 spine, docs/serving.md metric table):
 ``serving_queue_depth`` / ``serving_batch_size`` /
@@ -71,6 +83,13 @@ Degradation paths are deterministically drillable via
 - ``decode_nonfinite=<steps>`` (+ ``decode_nonfinite_lane``): one
   lane's cached K/V is poisoned with NaN — only that sequence
   quarantines; the rest of the batch keeps its tokens.
+- ``prefill_chunk_exception=<idx>``: the chunk-prefill dispatch
+  number ``idx`` raises — the binary-split retries re-check the SAME
+  dispatch index, so the whole chunk batch quarantines (private
+  blocks scrubbed+freed, shared prefix references released) and the
+  engine keeps serving. ``io:prefill_chunk`` injects by CALL index
+  instead: one transient index is absorbed by the retry with zero
+  quarantines.
 """
 
 from __future__ import annotations
@@ -90,16 +109,30 @@ from apex_tpu.serving.kv_cache import KVCache, PoolExhausted, bucket
 @dataclasses.dataclass
 class Request:
     """One generation request. ``deadline_ms`` is a TTL measured from
-    submission: a request still queued or decoding when it elapses is
-    reaped with outcome ``deadline_exceeded`` (its generated-so-far
-    tokens are returned; its blocks free immediately). ``None`` means
-    no deadline."""
+    submission: a request still queued, prefilling, or decoding when
+    it elapses is reaped with outcome ``deadline_exceeded`` (its
+    generated-so-far tokens are returned; its private blocks free
+    immediately, shared prefix references are released). ``None``
+    means no deadline.
+
+    Sampling knobs (fused in-program, serving/decode.py):
+    ``temperature == 0`` is greedy argmax — bitwise the pre-sampling
+    behavior; ``temperature > 0`` draws from the softmax at that
+    temperature, restricted to the top ``top_k`` logits (0 = off) and
+    the top-``top_p`` nucleus (1.0 = off). ``seed`` keys the
+    counter-based per-request PRNG — the stream is a pure function of
+    ``(seed, token index)``, so a drain/resume replay regenerates it
+    token for token."""
 
     id: Any
     prompt: Sequence[int]
     max_new_tokens: int = 16
     eos_id: Optional[int] = None
     deadline_ms: Optional[float] = None
+    temperature: float = 0.0
+    top_k: int = 0
+    top_p: float = 1.0
+    seed: int = 0
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).ravel()
@@ -111,6 +144,14 @@ class Request:
         if self.deadline_ms is not None and self.deadline_ms <= 0:
             raise ValueError(
                 f"request {self.id!r}: deadline_ms must be > 0 or None")
+        if self.temperature < 0:
+            raise ValueError(
+                f"request {self.id!r}: temperature must be >= 0")
+        if self.top_k < 0:
+            raise ValueError(f"request {self.id!r}: top_k must be >= 0")
+        if not 0.0 < self.top_p <= 1.0:
+            raise ValueError(
+                f"request {self.id!r}: top_p must be in (0, 1]")
 
 
 @dataclasses.dataclass
@@ -136,6 +177,11 @@ class _InFlight:
     t_submit: float
     t_first: Optional[float] = None
     t_last: Optional[float] = None
+    # chunked-prefill progress: prompt tokens already resident in the
+    # cache (prefix-cache matches count — prefill resumes after them);
+    # a request is PREFILLING while prefilled < len(prompt)
+    prefilled: int = 0
+    stalls: int = 0
 
     @property
     def position(self) -> int:
@@ -159,6 +205,9 @@ class ContinuousBatcher:
     def __init__(self, model, params, cache: KVCache, *,
                  max_batch: int = 8, max_prefill_batch: int = 4,
                  min_width_bucket: int = 4, min_seq_bucket: int = 16,
+                 prefill_chunk: Optional[int] = None,
+                 prefill_token_budget: Optional[int] = None,
+                 prefill_interval: int = 1,
                  registry=None, timeline=None,
                  clock: Callable[[], float] = time.perf_counter,
                  step_fn: Optional[DecodeStep] = None,
@@ -173,6 +222,31 @@ class ContinuousBatcher:
         self.max_prefill_batch = int(max_prefill_batch)
         self.min_width_bucket = int(min_width_bucket)
         self.min_seq_bucket = int(min_seq_bucket)
+        # chunked prefill (docs/serving.md): prompts longer than
+        # `prefill_chunk` advance one bucketed chunk per engine step,
+        # co-scheduled with the decode dispatch, instead of one
+        # monolithic prefill; `prefill_token_budget` caps the prefill
+        # tokens one step may spend (default: a full chunk batch).
+        # None = monolithic prefill (the pre-chunking behavior);
+        # prefix-cache resumes ride the chunk program either way.
+        if prefill_chunk is not None and prefill_chunk < 1:
+            raise ValueError("prefill_chunk must be >= 1 or None")
+        self.prefill_chunk = (int(prefill_chunk)
+                              if prefill_chunk is not None else None)
+        self.prefill_token_budget = (
+            int(prefill_token_budget) if prefill_token_budget is not None
+            else (self.prefill_chunk * self.max_prefill_batch
+                  if self.prefill_chunk else None))
+        # the prefill/decode interleave ratio (the Sarathi TTFT/TPOT
+        # dial): with k > 1, chunk dispatches run only every k-th step
+        # WHILE decodes are in flight — each skipped step is a pure
+        # decode step, bounding the chunking tax on TPOT at the price
+        # of slower long-prompt TTFT. With no decodes running, chunks
+        # advance every step regardless (throttling an idle engine
+        # buys nothing).
+        if prefill_interval < 1:
+            raise ValueError("prefill_interval must be >= 1")
+        self.prefill_interval = int(prefill_interval)
         self.clock = clock
         self._registry = (registry if registry is not None
                           else telemetry.registry())
@@ -184,9 +258,14 @@ class ContinuousBatcher:
         self._lock = threading.Lock()
         self.queue: "deque[Tuple[Request, float]]" = deque()
         self.running: List[_InFlight] = []
+        # PREFILLING: admitted, cache partially written, no first
+        # token yet — advanced chunk-by-chunk in _prefill_chunks
+        self.prefilling: List[_InFlight] = []
         self.finished: List[RequestResult] = []
         self.step_idx = 0
         self._seq_counter = 0
+        self._chunk_dispatches = 0        # prefill_chunk_exception idx
+        self._pending_copies: Dict[Any, List[Tuple[int, int, int]]] = {}
         self._pool_exhausted_dumped = False
         # resilience plane (serving/resilience.py)
         self.preemption = preemption          # guard.PreemptionHandler
@@ -216,14 +295,26 @@ class ContinuousBatcher:
         r.gauge("serving_kv_blocks_in_use",
                 "KV pool blocks held by in-flight sequences").set(
             self.cache.blocks_in_use)
+        stats = self.cache.prefix_stats()
+        r.gauge("serving_prefix_blocks_shared",
+                "KV blocks referenced by >= 2 sequences").set(
+            stats["shared_blocks"])
+        r.gauge("serving_prefix_cached_blocks",
+                "zero-ref prefix-cache blocks resident (reclaimable)"
+                ).set(stats["cached_blocks"])
+        r.gauge("serving_prefilling",
+                "admitted sequences still prefilling (chunked)").set(
+            len(self.prefilling))
 
     def _push_result(self, res: RequestResult) -> None:
         with self._lock:
             self.finished.append(res)
 
     def _finish(self, fl: _InFlight, reason: str,
-                error: Optional[str] = None) -> None:
-        self.cache.free(fl.seq_id)
+                error: Optional[str] = None, *, dirty: bool = False,
+                clean_blocks: Sequence[int] = ()) -> None:
+        self._pending_copies.pop(fl.seq_id, None)
+        self.cache.free(fl.seq_id, dirty=dirty, clean_blocks=clean_blocks)
         n = len(fl.generated)
         ttft = (fl.t_first - fl.t_submit) if fl.t_first is not None else None
         tpot = None
@@ -258,20 +349,44 @@ class ContinuousBatcher:
 
     # -- API -----------------------------------------------------------------
 
+    def _chunk_buckets(self) -> List[int]:
+        """The chunk-program seq buckets warmup mints: powers of two
+        from the bucket floor up to the full chunk (the final partial
+        chunk of a prompt buckets below ``prefill_chunk``)."""
+        top = bucket(self.prefill_chunk or self.min_seq_bucket)
+        lo = min(self.min_seq_bucket, top)
+        out = []
+        s = lo
+        while s <= top:
+            out.append(s)
+            s *= 2
+        return out
+
     def warmup(self, state, seq_buckets: Optional[Sequence[int]] = None,
-               width_buckets: Optional[Sequence[int]] = None):
+               width_buckets: Optional[Sequence[int]] = None,
+               chunk_buckets: Optional[Sequence[int]] = None):
         """Compile the engine's programs off the hot path: the decode
-        program per table-width bucket and the prefill programs for
+        program per table-width bucket, the prefill programs for
         every admission batch bucket x seq bucket (admissions trickle,
-        so batches of 1, 2, ... each mint a program). Every write
+        so batches of 1, 2, ... each mint a program), and the
+        chunk-resume programs per batch bucket x chunk bucket (chunked
+        prefill + prefix-cache resumes both ride them — pass
+        ``chunk_buckets`` covering the resume remainders you expect
+        when chunking is off but prefix sharing is on). Every write
         lands in the trash block; returns the threaded cache state.
         Serving latency after warmup never includes an XLA compile —
         and the compile tracker sees zero ``recompile`` events from
-        the hot loop (tools/check_serving.sh)."""
+        the hot loop (tools/check_serving.sh): chunking adds one
+        program per (batch bucket, chunk bucket, width), not a
+        storm."""
         import jax
 
         seqs = sorted(set(seq_buckets or [self.min_seq_bucket]))
         widths = sorted(set(width_buckets or [self.min_width_bucket]))
+        chunks = sorted(set(chunk_buckets
+                            if chunk_buckets is not None
+                            else (self._chunk_buckets()
+                                  if self.prefill_chunk else seqs)))
         batches = []
         b = 1
         while b < self.max_prefill_batch:
@@ -289,6 +404,13 @@ class ContinuousBatcher:
                 for s in seqs:
                     out = self.step_fn.prefill(
                         self.params, state, np.zeros((nb, s), np.int32),
+                        np.zeros((nb,), np.int32),
+                        np.zeros((nb, w), np.int32))
+                    state = out.cache
+                for s in chunks:
+                    out = self.step_fn.prefill_chunk(
+                        self.params, state, np.zeros((nb, s), np.int32),
+                        np.zeros((nb,), np.int32),
                         np.zeros((nb,), np.int32),
                         np.zeros((nb, w), np.int32))
                     state = out.cache
@@ -313,7 +435,8 @@ class ContinuousBatcher:
 
     def idle(self) -> bool:
         with self._lock:
-            return not self.queue and not self.running
+            return (not self.queue and not self.running
+                    and not self.prefilling)
 
     def drain(self) -> List[RequestResult]:
         with self._lock:
@@ -323,27 +446,39 @@ class ContinuousBatcher:
     # -- resilience plane (serving/resilience.py) ----------------------------
 
     def _snapshot_entries(self) -> List[Dict[str, Any]]:
-        """Every queued + in-flight request as JSON-ready entries (the
-        drain snapshot payload): prompt, generated-so-far tokens, and
-        the admission-relevant knobs. Queue order then running order —
-        the resumed engine re-admits in the same order."""
+        """Every queued + prefilling + in-flight request as JSON-ready
+        entries (the drain snapshot payload): prompt, generated-so-far
+        tokens, the admission-relevant knobs, and the per-request RNG
+        state (sampling knobs + seed — the sampled stream is a pure
+        function of ``(seed, token index)``, so the resumed engine
+        replays it token for token). Queue order, then prefilling,
+        then running — the resumed engine re-admits in the same
+        order."""
+        def entry(req: Request, generated: List[int],
+                  state: str) -> Dict[str, Any]:
+            return {"id": req.id,
+                    "prompt": [int(t) for t in req.prompt],
+                    "max_new_tokens": int(req.max_new_tokens),
+                    "eos_id": req.eos_id,
+                    "deadline_ms": req.deadline_ms,
+                    "temperature": float(req.temperature),
+                    "top_k": int(req.top_k),
+                    "top_p": float(req.top_p),
+                    "seed": int(req.seed),
+                    "generated": generated, "state": state}
+
         out: List[Dict[str, Any]] = []
         with self._lock:
             queued = list(self.queue)
         for req, _ in queued:
-            out.append({"id": req.id, "prompt": [int(t) for t in req.prompt],
-                        "max_new_tokens": int(req.max_new_tokens),
-                        "eos_id": req.eos_id,
-                        "deadline_ms": req.deadline_ms,
-                        "generated": [], "state": "queued"})
+            out.append(entry(req, [], "queued"))
+        for f in self.prefilling:
+            # no first token yet: the resumed engine re-prefills the
+            # whole prompt (chunk progress is cache state, not tokens)
+            out.append(entry(f.req, [], "prefilling"))
         for f in self.running:
-            out.append({"id": f.req.id,
-                        "prompt": [int(t) for t in f.req.prompt],
-                        "max_new_tokens": int(f.req.max_new_tokens),
-                        "eos_id": f.req.eos_id,
-                        "deadline_ms": f.req.deadline_ms,
-                        "generated": [int(t) for t in f.generated],
-                        "state": "in_flight"})
+            out.append(entry(f.req, [int(t) for t in f.generated],
+                             "in_flight"))
         return out
 
     def _stage_params(self, params, info: Dict[str, Any]) -> None:
@@ -368,9 +503,12 @@ class ContinuousBatcher:
                 new_digest=info["new_digest"])
 
     def _reap_deadlines(self, idx: int, now: float) -> List[Any]:
-        """Reap every queued + in-flight request whose TTL elapsed —
-        BEFORE admission and decode, so an expired request never buys
-        a prefill or decode slot. Returns the reaped ids."""
+        """Reap every queued + prefilling + in-flight request whose
+        TTL elapsed — BEFORE admission, chunking, and decode, so an
+        expired request never buys a prefill chunk or a decode slot.
+        A mid-``PREFILLING`` reap releases only the request's private
+        blocks (shared prefix references are just decremented —
+        refcounted free). Returns the reaped ids."""
         def expired(req: Request, t_submit: float) -> bool:
             return (req.deadline_ms is not None
                     and (now - t_submit) * 1000.0 >= req.deadline_ms)
@@ -383,9 +521,11 @@ class ContinuousBatcher:
                     (expired_q if expired(req, t) else keep).append(
                         (req, t))
                 self.queue = keep
+        expired_pre = [f for f in self.prefilling
+                       if expired(f.req, f.t_submit)]
         expired_run = [f for f in self.running
                        if expired(f.req, f.t_submit)]
-        if not expired_q and not expired_run:
+        if not expired_q and not expired_run and not expired_pre:
             return []
         r = self._registry
         ids: List[Any] = []
@@ -398,6 +538,18 @@ class ContinuousBatcher:
                 error=f"deadline {req.deadline_ms:g}ms elapsed before "
                       "admission"))
             ids.append(req.id)
+        if expired_pre:
+            gone = {id(f) for f in expired_pre}
+            self.prefilling = [f for f in self.prefilling
+                               if id(f) not in gone]
+            for f in expired_pre:
+                r.counter("serving_deadline_exceeded",
+                          "requests reaped past their TTL").inc(
+                    where="prefilling")
+                self._finish(f, "deadline_exceeded",
+                             error=f"deadline {f.req.deadline_ms:g}ms "
+                                   "elapsed mid-prefill")
+                ids.append(f.req.id)
         if expired_run:
             gone = {id(f) for f in expired_run}
             self.running = [f for f in self.running
@@ -416,21 +568,23 @@ class ContinuousBatcher:
                 requests=[str(i) for i in ids])
         return ids
 
-    def _scrub_blocks(self, state, flights: List[_InFlight]):
-        """Zero the pool blocks of sequences about to be quarantined.
-        A nonfinite lane APPENDED NaN K/V into its own blocks during
-        the dispatch that exposed it; masked attention zeroes masked
-        *scores*, not masked V rows (0 x NaN = NaN), so a freed block
-        must never hand NaN to its next tenant."""
-        import jax.numpy as jnp
+    def _scrub_pending(self, state):
+        """Zero the pool rows of dirty blocks whose refcount reached
+        zero since the last step (quarantined tenants of SHARED
+        blocks — refcount zero -> scrub -> free list), then hand them
+        back to the allocator. Runs at the top of every step, before
+        admission can reuse them."""
+        from apex_tpu.serving import kv_cache as _kv
 
-        blocks = sorted({b for f in flights
-                         for b in self.cache.table(f.seq_id)})
+        blocks = self.cache.take_pending_scrub()
         if not blocks:
             return state
-        b = jnp.asarray(blocks, jnp.int32)
-        return state._replace(k=state.k.at[:, b].set(0),
-                              v=state.v.at[:, b].set(0))
+        state = _kv.scrub_blocks(state, blocks)
+        self.cache.scrub_done(blocks)
+        self._registry.counter(
+            "serving_blocks_scrubbed",
+            "dirty blocks zeroed before reuse").inc(len(blocks))
+        return state
 
     def _quarantine(self, state, quarantined, idx: int,
                     report: Dict[str, Any]):
@@ -438,21 +592,36 @@ class ContinuousBatcher:
         ``error`` — blocks scrubbed then freed, counters/events/bundle
         emitted — while the rest of the engine keeps serving. The
         ``serving_quarantine`` trigger replaces the old engine-fatal
-        decode-exception path."""
+        decode-exception path.
+
+        A nonfinite lane APPENDED NaN K/V into its own blocks during
+        the dispatch that exposed it; masked attention zeroes masked
+        *scores*, not masked V rows (0 x NaN = NaN), so a freed block
+        must never hand NaN to its next tenant. Blocks ONLY this
+        sequence references (and nobody can match from the prefix
+        index) are zeroed right here; its shared/published blocks are
+        marked dirty instead — unpublished at once, and scrubbed when
+        their refcount reaches zero (``_scrub_pending``)."""
+        from apex_tpu.serving import kv_cache as _kv
         from apex_tpu.telemetry import flight as _flight
 
-        state = self._scrub_blocks(state, [f for f, _ in quarantined])
+        excl = sorted({b for f, _ in quarantined
+                       for b in self.cache.exclusive_blocks(f.seq_id)})
+        state = _kv.scrub_blocks(state, excl)
         r = self._registry
         ids = [str(f.req.id) for f, _ in quarantined]
         reasons = [msg for _, msg in quarantined]
         gone = {id(f) for f, _ in quarantined}
         self.running = [f for f in self.running if id(f) not in gone]
+        self.prefilling = [f for f in self.prefilling
+                           if id(f) not in gone]
         for f, msg in quarantined:
             kind = ("nonfinite" if "nonfinite" in msg else "exception")
             r.counter("serving_quarantined",
                       "sequences quarantined by per-request fault "
                       "isolation").inc(reason=kind)
-            self._finish(f, "error", error=f"quarantined: {msg}")
+            self._finish(f, "error", error=f"quarantined: {msg}",
+                         dirty=True, clean_blocks=excl)
             report["finished"].append(f.req.id)
         report.setdefault("quarantined", []).extend(
             f.req.id for f, _ in quarantined)
@@ -475,7 +644,8 @@ class ContinuousBatcher:
 
         self.draining = True
         signum = getattr(self.preemption, "signum", None)
-        n_queued, n_running = len(self.queue), len(self.running)
+        n_queued = len(self.queue)
+        n_running = len(self.running) + len(self.prefilling)
         path = None
         save_error: Optional[str] = None
         if self.snapshot_dir is not None:
@@ -491,7 +661,11 @@ class ContinuousBatcher:
             self.drained_snapshot = path
             for f in self.running:
                 self.cache.free(f.seq_id)
+            for f in self.prefilling:
+                self.cache.free(f.seq_id)
             self.running = []
+            self.prefilling = []
+            self._pending_copies.clear()
             with self._lock:
                 self.queue.clear()
         else:
@@ -520,18 +694,43 @@ class ContinuousBatcher:
 
     # -- one engine step -----------------------------------------------------
 
-    def _admit(self, exhausted: bool) -> List[_InFlight]:
+    def _admit(self, exhausted: bool) -> Tuple[List[_InFlight],
+                                               List[_InFlight]]:
+        """Pop queued requests into the engine; returns ``(direct,
+        chunked)`` — ``direct`` prefills monolithically this step (a
+        fresh short prompt: the pre-chunking program, bitwise
+        unchanged), ``chunked`` enters ``PREFILLING`` (a long prompt
+        under chunked prefill, or any prefix-cache resume).
+
+        Reservation is prefix-aware and staged: matched prefix blocks
+        are taken by REFERENCE (``serving_prefix_cache_hits``), and a
+        chunked admission reserves only its first chunk's private
+        blocks — ``_prefill_chunks`` extends the reservation chunk by
+        chunk, taking the decode span with the final chunk."""
         if self.draining:
-            return []                        # drain mode: queue frozen
-        admitted: List[_InFlight] = []
+            return [], []                    # drain mode: queue frozen
+        if any(f.stalls > 0 for f in self.prefilling):
+            # a PREFILLING sequence is waiting on blocks: admitting new
+            # work would steal the blocks it needs (and, after a
+            # deadlock-breaking requeue, ping-pong the pool between the
+            # two forever) — in-progress prompts drain first
+            self._registry.counter(
+                "serving_admission_deferred",
+                "admissions deferred by a transiently full pool").inc()
+            return [], []
+        direct: List[_InFlight] = []
+        chunked: List[_InFlight] = []
         rejects: List[Tuple[Request, str]] = []
+        hits: List[int] = []
         deferred = False
+        chunk = self.prefill_chunk
         # queue pop + pool reservation under ONE lock: a submit() on a
         # client thread can never interleave with the reservation
         with self._lock:
             while (self.queue
-                   and len(self.running) + len(admitted) < self.max_batch
-                   and len(admitted) < self.max_prefill_batch):
+                   and (len(self.running) + len(self.prefilling)
+                        + len(direct) + len(chunked) < self.max_batch)
+                   and len(direct) + len(chunked) < self.max_prefill_batch):
                 req, t_submit = self.queue[0]
                 total = len(req.prompt) + req.max_new_tokens
                 need = self.cache.blocks_for(total)
@@ -547,27 +746,62 @@ class ContinuousBatcher:
                 try:
                     self._seq_counter += 1
                     seq_id = ("s", self._seq_counter, req.id)
-                    self.cache.allocate(seq_id, total)
+                    match = self.cache.allocate_prefix(
+                        seq_id, req.prompt, total_len=total,
+                        chunk=chunk)
                 except PoolExhausted:
+                    self._seq_counter -= 1
                     deferred = True
                     break                    # wait for blocks to free
                 self.queue.popleft()
-                admitted.append(_InFlight(req=req, seq_id=seq_id,
-                                          generated=[],
-                                          t_submit=t_submit))
+                fl = _InFlight(req=req, seq_id=seq_id, generated=[],
+                               t_submit=t_submit,
+                               prefilled=match.matched)
+                hits.append(1 if match.matched > 0 else 0)
+                if match.copies:
+                    self._pending_copies[seq_id] = list(match.copies)
+                if (match.matched == 0
+                        and (chunk is None or len(req.prompt) <= chunk)):
+                    direct.append(fl)
+                else:
+                    chunked.append(fl)
         if deferred:
             self._registry.counter(
                 "serving_admission_deferred",
                 "admissions deferred by a transiently full pool").inc()
+        if hits:
+            c = self._registry.counter(
+                "serving_prefix_cache_hits",
+                "admissions by prompt-prefix cache outcome")
+            n_hit = sum(hits)
+            if n_hit:
+                c.inc(n_hit, outcome="hit")
+            if len(hits) - n_hit:
+                c.inc(len(hits) - n_hit, outcome="miss")
         for req, msg in rejects:
             self._reject(req, msg)
-        return admitted
+        return direct, chunked
 
     def _tables_for(self, flights: List[_InFlight], batch: int):
         widths = [len(self.cache.table(f.seq_id)) for f in flights]
         w = bucket(max(widths), self.min_width_bucket)
         return self.cache.table_array([f.seq_id for f in flights], w,
                                       batch=batch)
+
+    def _sampling_for(self, flights: List[_InFlight], batch: int):
+        """Per-lane sampling arrays (temps, top_ks, top_ps, seeds) for
+        a padded batch — dummy lanes are greedy (temperature 0), so an
+        all-greedy workload takes the in-program fast path."""
+        temps = np.zeros(batch, np.float32)
+        ks = np.zeros(batch, np.int32)
+        ps = np.ones(batch, np.float32)
+        seeds = np.zeros(batch, np.uint32)
+        for i, f in enumerate(flights):
+            temps[i] = f.req.temperature
+            ks[i] = f.req.top_k
+            ps[i] = f.req.top_p
+            seeds[i] = np.uint32(f.req.seed & 0xFFFFFFFF)
+        return temps, ks, ps, seeds
 
     def _prefill(self, admitted: List[_InFlight], state):
         """Prefill the admissions as one bucketed batch; returns
@@ -587,8 +821,9 @@ class ContinuousBatcher:
             lengths[i] = len(f.req.prompt)
         tables = self._tables_for(admitted, b)
         with self._tl().phase("prefill", category="serving"):
-            out = self.step_fn.prefill(self.params, state, tokens,
-                                       lengths, tables)
+            out = self.step_fn.prefill(
+                self.params, state, tokens, lengths, tables,
+                sampling=self._sampling_for(admitted, b))
             jax.block_until_ready(out.next_token)
         now = self.clock()
         ids = np.asarray(out.next_token)
@@ -598,8 +833,184 @@ class ContinuousBatcher:
         for i, f in enumerate(admitted):
             if finite[i]:
                 f.generated.append(int(ids[i]))
+                f.prefilled = len(f.req.prompt)
                 f.t_first = f.t_last = now
+                self.cache.publish_prefix(f.seq_id, f.req.prompt)
         return out.cache, finite
+
+    # -- chunked prefill (the PREFILLING state) ------------------------------
+
+    def _chunk_batch(self, state, batchees, cidx: int, b: int, s: int,
+                     width: int):
+        """ONE chunk-prefill dispatch over ``batchees`` = [(flight,
+        chunk_len)], padded to the top-level (b, s, width) so
+        binary-split retries reuse the same compiled program; returns
+        ``(cache_state, token_ids, finite, now)``. The fault sites
+        live here: ``prefill_chunk_exception=<idx>`` checks the
+        TOP-LEVEL dispatch index ``cidx`` (retries re-check the same
+        index, so the clause fails every sub-dispatch — the whole
+        batch quarantines), ``io:prefill_chunk`` counts calls (one
+        transient index is absorbed by the retry)."""
+        import jax
+
+        from apex_tpu.resilience import faults
+
+        tokens = np.zeros((b, s), np.int32)
+        starts = np.zeros((b,), np.int32)
+        lengths = np.zeros((b,), np.int32)
+        for i, (f, cs) in enumerate(batchees):
+            tokens[i, :cs] = f.req.prompt[f.prefilled:f.prefilled + cs]
+            starts[i] = f.prefilled
+            lengths[i] = cs
+        tables = self.cache.table_array(
+            [f.seq_id for f, _ in batchees], width, batch=b)
+        with self._tl().phase("prefill_chunk", category="serving"):
+            faults.maybe_prefill_chunk_exception(cidx)
+            faults.check("prefill_chunk")
+            out = self.step_fn.prefill_chunk(
+                self.params, state, tokens, starts, lengths, tables,
+                sampling=self._sampling_for([f for f, _ in batchees], b))
+            jax.block_until_ready(out.next_token)
+        now = self.clock()
+        ids = np.asarray(out.next_token)
+        finite = (np.asarray(out.finite)[:len(batchees)]
+                  if out.finite is not None
+                  else np.ones(len(batchees), bool))
+        return out.cache, ids, finite, now
+
+    def _isolate_chunks(self, state, batchees, cidx: int, b: int,
+                        s: int, width: int):
+        """Chunk-prefill ``batchees`` with per-request fault isolation
+        (the decode ``_isolate`` idiom on the chunk dispatch); returns
+        ``(state, done, quarantined)`` — ``done`` is ``[(flight,
+        chunk_len, token, t)]``, ``quarantined`` ``[(flight, msg)]``."""
+        try:
+            state, ids, finite, now = self._chunk_batch(
+                state, batchees, cidx, b, s, width)
+        except Exception as e:  # noqa: BLE001 — isolate, keep serving
+            if len(batchees) == 1:
+                msg = f"{type(e).__name__}: {str(e)[:200]}"
+                return state, [], [(batchees[0][0], msg)]
+            mid = len(batchees) // 2
+            state, d_lo, q_lo = self._isolate_chunks(
+                state, batchees[:mid], cidx, b, s, width)
+            state, d_hi, q_hi = self._isolate_chunks(
+                state, batchees[mid:], cidx, b, s, width)
+            return state, d_lo + d_hi, q_lo + q_hi
+        done, quarantined = [], []
+        for i, (f, cs) in enumerate(batchees):
+            if finite[i]:
+                done.append((f, cs, int(ids[i]), now))
+            else:
+                quarantined.append((f, "nonfinite logits (prefill chunk)"))
+        return state, done, quarantined
+
+    def _prefill_chunks(self, state, idx: int, report: Dict[str, Any]):
+        """Advance the PREFILLING sequences by one bucketed chunk each
+        under the per-step token budget, co-scheduled with the step's
+        decode dispatch (chunked prefill — the reason a 4k-token
+        prompt cannot stall in-flight decodes). Reservation is staged:
+        each chunk extends the block table just-in-time, and the FINAL
+        chunk reserves the decode span (prompt + max_new), restoring
+        the can-never-die-mid-decode invariant at the PREFILLING ->
+        DECODING transition. A sequence that cannot extend stalls in
+        place (``serving_prefill_stalled``); if nothing else is
+        running or prefilling — nothing will ever free blocks — the
+        head stalled sequence is requeued
+        (``serving_prefill_requeued``) so the engine cannot
+        deadlock."""
+        from apex_tpu.serving import kv_cache as _kv
+
+        if (self.prefill_interval > 1 and self.running
+                and idx % self.prefill_interval):
+            return state          # this step is decode-only (knob doc)
+        chunk = self.prefill_chunk
+        budget = self.prefill_token_budget
+        r = self._registry
+        batchees: List[Tuple[_InFlight, int]] = []
+        stalled: List[_InFlight] = []
+        used = 0
+        for f in self.prefilling:
+            if len(batchees) >= self.max_prefill_batch:
+                break
+            rem = len(f.req.prompt) - f.prefilled
+            cs = rem if chunk is None else min(rem, chunk)
+            if budget is not None and batchees and used + cs > budget:
+                break
+            final = f.prefilled + cs >= len(f.req.prompt)
+            target = (len(f.req.prompt) + f.req.max_new_tokens
+                      if final else f.prefilled + cs)
+            try:
+                self.cache.extend(f.seq_id, target)
+            except PoolExhausted:
+                f.stalls += 1
+                stalled.append(f)
+                r.counter("serving_prefill_stalled",
+                          "chunk reservations deferred by a full "
+                          "pool").inc()
+                continue
+            f.stalls = 0
+            batchees.append((f, cs))
+            used += cs
+        if not batchees:
+            if stalled and not self.running:
+                # nothing decodes, nothing prefills: no block will
+                # ever free — requeue the head stalled sequence
+                f = stalled[0]
+                self.prefilling.remove(f)
+                self._pending_copies.pop(f.seq_id, None)
+                self.cache.free(f.seq_id)
+                with self._lock:
+                    self.queue.appendleft((f.req, f.t_submit))
+                r.counter("serving_prefill_requeued",
+                          "prefilling sequences returned to the queue "
+                          "to break a reservation deadlock").inc()
+                r.event("serving_prefill_requeued", step=idx,
+                        request=str(f.req.id), prefilled=f.prefilled)
+            return state
+        # execute pending COW fork copies before the chunk gathers
+        copies: List[Tuple[int, int, int]] = []
+        for f, _ in batchees:
+            c = self._pending_copies.pop(f.seq_id, None)
+            if c:
+                copies.extend(c)
+        if copies:
+            state = _kv.apply_copies(state, copies)
+            for f, _ in batchees:
+                self.cache.fork_copied(f.seq_id)
+        cidx = self._chunk_dispatches
+        self._chunk_dispatches += 1
+        b = bucket(len(batchees))
+        floor = min(self.min_seq_bucket,
+                    bucket(chunk) if chunk else self.min_seq_bucket)
+        s = bucket(max(cs for _, cs in batchees), floor)
+        widths = [len(self.cache.table(f.seq_id)) for f, _ in batchees]
+        width = bucket(max(widths), self.min_width_bucket)
+        state, done, quarantined = self._isolate_chunks(
+            state, batchees, cidx, b, s, width)
+        now_done: List[_InFlight] = []
+        for f, cs, tok, now in done:
+            f.prefilled += cs
+            r.counter("serving_prefill_chunks",
+                      "prefill chunks dispatched").inc()
+            r.histogram("serving_prefill_chunk_tokens",
+                        "prompt tokens per prefill chunk",
+                        buckets=(8, 16, 32, 64, 128, 256, 512, 1024,
+                                 2048, 4096)).observe(cs)
+            report.setdefault("prefilled", []).append(f.req.id)
+            if f.prefilled >= len(f.req.prompt):
+                f.generated.append(tok)
+                f.t_first = f.t_last = now
+                now_done.append(f)
+                self.cache.publish_prefix(f.seq_id, f.req.prompt)
+        if now_done:
+            gone = {id(f) for f in now_done}
+            self.prefilling = [f for f in self.prefilling
+                               if id(f) not in gone]
+            self.running.extend(now_done)
+        if quarantined:
+            state = self._quarantine(state, quarantined, idx, report)
+        return state
 
     def _decode_batch(self, state, flights: List[_InFlight], idx: int,
                       width: int):
@@ -625,8 +1036,9 @@ class ContinuousBatcher:
         with self._tl().phase("decode", category="serving"):
             faults.maybe_decode_exception(idx)
             faults.check("decode_step")
-            out = self.step_fn.decode(self.params, state, tokens,
-                                      positions, tables)
+            out = self.step_fn.decode(
+                self.params, state, tokens, positions, tables,
+                sampling=self._sampling_for(flights, b))
             jax.block_until_ready(out.next_token)
         now = self.clock()
         ids = np.asarray(out.next_token)
@@ -693,9 +1105,11 @@ class ContinuousBatcher:
 
         Ordering is the resilience contract: staged weight swaps
         install FIRST (the step boundary between decode dispatches),
-        deadline-expired requests reap BEFORE admission and decode,
-        the preemption flag is drained before any new work starts, and
-        decode runs under per-request fault isolation."""
+        deadline-expired requests reap BEFORE admission, chunking,
+        and decode, the preemption flag is drained before any new
+        work starts, pending block scrubs land before admission can
+        reuse the blocks, and both the decode and the chunk-prefill
+        dispatch run under per-request fault isolation."""
         from apex_tpu.resilience import faults
         from apex_tpu.telemetry import flight as _flight
 
@@ -706,6 +1120,7 @@ class ContinuousBatcher:
         report: Dict[str, Any] = {
             "step": idx,
             "admitted": [],
+            "prefilled": [],
             "decoded": [],
             "finished": [],
             "expired": self._reap_deadlines(idx, self.clock()),
@@ -720,6 +1135,7 @@ class ContinuousBatcher:
                 report["blocks_in_use"] = self.cache.blocks_in_use
                 self._publish_gauges()
                 return state, report
+        state = self._scrub_pending(state)
         exhausted = faults.should_pool_exhaust(idx)
         if exhausted:
             self._registry.event("serving_pool_exhausted", step=idx,
@@ -731,18 +1147,25 @@ class ContinuousBatcher:
                 _flight.notify(
                     "serving_pool_exhausted", fleet=False,
                     extra={"step": idx, "queued": len(self.queue),
-                           "blocks_in_use": self.cache.blocks_in_use})
-        admitted = self._admit(exhausted)
-        report["admitted"] = [f.req.id for f in admitted]
+                           "blocks_in_use": self.cache.blocks_in_use,
+                           "prefix_cache": self.cache.prefix_stats()})
+        direct, chunked = self._admit(exhausted)
+        report["admitted"] = [f.req.id for f in direct + chunked]
         report["queued"] = len(self.queue)
-        if admitted:
-            state, finite = self._prefill(admitted, state)
-            good = [f for i, f in enumerate(admitted) if finite[i]]
+        self.prefilling.extend(chunked)
+        if direct:
+            state, finite = self._prefill(direct, state)
+            good = [f for i, f in enumerate(direct) if finite[i]]
             bad = [(f, "nonfinite logits (prefill)")
-                   for i, f in enumerate(admitted) if not finite[i]]
+                   for i, f in enumerate(direct) if not finite[i]]
             self.running.extend(good)
             if bad:
                 state = self._quarantine(state, bad, idx, report)
+        if self.prefilling:
+            # one bucketed chunk per sequence, budget-bounded — the
+            # step's decode dispatch below runs either way (chunked
+            # prefill's co-scheduling contract)
+            state = self._prefill_chunks(state, idx, report)
         # reap BEFORE decoding: a request whose prefill token already
         # hit max_new/EOS must not buy a decode slot
         report["finished"].extend(self._reap())
@@ -796,7 +1219,8 @@ def serve_loop(batcher: ContinuousBatcher, state, requests:
     results: List[RequestResult] = []
     i = 0
     while i < len(order) or not batcher.idle():
-        if batcher.draining and not batcher.running:
+        if (batcher.draining and not batcher.running
+                and not batcher.prefilling):
             break
         now = clock() - t0
         while (i < len(order) and not batcher.draining

@@ -1180,6 +1180,197 @@ def bench_fleet():
     }, "fleet")
 
 
+def _bench_serving_long_prompt():
+    """The serving hot-path record (docs/serving.md "Chunked
+    prefill"): a mixed long-prompt workload — ~10% of prompts at
+    16-32x the median length, 50% of the rest sharing one common
+    system prefix — through the SAME engine twice, chunked
+    (``prefill_chunk``) vs unchunked (monolithic prefill), prefix
+    cache armed in both. Headline: p99 TPOT under chunking (lower is
+    better); the in-record ``p99_tpot_unchunked_over_chunked`` ratio
+    is the chunking win (a monolithic long prefill stalls every
+    in-flight decode for its whole duration; a chunk stalls them for
+    one bucketed chunk), ``p99_ttft_chunked_over_unchunked`` the TTFT
+    cost bound (acceptance: >= 1.3x TPOT win at <= 1.1x TTFT), and
+    ``prefix_cache_hit_rate`` / ``prefill_tokens_saved`` the sharing
+    win. Knob: ``APEX_TPU_SERVING_LONG_REQUESTS`` (default 48)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import serving, telemetry
+    from apex_tpu.models.gpt import GPTConfig, GPTModel
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        cfg = GPTConfig(vocab_size=512, max_seq_len=512,
+                        hidden_size=128, num_layers=2, num_heads=4,
+                        num_kv_heads=2, dtype=jnp.float32,
+                        param_dtype=jnp.float32)
+        n_requests, max_batch = 48, 8
+    else:
+        cfg = GPTConfig(vocab_size=32768, max_seq_len=4096,
+                        hidden_size=1024, num_layers=12, num_heads=16,
+                        num_kv_heads=4, dtype=jnp.bfloat16)
+        n_requests, max_batch = 96, 16
+    n_requests = int(os.environ.get("APEX_TPU_SERVING_LONG_REQUESTS",
+                                    n_requests))
+    long_lo = cfg.max_seq_len // 2 - cfg.max_seq_len // 8   # 16-32x
+    long_hi = cfg.max_seq_len - 64                          # median
+    sys_len = 48
+    chunk = 64
+    rng = np.random.RandomState(0)
+    model = GPTModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8)), jnp.int32))
+    # pool sized so several long spans + the short mix coexist
+    blocks_per_long = -(-(long_hi + 40) // 16)
+    cache = serving.KVCache.for_config(
+        cfg, num_blocks=max_batch * blocks_per_long, block_size=16)
+    step_fn = serving.make_decode_step(model, cache)
+    sys_prefix = rng.randint(0, cfg.vocab_size, (sys_len,))
+
+    def make_requests(tag):
+        # identical workload per run (only the tag differs): the
+        # chunked/unchunked comparison is same-prompts, same-arrivals
+        r = np.random.RandomState(42)
+        out = []
+        for i in range(n_requests):
+            if i % 10 == 0:              # 10%: long prompts
+                plen = int(r.randint(long_lo, long_hi + 1))
+                prompt = r.randint(0, cfg.vocab_size, (plen,))
+                max_new = int(r.randint(8, 17))
+            else:
+                body = r.randint(0, cfg.vocab_size,
+                                 (int(r.randint(4, 25)),))
+                if i % 2 == 0:           # 50% share the system prefix
+                    prompt = np.concatenate([sys_prefix, body])
+                else:
+                    prompt = body
+                max_new = int(r.randint(4, 41))
+            out.append(serving.Request(id=f"{tag}{i}", prompt=prompt,
+                                       max_new_tokens=max_new))
+        return out
+
+    seq_buckets = [128, 256, bucket_pow2(long_hi + 40)]
+    width_buckets = [bucket_pow2(blocks_per_long)]
+
+    # calibrate the Poisson offered load at ~70% of decode capacity
+    # (the main serving bench's discipline): queueing happens,
+    # collapse doesn't
+    warm_state = cache.init_state()
+    tables = np.zeros((max_batch, width_buckets[0]), np.int32)
+    out = step_fn.decode(params, warm_state,
+                         np.zeros(max_batch, np.int32),
+                         np.zeros(max_batch, np.int32), tables)
+    warm_state = out.cache
+    jax.block_until_ready(out.next_token)
+    t0 = time.perf_counter()
+    for _ in range(5):
+        out = step_fn.decode(params, warm_state,
+                             np.zeros(max_batch, np.int32),
+                             np.zeros(max_batch, np.int32), tables)
+        warm_state = out.cache
+        jax.block_until_ready(out.next_token)
+    t_decode = (time.perf_counter() - t0) / 5
+    del warm_state
+    mean_out = 0.9 * (4 + 40) / 2.0 + 0.1 * (8 + 16) / 2.0
+    req_rate = 0.7 * (max_batch / t_decode) / mean_out
+    arrivals = list(np.cumsum(np.random.RandomState(7).exponential(
+        1.0 / req_rate, size=n_requests)))
+
+    def run(tag, prefill_chunk):
+        cache.reset_prefix_cache()
+        reg = telemetry.MetricsRegistry()
+        eng = serving.ContinuousBatcher(
+            model, params, cache, max_batch=max_batch, step_fn=step_fn,
+            min_seq_bucket=128, min_width_bucket=width_buckets[0],
+            prefill_chunk=prefill_chunk, registry=reg)
+        state = eng.warmup(cache.init_state(),
+                           seq_buckets=seq_buckets,
+                           width_buckets=width_buckets,
+                           chunk_buckets=([chunk] if prefill_chunk
+                                          else [128]))
+        reqs = make_requests(tag)
+        t0 = time.perf_counter()
+        state, results = serving.serve_loop(eng, state, reqs,
+                                            arrivals=arrivals)
+        wall = time.perf_counter() - t0
+        del state
+        toks = sum(len(r.tokens) for r in results)
+        ttft = [r.ttft_s for r in results if r.ttft_s is not None]
+        tpot = [r.tpot_s for r in results if r.tpot_s is not None]
+        stats = cache.prefix_stats()
+        chunk_hist = reg.histogram(
+            "serving_prefill_chunk_tokens").series().get(
+            "serving_prefill_chunk_tokens")
+        n_chunks = reg.counter("serving_prefill_chunks").value()
+        return {
+            "tokens": toks,
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(toks / wall, 1),
+            "p50_ttft_ms": round(float(np.percentile(ttft, 50)) * 1e3, 3),
+            "p99_ttft_ms": round(float(np.percentile(ttft, 99)) * 1e3, 3),
+            "p50_tpot_ms": round(float(np.percentile(tpot, 50)) * 1e3, 3),
+            "p99_tpot_ms": round(float(np.percentile(tpot, 99)) * 1e3, 3),
+            "prefix_cache_hit_rate": round(
+                stats["hits"] / max(stats["hits"] + stats["misses"], 1),
+                4),
+            "prefill_tokens_saved": stats["tokens_saved"],
+            "prefill_chunks": int(n_chunks),
+            "prefill_chunk_tokens": (
+                round(chunk_hist["sum"] / chunk_hist["count"], 1)
+                if chunk_hist and chunk_hist.get("count") else None),
+            "errors": sum(r.finish_reason == "error" for r in results),
+        }
+
+    unchunked = run("u", None)
+    chunked = run("c", chunk)
+    emit({
+        "metric": "serving_long_prompt_p99_tpot_ms",
+        "value": chunked["p99_tpot_ms"],
+        "unit": ("ms p99 time-per-output-token under the long-prompt "
+                 "mixed workload, chunked prefill (lower is better)"),
+        "vs_baseline": None,     # filled from the prior run by emit()
+        "detail": {
+            "n_requests": n_requests,
+            "max_batch": max_batch,
+            "workload": {
+                "long_fraction": 0.1,
+                "long_prompt_tokens": [long_lo, long_hi],
+                "short_prompt_tokens": [4, 24],
+                "shared_prefix_tokens": sys_len,
+                "shared_prefix_fraction": 0.5,
+            },
+            "prefill_chunk": chunk,
+            "chunked": chunked,
+            "unchunked": unchunked,
+            "p99_tpot_unchunked_over_chunked": round(
+                unchunked["p99_tpot_ms"] / chunked["p99_tpot_ms"], 4),
+            "p99_ttft_chunked_over_unchunked": round(
+                chunked["p99_ttft_ms"] / unchunked["p99_ttft_ms"], 4),
+            "prefix_cache_hit_rate": chunked["prefix_cache_hit_rate"],
+            "prefill_chunk_tokens": chunked["prefill_chunk_tokens"],
+            "compile_keys": step_fn.compile_keys(),
+            "kv_pool": {"num_blocks": cache.num_blocks,
+                        "block_size": cache.block_size,
+                        "pool_mb": round(cache.pool_bytes() / 1e6, 2)},
+            **backend_detail(),
+        },
+    }, "serving_long_prompt")
+
+
+def bucket_pow2(n, minimum=1):
+    """Next power of two >= n (the serving shape bucket)."""
+    b = max(int(minimum), 1)
+    while b < n:
+        b *= 2
+    return b
+
+
 def bench_serving():
     """Serving-tier accounting (docs/serving.md, ROADMAP item 1):
     synthetic many-client load — Poisson arrivals, mixed prompt and
@@ -1323,6 +1514,7 @@ def bench_serving():
     with faults.inject(
             decode_nonfinite_steps=frozenset({5, 25, 50})):
         faulted = run("cbf")
+    _bench_serving_long_prompt()
     emit({
         "metric": "serving_continuous_batching_tokens_per_sec",
         "value": cb["tokens_per_sec"],

@@ -121,6 +121,9 @@ SURFACE = {
         "save_snapshot", "latest_snapshot", "load_snapshot",
         "resume_requests", "merge_results", "swap_weights",
         "SnapshotError", "WeightSwapError",
+        # serving hot path (chunked prefill / prefix cache / sampling)
+        "PrefixMatch", "append_kv_chunk", "apply_copies",
+        "greedy_sampling", "scrub_blocks",
     ],
     "apex_tpu.runtime": [
         "HostFlatSpace", "PrefetchLoader", "cast_bf16_f32",

@@ -185,15 +185,18 @@ class _PoisonDecode:
         self.poison_blocks = set()
         self.decode_calls = 0
 
-    def prefill(self, *a):
-        return self.inner.prefill(*a)
+    def prefill(self, *a, **kw):
+        return self.inner.prefill(*a, **kw)
 
-    def decode(self, params, state, tokens, positions, tables):
+    def prefill_chunk(self, *a, **kw):
+        return self.inner.prefill_chunk(*a, **kw)
+
+    def decode(self, params, state, tokens, positions, tables, **kw):
         self.decode_calls += 1
         if self.poison_blocks & set(np.asarray(tables).ravel().tolist()):
             raise faults.FaultError("poisoned sequence in batch")
         return self.inner.decode(params, state, tokens, positions,
-                                 tables)
+                                 tables, **kw)
 
 
 class TestQuarantine:

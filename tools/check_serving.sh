@@ -9,13 +9,19 @@
 #    decode pads to max_batch over one table-width bucket), and
 #  - ZERO decode recompile events after warmup (no recompile storm in
 #    the hot loop — docs/serving.md "compile plane"),
+# then the serving hot path (docs/serving.md "Chunked prefill"):
+#  - the LONG-PROMPT smoke: a sustained decode workload with
+#    max-seq-scale prompts arriving mid-run, chunked — concurrent
+#    long prefill must not degrade the in-flight decode p99 TPOT by
+#    more than 25% vs a decode-only run of the same short workload,
+#    asserted from the recorded serving_tpot_seconds histograms,
 # then the resilience tier (docs/serving.md "Failure modes &
 # recovery"):
 #  - the APEX_TPU_FAULTS env-knob matrix: every serving clause parses
 #    from the env grammar and forces its degradation path
 #    (serving_pool_exhausted / decode_step_exception /
-#    decode_nonfinite / serving_snapshot_corrupt /
-#    weight_swap_mismatch), and
+#    prefill_chunk_exception / decode_nonfinite /
+#    serving_snapshot_corrupt / weight_swap_mismatch), and
 #  - the CHAOS smoke: 200 requests with decode_nonfinite injected AND
 #    a real mid-run SIGTERM — the engine must quarantine ONLY the
 #    poisoned sequence, drain with a committed serving snapshot (zero
@@ -30,7 +36,7 @@ export JAX_PLATFORMS=cpu
 rc=0
 
 python -m pytest tests/test_serving.py tests/test_serving_resilience.py \
-    "$@" -q -p no:cacheprovider || rc=1
+    tests/test_serving_hotpath.py "$@" -q -p no:cacheprovider || rc=1
 
 echo "== 200-request smoke: continuous batching vs static batch =="
 python - <<'PY' || rc=1
@@ -141,6 +147,121 @@ finally:
     _compiled.disable()
 PY
 
+echo "== long-prompt smoke: chunked prefill must not starve in-flight decode =="
+python - <<'PY' || rc=1
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import serving, telemetry
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+
+cfg = GPTConfig(vocab_size=512, max_seq_len=512, hidden_size=128,
+                num_layers=2, num_heads=4, num_kv_heads=2,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+model = GPTModel(cfg)
+rng = np.random.RandomState(0)
+params = model.init(jax.random.PRNGKey(0),
+                    jnp.asarray(rng.randint(0, 512, (1, 8)), jnp.int32))
+MAX_BATCH = 8
+# pool fits several full long spans: 448 prompt + 8 new = 29 blocks
+cache = serving.KVCache.for_config(cfg, num_blocks=MAX_BATCH * 31,
+                                   block_size=16)
+step_fn = serving.make_decode_step(model, cache)
+
+
+def hist_p99(reg, name):
+    """p99 from the recorded histogram (linear interpolation inside
+    the bucket) — the smoke asserts from telemetry, not raw lists."""
+    h = reg.histogram(name).series()[name]
+    buckets, total = h["buckets"], h["count"]
+    target = 0.99 * total
+    prev_le, prev_cum = 0.0, 0
+    for le, cum in buckets.items():
+        ub = float("inf") if le == "+Inf" else float(le)
+        if cum >= target:
+            if ub == float("inf"):
+                return prev_le
+            frac = (target - prev_cum) / max(cum - prev_cum, 1)
+            return prev_le + frac * (ub - prev_le)
+        prev_le, prev_cum = ub, cum
+    return prev_le
+
+
+def workload(tag, with_long, gap):
+    r = np.random.RandomState(3)
+    reqs, arr = [], []
+    t = 0.0
+    for i in range(40):
+        t += float(r.exponential(gap))
+        reqs.append(serving.Request(
+            id=f"{tag}{i}",
+            prompt=r.randint(0, 512, (int(r.randint(4, 13)),)),
+            max_new_tokens=int(r.randint(24, 41))))
+        arr.append(t)
+    if with_long:
+        # max-seq-scale prompts (the CPU stand-in for 4k tokens)
+        # arriving while decodes are in flight
+        for j in range(4):
+            reqs.append(serving.Request(
+                id=f"{tag}L{j}",
+                prompt=np.random.RandomState(7 + j).randint(
+                    0, 512, (448,)),
+                max_new_tokens=8))
+            arr.append(arr[39] * (j + 1) / 5.0)
+    return reqs, arr
+
+
+def run(tag, with_long, gap):
+    cache.reset_prefix_cache()
+    reg = telemetry.MetricsRegistry()
+    eng = serving.ContinuousBatcher(
+        model, params, cache, step_fn=step_fn, max_batch=MAX_BATCH,
+        min_seq_bucket=16, min_width_bucket=32, prefill_chunk=64,
+        prefill_interval=2, registry=reg)
+    state = eng.warmup(cache.init_state(), seq_buckets=[16],
+                       chunk_buckets=[64])
+    reqs, arr = workload(tag, with_long, gap)
+    state, res = serving.serve_loop(eng, state, reqs, arrivals=arr)
+    del state
+    assert len(res) == len(reqs)
+    assert all(r.finish_reason == "length" for r in res), tag
+    p99 = hist_p99(reg, "serving_tpot_seconds") * 1e3
+    chunks = reg.counter("serving_prefill_chunks").value()
+    print(f"  {tag}: p99 TPOT {p99:.2f}ms (histogram), "
+          f"{int(chunks)} prefill chunks")
+    return p99
+
+
+# calibrate ~60% decode load so queueing happens, collapse doesn't
+state = cache.init_state()
+tab = np.zeros((MAX_BATCH, 32), np.int32)
+out = step_fn.decode(params, state, np.zeros(MAX_BATCH, np.int32),
+                     np.zeros(MAX_BATCH, np.int32), tab)
+state = out.cache
+jax.block_until_ready(out.next_token)
+t0 = time.perf_counter()
+for _ in range(10):
+    out = step_fn.decode(params, state, np.zeros(MAX_BATCH, np.int32),
+                         np.zeros(MAX_BATCH, np.int32), tab)
+    state = out.cache
+    jax.block_until_ready(out.next_token)
+t_decode = (time.perf_counter() - t0) / 10
+del state
+gap = 32 / (0.6 * MAX_BATCH / t_decode)
+
+base = run("decode-only", False, gap)
+conc = run("with-long-prompts", True, gap)
+ratio = conc / base
+print(f"long-prompt smoke: p99 TPOT ratio {ratio:.3f}x "
+      f"(bound 1.25x)")
+assert ratio <= 1.25, (
+    f"concurrent chunked prefill degraded decode p99 TPOT {ratio:.3f}x "
+    f"(> 1.25x) vs the decode-only run")
+PY
+
 echo "== env-knob matrix: every serving fault clause, via APEX_TPU_FAULTS =="
 python - <<'PY' || rc=1
 import os
@@ -199,6 +320,15 @@ def d_exc():
     assert reg.counter("serving_quarantined").value(reason="exception") == 1
 
 
+def d_chunk_exc():
+    eng, reg = engine(prefill_chunk=4)
+    eng.submit(serving.Request(id=0, prompt=[1] * 10, max_new_tokens=4))
+    state, rep = eng.step(cache.init_state())
+    assert rep["quarantined"] == [0], rep
+    assert reg.counter("serving_quarantined").value(reason="exception") == 1
+    assert cache.blocks_in_use == 0
+
+
 def d_nonfinite():
     eng, reg = engine()
     for i in range(2):
@@ -241,10 +371,11 @@ def d_swap():
 
 drill("serving_pool_exhausted=0", d_pool)
 drill("decode_step_exception=0", d_exc)
+drill("prefill_chunk_exception=0", d_chunk_exc)
 drill("decode_nonfinite=1;decode_nonfinite_lane=1", d_nonfinite)
 drill("serving_snapshot_corrupt=0", d_snap)
 drill("weight_swap_mismatch=0", d_swap)
-print("env-knob matrix OK: 5 serving clauses")
+print("env-knob matrix OK: 6 serving clauses")
 PY
 
 echo "== chaos smoke: 200 requests, decode_nonfinite + mid-run SIGTERM =="
