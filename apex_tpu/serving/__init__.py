@@ -37,6 +37,15 @@ refcounted read-only blocks with copy-on-write at the divergence
 block, and token selection (temperature/top-k/top-p, per-request
 counter-based PRNG) is fused inside the decode program —
 ``temperature=0`` stays bitwise-greedy.
+
+The request plane (``serving/tracing.py`` + ``telemetry/slo.py``,
+docs/observability.md "Request plane"): ``RequestTracer`` follows one
+request through queued → prefill chunks → decode → quarantine/drain
+with perfetto export one track per request (trace ids survive drain/
+resume), ``SLOMonitor`` watches TTFT/TPOT/goodput/queue-depth
+objectives with multi-window burn-rate alerting and feeds the
+``should_shed()`` admission hook, and ``ContinuousBatcher.introspect``
+(rendered by ``tools/serving_top.py``) is the live view.
 """
 
 from apex_tpu.serving.decode import (
@@ -80,6 +89,10 @@ from apex_tpu.serving.scheduler import (
     serve_loop,
     static_batch_generate,
 )
+from apex_tpu.serving.tracing import (
+    RequestTrace,
+    RequestTracer,
+)
 
 __all__ = [
     "ContinuousBatcher",
@@ -90,6 +103,8 @@ __all__ = [
     "PrefixMatch",
     "Request",
     "RequestResult",
+    "RequestTrace",
+    "RequestTracer",
     "SnapshotError",
     "StepOut",
     "TRASH_BLOCK",

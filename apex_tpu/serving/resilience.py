@@ -238,12 +238,18 @@ def resume_requests(snapshot: Dict[str, Any]):
     maps request id -> the already-generated prefix;
     :func:`merge_results` folds it back so callers see full token
     streams.
+
+    The request plane rides along: each entry's persisted ``trace_id``
+    is handed back on the rebuilt request with ``resumed_from`` naming
+    the snapshot, so a traced resumed engine CONTINUES the same trace
+    (serving/tracing.py) instead of minting a fresh one.
     """
     from apex_tpu.serving.scheduler import Request
 
     if snapshot.get("format") != SNAPSHOT_FORMAT:
         raise SnapshotError(
             f"unsupported snapshot format {snapshot.get('format')!r}")
+    origin = f"serving_{int(snapshot.get('step', 0)):012d}"
     requests: List[Request] = []
     prior: Dict[Any, List[int]] = {}
     for e in snapshot.get("requests", []):
@@ -258,7 +264,9 @@ def resume_requests(snapshot: Dict[str, Any]):
             temperature=float(e.get("temperature", 0.0)),
             top_k=int(e.get("top_k", 0)),
             top_p=float(e.get("top_p", 1.0)),
-            seed=int(e.get("seed", 0))))
+            seed=int(e.get("seed", 0)),
+            trace_id=e.get("trace_id"),
+            resumed_from=origin))
         prior[e["id"]] = generated
     return requests, prior
 

@@ -90,6 +90,23 @@ Degradation paths are deterministically drillable via
   engine keeps serving. ``io:prefill_chunk`` injects by CALL index
   instead: one transient index is absorbed by the retry with zero
   quarantines.
+
+Request plane (serving/tracing.py + telemetry/slo.py,
+docs/observability.md "Request plane"): pass ``tracer=RequestTracer()``
+and every request gets a :class:`~apex_tpu.serving.tracing.RequestTrace`
+— trace id minted at :meth:`ContinuousBatcher.submit`, spans/marks at
+every state transition (queued, admitted, prefill, each
+``prefill_chunk[i]``, a coalesced decode window, retry/quarantine/
+drain/finish), perfetto export one track per request, and the trace id
+persisted in drain snapshots so a resumed engine continues the SAME
+trace. Pass ``slo=SLOMonitor(...)`` and the engine feeds it per-request
+TTFT/TPOT/goodput and per-step queue depth, publishes burn-rate gauges
+via ``slo.tick()``, and consults ``slo.should_shed()`` at admission —
+a latched burn-rate alert sheds load to the queue
+(``serving_slo_shed``) exactly like a transiently exhausted pool.
+:meth:`ContinuousBatcher.introspect` is the live view over all of it.
+Both default to None: the unarmed engine pays one attribute check per
+hook site (the ``disabled is step`` discipline).
 """
 
 from __future__ import annotations
@@ -104,6 +121,7 @@ import numpy as np
 
 from apex_tpu.serving.decode import DecodeStep, make_decode_step
 from apex_tpu.serving.kv_cache import KVCache, PoolExhausted, bucket
+from apex_tpu.telemetry.metrics import TOKEN_COUNT_BUCKETS
 
 
 @dataclasses.dataclass
@@ -122,7 +140,12 @@ class Request:
     the top-``top_p`` nucleus (1.0 = off). ``seed`` keys the
     counter-based per-request PRNG — the stream is a pure function of
     ``(seed, token index)``, so a drain/resume replay regenerates it
-    token for token."""
+    token for token.
+
+    ``trace_id`` is the request plane's identity: normally None (the
+    engine's tracer mints one at ``submit()``); a resumed drain
+    snapshot carries the ORIGINAL id back (with ``resumed_from``
+    naming the snapshot) so the continued trace is the same trace."""
 
     id: Any
     prompt: Sequence[int]
@@ -133,6 +156,8 @@ class Request:
     top_k: int = 0
     top_p: float = 1.0
     seed: int = 0
+    trace_id: Optional[str] = None
+    resumed_from: Optional[str] = None
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).ravel()
@@ -211,7 +236,8 @@ class ContinuousBatcher:
                  registry=None, timeline=None,
                  clock: Callable[[], float] = time.perf_counter,
                  step_fn: Optional[DecodeStep] = None,
-                 preemption=None, snapshot_dir: Optional[str] = None):
+                 preemption=None, snapshot_dir: Optional[str] = None,
+                 tracer=None, slo=None):
         from apex_tpu import telemetry
 
         self.params = params
@@ -275,6 +301,17 @@ class ContinuousBatcher:
         self._pending_swap = None             # (params, info) staged
         self._snapshot_count = 0
         self._swap_count = 0
+        # request plane (serving/tracing.py, telemetry/slo.py): both
+        # optional — an unarmed engine pays one attribute check per
+        # hook site (the `disabled is step` discipline)
+        self.tracer = tracer                  # tracing.RequestTracer
+        self.slo = slo                        # slo.SLOMonitor
+        self._shed_active = False
+        if slo is not None:
+            slo.attach(
+                trace_provider=(tracer.trace_dicts
+                                if tracer is not None else None),
+                introspect_provider=self.introspect)
 
     # -- telemetry helpers ---------------------------------------------------
 
@@ -307,6 +344,18 @@ class ContinuousBatcher:
             len(self.prefilling))
 
     def _push_result(self, res: RequestResult) -> None:
+        # the single completion chokepoint: every outcome — length/
+        # eos, quarantine, deadline, rejection — lands here, so the
+        # request plane closes traces and feeds the SLO window here
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.finish(res.id, res.finish_reason, t=self.clock(),
+                      error=res.error)
+        if self.slo is not None:
+            self.slo.observe_request(
+                res.id, ttft_s=res.ttft_s, tpot_s=res.tpot_s,
+                ok=res.finish_reason in ("length", "eos"),
+                t=self.clock())
         with self._lock:
             self.finished.append(res)
 
@@ -422,7 +471,18 @@ class ContinuousBatcher:
         """Enqueue one request (thread-safe: clients may submit while
         the engine thread is admitting). A draining engine refuses
         loudly — its snapshot is already committed, so a late request
-        must go to the resumed engine, never silently vanish."""
+        must go to the resumed engine, never silently vanish.
+
+        The request plane starts here: with a tracer attached, the
+        trace id is minted now (or CONTINUED, when a resumed snapshot
+        already set ``request.trace_id`` — the trace then carries a
+        ``resumed_from`` mark naming the snapshot)."""
+        now = self.clock()
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            request.trace_id = tr.begin(
+                request.id, t_submit=now, trace_id=request.trace_id,
+                resumed_from=request.resumed_from)
         if self.draining:
             self._push_result(RequestResult(
                 id=request.id, tokens=[], ttft_s=None, tpot_s=None,
@@ -431,7 +491,7 @@ class ContinuousBatcher:
                       "resumed engine"))
             return
         with self._lock:
-            self.queue.append((request, self.clock()))
+            self.queue.append((request, now))
 
     def idle(self) -> bool:
         with self._lock:
@@ -442,6 +502,67 @@ class ContinuousBatcher:
         with self._lock:
             out, self.finished = self.finished, []
         return out
+
+    def introspect(self) -> Dict[str, Any]:
+        """One JSON-able snapshot of the live engine — what
+        ``tools/serving_top.py`` renders and ``slo_violation`` bundles
+        embed: every queued / prefilling / decoding request (state,
+        age, deadline headroom, block-table size, chunk progress,
+        generated count, trace id), pool + prefix-cache occupancy,
+        and the SLO window summary. Host-side reads only — safe to
+        call from any thread between (or during) engine steps."""
+        now = self.clock()
+        with self._lock:
+            queued = list(self.queue)
+        prefilling = list(self.prefilling)
+        running = list(self.running)
+
+        def entry(req: Request, state: str, t_submit: float,
+                  fl: Optional[_InFlight] = None) -> Dict[str, Any]:
+            age = now - t_submit
+            left = (req.deadline_ms - age * 1e3
+                    if req.deadline_ms is not None else None)
+            out = {"id": str(req.id), "trace_id": req.trace_id,
+                   "state": state, "age_s": round(age, 6),
+                   "deadline_ms": req.deadline_ms,
+                   "deadline_left_ms": (round(left, 3)
+                                        if left is not None else None),
+                   "prompt_tokens": int(len(req.prompt)),
+                   "max_new_tokens": int(req.max_new_tokens),
+                   "prefilled": 0, "generated": 0, "blocks": 0}
+            if fl is not None:
+                out["prefilled"] = int(fl.prefilled)
+                out["generated"] = len(fl.generated)
+                try:
+                    out["blocks"] = len(self.cache.table(fl.seq_id))
+                except KeyError:
+                    pass
+            return out
+
+        requests = ([entry(req, "queued", t) for req, t in queued]
+                    + [entry(f.req, "prefilling", f.t_submit, f)
+                       for f in prefilling]
+                    + [entry(f.req, "decoding", f.t_submit, f)
+                       for f in running])
+        return {
+            "step": self.step_idx,
+            "draining": self.draining,
+            "queue_depth": len(queued),
+            "in_flight": len(running),
+            "prefilling": len(prefilling),
+            "requests": requests,
+            "pool": {
+                "num_blocks": self.cache.num_blocks,
+                "block_size": self.cache.block_size,
+                "blocks_in_use": self.cache.blocks_in_use,
+                "free_blocks": self.cache.free_blocks,
+                "prefix": self.cache.prefix_stats(),
+            },
+            "slo": (self.slo.summary()
+                    if self.slo is not None else None),
+            "traces": (self.tracer.summary()
+                       if self.tracer is not None else None),
+        }
 
     # -- resilience plane (serving/resilience.py) ----------------------------
 
@@ -465,6 +586,7 @@ class ContinuousBatcher:
                     "top_k": int(req.top_k),
                     "top_p": float(req.top_p),
                     "seed": int(req.seed),
+                    "trace_id": req.trace_id,
                     "generated": generated, "state": state}
 
         out: List[Dict[str, Any]] = []
@@ -615,11 +737,15 @@ class ContinuousBatcher:
         self.running = [f for f in self.running if id(f) not in gone]
         self.prefilling = [f for f in self.prefilling
                            if id(f) not in gone]
+        traced = self.tracer is not None and self.tracer.enabled
         for f, msg in quarantined:
             kind = ("nonfinite" if "nonfinite" in msg else "exception")
             r.counter("serving_quarantined",
                       "sequences quarantined by per-request fault "
                       "isolation").inc(reason=kind)
+            if traced:
+                self.tracer.mark(f.req.id, "quarantine", self.clock(),
+                                 reason=msg, step=idx)
             self._finish(f, "error", error=f"quarantined: {msg}",
                          dirty=True, clean_blocks=excl)
             report["finished"].append(f.req.id)
@@ -659,6 +785,18 @@ class ContinuousBatcher:
                 save_error = f"{type(e).__name__}: {str(e)[:200]}"
         if path is not None:
             self.drained_snapshot = path
+            tr = self.tracer
+            if tr is not None and tr.enabled:
+                # close every snapshotted trace here with outcome
+                # `drained`; the resumed engine CONTINUES the same
+                # trace id (the snapshot carries it) on its side
+                t = self.clock()
+                with self._lock:
+                    queued_reqs = [req for req, _ in self.queue]
+                for req in queued_reqs:
+                    tr.drained(req.id, t, snapshot=path)
+                for f in self.prefilling + self.running:
+                    tr.drained(f.req.id, t, snapshot=path)
             for f in self.running:
                 self.cache.free(f.seq_id)
             for f in self.prefilling:
@@ -709,6 +847,24 @@ class ContinuousBatcher:
         chunk, taking the decode span with the final chunk."""
         if self.draining:
             return [], []                    # drain mode: queue frozen
+        if self.slo is not None and self.slo.should_shed():
+            # a latched burn-rate alert (telemetry/slo.py) sheds load
+            # exactly like an exhausted pool: requests stay queued,
+            # in-flight decodes keep running, admission resumes when
+            # the short window recovers (only passes with work queued
+            # count as shed)
+            if self.queue:
+                self._registry.counter(
+                    "serving_slo_shed",
+                    "admission passes shed by a latched SLO "
+                    "burn-rate alert").inc()
+                if not self._shed_active:
+                    self._shed_active = True
+                    self._registry.event("serving_slo_shed",
+                                         slos=self.slo.alerting(),
+                                         queued=len(self.queue))
+            return [], []
+        self._shed_active = False
         if any(f.stalls > 0 for f in self.prefilling):
             # a PREFILLING sequence is waiting on blocks: admitting new
             # work would steal the blocks it needs (and, after a
@@ -780,6 +936,15 @@ class ContinuousBatcher:
                 c.inc(len(hits) - n_hit, outcome="miss")
         for req, msg in rejects:
             self._reject(req, msg)
+        tr = self.tracer
+        if tr is not None and tr.enabled and (direct or chunked):
+            now = self.clock()
+            for fl in direct:
+                tr.admitted(fl.req.id, now, mode="direct",
+                            matched=fl.prefilled)
+            for fl in chunked:
+                tr.admitted(fl.req.id, now, mode="chunked",
+                            matched=fl.prefilled)
         return direct, chunked
 
     def _tables_for(self, flights: List[_InFlight], batch: int):
@@ -820,6 +985,7 @@ class ContinuousBatcher:
             tokens[i, :len(f.req.prompt)] = f.req.prompt
             lengths[i] = len(f.req.prompt)
         tables = self._tables_for(admitted, b)
+        t0 = self.clock()
         with self._tl().phase("prefill", category="serving"):
             out = self.step_fn.prefill(
                 self.params, state, tokens, lengths, tables,
@@ -830,12 +996,19 @@ class ContinuousBatcher:
         finite = (np.asarray(out.finite)[:len(admitted)]
                   if out.finite is not None
                   else np.ones(len(admitted), bool))
+        tr = self.tracer
+        traced = tr is not None and tr.enabled
         for i, f in enumerate(admitted):
+            if traced:
+                tr.span(f.req.id, "prefill", t0, now - t0,
+                        tokens=len(f.req.prompt))
             if finite[i]:
                 f.generated.append(int(ids[i]))
                 f.prefilled = len(f.req.prompt)
                 f.t_first = f.t_last = now
                 self.cache.publish_prefix(f.seq_id, f.req.prompt)
+                if traced:
+                    tr.mark(f.req.id, "first_token", now)
         return out.cache, finite
 
     # -- chunked prefill (the PREFILLING state) ------------------------------
@@ -891,6 +1064,12 @@ class ContinuousBatcher:
             if len(batchees) == 1:
                 msg = f"{type(e).__name__}: {str(e)[:200]}"
                 return state, [], [(batchees[0][0], msg)]
+            if self.tracer is not None and self.tracer.enabled:
+                t = self.clock()
+                for f, _ in batchees:
+                    self.tracer.mark(f.req.id, "retry_split", t,
+                                     batch=len(batchees),
+                                     site="prefill_chunk")
             mid = len(batchees) // 2
             state, d_lo, q_lo = self._isolate_chunks(
                 state, batchees[:mid], cidx, b, s, width)
@@ -948,6 +1127,10 @@ class ContinuousBatcher:
                 r.counter("serving_prefill_stalled",
                           "chunk reservations deferred by a full "
                           "pool").inc()
+                if (f.stalls == 1 and self.tracer is not None
+                        and self.tracer.enabled):
+                    self.tracer.mark(f.req.id, "prefill_stalled",
+                                     prefilled=f.prefilled)
                 continue
             f.stalls = 0
             batchees.append((f, cs))
@@ -967,6 +1150,8 @@ class ContinuousBatcher:
                           "to break a reservation deadlock").inc()
                 r.event("serving_prefill_requeued", step=idx,
                         request=str(f.req.id), prefilled=f.prefilled)
+                if self.tracer is not None and self.tracer.enabled:
+                    self.tracer.requeued(f.req.id, self.clock())
             return state
         # execute pending COW fork copies before the chunk gathers
         copies: List[Tuple[int, int, int]] = []
@@ -986,8 +1171,12 @@ class ContinuousBatcher:
         s = bucket(max(cs for _, cs in batchees), floor)
         widths = [len(self.cache.table(f.seq_id)) for f, _ in batchees]
         width = bucket(max(widths), self.min_width_bucket)
+        t0 = self.clock()
         state, done, quarantined = self._isolate_chunks(
             state, batchees, cidx, b, s, width)
+        t1 = self.clock()
+        tr = self.tracer
+        traced = tr is not None and tr.enabled
         now_done: List[_InFlight] = []
         for f, cs, tok, now in done:
             f.prefilled += cs
@@ -995,14 +1184,18 @@ class ContinuousBatcher:
                       "prefill chunks dispatched").inc()
             r.histogram("serving_prefill_chunk_tokens",
                         "prompt tokens per prefill chunk",
-                        buckets=(8, 16, 32, 64, 128, 256, 512, 1024,
-                                 2048, 4096)).observe(cs)
+                        buckets=TOKEN_COUNT_BUCKETS).observe(cs)
             report.setdefault("prefilled", []).append(f.req.id)
+            if traced:
+                tr.chunk_span(f.req.id, t0, t1 - t0, tokens=cs)
             if f.prefilled >= len(f.req.prompt):
                 f.generated.append(tok)
                 f.t_first = f.t_last = now
                 now_done.append(f)
                 self.cache.publish_prefix(f.seq_id, f.req.prompt)
+                if traced:
+                    tr.mark(f.req.id, "first_token", now)
+                    tr.decoding(f.req.id)
         if now_done:
             gone = {id(f) for f in now_done}
             self.prefilling = [f for f in self.prefilling
@@ -1066,6 +1259,11 @@ class ContinuousBatcher:
             if len(flights) == 1:
                 msg = f"{type(e).__name__}: {str(e)[:200]}"
                 return state, [], [(flights[0], msg)]
+            if self.tracer is not None and self.tracer.enabled:
+                t = self.clock()
+                for f in flights:
+                    self.tracer.mark(f.req.id, "retry_split", t,
+                                     batch=len(flights), site="decode")
             mid = len(flights) // 2
             state, acc_lo, q_lo = self._isolate(state, flights[:mid],
                                                 idx, width)
@@ -1180,11 +1378,16 @@ class ContinuousBatcher:
                 f = self.running[lane]
                 state = _sresil.poison_lane_kv(
                     state, self.cache, f.seq_id, f.position - 1)
+            t0 = self.clock()
             state, accepted, quarantined = self._isolate(
                 state, self.running, idx, width)
+            tr = self.tracer
+            traced = tr is not None and tr.enabled
             for f, tok, now in accepted:
                 f.generated.append(tok)
                 f.t_last = now
+                if traced:
+                    tr.decode_tick(f.req.id, t0, now)
             report["decoded"] = [f.req.id for f, _, _ in accepted]
             if quarantined:
                 state = self._quarantine(state, quarantined, idx,
@@ -1192,6 +1395,11 @@ class ContinuousBatcher:
         report["finished"].extend(self._reap())
         report["blocks_in_use"] = self.cache.blocks_in_use
         self._publish_gauges()
+        if self.slo is not None:
+            now = self.clock()
+            self.slo.observe("queue_depth", float(report["queued"]),
+                             t=now)
+            self.slo.tick(now=now, step=idx)
         return state, report
 
 

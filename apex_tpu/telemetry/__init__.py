@@ -66,6 +66,7 @@ from apex_tpu.telemetry import (
     fleet,
     flight,
     metrics,
+    slo,
     timeline,
 )
 from apex_tpu.telemetry.compiled import CompileTracker
@@ -84,8 +85,14 @@ from apex_tpu.telemetry.metrics import (
     JsonlSink,
     MetricsRegistry,
     StdoutSink,
+    TOKEN_COUNT_BUCKETS,
     registry,
     to_prometheus_text,
+)
+from apex_tpu.telemetry.slo import (
+    SLOMonitor,
+    SLOTarget,
+    SlidingWindowQuantile,
 )
 from apex_tpu.telemetry.timeline import (
     PHASES,
@@ -159,9 +166,13 @@ __all__ = [
     "JsonlSink",
     "MetricsRegistry",
     "PHASES",
+    "SLOMonitor",
+    "SLOTarget",
+    "SlidingWindowQuantile",
     "Span",
     "StdoutSink",
     "StepTimeline",
+    "TOKEN_COUNT_BUCKETS",
     "compiled",
     "cost",
     "devmem",
@@ -176,6 +187,7 @@ __all__ = [
     "metrics",
     "registry",
     "reset",
+    "slo",
     "snapshot",
     "snapshot_detail",
     "timeline",

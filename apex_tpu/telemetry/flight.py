@@ -53,6 +53,14 @@ trigger                fired by
                        the structured mismatch list; successful swaps
                        emit only the ``serving_weight_swap`` event,
                        which rides this ring into the next bundle)
+``slo_violation``      the SLO monitor's multi-window burn-rate alert
+                       latched (``telemetry.slo.SLOMonitor`` — TTFT/
+                       TPOT p99, goodput, queue depth); the bundle's
+                       ``extra`` embeds the OFFENDING requests' trace
+                       dicts and a live ``engine.introspect()``
+                       snapshot, so the latency postmortem opens with
+                       the slow requests' timelines in hand
+                       (host-local; one bundle per violation episode)
 ====================== ====================================================
 
 Fleet-level triggers (the guard's, the shutdown's) fire on EVERY

@@ -53,6 +53,15 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
     0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
     0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0)
 
+# count-scale quantities (tokens per chunk, queue depths, batch
+# sizes). Observing a count into the seconds-scale grid above lands
+# EVERYTHING in +Inf and the histogram reads as one useless spike —
+# use this grid (or your own) for anything that isn't a duration;
+# `MetricsRegistry.histogram` now refuses conflicting re-registration
+# so the mismatch fails loudly instead of silently mis-bucketing.
+TOKEN_COUNT_BUCKETS: Tuple[float, ...] = (
+    1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
+
 
 def _series_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
@@ -120,6 +129,25 @@ class Counter(_Metric):
         return s[0]
 
 
+class _BoundGauge:
+    """One pre-resolved labeled gauge series: hot loops pay a list
+    store per :meth:`set` instead of per-call label sorting + dict
+    lookup (:meth:`Gauge.bind`)."""
+
+    __slots__ = ("_s", "_lock")
+
+    def __init__(self, s, lock):
+        self._s = s
+        self._lock = lock
+
+    def set(self, v: float) -> None:
+        with self._lock:
+            self._s[0] = float(v)
+
+    def value(self) -> float:
+        return self._s[0]
+
+
 class Gauge(_Metric):
     """Last-write-wins float, optionally labeled."""
 
@@ -132,6 +160,11 @@ class Gauge(_Metric):
         s = self._get(labels)
         with self._lock:
             s[0] = float(v)
+
+    def bind(self, **labels) -> _BoundGauge:
+        """Resolve one labeled series once; the returned handle's
+        ``set`` skips the label machinery (per-step publishers)."""
+        return _BoundGauge(self._get(labels), self._lock)
 
     def inc(self, n: float = 1.0, **labels) -> None:
         s = self._get(labels)
@@ -380,8 +413,34 @@ class MetricsRegistry:
         return self._instrument(Gauge, name, help)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
-        return self._instrument(Histogram, name, help, buckets=buckets)
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
+        """Get-or-create. ``buckets=None`` means "no opinion": a new
+        histogram gets :data:`DEFAULT_BUCKETS`, an existing one is
+        returned as-is (readers never pin a grid). EXPLICIT buckets on
+        an already-registered histogram must match its grid exactly —
+        a silent mismatch would route observations into the wrong
+        buckets (the classic failure: a token COUNT observed into the
+        seconds-scale default grid lands every sample in +Inf), so it
+        raises instead."""
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = Histogram(name, help, self._lock,
+                              buckets=(buckets if buckets is not None
+                                       else DEFAULT_BUCKETS))
+                self._metrics[name] = m
+            elif not isinstance(m, Histogram):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"not histogram")
+            elif buckets is not None:
+                want = tuple(sorted(float(b) for b in buckets))
+                if want != m.buckets:
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {m.buckets}; conflicting grid {want} "
+                        "would silently mis-bucket observations")
+            return m
 
     # -- info blobs --------------------------------------------------------
 
@@ -583,6 +642,7 @@ def reset() -> None:
 __all__ = [
     "Counter",
     "DEFAULT_BUCKETS",
+    "TOKEN_COUNT_BUCKETS",
     "Gauge",
     "Histogram",
     "InMemorySink",

@@ -1388,9 +1388,13 @@ def bench_serving():
     fraction of admitted requests that still finished ok — quarantine
     must stay per-request) and ``p99_ttft_under_faults_ms``, so a
     regression in fault isolation shows up in BENCH records, not just
-    in the chaos smoke. ``vs_baseline`` is left to emit()'s prior-run
-    machinery. Knob: ``APEX_TPU_SERVING_REQUESTS`` (default 48 CPU /
-    128 TPU)."""
+    in the chaos smoke. The request plane (docs/observability.md
+    "Request plane") is armed on that faulted run — per-request
+    traces + an SLO monitor with objectives derived from the clean
+    run's p99s — and ``detail.request_plane`` records what it saw
+    (quarantined trace ids, burn-rate alerts, window values).
+    ``vs_baseline`` is left to emit()'s prior-run machinery. Knob:
+    ``APEX_TPU_SERVING_REQUESTS`` (default 48 CPU / 128 TPU)."""
     import os
 
     import jax
@@ -1473,7 +1477,7 @@ def bench_serving():
         return {"p50_ms": round(float(np.percentile(vals, 50)) * 1e3, 3),
                 "p99_ms": round(float(np.percentile(vals, 99)) * 1e3, 3)}
 
-    def run(kind):
+    def run(kind, tracer=None, slo=None):
         reqs = make_requests(kind)
         arrivals = list(np.cumsum(
             rng.exponential(1.0 / req_rate, size=n_requests)))
@@ -1487,7 +1491,8 @@ def bench_serving():
         else:
             eng = serving.ContinuousBatcher(
                 model, params, cache, max_batch=max_batch,
-                step_fn=step_fn, min_seq_bucket=seq_bucket)
+                step_fn=step_fn, min_seq_bucket=seq_bucket,
+                tracer=tracer, slo=slo)
             state, results = serving.serve_loop(
                 eng, state, reqs, arrivals=arrivals)
         wall = time.perf_counter() - t0
@@ -1510,10 +1515,38 @@ def bench_serving():
     cb = run("cb")
     # robustness pass: same continuous workload with one lane's cached
     # K/V NaN-poisoned at several engine steps — quarantine must stay
-    # per-request, so availability stays near 1 and TTFT stays sane
+    # per-request, so availability stays near 1 and TTFT stays sane.
+    # The request plane rides THIS run (it exists to explain exactly
+    # such runs): objectives derived from the clean run's p99s, the
+    # per-request traces and SLO window land in detail.request_plane
+    from apex_tpu.telemetry.slo import SLOMonitor
+
+    tracer = serving.RequestTracer(keep=n_requests)
+    # shed=False: observe-only — the faulted run must measure fault
+    # ISOLATION; latency-alert shedding would starve the queue and
+    # distort exactly the availability/TTFT numbers being recorded
+    slo = SLOMonitor.serving_default(
+        ttft_p99_s=max((cb["ttft"]["p99_ms"] or 1e3) * 3e-3, 0.05),
+        tpot_p99_s=max((cb["tpot"]["p99_ms"] or 1e3) * 3e-3, 0.01),
+        queue_depth=4 * max_batch, shed=False)
     with faults.inject(
             decode_nonfinite_steps=frozenset({5, 25, 50})):
-        faulted = run("cbf")
+        faulted = run("cbf", tracer=tracer, slo=slo)
+    slo_summary = slo.summary()
+    quarantined_traces = [
+        t for t in tracer.trace_dicts()
+        if any(m["name"] == "quarantine" for m in t["marks"])]
+    request_plane = {
+        "traces_completed": tracer.summary()["finished"],
+        "quarantined_traces": [t["trace_id"]
+                               for t in quarantined_traces],
+        "slo_alerts_total": slo_summary.get("alerts_total", 0),
+        "slo_alerting": slo_summary.get("alerting", []),
+        "slo_window_values": {
+            name: tgt.get("window_value")
+            for name, tgt in (slo_summary.get("targets") or {}).items()
+        },
+    }
     _bench_serving_long_prompt()
     emit({
         "metric": "serving_continuous_batching_tokens_per_sec",
@@ -1538,6 +1571,7 @@ def bench_serving():
             "availability_under_faults": faulted["availability"],
             "p99_ttft_under_faults_ms": faulted["ttft"]["p99_ms"],
             "under_faults": faulted,
+            "request_plane": request_plane,
             "compile_keys": step_fn.compile_keys(),
             "kv_pool": {"num_blocks": cache.num_blocks,
                         "block_size": cache.block_size,

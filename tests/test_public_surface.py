@@ -124,6 +124,8 @@ SURFACE = {
         # serving hot path (chunked prefill / prefix cache / sampling)
         "PrefixMatch", "append_kv_chunk", "apply_copies",
         "greedy_sampling", "scrub_blocks",
+        # request plane (tracing + SLO, docs/observability.md)
+        "RequestTrace", "RequestTracer",
     ],
     "apex_tpu.runtime": [
         "HostFlatSpace", "PrefetchLoader", "cast_bf16_f32",

@@ -1,0 +1,616 @@
+"""Serving request plane (apex_tpu/serving/tracing.py +
+apex_tpu/telemetry/slo.py + scheduler integration,
+docs/observability.md "Request plane").
+
+Anchors:
+
+- per-request traces: trace id minted at ``submit()``, spans at every
+  state transition (queued / admitted / prefill / ``prefill_chunk[i]``
+  / a coalesced decode window / finished), keep-last-k ring, perfetto
+  export with ONE TRACK PER REQUEST;
+- trace continuity across drain -> resume: the trace id survives the
+  snapshot bitwise, the resumed engine CONTINUES the same trace with a
+  ``resumed_from`` annotation, and the ``slo_violation`` bundle embeds
+  complete traces;
+- the SLO monitor: exact sliding-window quantiles, multi-window
+  burn-rate gauges, one latched ``slo_alert`` per violation episode,
+  a clean run stays silent, and ``should_shed()`` gates admission
+  (``serving_slo_shed``);
+- the ``serving_prefill_chunk_tokens`` regression: token counts land
+  in finite token-count buckets, never all in +Inf, and the registry
+  refuses a silently conflicting bucket grid;
+- ``introspect()`` + tools/serving_top.py + the telemetry_dump
+  ``serving`` section.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu import records, serving, telemetry  # noqa: E402
+from apex_tpu.models.gpt import GPTConfig, GPTModel  # noqa: E402
+from apex_tpu.resilience.guard import PreemptionHandler  # noqa: E402
+from apex_tpu.serving import resilience as sresil  # noqa: E402
+from apex_tpu.serving.kv_cache import KVCache  # noqa: E402
+from apex_tpu.serving.tracing import RequestTracer  # noqa: E402
+from apex_tpu.telemetry import flight  # noqa: E402
+from apex_tpu.telemetry.slo import (  # noqa: E402
+    SLOMonitor,
+    SLOTarget,
+    SlidingWindowQuantile,
+)
+
+sys.path.insert(0, os.path.join(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__))), "tools"))
+
+VOCAB, SEQ, HID, HEADS, KV, LAYERS = 64, 64, 32, 4, 2, 2
+
+
+def tiny_config():
+    return GPTConfig(vocab_size=VOCAB, max_seq_len=SEQ,
+                     hidden_size=HID, num_layers=LAYERS,
+                     num_heads=HEADS, num_kv_heads=KV,
+                     dtype=jnp.float32, param_dtype=jnp.float32)
+
+
+def fresh_cache(num_blocks=32, block_size=4):
+    return KVCache(LAYERS, KV, HID // HEADS, num_blocks=num_blocks,
+                   block_size=block_size, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = GPTModel(tiny_config())
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, VOCAB, (1, 8)), jnp.int32)
+    return model, model.init(jax.random.PRNGKey(0), toks)
+
+
+@pytest.fixture(scope="module")
+def step_fn(model_and_params):
+    model, _ = model_and_params
+    return serving.make_decode_step(model, fresh_cache())
+
+
+@pytest.fixture()
+def records_dir(tmp_path, monkeypatch):
+    path = tmp_path / "records"
+    monkeypatch.setattr(records, "RECORDS_DIR", str(path))
+    return path
+
+
+def make_engine(model, params, step_fn, cache, **kw):
+    reg = kw.pop("registry", None) or telemetry.MetricsRegistry()
+    sink = telemetry.InMemorySink()
+    reg.add_sink(sink)
+    kw.setdefault("max_batch", 4)
+    eng = serving.ContinuousBatcher(model, params, cache,
+                                    step_fn=step_fn, registry=reg,
+                                    **kw)
+    return eng, reg, sink
+
+
+def mk_requests(n, rng, **kw):
+    return [serving.Request(
+        id=i, prompt=rng.randint(0, VOCAB, (int(rng.randint(3, 9)),)),
+        max_new_tokens=int(rng.randint(3, 6)), **kw) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# RequestTracer
+# ---------------------------------------------------------------------------
+
+
+class TestTracer:
+    def test_trace_minted_at_submit_and_spans_at_transitions(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        tracer = RequestTracer()
+        eng, _, _ = make_engine(model, params, step_fn, cache,
+                                tracer=tracer)
+        req = serving.Request(id="r0", prompt=[1] * 5,
+                              max_new_tokens=3)
+        assert req.trace_id is None
+        eng.submit(req)
+        assert req.trace_id is not None          # minted at submit()
+        assert tracer.summary()["live"] == 1
+        state = cache.init_state()
+        while not eng.idle():
+            state, _ = eng.step(state)
+        (res,) = eng.drain()
+        assert res.finish_reason == "length"
+        (trace,) = tracer.trace_dicts()
+        assert trace["trace_id"] == req.trace_id
+        assert trace["outcome"] == "length"
+        names = [s["name"] for s in trace["spans"]]
+        assert "queued" in names and "prefill" in names
+        assert "decode" in names                 # the coalesced window
+        decode = next(s for s in trace["spans"] if s["name"] == "decode")
+        assert decode["args"]["tokens"] == 2     # 3 total, 1 at prefill
+        marks = [m["name"] for m in trace["marks"]]
+        assert marks[:2] == ["admitted", "first_token"]
+        assert marks[-1] == "finished"
+
+    def test_chunked_prefill_gets_per_chunk_spans(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        tracer = RequestTracer()
+        eng, _, _ = make_engine(model, params, step_fn, cache,
+                                tracer=tracer, prefill_chunk=4)
+        eng.submit(serving.Request(id="long", prompt=[2] * 11,
+                                   max_new_tokens=2))
+        state = cache.init_state()
+        while not eng.idle():
+            state, _ = eng.step(state)
+        (trace,) = tracer.trace_dicts()
+        chunk_names = [s["name"] for s in trace["spans"]
+                       if s["name"].startswith("prefill_chunk")]
+        # 11 tokens / chunk 4 -> chunks of 4, 4, 3 with ordinals
+        assert chunk_names == ["prefill_chunk[0]", "prefill_chunk[1]",
+                               "prefill_chunk[2]"]
+        toks = [s["args"]["tokens"] for s in trace["spans"]
+                if s["name"].startswith("prefill_chunk")]
+        assert sum(toks) == 11
+
+    def test_perfetto_export_one_track_per_request(
+            self, model_and_params, step_fn, tmp_path):
+        model, params = model_and_params
+        cache = fresh_cache()
+        tracer = RequestTracer()
+        eng, _, _ = make_engine(model, params, step_fn, cache,
+                                tracer=tracer)
+        reqs = mk_requests(5, np.random.RandomState(3))
+        state, _ = serving.serve_loop(eng, cache.init_state(), reqs)
+        path = tmp_path / "requests.json"
+        trace = tracer.export_trace(str(path))
+        on_disk = json.loads(path.read_text())
+        assert on_disk == trace
+        meta = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        assert len(meta) == 5                    # one track per request
+        tids = {e["tid"] for e in meta}
+        assert len(tids) == 5
+        # every complete event carries µs ts/dur and its trace id —
+        # the StepTimeline.export_trace event format
+        for e in trace["traceEvents"]:
+            if e["ph"] == "X":
+                assert {"name", "cat", "ts", "dur", "pid", "tid",
+                        "args"} <= set(e)
+                assert "trace_id" in e["args"]
+
+    def test_completed_ring_is_bounded(self, model_and_params,
+                                       step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        tracer = RequestTracer(keep=3)
+        eng, _, _ = make_engine(model, params, step_fn, cache,
+                                tracer=tracer)
+        reqs = mk_requests(8, np.random.RandomState(5))
+        serving.serve_loop(eng, cache.init_state(), reqs)
+        assert len(tracer.completed()) == 3
+        assert tracer.summary()["finished"] == 8
+
+    def test_untraced_engine_leaves_requests_untouched(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, _, _ = make_engine(model, params, step_fn, cache)
+        req = serving.Request(id=0, prompt=[1] * 4, max_new_tokens=2)
+        eng.submit(req)
+        state = cache.init_state()
+        while not eng.idle():
+            state, _ = eng.step(state)
+        assert req.trace_id is None              # disabled is step
+
+    def test_quarantine_marks_and_outcome(self, model_and_params,
+                                          step_fn, monkeypatch):
+        model, params = model_and_params
+        cache = fresh_cache()
+        tracer = RequestTracer()
+        eng, _, _ = make_engine(model, params, step_fn, cache,
+                                tracer=tracer)
+        monkeypatch.setenv("APEX_TPU_FAULTS",
+                           "decode_nonfinite=1;decode_nonfinite_lane=0")
+        for i in range(2):
+            eng.submit(serving.Request(id=i, prompt=[1 + i] * 4,
+                                       max_new_tokens=4))
+        state = cache.init_state()
+        state, _ = eng.step(state)
+        state, rep = eng.step(state)
+        assert rep["quarantined"] == [0]
+        traces = {t["request_id"]: t for t in tracer.trace_dicts()}
+        bad = traces["0"]
+        assert bad["outcome"] == "error"
+        assert any(m["name"] == "quarantine" for m in bad["marks"])
+
+
+# ---------------------------------------------------------------------------
+# drain -> resume trace continuity
+# ---------------------------------------------------------------------------
+
+
+class TestTraceContinuity:
+    def test_trace_id_survives_snapshot_and_resume_continues(
+            self, model_and_params, step_fn, tmp_path):
+        model, params = model_and_params
+        handler = PreemptionHandler()        # not installed: flag only
+        cache = fresh_cache()
+        tracer = RequestTracer()
+        eng, _, _ = make_engine(model, params, step_fn, cache,
+                                max_batch=2, tracer=tracer,
+                                preemption=handler,
+                                snapshot_dir=str(tmp_path))
+        state = cache.init_state()
+        for r in mk_requests(5, np.random.RandomState(11)):
+            eng.submit(r)
+        state, _ = eng.step(state)
+        state, _ = eng.step(state)
+        handler.requested = True
+        state, rep = eng.step(state)
+        assert rep["snapshot"] is not None
+
+        snap = sresil.load_snapshot(rep["snapshot"])
+        # every snapshotted entry carries its trace id, bitwise
+        by_id = {e["id"]: e for e in snap["requests"]}
+        drained = {t["request_id"]: t for t in tracer.trace_dicts()
+                   if t["outcome"] == "drained"}
+        assert set(drained) == {str(i) for i in by_id}
+        for rid, e in by_id.items():
+            assert e["trace_id"] == drained[str(rid)]["trace_id"]
+
+        resumed, _prior = sresil.resume_requests(snap)
+        origin = f"serving_{snap['step']:012d}"
+        assert all(r.resumed_from == origin for r in resumed)
+        assert all(r.trace_id == by_id[r.id]["trace_id"]
+                   for r in resumed)
+
+        cache2 = fresh_cache()
+        tracer2 = RequestTracer()
+        eng2, _, _ = make_engine(model, params, step_fn, cache2,
+                                 max_batch=2, tracer=tracer2)
+        serving.serve_loop(eng2, cache2.init_state(), resumed)
+        cont = {t["request_id"]: t for t in tracer2.trace_dicts()}
+        for r in resumed:
+            t = cont[str(r.id)]
+            # SAME trace id on the resumed side, resumed_from set and
+            # marked, and the continuation ends normally
+            assert t["trace_id"] == by_id[r.id]["trace_id"]
+            assert t["resumed_from"] == origin
+            assert any(m["name"] == "resumed" and
+                       m["args"]["resumed_from"] == origin
+                       for m in t["marks"])
+            assert t["outcome"] in ("length", "eos")
+        # the perfetto track label carries the resumed_from annotation
+        meta = [e for e in tracer2.export_trace()["traceEvents"]
+                if e["ph"] == "M"]
+        assert all(origin in e["args"]["name"] for e in meta)
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor
+# ---------------------------------------------------------------------------
+
+
+class TestSlidingWindowQuantile:
+    def test_exact_quantiles_and_pruning(self):
+        est = SlidingWindowQuantile(10.0)
+        for i, v in enumerate([1.0, 2.0, 3.0, 4.0]):
+            est.observe(v, t=float(i))
+        assert est.quantile(0.0, now=4.0) == 1.0
+        assert est.quantile(1.0, now=4.0) == 4.0
+        assert est.quantile(0.5, now=4.0) == pytest.approx(2.5)
+        # samples age out of the window (cutoff now - 10s)
+        assert est.quantile(0.0, now=11.5) == 3.0
+        assert est.count(now=12.5) == 1
+        assert est.quantile(0.5, now=100.0) is None
+
+    def test_capacity_bounds_memory(self):
+        est = SlidingWindowQuantile(1e9, capacity=4)
+        for i in range(100):
+            est.observe(float(i), t=float(i))
+        assert est.count(now=100.0) == 4
+        assert est.quantile(0.0, now=100.0) == 96.0
+
+
+class TestSLOMonitor:
+    def mk(self, reg, **kw):
+        kw.setdefault("windows", ((10.0, 2.0, 2.0),))
+        kw.setdefault("min_samples", 2)
+        kw.setdefault("check_every", 1)
+        return SLOMonitor([SLOTarget("ttft_p99", 0.1, budget=0.1)],
+                          registry=reg, **kw)
+
+    def test_clean_run_stays_silent(self, records_dir):
+        reg = telemetry.MetricsRegistry()
+        sink = telemetry.InMemorySink()
+        reg.add_sink(sink)
+        mon = self.mk(reg)
+        for i in range(20):
+            mon.observe("ttft_p99", 0.01, t=i * 0.1)
+        out = mon.check(now=2.0)
+        assert out["alerting"] == []
+        assert not mon.should_shed()
+        assert all(e["event"] != "slo_alert" for e in sink.events)
+        assert reg.gauge("slo_burn_rate").value(
+            slo="ttft_p99", window="10s") == 0.0
+
+    def test_burn_rate_alert_latches_once_and_recovers(self):
+        reg = telemetry.MetricsRegistry()
+        sink = telemetry.InMemorySink()
+        reg.add_sink(sink)
+        mon = self.mk(reg)
+        for i in range(10):
+            mon.observe("ttft_p99", 5.0, t=float(i) * 0.2,
+                        request_id=f"r{i}")
+        out = mon.check(now=2.0)
+        assert out["alerting"] == ["ttft_p99"]
+        assert mon.should_shed()
+        # burn = bad_frac (1.0) / budget (0.1) = 10x
+        assert reg.gauge("slo_burn_rate").value(
+            slo="ttft_p99", window="10s") == pytest.approx(10.0)
+        mon.check(now=2.5)                   # still violating: latched
+        alerts = [e for e in sink.events if e["event"] == "slo_alert"]
+        assert len(alerts) == 1
+        assert alerts[0]["requests"]         # offenders named
+        # the short window empties -> recovery event, gauge drops
+        out = mon.check(now=60.0)
+        assert out["alerting"] == []
+        assert not mon.should_shed()
+        assert [e["event"] for e in sink.events].count(
+            "slo_recovered") == 1
+        assert reg.gauge("slo_alert_active").value(slo="ttft_p99") == 0
+
+    def test_min_samples_guards_single_bad_request(self):
+        reg = telemetry.MetricsRegistry()
+        mon = self.mk(reg)
+        mon.observe("ttft_p99", 99.0, t=1.9)
+        out = mon.check(now=2.0)
+        assert out["alerting"] == []         # one sample never alerts
+
+    def test_summary_mirrored_into_info(self):
+        reg = telemetry.MetricsRegistry()
+        mon = self.mk(reg)
+        mon.observe("ttft_p99", 0.01, t=0.0)
+        mon.check(now=1.0)
+        info = reg.snapshot()["info"]["slo_window"]
+        assert "ttft_p99" in info["targets"]
+        json.dumps(info)                     # JSON-able end to end
+
+    def test_unconfigured_target_is_noop(self):
+        mon = self.mk(telemetry.MetricsRegistry())
+        mon.observe("nonexistent", 1.0, t=0.0)   # must not raise
+
+    def test_should_shed_gates_admission(self, model_and_params,
+                                          step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        t = [0.0]
+        reg = telemetry.MetricsRegistry()
+        sink = telemetry.InMemorySink()
+        reg.add_sink(sink)
+        mon = SLOMonitor([SLOTarget("tpot_p99", 1e-6, budget=0.1)],
+                         windows=((8.0, 4.0, 2.0),), min_samples=1,
+                         check_every=1, registry=reg,
+                         clock=lambda: t[0])
+        eng, _, _ = make_engine(model, params, step_fn, cache,
+                                registry=reg, slo=mon,
+                                clock=lambda: t[0])
+        eng._registry = reg
+        state = cache.init_state()
+        eng.submit(serving.Request(id=0, prompt=[1] * 4,
+                                   max_new_tokens=3))
+        while not eng.idle():
+            t[0] += 0.5
+            state, _ = eng.step(state)       # finishes -> violating tpot
+        assert mon.should_shed()
+        eng.submit(serving.Request(id=1, prompt=[2] * 4,
+                                   max_new_tokens=2))
+        t[0] += 0.5
+        state, rep = eng.step(state)
+        assert rep["admitted"] == []         # shed: stays queued
+        assert rep["queued"] == 1
+        assert reg.counter("serving_slo_shed").value() >= 1
+        assert "serving_slo_shed" in [e["event"] for e in sink.events]
+        # the violating samples age out; the end-of-step check clears
+        # the latch, so the step AFTER the recovery check admits
+        t[0] += 30.0
+        state, _ = eng.step(state)
+        assert not mon.should_shed()
+        state, rep = eng.step(state)
+        assert rep["admitted"] == [1]
+
+    def test_violation_bundle_embeds_traces_and_introspect(
+            self, model_and_params, step_fn, records_dir):
+        model, params = model_and_params
+        cache = fresh_cache()
+        tracer = RequestTracer()
+        reg = telemetry.MetricsRegistry()
+        mon = SLOMonitor([SLOTarget("tpot_p99", 1e-9)],
+                         windows=((5.0, 0.5, 1.0),), min_samples=1,
+                         check_every=1, registry=reg)
+        rec = flight.enable(keep=3)
+        try:
+            eng, _, _ = make_engine(model, params, step_fn, cache,
+                                    registry=reg, tracer=tracer,
+                                    slo=mon)
+            reqs = mk_requests(3, np.random.RandomState(9))
+            serving.serve_loop(eng, cache.init_state(), reqs)
+            assert rec.dumps == 1
+            assert rec.last_trigger == "slo_violation"
+            with open(rec.last_dump) as f:
+                bundle = json.load(f)["payload"]
+            extra = bundle["extra"]
+            assert extra["slo"] == "tpot_p99"
+            assert extra["requests"]
+            traces = {t["request_id"]: t for t in extra["traces"]}
+            for rid in extra["requests"]:
+                # COMPLETE traces: terminal outcome, decode span,
+                # perfetto-exportable span payloads
+                t = traces[str(rid)]
+                assert t["outcome"] is not None
+                assert any(s["name"] == "decode" for s in t["spans"])
+            assert extra["introspect"]["slo"]["alerting"] == [
+                "tpot_p99"]
+        finally:
+            flight.disable()
+
+
+# ---------------------------------------------------------------------------
+# serving_prefill_chunk_tokens regression (ISSUE 11 satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestChunkTokensHistogram:
+    def test_chunk_tokens_land_in_finite_buckets(
+            self, model_and_params, step_fn):
+        """Token COUNTS must never observe into the seconds-scale
+        DEFAULT_BUCKETS grid (every ~40-token chunk would land in
+        +Inf and the histogram reads as one useless spike)."""
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, reg, _ = make_engine(model, params, step_fn, cache,
+                                  prefill_chunk=4)
+        eng.submit(serving.Request(id=0, prompt=[3] * 14,
+                                   max_new_tokens=2))
+        state = cache.init_state()
+        while not eng.idle():
+            state, _ = eng.step(state)
+        h = reg.histogram("serving_prefill_chunk_tokens").series()[
+            "serving_prefill_chunk_tokens"]
+        assert h["count"] == 4               # chunks of 4,4,4,2
+        finite = [le for le in h["buckets"] if le != "+Inf"]
+        top = max(finite, key=float)
+        # ALL mass sits below +Inf: the grid is token-count scale
+        assert h["buckets"][top] == h["count"]
+        assert float(top) >= 4096            # TOKEN_COUNT_BUCKETS
+
+    def test_registry_refuses_conflicting_bucket_grid(self):
+        reg = telemetry.MetricsRegistry()
+        reg.histogram("toks", buckets=(8, 64, 512))
+        # a reader with no opinion gets the existing instrument
+        assert reg.histogram("toks").buckets == (8.0, 64.0, 512.0)
+        with pytest.raises(ValueError, match="mis-bucket"):
+            reg.histogram("toks", buckets=(0.1, 1.0))
+
+    def test_token_count_buckets_exported(self):
+        assert telemetry.TOKEN_COUNT_BUCKETS[0] == 1
+        assert telemetry.TOKEN_COUNT_BUCKETS[-1] >= 4096
+
+
+# ---------------------------------------------------------------------------
+# introspection + serving_top + telemetry_dump serving section
+# ---------------------------------------------------------------------------
+
+
+class TestIntrospection:
+    def test_introspect_reports_all_states(self, model_and_params,
+                                           step_fn):
+        model, params = model_and_params
+        cache = fresh_cache()
+        tracer = RequestTracer()
+        eng, _, _ = make_engine(model, params, step_fn, cache,
+                                max_batch=2, max_prefill_batch=1,
+                                prefill_chunk=4, tracer=tracer)
+        state = cache.init_state()
+        eng.submit(serving.Request(id="short", prompt=[1] * 4,
+                                   max_new_tokens=8,
+                                   deadline_ms=60000.0))
+        eng.submit(serving.Request(id="long", prompt=[2] * 12,
+                                   max_new_tokens=4))
+        eng.submit(serving.Request(id="waiting", prompt=[3] * 4,
+                                   max_new_tokens=2))
+        state, _ = eng.step(state)
+        state, _ = eng.step(state)
+        intro = eng.introspect()
+        json.dumps(intro)                    # JSON-able end to end
+        by_id = {r["id"]: r for r in intro["requests"]}
+        assert by_id["short"]["state"] == "decoding"
+        assert by_id["short"]["generated"] >= 1
+        assert by_id["short"]["deadline_left_ms"] is not None
+        assert by_id["long"]["state"] == "prefilling"
+        assert 0 < by_id["long"]["prefilled"] < 12
+        assert by_id["waiting"]["state"] == "queued"
+        assert by_id["short"]["trace_id"] is not None
+        assert intro["pool"]["blocks_in_use"] > 0
+        assert intro["traces"]["live"] == 3
+
+    def test_serving_top_renders_live_and_bundle(
+            self, model_and_params, step_fn):
+        import serving_top
+
+        model, params = model_and_params
+        cache = fresh_cache()
+        tracer = RequestTracer()
+        eng, _, _ = make_engine(model, params, step_fn, cache,
+                                max_batch=2, prefill_chunk=4,
+                                tracer=tracer)
+        state = cache.init_state()
+        eng.submit(serving.Request(id="alpha", prompt=[1] * 4,
+                                   max_new_tokens=6))
+        state, _ = eng.step(state)
+        text = serving_top.render_live(eng)
+        assert "alpha" in text and "decoding" in text
+        assert "kv pool" in text
+        bundle = {"trigger": "slo_violation",
+                  "error": "RuntimeError: SLO ...", "pid": 1,
+                  "extra": {"slo": "tpot_p99", "requests": ["alpha"],
+                            "traces": tracer.trace_dicts(),
+                            "introspect": eng.introspect()}}
+        out = serving_top.render_bundle(bundle)
+        assert "slo_violation" in out
+        assert "alpha" in out
+
+    def test_serving_top_cli_resolves_shapes(self, model_and_params,
+                                             step_fn, tmp_path,
+                                             capsys):
+        import serving_top
+
+        model, params = model_and_params
+        cache = fresh_cache()
+        eng, _, _ = make_engine(model, params, step_fn, cache)
+        intro = tmp_path / "intro.json"
+        intro.write_text(json.dumps(eng.introspect()))
+        assert serving_top.main([str(intro)]) == 0
+        assert "serving engine" in capsys.readouterr().out
+        bad = tmp_path / "bad.json"
+        bad.write_text("{}")
+        assert serving_top.main([str(bad)]) == 2
+
+    def test_telemetry_dump_serving_section(self, model_and_params,
+                                            step_fn):
+        import telemetry_dump
+
+        model, params = model_and_params
+        cache = fresh_cache()
+        reg = telemetry.MetricsRegistry()
+        mon = SLOMonitor([SLOTarget("ttft_p99", 10.0)],
+                         windows=((10.0, 1.0, 2.0),), check_every=1,
+                         registry=reg)
+        eng, _, _ = make_engine(model, params, step_fn, cache,
+                                registry=reg, slo=mon)
+        reqs = mk_requests(2, np.random.RandomState(1))
+        serving.serve_loop(eng, cache.init_state(), reqs)
+        snap = reg.snapshot()
+        sec = telemetry_dump.serving_section(snap)
+        assert any(k.startswith("serving_requests")
+                   for k in sec["counters"])
+        assert any(k.startswith("slo_burn_rate")
+                   for k in sec["gauges"])
+        assert sec["prefix_cache_hit_rate"] is not None
+        assert sec["slo_window"]["targets"]["ttft_p99"]
+        comments = telemetry_dump.plane_comments(snap)
+        assert "# serving:" in comments
+        assert "alerting=none" in comments
+        # no serving series -> the section stays null-with-reason and
+        # the comment line is omitted
+        empty = telemetry.MetricsRegistry().snapshot()
+        sec2 = telemetry_dump.serving_section(empty)
+        assert sec2["slo_reason"]
+        assert "# serving:" not in telemetry_dump.plane_comments(empty)

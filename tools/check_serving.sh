@@ -27,7 +27,19 @@
 #    poisoned sequence, drain with a committed serving snapshot (zero
 #    admitted requests silently dropped), resume on a fresh engine
 #    with bitwise-identical token streams, and land >= 90% of the
-#    fault-free goodput.
+#    fault-free goodput,
+# then the request plane (docs/observability.md "Request plane"):
+#  - the TRACING smoke: a 200-request traced run must export a
+#    perfetto trace with ONE TRACK PER REQUEST (prefill/prefill-chunk
+#    + decode spans on every track), and armed tracing+SLO must stay
+#    within the steady-state engine-step overhead budget vs disabled
+#    (the `disabled is step` discipline), and
+#  - the SLO smoke: a clean run stays alert-free (zero slo_alert
+#    events, zero slo_violation bundles); a run with decode_nonfinite
+#    injected AND an artificial decode stall must commit EXACTLY ONE
+#    slo_violation flight bundle embedding the offending requests'
+#    complete traces — and tools/serving_top.py must render both the
+#    bundle and the live engine.
 # Extra args pass through to pytest.
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -36,7 +48,8 @@ export JAX_PLATFORMS=cpu
 rc=0
 
 python -m pytest tests/test_serving.py tests/test_serving_resilience.py \
-    tests/test_serving_hotpath.py "$@" -q -p no:cacheprovider || rc=1
+    tests/test_serving_hotpath.py tests/test_serving_request_plane.py \
+    "$@" -q -p no:cacheprovider || rc=1
 
 echo "== 200-request smoke: continuous batching vs static batch =="
 python - <<'PY' || rc=1
@@ -481,6 +494,248 @@ print(f"chaos OK: quarantined only id {bad_id}, snapshot carried "
       f"resume bitwise, goodput {goodput:.3f} of fault-free")
 assert goodput >= 0.90, f"goodput {goodput:.3f} < 0.90"
 shutil.rmtree(snapdir, ignore_errors=True)
+PY
+
+echo "== request plane smoke: tracing tracks + overhead, SLO burn-rate monitor =="
+python - <<'PY' || rc=1
+import json
+import shutil
+import tempfile
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import records, serving, telemetry
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.resilience import faults
+from apex_tpu.telemetry import flight
+from apex_tpu.telemetry.slo import SLOMonitor, SLOTarget
+
+import sys, os
+sys.path.insert(0, os.path.join(os.getcwd(), "tools"))
+import serving_top
+
+cfg = GPTConfig(vocab_size=512, max_seq_len=128, hidden_size=128,
+                num_layers=2, num_heads=4, num_kv_heads=2,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+model = GPTModel(cfg)
+rng = np.random.RandomState(0)
+params = model.init(jax.random.PRNGKey(0),
+                    jnp.asarray(rng.randint(0, 512, (1, 8)), jnp.int32))
+MAX_BATCH = 8
+cache = serving.KVCache.for_config(cfg, num_blocks=MAX_BATCH * 8,
+                                   block_size=16)
+step_fn = serving.make_decode_step(model, cache)
+N = 200
+
+
+def make_requests(tag, n=N):
+    r = np.random.RandomState(7)
+    return [serving.Request(
+        id=f"{tag}{i}", prompt=r.randint(0, 512, (int(r.randint(4, 25)),)),
+        max_new_tokens=int(r.randint(4, 41))) for i in range(n)]
+
+
+# -- tracing smoke: 200 requests, one perfetto track per request ------------
+tracer = serving.RequestTracer(keep=N)
+eng = serving.ContinuousBatcher(model, params, cache, step_fn=step_fn,
+                                max_batch=MAX_BATCH, min_seq_bucket=32,
+                                prefill_chunk=16, tracer=tracer)
+state = eng.warmup(cache.init_state())
+state, res = serving.serve_loop(eng, state, make_requests("t"))
+del state
+assert len(res) == N
+trace = tracer.export_trace()
+tracks = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+assert len(tracks) == N, f"expected {N} request tracks, got {len(tracks)}"
+by_tid = {}
+for e in trace["traceEvents"]:
+    if e["ph"] == "X":
+        by_tid.setdefault(e["tid"], set()).add(e["name"])
+for t in tracks:
+    names = by_tid[t["tid"]]
+    assert "decode" in names, f"track {t['args']['name']}: no decode span"
+    assert "prefill" in names or any(n.startswith("prefill_chunk")
+                                     for n in names), (
+        f"track {t['args']['name']}: no prefill span")
+print(f"tracing OK: {N} requests -> {len(tracks)} perfetto tracks, "
+      f"{sum(len(v) for v in by_tid.values())} distinct span names total")
+
+# -- overhead: armed tracing+SLO vs disabled on a steady decode loop --------
+# the budget is 2% on a quiet machine; at ~2ms/step CI noise swamps
+# that, so measurements INTERLEAVE (ABAB), take the min per config,
+# and assert a noise-tolerant 25% ceiling while printing the real
+# number — a request plane that actually cost its 18%-style bug
+# (per-step label sorting, json mirrors) fails this loudly
+def steady_step_ms(tracer, slo):
+    eng = serving.ContinuousBatcher(
+        model, params, cache, step_fn=step_fn, max_batch=MAX_BATCH,
+        min_seq_bucket=32, tracer=tracer, slo=slo)
+    state = cache.init_state()
+    for i in range(MAX_BATCH):
+        eng.submit(serving.Request(id=f"o{i}", prompt=[1 + i] * 8,
+                                   max_new_tokens=100))
+    state, _ = eng.step(state)          # admission + prefill
+    t0 = time.perf_counter()
+    steps = 0
+    while not eng.idle():
+        state, _ = eng.step(state)
+        steps += 1
+    ms = (time.perf_counter() - t0) / steps * 1e3
+    del state
+    eng.drain()
+    return ms
+
+
+armed_slo = SLOMonitor.serving_default(
+    ttft_p99_s=60.0, tpot_p99_s=60.0, queue_depth=10000,
+    registry=telemetry.MetricsRegistry())
+base_ms, armed_ms = None, None
+for _ in range(4):
+    b = steady_step_ms(None, None)
+    a = steady_step_ms(serving.RequestTracer(), armed_slo)
+    base_ms = b if base_ms is None else min(base_ms, b)
+    armed_ms = a if armed_ms is None else min(armed_ms, a)
+ratio = armed_ms / base_ms
+print(f"overhead: disabled {base_ms:.3f}ms/step, armed {armed_ms:.3f}"
+      f"ms/step = {100 * (ratio - 1):+.2f}% (budget 2% quiet-machine, "
+      f"CI bound 25%)")
+assert ratio < 1.25, (
+    f"armed request plane cost {100 * (ratio - 1):.1f}% per step")
+
+# -- SLO smoke: clean run alert-free ----------------------------------------
+records.RECORDS_DIR = tempfile.mkdtemp(prefix="apex_tpu_slo_smoke_")
+
+
+def slo_monitor(reg, tpot_objective_s):
+    # goodput budget 0.9: armed, but one quarantined lane must not
+    # alert — the bundle count pins tpot_p99 as the only episode
+    return SLOMonitor(
+        [SLOTarget("tpot_p99", tpot_objective_s, budget=0.05),
+         SLOTarget("goodput", 1.0, kind="ge", budget=0.9)],
+        windows=((6.0, 2.0, 1.5),), min_samples=2, registry=reg)
+
+
+def slo_bundles():
+    out = []
+    for name in sorted(os.listdir(records.RECORDS_DIR)):
+        if name.startswith("flightrec"):
+            with open(os.path.join(records.RECORDS_DIR, name)) as f:
+                b = json.load(f)["payload"]
+            if b["trigger"] == "slo_violation":
+                out.append(b)
+    return out
+
+
+# calibrate a clean-decode tpot so the objective separates stall from noise
+t0 = time.perf_counter()
+state = cache.init_state()
+tab = np.zeros((MAX_BATCH, 4), np.int32)
+for _ in range(10):
+    out = step_fn.decode(params, state, np.zeros(MAX_BATCH, np.int32),
+                         np.zeros(MAX_BATCH, np.int32), tab)
+    state = out.cache
+    jax.block_until_ready(out.next_token)
+t_decode = (time.perf_counter() - t0) / 10
+del state
+objective = max(t_decode * 8, 0.02)
+stall_s = max(t_decode * 40, 0.05)
+
+recorder = flight.enable(keep=20)
+try:
+    reg = telemetry.MetricsRegistry()
+    sink = telemetry.InMemorySink()
+    reg.add_sink(sink)
+    tracer = serving.RequestTracer(keep=64)
+    eng = serving.ContinuousBatcher(
+        model, params, cache, step_fn=step_fn, max_batch=MAX_BATCH,
+        min_seq_bucket=32, registry=reg, tracer=tracer,
+        slo=slo_monitor(reg, objective))
+    state = eng.warmup(cache.init_state())
+    state, res = serving.serve_loop(eng, state, make_requests("c", 60))
+    del state
+    assert all(r.finish_reason == "length" for r in res)
+    assert not [e for e in sink.events if e["event"] == "slo_alert"], \
+        "clean run fired an SLO alert"
+    assert not slo_bundles(), "clean run committed an slo_violation bundle"
+    assert reg.counter("serving_slo_shed").value() == 0
+    clean_top = serving_top.render_live(eng)
+    assert "serving engine" in clean_top and "tpot_p99" in clean_top
+    print(f"clean run OK: zero alerts, zero bundles "
+          f"(objective {objective * 1e3:.1f}ms)")
+
+    # -- faulted run: decode_nonfinite + an artificial decode stall ---------
+    # every request is admitted BEFORE the stall bites (max_batch >=
+    # N, burst arrivals), so the violation is ONE episode: the alert
+    # latches once, everyone in flight finishes under it, and exactly
+    # one slo_violation bundle commits — a shed/starve/recover cycle
+    # would legitimately fire once per episode instead
+    class StallingStep:
+        """Proxy step_fn: decode calls past `after` sleep `stall_s` —
+        the artificial stall that must burn the TPOT error budget."""
+        def __init__(self, inner, after, stall_s):
+            self.inner, self.after, self.stall_s = inner, after, stall_s
+            self.calls = 0
+        def prefill(self, *a, **kw):
+            return self.inner.prefill(*a, **kw)
+        def prefill_chunk(self, *a, **kw):
+            return self.inner.prefill_chunk(*a, **kw)
+        def decode(self, *a, **kw):
+            self.calls += 1
+            if self.calls > self.after:
+                time.sleep(self.stall_s)
+            return self.inner.decode(*a, **kw)
+
+    reg = telemetry.MetricsRegistry()
+    sink = telemetry.InMemorySink()
+    reg.add_sink(sink)
+    tracer = serving.RequestTracer(keep=64)
+    eng = serving.ContinuousBatcher(
+        model, params, cache, step_fn=StallingStep(step_fn, 8, stall_s),
+        max_batch=16, min_seq_bucket=32, registry=reg,
+        tracer=tracer, slo=slo_monitor(reg, objective))
+    state = cache.init_state()
+    with faults.inject(decode_nonfinite_steps=frozenset({10})):
+        state, res = serving.serve_loop(eng, state,
+                                        make_requests("f", 12))
+    del state
+    quarantined = [r for r in res if r.finish_reason == "error"]
+    assert len(quarantined) == 1, "nonfinite lane not quarantined"
+    alerts = [e for e in sink.events if e["event"] == "slo_alert"]
+    assert alerts, "stalled run fired no SLO alert"
+    bundles = slo_bundles()
+    assert len(bundles) == 1, (
+        f"expected exactly one slo_violation bundle, got {len(bundles)}")
+    extra = bundles[0]["extra"]
+    assert extra["slo"] == "tpot_p99" and extra["requests"]
+    traces = {t["request_id"]: t for t in extra["traces"]}
+    for rid in extra["requests"]:
+        t = traces[str(rid)]
+        assert t["outcome"] is not None and t["spans"], (
+            f"offending trace {rid} incomplete")
+    assert extra["introspect"]["slo"]["alerting"] == ["tpot_p99"]
+    shed = reg.counter("serving_slo_shed").value()
+    # serving_top renders the committed bundle file itself
+    rendered = 0
+    for name in sorted(os.listdir(records.RECORDS_DIR)):
+        if not name.startswith("flightrec"):
+            continue
+        p = os.path.join(records.RECORDS_DIR, name)
+        with open(p) as f:
+            if json.load(f)["payload"]["trigger"] != "slo_violation":
+                continue
+        assert serving_top.main([p]) == 0
+        rendered += 1
+    assert rendered == 1, "serving_top could not render the slo bundle"
+    print(f"slo smoke OK: 1 slo_violation bundle, "
+          f"{len(extra['requests'])} offending traces embedded, "
+          f"{int(shed)} admission passes shed, quarantine isolated "
+          f"{quarantined[0].id}")
+finally:
+    flight.disable()
+    shutil.rmtree(records.RECORDS_DIR, ignore_errors=True)
 PY
 
 if [ "$rc" -ne 0 ]; then

@@ -1,0 +1,203 @@
+"""Top-style view of a serving engine: live introspection or a dumped
+flight bundle.
+
+The request plane's human surface (docs/serving.md "follow one slow
+request"): render what ``ContinuousBatcher.introspect()`` reports —
+per-request state/age/deadline headroom/block footprint/chunk
+progress, pool + prefix-cache occupancy, the SLO burn-rate window —
+as one terminal screenful, from either
+
+- a LIVE engine (``render_live(engine)`` from the serving process —
+  the smoke in tools/check_serving.sh does exactly this), or
+- a DUMPED bundle: an ``slo_violation`` / ``serving_*`` flight record
+  (whose ``extra`` embeds the introspection snapshot and the offending
+  requests' traces) or a bare ``introspect()`` JSON you saved
+  yourself::
+
+    python tools/serving_top.py bench_records/flightrec_*.json
+    python tools/serving_top.py introspect.json
+
+File shapes are resolved by structure, not name (the
+telemetry_dump.py discipline): a records wrapper (``payload``), a
+flight bundle (``trigger``), or a bare introspection dict
+(``requests`` + ``pool``) all work.
+"""
+
+import argparse
+import json
+import os
+import sys
+from typing import Any, Dict, List, Optional
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _bar(frac: float, width: int = 24) -> str:
+    frac = min(max(float(frac), 0.0), 1.0)
+    n = int(round(frac * width))
+    return "[" + "#" * n + "." * (width - n) + f"] {frac * 100:5.1f}%"
+
+
+def _fmt(v: Any, nd: int = 1) -> str:
+    if v is None:
+        return "-"
+    if isinstance(v, float):
+        return f"{v:.{nd}f}"
+    return str(v)
+
+
+def render(intro: Dict[str, Any]) -> str:
+    """An ``introspect()`` dict as a top-style text screen."""
+    lines: List[str] = []
+    pool = intro.get("pool") or {}
+    n_blocks = max(int(pool.get("num_blocks") or 1), 1)
+    in_use = int(pool.get("blocks_in_use") or 0)
+    lines.append(
+        f"serving engine  step={intro.get('step')}  "
+        f"queued={intro.get('queue_depth')}  "
+        f"prefilling={intro.get('prefilling')}  "
+        f"decoding={intro.get('in_flight')}"
+        + ("  DRAINING" if intro.get("draining") else ""))
+    lines.append(f"kv pool  {_bar(in_use / n_blocks)}  "
+                 f"{in_use}/{n_blocks} blocks x "
+                 f"{pool.get('block_size')} tokens")
+    prefix = pool.get("prefix") or {}
+    if prefix:
+        hits = int(prefix.get("hits") or 0)
+        misses = int(prefix.get("misses") or 0)
+        rate = hits / max(hits + misses, 1)
+        lines.append(
+            f"prefix cache  hit rate {rate:.2f} ({hits}/{hits + misses})"
+            f"  shared={prefix.get('shared_blocks')}"
+            f"  cached={prefix.get('cached_blocks')}"
+            f"  tokens_saved={prefix.get('tokens_saved')}")
+    slo = intro.get("slo")
+    if slo:
+        alerting = slo.get("alerting") or []
+        lines.append(f"slo  alerts_total={slo.get('alerts_total', 0)}"
+                     + (f"  ALERTING: {', '.join(alerting)}"
+                        if alerting else "  ok"))
+        for name, tgt in sorted((slo.get("targets") or {}).items()):
+            burns = "  ".join(
+                f"{w['long_s']:g}s/{w['short_s']:g}s="
+                f"{_fmt(w.get('burn_long'), 2)}/"
+                f"{_fmt(w.get('burn_short'), 2)}"
+                for w in tgt.get("windows") or [])
+            flag = " !" if tgt.get("alerting") else ""
+            lines.append(
+                f"  {name:<12} {tgt.get('kind', 'le')} "
+                f"{_fmt(tgt.get('objective'), 4)}  "
+                f"window={_fmt(tgt.get('window_value'), 4)}  "
+                f"burn {burns or '-'}{flag}")
+    traces = intro.get("traces")
+    if traces:
+        lines.append(f"traces  live={traces.get('live')}  "
+                     f"completed={traces.get('completed')}  "
+                     f"minted={traces.get('minted')}")
+    reqs = intro.get("requests") or []
+    lines.append("")
+    lines.append(f"{'ID':<14}{'STATE':<12}{'AGE_S':>8}{'DEADLN':>8}"
+                 f"{'BLKS':>6}{'PREFILL':>10}{'GEN':>8}  TRACE")
+    order = {"decoding": 0, "prefilling": 1, "queued": 2}
+    for r in sorted(reqs, key=lambda r: (order.get(r.get("state"), 3),
+                                         -float(r.get("age_s") or 0))):
+        left = r.get("deadline_left_ms")
+        lines.append(
+            f"{str(r.get('id'))[:13]:<14}{r.get('state'):<12}"
+            f"{_fmt(r.get('age_s'), 2):>8}"
+            f"{(_fmt(left, 0) if left is not None else '-'):>8}"
+            f"{r.get('blocks', 0):>6}"
+            f"{str(r.get('prefilled')) + '/' + str(r.get('prompt_tokens')):>10}"
+            f"{str(r.get('generated')) + '/' + str(r.get('max_new_tokens')):>8}"
+            f"  {r.get('trace_id') or '-'}")
+    if not reqs:
+        lines.append("(no requests in flight)")
+    return "\n".join(lines) + "\n"
+
+
+def _trace_table(traces: List[Dict[str, Any]]) -> str:
+    lines = [f"{'REQUEST':<14}{'TRACE':<22}{'OUTCOME':<18}"
+             f"{'SPANS':>6}{'CHUNKS':>7}{'TTFT_S':>9}{'WALL_S':>9}"
+             "  RESUMED_FROM"]
+    for t in traces:
+        first = next((m["t"] for m in t.get("marks") or []
+                      if m["name"] == "first_token"), None)
+        ttft = (first - t["t_submit"]) if first is not None else None
+        wall = ((t["t_finish"] - t["t_submit"])
+                if t.get("t_finish") is not None else None)
+        chunks = sum(1 for s in t.get("spans") or []
+                     if s["name"].startswith("prefill_chunk"))
+        lines.append(
+            f"{str(t.get('request_id'))[:13]:<14}"
+            f"{str(t.get('trace_id'))[:21]:<22}"
+            f"{str(t.get('outcome') or t.get('state'))[:17]:<18}"
+            f"{len(t.get('spans') or []):>6}{chunks:>7}"
+            f"{_fmt(ttft, 4):>9}{_fmt(wall, 4):>9}"
+            f"  {t.get('resumed_from') or '-'}")
+    return "\n".join(lines) + "\n"
+
+
+def render_bundle(obj: Dict[str, Any]) -> str:
+    """A flight-recorder bundle (`slo_violation` or any serving
+    trigger): header + the embedded introspection snapshot and/or
+    offending-request traces from ``extra``."""
+    bundle = obj.get("payload") if isinstance(obj.get("payload"),
+                                              dict) else obj
+    lines = [f"flight bundle  trigger={bundle.get('trigger')}  "
+             f"pid={bundle.get('pid')}"]
+    if bundle.get("error"):
+        lines.append(f"error: {bundle['error']}")
+    extra = bundle.get("extra") or {}
+    if extra.get("slo"):
+        offenders = ", ".join(map(str, extra.get("requests") or []))
+        lines.append(f"slo: {extra['slo']}  "
+                     f"offending requests: {offenders or '-'}")
+    out = "\n".join(lines) + "\n"
+    intro = extra.get("introspect")
+    if isinstance(intro, dict):
+        out += "\n" + render(intro)
+    traces = extra.get("traces")
+    if traces:
+        out += "\n" + _trace_table(traces)
+    return out
+
+
+def render_live(engine) -> str:
+    """The live view: ``render(engine.introspect())``."""
+    return render(engine.introspect())
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="top-style view of a serving engine introspection "
+                    "dump or flight bundle")
+    parser.add_argument("path", help="JSON file: flight-recorder "
+                                     "bundle or introspect() dump")
+    args = parser.parse_args(argv)
+    try:
+        with open(args.path) as f:
+            obj = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"error: cannot read {args.path}: {e}", file=sys.stderr)
+        return 2
+    payload = (obj.get("payload")
+               if isinstance(obj, dict) and isinstance(obj.get("payload"),
+                                                       dict) else obj)
+    if not isinstance(payload, dict):
+        print(f"error: {args.path} holds no renderable dict",
+              file=sys.stderr)
+        return 2
+    if "trigger" in payload:
+        sys.stdout.write(render_bundle(payload))
+    elif "requests" in payload and "pool" in payload:
+        sys.stdout.write(render(payload))
+    else:
+        print(f"error: {args.path} is neither a flight bundle nor an "
+              "introspect() dump", file=sys.stderr)
+        return 2
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,14 @@ the snapshot, with the explicit ``devmem_reason`` when the backend has
 no stats); Prometheus output renders every ``compile_*`` /
 ``recompile*`` / ``devmem_*`` series through the standard exposition
 and appends one summary comment line per plane.
+
+The SERVING plane rides the same way (docs/observability.md "Request
+plane"): JSON output appends a ``serving`` section — every
+``serving_*`` / ``slo_*`` series by kind, the computed prefix-cache
+hit rate, and the SLO window summary the monitor mirrored into
+``info["slo_window"]`` — and Prometheus output adds one serving
+summary comment line (requests by outcome, tokens, queue depth, hit
+rate, SLO alerts).
 """
 
 import argparse
@@ -95,6 +103,41 @@ def devmem_section(snap):
     return out
 
 
+_SERVING_PREFIXES = ("serving_", "slo_")
+
+
+def _counter_total(snap, base):
+    return sum(v for k, v in (snap.get("counters") or {}).items()
+               if _series_base(k) == base)
+
+
+def _counter_label(snap, base, **labels):
+    # snapshot series names carry sorted labels (metrics._series_name)
+    inner = ",".join(f'{k}="{v}"' for k, v in sorted(labels.items()))
+    return (snap.get("counters") or {}).get(f"{base}{{{inner}}}", 0.0)
+
+
+def serving_section(snap):
+    """The serving plane of a registry snapshot: every ``serving_*``
+    and ``slo_*`` series by kind, plus the computed prefix-cache hit
+    rate and the SLO window summary the monitor mirrors into
+    ``info["slo_window"]`` (absent = no monitor armed, reported
+    explicitly — the null-with-reason contract)."""
+    out = _plane(snap, lambda base: base.startswith(_SERVING_PREFIXES))
+    hits = _counter_label(snap, "serving_prefix_cache_hits",
+                          outcome="hit")
+    misses = _counter_label(snap, "serving_prefix_cache_hits",
+                            outcome="miss")
+    out["prefix_cache_hit_rate"] = (
+        round(hits / (hits + misses), 4) if hits + misses else None)
+    slo = (snap.get("info") or {}).get("slo_window")
+    if slo is not None:
+        out["slo_window"] = slo
+    else:
+        out["slo_reason"] = "no SLO monitor armed in this snapshot"
+    return out
+
+
 def plane_comments(snap) -> str:
     """One summary comment line per plane, appended to the Prometheus
     text (comments are legal exposition; the series themselves render
@@ -118,6 +161,20 @@ def plane_comments(snap) -> str:
                      f"watermark={mark}")
     else:
         lines.append(f"# devmem: unavailable ({dm['devmem_reason']})")
+    sv = serving_section(snap)
+    if sv.get("counters") or sv.get("gauges") or sv.get("histograms"):
+        n_req = int(_counter_total(snap, "serving_requests"))
+        n_tok = int(_counter_total(snap, "serving_tokens"))
+        depth = (sv.get("gauges") or {}).get("serving_queue_depth")
+        rate = sv.get("prefix_cache_hit_rate")
+        slo = sv.get("slo_window")
+        alerts = (slo or {}).get("alerts_total")
+        alerting = ",".join((slo or {}).get("alerting") or []) or "none"
+        lines.append(
+            f"# serving: {n_req} requests, {n_tok} tokens, "
+            f"queue_depth={depth} prefix_hit_rate={rate} "
+            + (f"slo_alerts={alerts} alerting={alerting}"
+               if slo is not None else f"slo={sv.get('slo_reason')}"))
     return "\n".join(lines) + "\n"
 
 
@@ -128,6 +185,7 @@ def _emit(snap, fmt, help_source=None) -> None:
         out = dict(snap)
         out["compile"] = compile_section(snap)
         out["devmem"] = devmem_section(snap)
+        out["serving"] = serving_section(snap)
         print(json.dumps(out, indent=1, sort_keys=True))
         return
     if help_source is not None:
