@@ -78,18 +78,25 @@ def process_collective():
     :class:`~apex_tpu.resilience.guard.KVStoreCollective` riding the
     same ``jax.distributed`` coordination service — identical
     protocol, host-side transport.
+
+    When the comms plane is armed (``telemetry.comms.enable()`` or
+    ``APEX_TPU_COMMS=1``) the returned collective is routed through
+    ``comms.instrument`` — per-op counters/bytes/ms, timeline spans,
+    the wire bandwidth ledger. Disabled, the raw object comes back
+    untouched.
     """
     import jax
 
     from apex_tpu.resilience.guard import (KVStoreCollective,
                                            NullCollective,
                                            ProcessCollective)
+    from apex_tpu.telemetry import comms
 
     if jax.process_count() > 1:
         if jax.default_backend() == "cpu":
-            return KVStoreCollective()
-        return ProcessCollective()
-    return NullCollective()
+            return comms.instrument(KVStoreCollective())
+        return comms.instrument(ProcessCollective())
+    return comms.instrument(NullCollective())
 
 
 def elastic_checkpoint_manager(directory, **kwargs):

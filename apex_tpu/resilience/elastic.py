@@ -404,7 +404,13 @@ class ElasticCheckpointManager(CheckpointManager):
                                      t0)
 
     def _restore_elastic(self, path, commit, template, collective, t0):
+        from apex_tpu.telemetry import comms as _comms
+
         layout = commit["layout"]
+        # range fetches are the fattest payloads any collective in the
+        # repo moves — route them through the comms plane (identity
+        # when it is disabled, so the raw collective stays raw)
+        collective = _comms.instrument(collective)
         n_new = collective.n_replicas if collective is not None else 1
         me = collective.replica_id if collective is not None else 0
         planner = None

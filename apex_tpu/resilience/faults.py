@@ -76,6 +76,30 @@ Elastic-resharding sites (resilience/elastic.py, docs/resilience.md
                                  these 0-based fetch indices times out;
                                  the planner must fall back to disk
 
+Comms-plane sites (telemetry/comms.py instrumented collectives,
+docs/observability.md "Comms & sharding plane"):
+
+- ``io:collective=<idx>``        transient ``FaultError`` raised out of
+                                 the traced collective op at these
+                                 0-based call indices (every traced op
+                                 counts — barriers included)
+- ``collective_slow=<ms>``       add a ``ms`` delay to traced
+                                 collective ops — the deterministic
+                                 slow-interconnect drill behind the
+                                 ``collective_slow`` EWMA escalation
+- ``collective_slow_at=<idx>``   restrict the injected delay to these
+                                 0-based traced-op indices (default:
+                                 every op once ``collective_slow`` is
+                                 set — set late indices so the EWMA
+                                 warms up on healthy ops first)
+- ``collective_payload_corrupt=<idx>`` flip ONE byte of the result of
+                                 the payload-carrying traced op
+                                 (all_gather / broadcast_from) at
+                                 these 0-based payload-op indices —
+                                 silent wire corruption the consumer
+                                 (guard fingerprints, elastic verify)
+                                 must catch
+
 Serving sites (apex_tpu/serving/scheduler.py, docs/serving.md):
 
 - ``serving_pool_exhausted=<steps>`` admission control at these engine
@@ -174,6 +198,10 @@ class FaultInjector:
     shard_truncate_host: int = 0
     world_mismatch_steps: FrozenSet[int] = frozenset()
     range_fetch_timeout: FrozenSet[int] = frozenset()
+    # comms-plane sites (telemetry/comms.py instrumented collectives)
+    collective_slow_ms: float = 0.0
+    collective_slow_at: FrozenSet[int] = frozenset()
+    collective_corrupt_indices: FrozenSet[int] = frozenset()
     # serving sites (apex_tpu/serving/scheduler.py, serving/resilience.py)
     pool_exhausted_steps: FrozenSet[int] = frozenset()
     decode_exception_steps: FrozenSet[int] = frozenset()
@@ -285,6 +313,31 @@ class FaultInjector:
         (0-based, per restore) is planned to time out."""
         return int(index) in self.range_fetch_timeout
 
+    # -- comms-plane sites -------------------------------------------------
+
+    def collective_delay_s(self) -> float:
+        """Seconds of injected delay for THIS traced collective op
+        (each call advances the 0-based traced-op index;
+        ``collective_slow_at`` empty means every op once
+        ``collective_slow_ms`` is set). 0.0 off-plan."""
+        with self._lock:
+            idx = self._counts.get("collective_slow", 0)
+            self._counts["collective_slow"] = idx + 1
+        if self.collective_slow_ms <= 0.0:
+            return 0.0
+        if self.collective_slow_at and idx not in self.collective_slow_at:
+            return 0.0
+        return self.collective_slow_ms / 1e3
+
+    def should_corrupt_collective(self) -> bool:
+        """True when THIS payload-carrying traced op (all_gather /
+        broadcast_from; each call advances the 0-based payload-op
+        index) must have one result byte flipped."""
+        with self._lock:
+            idx = self._counts.get("collective_corrupt", 0)
+            self._counts["collective_corrupt"] = idx + 1
+        return idx in self.collective_corrupt_indices
+
     # -- serving sites -----------------------------------------------------
 
     def should_pool_exhaust(self, step: int) -> bool:
@@ -383,6 +436,12 @@ class FaultInjector:
                 kw["world_mismatch_steps"] = _int_set(val)
             elif key == "range_fetch_timeout":
                 kw["range_fetch_timeout"] = _int_set(val)
+            elif key == "collective_slow":
+                kw["collective_slow_ms"] = float(val)
+            elif key == "collective_slow_at":
+                kw["collective_slow_at"] = _int_set(val)
+            elif key == "collective_payload_corrupt":
+                kw["collective_corrupt_indices"] = _int_set(val)
             elif key == "serving_pool_exhausted":
                 kw["pool_exhausted_steps"] = _int_set(val)
             elif key == "decode_step_exception":
@@ -504,6 +563,16 @@ def should_range_timeout(index: int) -> bool:
     return inj is not None and inj.should_range_timeout(index)
 
 
+def collective_delay_s() -> float:
+    inj = active()
+    return 0.0 if inj is None else inj.collective_delay_s()
+
+
+def should_corrupt_collective() -> bool:
+    inj = active()
+    return inj is not None and inj.should_corrupt_collective()
+
+
 def should_pool_exhaust(step: int) -> bool:
     inj = active()
     return inj is not None and inj.should_pool_exhaust(step)
@@ -538,7 +607,8 @@ def should_weight_swap_mismatch(index: int) -> bool:
 
 __all__ = [
     "ENV_KNOB", "FaultError", "FaultInjector", "SimulatedCrash",
-    "active", "check", "flip_bits", "inject", "install", "maybe_crash",
+    "active", "check", "collective_delay_s", "flip_bits", "inject",
+    "install", "maybe_crash", "should_corrupt_collective",
     "maybe_crash_before_commit", "maybe_decode_exception",
     "maybe_prefill_chunk_exception",
     "maybe_sigterm", "nonfinite_lane_at", "poison_grads",

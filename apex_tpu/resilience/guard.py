@@ -188,10 +188,21 @@ def compare_fingerprints(stacked: np.ndarray) -> DivergenceReport:
 class Collective:
     """The minimal replica-set interface the guard needs. Replicas are
     the members of the data axis that hold (supposedly) bit-identical
-    state — one per host process on a multi-host deployment."""
+    state — one per host process on a multi-host deployment.
+
+    Observability: route instances through
+    ``telemetry.comms.instrument()`` to trace every op (per-op
+    counters/bytes/ms, timeline spans, the wire bandwidth ledger);
+    with the comms plane disabled that call returns the raw object
+    unchanged. :meth:`impl_name` is the ``impl=`` label the traced
+    metrics carry."""
 
     n_replicas: int = 1
     replica_id: int = 0
+
+    def impl_name(self) -> str:
+        """Implementation label for comms tracing (telemetry/comms)."""
+        return type(self).__name__
 
     def all_gather(self, arr: np.ndarray) -> np.ndarray:
         """(n_replicas, *arr.shape) — every replica's copy, by id."""
@@ -371,6 +382,9 @@ class _LocalHandle(Collective):
         self.group = group
         self.n_replicas = group.n_replicas
         self.replica_id = int(replica_id)
+
+    def impl_name(self) -> str:
+        return "LocalCollective"        # the sim, not its handle class
 
     def all_gather(self, arr: np.ndarray) -> np.ndarray:
         slots = self.group._exchange(self.replica_id, np.asarray(arr))

@@ -3,7 +3,7 @@
 flight recorder.
 
 The observability layer the rest of the runtime reports through
-(docs/observability.md). Seven parts:
+(docs/observability.md). Nine parts:
 
 - :mod:`~apex_tpu.telemetry.metrics` — process-global registry of
   counters / gauges / fixed-bucket histograms with labeled series,
@@ -31,7 +31,19 @@ The observability layer the rest of the runtime reports through
 - :mod:`~apex_tpu.telemetry.fleet` — cross-host snapshot aggregation
   over the guard's ``Collective`` abstraction (counters summed, gauges
   per-host, histograms bucket-merged, timelines side by side) with
-  EWMA straggler detection (``fleet_straggler`` events + gauges).
+  EWMA straggler detection (``fleet_straggler`` events + gauges),
+  barrier-midpoint clock-offset estimation, and
+  ``export_fleet_trace`` — every host's timeline merged onto one
+  offset-corrected perfetto trace, one process track per host.
+- :mod:`~apex_tpu.telemetry.comms` — the comms plane:
+  ``instrument(collective)`` traces every ``Collective`` op
+  (``collective_ops/bytes/ms``, timeline spans, the measured-vs-
+  analytic wire bandwidth ledger, ``collective_slow`` EWMA
+  escalation); disabled means the raw collective object, untouched.
+- :mod:`~apex_tpu.telemetry.sharding` — compiled executables'
+  input/output shardings, mesh axes, and per-device buffer bytes
+  normalized to a fixed-key dict (``sharding_reason`` nulls on
+  meshless backends) + ``sharding_devices{fn=}`` gauges.
 - :mod:`~apex_tpu.telemetry.flight` — the crash flight recorder:
   bounded rings of recent events / timeline spans / state digests,
   dumped as a self-contained ``flightrec_*.json`` postmortem bundle on
@@ -60,15 +72,18 @@ from __future__ import annotations
 from typing import Any, Dict
 
 from apex_tpu.telemetry import (
+    comms,
     compiled,
     cost,
     devmem,
     fleet,
     flight,
     metrics,
+    sharding,
     slo,
     timeline,
 )
+from apex_tpu.telemetry.comms import CommsTracer, InstrumentedCollective
 from apex_tpu.telemetry.compiled import CompileTracker
 from apex_tpu.telemetry.devmem import DeviceMemoryLedger
 from apex_tpu.telemetry.fleet import (
@@ -83,7 +98,9 @@ from apex_tpu.telemetry.metrics import (
     Histogram,
     InMemorySink,
     JsonlSink,
+    LATENCY_MS_BUCKETS,
     MetricsRegistry,
+    PAYLOAD_BYTES_BUCKETS,
     StdoutSink,
     TOKEN_COUNT_BUCKETS,
     registry,
@@ -141,20 +158,33 @@ def snapshot_detail() -> Dict[str, Any]:
         out["devmem_reason"] = (
             reg.get_info("devmem_reason")
             or "no device-memory poll in this process")
+    # sharding rides the same contract: the per-fn introspection blobs
+    # publish_shardings deposited, or an explicit null with the reason
+    shardings = reg.get_info("sharding")
+    if shardings:
+        out["sharding"] = shardings
+    else:
+        out["sharding"] = None
+        out["sharding_reason"] = (
+            "no sharding introspection published in this process "
+            "(telemetry.sharding.publish_shardings)")
     return out
 
 
 def reset() -> None:
     """Fresh registry + disabled global timeline + disarmed flight
-    recorder / compile tracker / devmem ledger (tests)."""
+    recorder / compile tracker / devmem ledger / comms tracer
+    (tests)."""
     flight.disable()
     compiled.disable()
     devmem.disable()
+    comms.disable()
     metrics.reset()
     timeline.disable()
 
 
 __all__ = [
+    "CommsTracer",
     "CompileTracker",
     "Counter",
     "DeviceMemoryLedger",
@@ -163,8 +193,11 @@ __all__ = [
     "Gauge",
     "Histogram",
     "InMemorySink",
+    "InstrumentedCollective",
     "JsonlSink",
+    "LATENCY_MS_BUCKETS",
     "MetricsRegistry",
+    "PAYLOAD_BYTES_BUCKETS",
     "PHASES",
     "SLOMonitor",
     "SLOTarget",
@@ -173,6 +206,7 @@ __all__ = [
     "StdoutSink",
     "StepTimeline",
     "TOKEN_COUNT_BUCKETS",
+    "comms",
     "compiled",
     "cost",
     "devmem",
@@ -187,6 +221,7 @@ __all__ = [
     "metrics",
     "registry",
     "reset",
+    "sharding",
     "slo",
     "snapshot",
     "snapshot_detail",

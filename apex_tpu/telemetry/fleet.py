@@ -30,6 +30,23 @@ apex/parallel/distributed.py:360-561); the production-stack answer
   ``fleet_straggler`` event + gauges whenever one host's EWMA exceeds
   a configurable multiple of the fleet median — the host that is
   quietly gating every collective gets named while it is still alive.
+- :func:`estimate_clock_offsets` measures per-host clock skew over
+  the collective itself (barrier round-trip midpoints: each barrier
+  release is one shared fleet instant, so gathered midpoints read
+  every host's clock at the same moment), and
+  :func:`export_fleet_trace` merges every host's ``export_trace()``
+  onto ONE perfetto timeline — one process track per host, every
+  host's ``ts`` shifted to the shared barrier instant (so cross-host
+  causality reads correctly), with ``fleet_straggler`` /
+  ``collective_slow`` events from the flight ring annotated as
+  instants.
+
+Gather hardening: host snapshots ride two fixed-shape gathers, so one
+host with a pathologically fat registry would make EVERY host allocate
+its padded buffer. ``gather_snapshots`` caps the payload
+(``max_bytes``, default 4 MiB) and replaces an oversized snapshot with
+a structured stub + a ``fleet_snapshot_truncated`` event — no silent
+caps (the no-silent-caps discipline of docs/observability.md).
 
 Every collective here must be called by ALL replicas (the Collective
 contract); single-replica collectives short-circuit to the local
@@ -39,6 +56,7 @@ snapshot so the same loop runs unchanged at both scales.
 from __future__ import annotations
 
 import json
+import os
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -47,6 +65,15 @@ import numpy as np
 # phases watched for stragglers by default: the fused-step dispatch and
 # the input-pipeline wait — the two that gate a lockstep fleet
 DEFAULT_STRAGGLER_PHASES: Tuple[str, ...] = ("step", "data_wait")
+
+# one host's snapshot payload past this rides as a stub + a
+# fleet_snapshot_truncated event — every host allocates the padded
+# gather buffer at the fleet MAX, so one fat registry taxes them all
+DEFAULT_SNAPSHOT_CAP_BYTES = 4 << 20
+
+# flight-ring events annotated as perfetto instants on merged traces
+TRACE_INSTANT_EVENTS = ("fleet_straggler", "collective_slow",
+                        "collective_payload_corrupt")
 
 
 def local_snapshot() -> Dict[str, Any]:
@@ -57,9 +84,43 @@ def local_snapshot() -> Dict[str, Any]:
     return telemetry.snapshot_detail()
 
 
+def _gather_blobs(collective, data: bytes) -> List[bytes]:
+    """Every replica's variable-length payload, on every replica: two
+    fixed-shape gathers (the Collective contract wants identical
+    shapes everywhere), lengths first, then the payloads right-padded
+    to the fleet max."""
+    lens = collective.all_gather(np.asarray([len(data)], np.int64))
+    max_len = max(int(lens.max()), 1)
+    buf = np.zeros((max_len,), np.uint8)
+    buf[: len(data)] = np.frombuffer(data, np.uint8)
+    gathered = collective.all_gather(buf)
+    out = []
+    for r in range(collective.n_replicas):
+        n = int(np.asarray(lens)[r, 0])
+        out.append(bytes(bytearray(np.asarray(gathered)[r, :n])))
+    return out
+
+
+def _truncation_stub(n_bytes: int, max_bytes: int,
+                     replica_id: int) -> Dict[str, Any]:
+    """The structured stand-in an oversized snapshot gathers as: still
+    a valid snapshot_detail shape (empty registry), explicitly marked
+    so the merge and its consumers see the cap, not a quiet gap."""
+    return {
+        "truncated": True,
+        "original_bytes": int(n_bytes),
+        "max_bytes": int(max_bytes),
+        "replica_id": int(replica_id),
+        "registry": {"counters": {}, "gauges": {}, "histograms": {}},
+        "step_timeline": None,
+        "mfu": None,
+    }
+
+
 def gather_snapshots(collective,
-                     snapshot: Optional[Dict[str, Any]] = None
-                     ) -> List[Dict[str, Any]]:
+                     snapshot: Optional[Dict[str, Any]] = None, *,
+                     max_bytes: Optional[int] = DEFAULT_SNAPSHOT_CAP_BYTES,
+                     registry=None) -> List[Dict[str, Any]]:
     """Every host's telemetry snapshot, by replica id, on EVERY host.
 
     ``snapshot`` overrides the local ``telemetry.snapshot_detail()``
@@ -67,26 +128,34 @@ def gather_snapshots(collective,
     process-global registry can't be three hosts at once). A collective
     op: all replicas must call it; with no collective (or one replica)
     it degrades to ``[snapshot]`` with zero collectives issued.
+
+    A snapshot past ``max_bytes`` (None disables the cap) is replaced
+    by a structured stub and announced with ONE
+    ``fleet_snapshot_truncated`` event + counter on the oversized host
+    — the fleet still gathers (the other hosts' views are intact), and
+    nothing is silently dropped.
     """
     if snapshot is None:
         snapshot = local_snapshot()
     if collective is None or collective.n_replicas <= 1:
         return [dict(snapshot)]
     data = json.dumps(snapshot, sort_keys=True).encode("utf-8")
-    # two fixed-shape gathers carry the variable-length payloads:
-    # every replica must present the same array shape, so lengths go
-    # first and the payloads ride right-padded to the fleet max
-    lens = collective.all_gather(np.asarray([len(data)], np.int64))
-    max_len = int(lens.max())
-    buf = np.zeros((max_len,), np.uint8)
-    buf[: len(data)] = np.frombuffer(data, np.uint8)
-    gathered = collective.all_gather(buf)
-    out = []
-    for r in range(collective.n_replicas):
-        n = int(np.asarray(lens)[r, 0])
-        out.append(json.loads(bytes(bytearray(
-            np.asarray(gathered)[r, :n])).decode("utf-8")))
-    return out
+    if max_bytes is not None and len(data) > max_bytes:
+        from apex_tpu.telemetry import metrics as _metrics
+
+        reg = registry if registry is not None else _metrics.registry()
+        rid = getattr(collective, "replica_id", 0)
+        reg.counter("fleet_snapshot_truncated_total",
+                    "snapshots replaced by a stub at the gather cap"
+                    ).inc()
+        reg.event("fleet_snapshot_truncated",
+                  original_bytes=len(data), max_bytes=int(max_bytes),
+                  replica=int(rid))
+        data = json.dumps(
+            _truncation_stub(len(data), max_bytes, rid),
+            sort_keys=True).encode("utf-8")
+    return [json.loads(b.decode("utf-8"))
+            for b in _gather_blobs(collective, data)]
 
 
 # ---------------------------------------------------------------------------
@@ -312,9 +381,213 @@ class FleetAggregator:
         return fleet
 
 
+# ---------------------------------------------------------------------------
+# Clock offsets + the fleet-merged trace
+# ---------------------------------------------------------------------------
+
+
+def estimate_clock_offsets(collective, *, rounds: int = 5,
+                           clock=time.perf_counter,
+                           registry=None) -> Dict[str, Any]:
+    """Per-host clock offsets measured over the collective itself.
+
+    Each round every host brackets one ``barrier()`` with its local
+    clock and takes the midpoint: the barrier RELEASE is one shared
+    fleet instant, so the midpoints are every host's clock read at
+    (approximately) the same moment, and arrival skew cancels to first
+    order. The per-round midpoints are gathered (one fixed-shape
+    float64 collective) and host ``r``'s offset vs host 0 is the
+    median over rounds of ``mid[r] - mid[0]`` — the median absorbs the
+    occasional round where one host's barrier wake-up was late.
+
+    Returns (and publishes as ``fleet_clock_offset_ms{host=}`` /
+    ``fleet_clock_offset_spread_ms`` gauges, and deposits into the
+    armed comms tracer)::
+
+        {"n_hosts", "rounds", "anchor", "anchor_wall",
+         "offsets_ms": {host: ms vs host 0}, "local_offset_ms",
+         "spread_ms", "rtt_ms"}
+
+    ``anchor`` is THIS host's local clock at the (median) shared
+    instant — what :func:`export_fleet_trace` shifts this host's spans
+    against; ``anchor_wall`` is the matching ``time.time()`` reading
+    (dates the flight ring's wall-clock events onto the same axis).
+    ``rtt_ms`` (the median barrier round-trip) bounds the estimate's
+    uncertainty. A collective op: all replicas must call it; a single
+    replica short-circuits with zero collectives issued.
+    """
+    n = getattr(collective, "n_replicas", 1) if collective else 1
+    if collective is None or n <= 1:
+        return {"n_hosts": 1, "rounds": 0, "anchor": clock(),
+                "anchor_wall": time.time(), "offsets_ms": {"0": 0.0},
+                "local_offset_ms": 0.0, "spread_ms": 0.0, "rtt_ms": 0.0}
+    collective.barrier()          # align arrival before measuring
+    mids, rtts = [], []
+    for _ in range(int(rounds)):
+        t0 = clock()
+        collective.barrier()
+        t1 = clock()
+        mids.append((t0 + t1) / 2.0)
+        rtts.append(t1 - t0)
+    anchor_wall = time.time()
+    gathered = np.asarray(collective.all_gather(
+        np.asarray(mids, np.float64)))            # (n_hosts, rounds)
+    deltas = gathered - gathered[0:1, :]          # vs host 0, per round
+    med = np.median(deltas, axis=1)               # (n_hosts,)
+    offsets_ms = {str(r): round(float(med[r]) * 1e3, 6)
+                  for r in range(n)}
+    rid = int(getattr(collective, "replica_id", 0))
+    out = {
+        "n_hosts": n,
+        "rounds": int(rounds),
+        "anchor": float(np.median(np.asarray(mids))),
+        "anchor_wall": anchor_wall,
+        "offsets_ms": offsets_ms,
+        "local_offset_ms": offsets_ms[str(rid)],
+        "spread_ms": round(float(med.max() - med.min()) * 1e3, 6),
+        "rtt_ms": round(float(np.median(np.asarray(rtts))) * 1e3, 6),
+    }
+    from apex_tpu.telemetry import comms as _comms
+    from apex_tpu.telemetry import metrics as _metrics
+
+    reg = registry if registry is not None else _metrics.registry()
+    g = reg.gauge("fleet_clock_offset_ms",
+                  "per-host clock offset vs host 0 (barrier midpoint)")
+    for h, v in offsets_ms.items():
+        g.set(v, host=h)
+    reg.gauge("fleet_clock_offset_spread_ms",
+              "max-min per-host clock offset").set(out["spread_ms"])
+    tracer = _comms.get_tracer()
+    if tracer is not None:
+        tracer.note_clock_offsets(out)
+    return out
+
+
+def export_fleet_trace(collective, path: Optional[str] = None, *,
+                       timeline=None, offsets: Optional[Dict] = None,
+                       rounds: int = 5, clock=time.perf_counter,
+                       instant_events=None) -> Dict[str, Any]:
+    """Every host's ``export_trace()`` merged onto ONE perfetto
+    timeline, offset-corrected — the fleet's "where did the step go"
+    view on a single time axis.
+
+    Each host shifts its events so ``ts`` is relative to the shared
+    barrier instant from :func:`estimate_clock_offsets` (pass a
+    pre-computed ``offsets`` to reuse one estimation across exports —
+    it must be THIS host's result, the anchor is host-local), the
+    shifted traces ride the same two-fixed-shape-gather transport as
+    snapshots, and the merge gives each host its own ``pid`` (replica
+    id) with a ``process_name`` metadata track — so ui.perfetto.dev
+    shows one process track per host, aligned. Flight-ring events in
+    :data:`TRACE_INSTANT_EVENTS` (straggler flags, slow collectives)
+    land as ``"ph": "i"`` instants on an ``events`` track, dated via
+    the wall-clock anchor. All ``ts`` are normalized so the earliest
+    event sits at 0 (``otherData.ts_shift_us`` records the shift).
+
+    A collective op: all replicas must call it (every host gets the
+    full merged dict back; ``path`` writes it tmp→rename — pass it on
+    one host or give each host its own path). Hosts whose timeline is
+    disabled contribute only their metadata track.
+    """
+    from apex_tpu.telemetry import flight as _flight
+    from apex_tpu.telemetry import timeline as _timeline
+
+    tl = timeline if timeline is not None else _timeline.get_timeline()
+    if offsets is None:
+        offsets = estimate_clock_offsets(collective, rounds=rounds,
+                                         clock=clock)
+    anchor, anchor_wall = offsets["anchor"], offsets["anchor_wall"]
+    events: List[Dict[str, Any]] = []
+    tids_used = 0
+    if tl is not None and tl.enabled:
+        local = tl.export_trace()
+        shift_us = (tl.origin - anchor) * 1e6
+        for e in local["traceEvents"]:
+            e = dict(e)
+            e.pop("pid", None)              # the merge owns pids
+            if "ts" in e:
+                e["ts"] = round(e["ts"] + shift_us, 3)
+            events.append(e)
+            tids_used = max(tids_used, int(e.get("tid", 0)) + 1)
+    src = instant_events
+    if src is None:
+        rec = _flight.get_recorder()
+        src = list(rec.events) if rec is not None else []
+    instant_tid = None
+    for ev in src:
+        if ev.get("event") not in TRACE_INSTANT_EVENTS:
+            continue
+        wall = ev.get("wall_time")
+        if wall is None:
+            continue
+        if instant_tid is None:
+            instant_tid = tids_used
+            events.append({"name": "thread_name", "ph": "M",
+                           "tid": instant_tid,
+                           "args": {"name": "events"}})
+        args = {k: v for k, v in ev.items()
+                if k not in ("event", "wall_time")
+                and isinstance(v, (str, int, float, bool, type(None)))}
+        events.append({
+            "name": ev["event"], "cat": "events", "ph": "i", "s": "p",
+            "ts": round((wall - anchor_wall) * 1e6, 3),
+            "tid": instant_tid, "args": args,
+        })
+    rid = int(getattr(collective, "replica_id", 0)) if collective else 0
+    payload = {"host": rid,
+               "offset_ms": offsets["offsets_ms"].get(str(rid), 0.0),
+               "events": events}
+    data = json.dumps(payload, sort_keys=True).encode("utf-8")
+    if collective is not None and \
+            getattr(collective, "n_replicas", 1) > 1:
+        per_host = [json.loads(b.decode("utf-8"))
+                    for b in _gather_blobs(collective, data)]
+    else:
+        per_host = [payload]
+    merged: List[Dict[str, Any]] = []
+    for r, host in enumerate(per_host):
+        for e in host["events"]:
+            e = dict(e)
+            e["pid"] = r
+            merged.append(e)
+        merged.append({"name": "process_name", "ph": "M", "pid": r,
+                       "args": {"name": f"host {host.get('host', r)}"}})
+        merged.append({"name": "process_sort_index", "ph": "M",
+                       "pid": r, "args": {"sort_index": r}})
+    # perfetto dislikes negative ts: slide everything so min ts == 0
+    ts_values = [e["ts"] for e in merged if "ts" in e]
+    ts_shift = -min(ts_values) if ts_values and min(ts_values) < 0 \
+        else 0.0
+    if ts_shift:
+        for e in merged:
+            if "ts" in e:
+                e["ts"] = round(e["ts"] + ts_shift, 3)
+    trace = {
+        "traceEvents": merged,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "n_hosts": len(per_host),
+            "clock_offsets_ms": offsets["offsets_ms"],
+            "clock_offset_spread_ms": offsets["spread_ms"],
+            "clock_offset_rounds": offsets["rounds"],
+            "ts_shift_us": round(ts_shift, 3),
+        },
+    }
+    if path is not None:
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(trace, f)
+        os.replace(tmp, path)
+    return trace
+
+
 __all__ = [
+    "DEFAULT_SNAPSHOT_CAP_BYTES",
     "DEFAULT_STRAGGLER_PHASES",
     "FleetAggregator",
+    "TRACE_INSTANT_EVENTS",
+    "estimate_clock_offsets",
+    "export_fleet_trace",
     "gather_snapshots",
     "local_snapshot",
     "merge_snapshots",

@@ -219,6 +219,17 @@ class FlightRecorder:
         except Exception as e:  # noqa: BLE001
             return {"error": f"{type(e).__name__}: {e}"}
 
+    def _comms(self):
+        # the comms plane: per-op stats + bandwidth ledger + clock
+        # offsets when the tracer is armed, else the explicit disabled
+        # marker with its reason (comms.section's contract)
+        from apex_tpu.telemetry import comms as _comms
+
+        try:
+            return _comms.section()
+        except Exception as e:  # noqa: BLE001
+            return {"error": f"{type(e).__name__}: {e}"}
+
     def _last_checkpoint(self):
         if self.manager is None:
             return None
@@ -280,6 +291,7 @@ class FlightRecorder:
                 "trace": self._trace_slice(self.timeline),
                 "devmem": self._devmem(),
                 "compile_plane": self._compile_plane(),
+                "comms": self._comms(),
                 "recent_events": list(self.events),
                 "state_digests": list(self.digests),
                 "last_checkpoint": self._last_checkpoint(),

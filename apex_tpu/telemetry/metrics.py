@@ -62,6 +62,20 @@ DEFAULT_BUCKETS: Tuple[float, ...] = (
 TOKEN_COUNT_BUCKETS: Tuple[float, ...] = (
     1, 2, 4, 8, 16, 32, 64, 128, 256, 512, 1024, 2048, 4096, 8192)
 
+# millisecond-scale latencies (collective ops: a KV-store barrier is
+# ~1ms, an elastic range fetch can be seconds) — values observed here
+# are ALREADY in ms, unlike the seconds-scale default grid
+LATENCY_MS_BUCKETS: Tuple[float, ...] = (
+    0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 25.0,
+    50.0, 100.0, 250.0, 1000.0, 5000.0)
+
+# payload sizes in bytes, 64 B fingerprints to 256 MB buffer
+# broadcasts — the grid `collective_bytes{op=}` rides so the bandwidth
+# ledger can tell latency-bound ops from bandwidth-bound ones
+PAYLOAD_BYTES_BUCKETS: Tuple[float, ...] = (
+    64, 256, 1024, 4096, 16384, 65536, 262144, 1048576, 4194304,
+    16777216, 67108864, 268435456)
+
 
 def _series_key(labels: Dict[str, Any]) -> Tuple[Tuple[str, str], ...]:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
@@ -642,6 +656,8 @@ def reset() -> None:
 __all__ = [
     "Counter",
     "DEFAULT_BUCKETS",
+    "LATENCY_MS_BUCKETS",
+    "PAYLOAD_BYTES_BUCKETS",
     "TOKEN_COUNT_BUCKETS",
     "Gauge",
     "Histogram",

@@ -1,0 +1,232 @@
+"""Sharding plane: normalized shardings of compiled executables.
+
+ROADMAP item 1's mesh planner needs to SEE how a compiled program laid
+its arrays out — which mesh axes exist, how each input/output is
+partitioned, and how many bytes each device actually holds — and
+today that story lives in repr strings scattered across
+``jax.stages.Compiled`` internals. This module normalizes it into one
+fixed-key JSON-able dict, the same way ``cost.py`` normalizes
+``cost_analysis()`` and ``devmem.py`` normalizes
+``memory_analysis()``, under the same contract: on a backend without
+meshes (the CPU CI, a single device) every key is still present and
+the nulls carry an explicit ``sharding_reason`` — never silently
+absent, never an exception out of an introspection call.
+
+- :func:`normalize_sharding` — one ``jax.sharding.Sharding`` leaf to
+  ``{kind, n_devices, mesh, spec, memory_kind, shard_shape,
+  shard_bytes}``.
+- :func:`executable_shardings` — a compiled executable's inputs +
+  outputs + mesh axes + per-device bytes, fixed keys, never raises.
+- :func:`jitted_shardings` — lower+compile a jitted fn on example
+  args and introspect it (the one-liner bench and tests use).
+- :func:`publish_shardings` — ``sharding_devices{fn=}`` gauges + the
+  registry info blob ``snapshot_detail()`` folds in, keyed by fn so
+  repeated publishes of different programs accumulate.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+# the fixed key set executable_shardings always returns — consumers
+# (snapshot_detail, flight bundles, the future planner) can index
+# without existence checks
+SHARDING_KEYS = ("fn", "backend", "n_devices", "mesh", "inputs",
+                 "outputs", "input_bytes_per_device",
+                 "output_bytes_per_device", "sharding_reason")
+
+_NO_MESH_REASON = ("no mesh-sharded arrays: every sharding is "
+                   "single-device (backend={backend})")
+
+
+def _aval_bytes(shape: Sequence[int], dtype) -> Optional[int]:
+    try:
+        import numpy as np
+
+        return int(math.prod(shape)) * int(np.dtype(dtype).itemsize)
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def normalize_sharding(s, shape: Optional[Sequence[int]] = None,
+                       dtype=None) -> Dict[str, Any]:
+    """One sharding leaf as a fixed-key dict. ``shape``/``dtype`` (the
+    aval's) enable the per-shard keys; without them those are null."""
+    out: Dict[str, Any] = {
+        "kind": type(s).__name__,
+        "n_devices": 1,
+        "mesh": None,
+        "spec": None,
+        "memory_kind": None,
+        "shard_shape": None,
+        "shard_bytes": None,
+    }
+    try:
+        devs = getattr(s, "device_set", None)
+        if devs:
+            out["n_devices"] = len(devs)
+        mesh = getattr(s, "mesh", None)
+        if mesh is not None and getattr(mesh, "shape", None):
+            out["mesh"] = {str(name): int(size)
+                           for name, size in dict(mesh.shape).items()}
+        spec = getattr(s, "spec", None)
+        if spec is not None:
+            out["spec"] = str(tuple(spec))
+        out["memory_kind"] = getattr(s, "memory_kind", None)
+        if shape is not None:
+            shard_shape = tuple(int(d) for d in
+                                s.shard_shape(tuple(shape)))
+            out["shard_shape"] = list(shard_shape)
+            if dtype is not None:
+                out["shard_bytes"] = _aval_bytes(shard_shape, dtype)
+    except Exception:  # noqa: BLE001 — introspection never raises
+        pass
+    return out
+
+
+def _flatten_avals(avals) -> Optional[List[Any]]:
+    try:
+        import jax.tree_util as jtu
+
+        return list(jtu.tree_leaves(avals))
+    except Exception:  # noqa: BLE001
+        return None
+
+
+def _leaf_entries(shardings, avals) -> List[Dict[str, Any]]:
+    import jax.tree_util as jtu
+
+    leaves = jtu.tree_leaves(
+        shardings, is_leaf=lambda x: hasattr(x, "device_set"))
+    avals = _flatten_avals(avals)
+    if avals is not None and len(avals) != len(leaves):
+        avals = None                 # structure mismatch: shapes unknown
+    out = []
+    for i, s in enumerate(leaves):
+        shape = dtype = None
+        if avals is not None:
+            a = avals[i]
+            shape = tuple(getattr(a, "shape", ()) or ())
+            dtype = getattr(a, "dtype", None)
+        out.append(normalize_sharding(s, shape=shape, dtype=dtype))
+    return out
+
+
+def executable_shardings(compiled, *, fn: str = "jit",
+                         out_avals=None) -> Dict[str, Any]:
+    """A compiled executable (``jit(f).lower(...).compile()``) as the
+    fixed-key sharding dict (:data:`SHARDING_KEYS`). Never raises: a
+    backend/executable without the introspection surface returns nulls
+    with ``sharding_reason``.
+
+    ``out_avals`` supplies output shapes/dtypes (``Compiled`` carries
+    input avals but not output ones — :func:`jitted_shardings` fills
+    them from ``jax.eval_shape``); without it per-output shard bytes
+    are null.
+    """
+    out: Dict[str, Any] = {k: None for k in SHARDING_KEYS}
+    out["fn"] = str(fn)
+    try:
+        import jax
+
+        out["backend"] = jax.default_backend()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        in_sh = compiled.input_shardings
+        out_sh = compiled.output_shardings
+    except Exception as e:  # noqa: BLE001
+        out["sharding_reason"] = (
+            f"executable exposes no shardings "
+            f"({type(e).__name__}: {e})")
+        return out
+    try:
+        in_avals = getattr(compiled, "in_avals", None)
+        inputs = _leaf_entries(in_sh, in_avals)
+        outputs = _leaf_entries(out_sh, out_avals)
+        out["inputs"] = inputs
+        out["outputs"] = outputs
+        out["n_devices"] = max(
+            [e["n_devices"] for e in inputs + outputs] or [1])
+        # the union of mesh axes any array is laid out over
+        mesh: Dict[str, int] = {}
+        for e in inputs + outputs:
+            if e["mesh"]:
+                mesh.update(e["mesh"])
+        out["mesh"] = mesh or None
+
+        def _total(entries):
+            vals = [e["shard_bytes"] for e in entries]
+            if any(v is None for v in vals):
+                return None
+            return int(sum(vals))
+
+        out["input_bytes_per_device"] = _total(inputs)
+        out["output_bytes_per_device"] = _total(outputs)
+        if out["mesh"] is None:
+            out["sharding_reason"] = _NO_MESH_REASON.format(
+                backend=out["backend"])
+    except Exception as e:  # noqa: BLE001
+        out["sharding_reason"] = (
+            f"sharding introspection failed "
+            f"({type(e).__name__}: {e})")
+    return out
+
+
+def jitted_shardings(jitted, *args, fn: str = "jit",
+                     **kwargs) -> Dict[str, Any]:
+    """Lower+compile ``jitted`` on example args and introspect the
+    result; output avals come from ``jax.eval_shape`` so per-output
+    shard bytes are real. Never raises."""
+    try:
+        import jax
+
+        compiled = jitted.lower(*args, **kwargs).compile()
+        try:
+            out_avals = jax.eval_shape(jitted, *args, **kwargs)
+        except Exception:  # noqa: BLE001
+            out_avals = None
+        return executable_shardings(compiled, fn=fn,
+                                    out_avals=out_avals)
+    except Exception as e:  # noqa: BLE001
+        out = {k: None for k in SHARDING_KEYS}
+        out["fn"] = str(fn)
+        out["sharding_reason"] = (
+            f"lower/compile failed ({type(e).__name__}: {e})")
+        return out
+
+
+def publish_shardings(info: Dict[str, Any], *, registry=None
+                      ) -> Dict[str, Any]:
+    """Publish one :func:`executable_shardings` dict:
+    ``sharding_devices{fn=}`` gauge + per-direction
+    ``sharding_bytes_per_device{fn=,dir=}`` gauges (when known), and
+    merge it into the registry's ``sharding`` info blob keyed by fn —
+    what ``snapshot_detail()`` folds in. Returns ``info``."""
+    from apex_tpu.telemetry import metrics as _metrics
+
+    reg = registry if registry is not None else _metrics.registry()
+    fn = str(info.get("fn") or "jit")
+    reg.gauge("sharding_devices",
+              "devices a compiled fn's arrays are laid out over"
+              ).set(info.get("n_devices") or 1, fn=fn)
+    bytes_g = reg.gauge("sharding_bytes_per_device",
+                        "per-device buffer bytes of a compiled fn")
+    for direction in ("input", "output"):
+        v = info.get(f"{direction}_bytes_per_device")
+        if v is not None:
+            bytes_g.set(v, fn=fn, dir=direction)
+    blob = dict(reg.get_info("sharding") or {})
+    blob[fn] = info
+    reg.set_info("sharding", blob)
+    return info
+
+
+__all__ = [
+    "SHARDING_KEYS",
+    "executable_shardings",
+    "jitted_shardings",
+    "normalize_sharding",
+    "publish_shardings",
+]

@@ -51,13 +51,16 @@ PHASES = ("data_wait", "h2d", "step", "checkpoint", "collective")
 class Span(NamedTuple):
     """One timed region: ``t0`` is absolute ``perf_counter`` seconds,
     ``dur`` seconds, ``step`` the host-loop step index it happened in
-    (-1 = outside any step scope)."""
+    (-1 = outside any step scope); ``args`` are extra JSON-able
+    key/values the trace export folds into the event (the comms plane
+    attributes payload/wire bytes to its ``collective:*`` spans)."""
 
     name: str
     t0: float
     dur: float
     step: int
     category: str
+    args: Optional[Dict[str, Any]] = None
 
 
 class StepTimeline:
@@ -86,13 +89,15 @@ class StepTimeline:
 
     def record_span(self, name: str, t0: float, dur: float, *,
                     category: str = "phase",
-                    step: Optional[int] = None) -> None:
+                    step: Optional[int] = None,
+                    args: Optional[Dict[str, Any]] = None) -> None:
         if not self.enabled:
             return
         with self._lock:
             self._spans.append(Span(
                 str(name), float(t0), float(dur),
-                self._step if step is None else int(step), str(category)))
+                self._step if step is None else int(step), str(category),
+                dict(args) if args else None))
             self._recorded += 1
 
     @contextlib.contextmanager
@@ -163,6 +168,14 @@ class StepTimeline:
 
     # -- reading -----------------------------------------------------------
 
+    @property
+    def origin(self) -> float:
+        """The local clock value ``export_trace``'s ``ts=0`` maps to —
+        what ``fleet.export_fleet_trace`` shifts against when it moves
+        every host's events onto the shared barrier instant."""
+        with self._lock:
+            return self._origin
+
     def spans(self) -> list:
         with self._lock:
             return list(self._spans)
@@ -222,6 +235,9 @@ class StepTimeline:
         events = []
         for s in spans:
             tid = tids.setdefault(s.category, len(tids))
+            ev_args: Dict[str, Any] = {"step": s.step}
+            if s.args:
+                ev_args.update(s.args)
             events.append({
                 "name": s.name,
                 "cat": s.category,
@@ -230,7 +246,7 @@ class StepTimeline:
                 "dur": round(s.dur * 1e6, 3),
                 "pid": pid,
                 "tid": tid,
-                "args": {"step": s.step},
+                "args": ev_args,
             })
         # thread-name metadata makes the perfetto track labels readable
         for cat, tid in tids.items():
@@ -303,14 +319,16 @@ def global_enabled() -> bool:
 
 
 def record_global_span(name: str, t0: float, dur: float, *,
-                       category: str = "phase") -> None:
+                       category: str = "phase",
+                       args: Optional[Dict[str, Any]] = None) -> None:
     """Record into the global timeline iff it is enabled (no-op —
     not even a timeline construction — otherwise)."""
     tl = _GLOBAL
     if tl is not None and tl.enabled:
-        tl.record_span(name, t0, dur, category=category)
+        tl.record_span(name, t0, dur, category=category, args=args)
     elif tl is None and _env_enabled():
-        get_timeline().record_span(name, t0, dur, category=category)
+        get_timeline().record_span(name, t0, dur, category=category,
+                                   args=args)
 
 
 __all__ = [

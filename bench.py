@@ -1096,13 +1096,18 @@ def bench_fleet():
     deterministic straggler injected so the detection path, not just
     the merge, is on the clock. Reports the per-boundary aggregation
     latency — the price a training loop pays each time it takes the
-    fleet view — and the detected straggler spread."""
+    fleet view — the detected straggler spread, and (docs/
+    observability.md "Comms & sharding plane") the per-op collective
+    bandwidth ledger + clock-offset spread measured over the same
+    protocol."""
     import threading
 
     from apex_tpu.resilience.guard import LocalCollective
     from apex_tpu.telemetry import StepTimeline
+    from apex_tpu.telemetry import comms as _comms
     from apex_tpu.telemetry import metrics as _tmetrics
-    from apex_tpu.telemetry.fleet import FleetAggregator
+    from apex_tpu.telemetry.fleet import (FleetAggregator,
+                                          estimate_clock_offsets)
 
     n_hosts = 4
     sim_steps = 32
@@ -1132,10 +1137,20 @@ def bench_fleet():
     fleet_out = [None] * n_hosts
     lat_out = [None] * n_hosts
     err_out = [None] * n_hosts
+    tracer_out = [None] * n_hosts
+    offsets_out = [None] * n_hosts
 
     def loop(r):
         try:
-            agg = FleetAggregator(handles[r])
+            # a per-host tracer + private registry, the way each real
+            # host's process-global ones would be armed — so the gather
+            # protocol under the aggregation is itself on the ledger
+            reg = _tmetrics.MetricsRegistry()
+            tracer = _comms.CommsTracer(registry=reg,
+                                        timeline=StepTimeline(
+                                            capacity=16 * reps))
+            col = _comms.instrument(handles[r], tracer=tracer)
+            agg = FleetAggregator(col)
             snap = host_snapshot(r)
             agg.aggregate(snap, publish=False)          # warm
             t0 = time.perf_counter()
@@ -1143,6 +1158,15 @@ def bench_fleet():
                 fleet = agg.aggregate(snap, publish=False)
             lat_out[r] = (time.perf_counter() - t0) / reps
             fleet_out[r] = fleet
+            offsets_out[r] = estimate_clock_offsets(col, rounds=3,
+                                                    registry=reg)
+            g = reg.gauge("collective_bandwidth_mbps",
+                          "measured collective payload bandwidth "
+                          "over the bench window")
+            for row in tracer.ledger():
+                if row["calls"] and row["measured_mbps"] is not None:
+                    g.set(row["measured_mbps"], op=row["op"])
+            tracer_out[r] = tracer
         except BaseException as e:  # noqa: BLE001 — surfaced below
             err_out[r] = e
 
@@ -1159,6 +1183,21 @@ def bench_fleet():
     strag = fleet["straggler"]["phases"]["step"]
     counters_ok = (fleet["counters"]["fleet_bench_steps"]
                    == n_hosts * sim_steps)
+    ledger = tracer_out[0].ledger()
+    off = offsets_out[0] or {}
+    comms_detail = {
+        "collective_bandwidth_mbps": {
+            row["op"]: row["measured_mbps"] for row in ledger
+            if row["calls"]},
+        "collective_calls": {
+            row["op"]: row["calls"] for row in ledger if row["calls"]},
+        "collective_wire_bytes": {
+            row["op"]: row["wire_bytes"] for row in ledger
+            if row["calls"]},
+        "clock_offset_spread_ms": off.get("spread_ms"),
+        "clock_offsets_ms": off.get("offsets_ms"),
+        "clock_offset_rounds": off.get("rounds"),
+    }
     emit({
         "metric": "fleet_snapshot_aggregation_ms",
         "value": round(lat_out[0] * 1e3, 3),
@@ -1175,6 +1214,7 @@ def bench_fleet():
             "injected_straggler": {"host": str(straggler_host),
                                    "factor": straggle_factor},
             "fleet_counters_sum_ok": bool(counters_ok),
+            "comms": comms_detail,
             **backend_detail(),
         },
     }, "fleet")

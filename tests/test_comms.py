@@ -1,0 +1,577 @@
+"""Comms & sharding plane (apex_tpu/telemetry/comms.py, sharding.py,
+the fleet merged-trace path in telemetry/fleet.py): collective tracing
+across the Collective impls, the measured-vs-analytic bandwidth
+ledger, the EWMA slow-op escalation latch, the collective fault
+clauses, clock-offset estimation under injected skew, merged-trace
+well-formedness, and the sharding introspection null-with-reason
+contract on CPU.
+
+Replica sets are simulated with ``LocalCollective`` threads (pattern
+of tests/test_fleet.py); the real-process KVStoreCollective analog is
+``tools/fleet_drill.py``'s comms phase.
+"""
+
+import json
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.resilience import faults
+from apex_tpu.resilience.guard import LocalCollective, NullCollective
+from apex_tpu.telemetry import comms
+from apex_tpu.telemetry import metrics as tmetrics
+from apex_tpu.telemetry import sharding as tsharding
+from apex_tpu.telemetry.fleet import (
+    estimate_clock_offsets,
+    export_fleet_trace,
+)
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    telemetry.reset()
+    faults.install(None)
+    yield
+    faults.install(None)
+    telemetry.reset()
+
+
+def run_fleet(n, fn):
+    """``fn(rid, handle)`` on one thread per simulated host; returns
+    per-host results, surfacing any thread's error."""
+    group = LocalCollective(n)
+    handles = group.handles()
+    out = [None] * n
+    errs = [None] * n
+
+    def loop(r):
+        try:
+            out[r] = fn(r, handles[r])
+        except BaseException as e:  # noqa: BLE001
+            errs[r] = e
+
+    ts = [threading.Thread(target=loop, args=(r,), daemon=True)
+          for r in range(n)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(60)
+    for e in errs:
+        if e is not None:
+            raise e
+    return out
+
+
+def private_tracer(**kw):
+    return comms.CommsTracer(registry=tmetrics.MetricsRegistry(),
+                             timeline=telemetry.StepTimeline(capacity=256),
+                             **kw)
+
+
+class TestInstrumentIdentity:
+    def test_disabled_returns_the_exact_object(self):
+        col = NullCollective()
+        assert comms.instrument(col) is col
+        assert not comms.enabled()
+
+    def test_none_stays_none(self):
+        assert comms.instrument(None) is None
+
+    def test_enable_wraps_and_rewrap_is_idempotent(self):
+        comms.enable()
+        col = NullCollective()
+        wrapped = comms.instrument(col)
+        assert isinstance(wrapped, comms.InstrumentedCollective)
+        assert wrapped.inner is col
+        assert comms.instrument(wrapped) is wrapped
+        assert wrapped.n_replicas == 1 and wrapped.replica_id == 0
+
+    def test_rewrap_with_new_tracer_swaps_not_nests(self):
+        t1, t2 = private_tracer(), private_tracer()
+        w1 = comms.instrument(NullCollective(), tracer=t1)
+        w2 = comms.instrument(w1, tracer=t2)
+        assert w2 is not w1 and w2.inner is w1.inner
+        assert w2.tracer is t2
+
+    def test_reset_disarms(self):
+        comms.enable()
+        assert comms.enabled()
+        telemetry.reset()
+        assert not comms.enabled()
+        assert comms.section()["enabled"] is False
+        assert "reason" in comms.section()
+
+    def test_env_knob_arms(self, monkeypatch):
+        monkeypatch.setenv("APEX_TPU_COMMS", "1")
+        comms.disable()
+        try:
+            assert comms.enabled()
+            assert isinstance(comms.instrument(NullCollective()),
+                              comms.InstrumentedCollective)
+        finally:
+            monkeypatch.delenv("APEX_TPU_COMMS")
+            comms.disable()
+
+    def test_results_byte_identical_to_raw(self):
+        tr = private_tracer()
+        col = comms.instrument(NullCollective(), tracer=tr)
+        x = np.arange(32, dtype=np.float32)
+        raw = NullCollective().all_gather(x)
+        traced = col.all_gather(x)
+        assert np.array_equal(np.asarray(traced), np.asarray(raw))
+        assert col.agree_any(True) is True
+        assert col.agree_any(False) is False
+
+
+class TestOpAccounting:
+    def test_null_collective_ops_and_bytes(self):
+        tr = private_tracer()
+        col = comms.instrument(NullCollective(), tracer=tr)
+        x = np.ones(256, np.float32)               # 1024 bytes
+        col.all_gather(x)
+        col.broadcast_from(0, [x, x])
+        col.barrier()
+        col.agree_any(False)
+        c = tr.registry.snapshot()["counters"]
+        for op in comms.COLLECTIVE_OPS:
+            key = f'collective_ops{{impl="NullCollective",op="{op}"}}'
+            assert c.get(key) == 1.0, (op, c)
+        st = tr.op_stats()
+        assert st["all_gather"]["payload_bytes"] == 1024
+        assert st["broadcast_from"]["payload_bytes"] == 2048
+        assert st["barrier"]["payload_bytes"] == 0
+        assert st["agree_any"]["payload_bytes"] == 4
+        # timeline spans landed, one per op, category "collective"
+        spans = tr.timeline.spans()
+        assert sorted(s.name for s in spans) == sorted(
+            f"collective:{op}" for op in comms.COLLECTIVE_OPS)
+        assert all(s.category == "collective" for s in spans)
+        # bytes attribution rides the span into every exported trace
+        by_name = {s.name: s.args for s in spans}
+        assert by_name["collective:all_gather"]["payload_bytes"] == 1024
+        assert by_name["collective:all_gather"]["wire_bytes"] == 1024
+        assert by_name["collective:barrier"]["payload_bytes"] == 0
+        trace = tr.timeline.export_trace()
+        gather_ev = [e for e in trace["traceEvents"]
+                     if e.get("name") == "collective:all_gather"]
+        assert gather_ev[0]["args"]["payload_bytes"] == 1024
+
+    def test_local_collective_threaded_per_host_accounting(self):
+        def host(r, handle):
+            tr = private_tracer()
+            col = comms.instrument(handle, tracer=tr)
+            assert col.impl_name() == "LocalCollective"
+            got = col.all_gather(np.full(64, r, np.float32))
+            col.barrier()
+            assert col.agree_any(r == 1) is True   # any host voting True
+            return np.asarray(got), tr
+
+        outs = run_fleet(3, host)
+        for got, tr in outs:
+            assert got.shape[0] == 3
+            assert [float(row[0]) for row in got] == [0.0, 1.0, 2.0]
+            st = tr.op_stats()
+            assert st["all_gather"]["calls"] == 1
+            # analytic wire bytes: payload x n for the gather
+            assert st["all_gather"]["wire_bytes"] == 64 * 4 * 3
+            assert st["agree_any"]["wire_bytes"] == 4 * 3
+            c = tr.registry.snapshot()["counters"]
+            key = ('collective_ops{impl="LocalCollective",'
+                   'op="all_gather"}')
+            assert c.get(key) == 1.0
+
+    def test_histograms_observe_bytes_and_ms(self):
+        tr = private_tracer()
+        col = comms.instrument(NullCollective(), tracer=tr)
+        col.all_gather(np.ones(1024, np.float32))
+        h = tr.registry.snapshot()["histograms"]
+        b = h['collective_bytes{op="all_gather"}']
+        assert b["count"] == 1 and b["sum"] == 4096.0
+        m = h['collective_ms{op="all_gather"}']
+        assert m["count"] == 1 and m["sum"] >= 0.0
+        # barrier carries no payload: no bytes observation
+        col.barrier()
+        h = tr.registry.snapshot()["histograms"]
+        assert 'collective_bytes{op="barrier"}' not in h
+        assert h['collective_ms{op="barrier"}']["count"] == 1
+
+
+class TestWireBytes:
+    def test_analytic_model(self):
+        assert comms.wire_bytes("all_gather", 1000, 4) == 4000
+        assert comms.wire_bytes("broadcast_from", 1000, 4) == 1000
+        assert comms.wire_bytes("barrier", 0, 4) == 0
+        assert comms.wire_bytes("agree_any", 4, 4) == 16
+
+    def test_degenerate_world(self):
+        assert comms.wire_bytes("all_gather", 100, 0) == 100
+
+
+class TestLedger:
+    def test_measured_column_math(self):
+        tr = private_tracer()
+        # 2 gathers x 1 MB payload on a 4-host set, 10 ms each:
+        # wire = 2 x 4 MB over 20 ms -> 400 MB/s
+        for _ in range(2):
+            tr.record("all_gather", "X", 1_000_000,
+                      comms.wire_bytes("all_gather", 1_000_000, 4),
+                      t0=0.0, dur_s=0.010)
+        [row] = tr.ledger()
+        assert row["op"] == "all_gather" and row["calls"] == 2
+        assert row["payload_bytes"] == 2_000_000
+        assert row["wire_bytes"] == 8_000_000
+        assert row["wall_ms"] == pytest.approx(20.0)
+        assert row["mean_ms"] == pytest.approx(10.0)
+        assert row["measured_mbps"] == pytest.approx(400.0)
+
+    def test_analytic_column_null_with_reason_without_link(self):
+        tr = private_tracer()
+        tr.record("barrier", "X", 0, 0, t0=0.0, dur_s=0.001)
+        [row] = tr.ledger()
+        assert row["analytic_ms"] is None
+        assert "link_gbps" in row["analytic_reason"]
+        assert row["measured_mbps"] is None      # zero wire bytes
+
+    def test_analytic_column_with_link(self):
+        tr = private_tracer(link_gbps=8.0)       # 1 GB/s
+        # 4 MB wire at 1 GB/s -> 4 ms analytic; measured 8 ms -> 2.0x
+        tr.record("all_gather", "X", 1_000_000, 4_000_000,
+                  t0=0.0, dur_s=0.008)
+        [row] = tr.ledger()
+        assert row["analytic_ms"] == pytest.approx(4.0)
+        assert row["measured_over_analytic"] == pytest.approx(2.0)
+        assert "analytic_reason" not in row
+
+    def test_summary_carries_the_whole_story(self):
+        tr = private_tracer()
+        tr.record("barrier", "X", 0, 0, t0=0.0, dur_s=0.001)
+        s = tr.summary()
+        assert set(s) >= {"ops", "ledger", "clock_offsets",
+                          "slow_factor", "min_samples"}
+        assert s["clock_offsets"] is None
+        tr.note_clock_offsets({"offsets_ms": {"0": 0.0}, "spread_ms": 0.0,
+                               "rounds": 3, "rtt_ms": 0.1, "junk": 1})
+        s = tr.summary()
+        assert s["clock_offsets"]["rounds"] == 3
+        assert "junk" not in s["clock_offsets"]
+        json.dumps(s)
+
+    def test_knob_validation(self):
+        with pytest.raises(ValueError, match="slow_factor"):
+            comms.CommsTracer(registry=tmetrics.MetricsRegistry(),
+                              slow_factor=1.0)
+        with pytest.raises(ValueError, match="ewma_alpha"):
+            comms.CommsTracer(registry=tmetrics.MetricsRegistry(),
+                              ewma_alpha=0.0)
+
+
+class TestSlowEscalation:
+    def drive(self, tr, op, ms_seq):
+        for ms in ms_seq:
+            tr.record(op, "X", 0, 0, t0=0.0, dur_s=ms / 1e3)
+
+    def test_latch_one_event_per_excursion(self):
+        tr = private_tracer(slow_factor=4.0, min_samples=5)
+        sink = tmetrics.InMemorySink()
+        tr.registry.add_sink(sink)
+        # 6 healthy samples warm the EWMA; a 3-op slow excursion must
+        # raise ONE event; recovery unlatches; a second excursion
+        # raises the second
+        self.drive(tr, "barrier", [1.0] * 6)
+        assert tr.op_stats()["barrier"]["slow_events"] == 0
+        self.drive(tr, "barrier", [50.0, 50.0, 50.0])
+        assert tr.op_stats()["barrier"]["slow_events"] == 1
+        self.drive(tr, "barrier", [1.0, 1.0])      # healthy: unlatch
+        self.drive(tr, "barrier", [50.0])
+        st = tr.op_stats()["barrier"]
+        assert st["slow_events"] == 2
+        evs = [e for e in sink.events if e["event"] == "collective_slow"]
+        assert len(evs) == 2
+        assert evs[0]["op"] == "barrier" and evs[0]["ms"] >= 50.0
+        c = tr.registry.snapshot()["counters"]
+        assert c['collective_slow_total{op="barrier"}'] == 2.0
+
+    def test_slow_sample_never_raises_its_own_bar(self):
+        tr = private_tracer(slow_factor=4.0, min_samples=2, ewma_alpha=1.0)
+        self.drive(tr, "barrier", [1.0, 1.0, 1.0])
+        ewma_before = tr.op_stats()["barrier"]["ewma_ms"]
+        self.drive(tr, "barrier", [100.0, 100.0])
+        st = tr.op_stats()["barrier"]
+        assert st["ewma_ms"] == pytest.approx(ewma_before)
+        assert st["slow_events"] == 1
+
+    def test_no_escalation_inside_warmup(self):
+        tr = private_tracer(slow_factor=4.0, min_samples=10)
+        self.drive(tr, "barrier", [1.0] * 5 + [500.0])
+        assert tr.op_stats()["barrier"]["slow_events"] == 0
+
+    def test_per_op_state_is_independent(self):
+        tr = private_tracer(min_samples=2)
+        self.drive(tr, "barrier", [1.0] * 3 + [50.0])
+        self.drive(tr, "all_gather", [50.0] * 4)   # uniformly slow: fine
+        assert tr.op_stats()["barrier"]["slow_events"] == 1
+        assert tr.op_stats()["all_gather"]["slow_events"] == 0
+
+
+class TestFaultClauses:
+    def test_from_env_grammar(self):
+        inj = faults.FaultInjector.from_env(
+            "collective_slow=25;collective_slow_at=2,4;"
+            "collective_payload_corrupt=1")
+        assert inj.collective_slow_ms == 25.0
+        assert inj.collective_slow_at == frozenset({2, 4})
+        assert inj.collective_corrupt_indices == frozenset({1})
+
+    def test_delay_applies_at_planned_indices_only(self):
+        inj = faults.FaultInjector.from_env(
+            "collective_slow=40;collective_slow_at=1")
+        assert inj.collective_delay_s() == 0.0         # op 0
+        assert inj.collective_delay_s() == pytest.approx(0.040)
+        assert inj.collective_delay_s() == 0.0         # op 2
+
+    def test_empty_at_set_means_every_op(self):
+        inj = faults.FaultInjector.from_env("collective_slow=10")
+        assert all(inj.collective_delay_s() == pytest.approx(0.010)
+                   for _ in range(3))
+
+    def test_injected_delay_lands_in_the_measured_ms(self):
+        tr = private_tracer()
+        col = comms.instrument(NullCollective(), tracer=tr)
+        with faults.inject(collective_slow_ms=30.0):
+            col.barrier()
+        assert tr.op_stats()["barrier"]["last_ms"] >= 30.0
+
+    def test_io_collective_raises_out_of_the_op(self):
+        tr = private_tracer()
+        col = comms.instrument(NullCollective(), tracer=tr)
+        faults.install(faults.FaultInjector.from_env("io:collective=1"))
+        col.barrier()                                   # call 0: fine
+        with pytest.raises(faults.FaultError, match="collective"):
+            col.barrier()                               # call 1: planned
+        # the failed op never reached the tracer
+        assert tr.op_stats()["barrier"]["calls"] == 1
+
+    def test_corrupt_flips_one_byte_and_events(self):
+        tr = private_tracer()
+        sink = tmetrics.InMemorySink()
+        tr.registry.add_sink(sink)
+        col = comms.instrument(NullCollective(), tracer=tr)
+        x = np.ones(16, np.float32)
+        faults.install(faults.FaultInjector.from_env(
+            "collective_payload_corrupt=1"))
+        clean = np.asarray(col.all_gather(x))           # payload op 0
+        assert np.array_equal(clean[0], x)
+        bad = np.asarray(col.all_gather(x))             # payload op 1
+        assert not np.array_equal(bad[0], x)
+        # exactly ONE byte differs
+        diff = (np.asarray(bad).view(np.uint8).reshape(-1)
+                != np.asarray(clean).view(np.uint8).reshape(-1))
+        assert int(diff.sum()) == 1
+        evs = [e for e in sink.events
+               if e["event"] == "collective_payload_corrupt"]
+        assert len(evs) == 1 and evs[0]["op"] == "all_gather"
+
+    def test_barrier_never_corruptible(self):
+        tr = private_tracer()
+        col = comms.instrument(NullCollective(), tracer=tr)
+        faults.install(faults.FaultInjector.from_env(
+            "collective_payload_corrupt=0"))
+        col.barrier()          # consumes no payload-op index
+        bad = np.asarray(col.all_gather(np.ones(8, np.float32)))
+        assert not np.array_equal(bad[0], np.ones(8, np.float32))
+
+
+class TestClockOffsets:
+    def test_single_host_short_circuits(self):
+        out = estimate_clock_offsets(NullCollective())
+        assert out["n_hosts"] == 1 and out["rounds"] == 0
+        assert out["offsets_ms"] == {"0": 0.0}
+        assert out["spread_ms"] == 0.0
+
+    def test_recovers_injected_skew(self):
+        skew = [0.0, 0.25, -0.1]                  # seconds vs host 0
+
+        def host(r, handle):
+            reg = tmetrics.MetricsRegistry()
+            return estimate_clock_offsets(
+                handle, rounds=5, registry=reg,
+                clock=lambda: time.perf_counter() + skew[r]), reg
+
+        outs = run_fleet(3, host)
+        for r, (out, reg) in enumerate(outs):
+            assert out["n_hosts"] == 3
+            for h in range(3):
+                want = (skew[h] - skew[0]) * 1e3
+                got = out["offsets_ms"][str(h)]
+                assert got == pytest.approx(want, abs=10.0), (h, got)
+            assert out["local_offset_ms"] == out["offsets_ms"][str(r)]
+            assert out["spread_ms"] == pytest.approx(350.0, abs=20.0)
+            assert out["rtt_ms"] >= 0.0
+            g = reg.snapshot()["gauges"]
+            assert g['fleet_clock_offset_ms{host="1"}'] == pytest.approx(
+                250.0, abs=10.0)
+            assert "fleet_clock_offset_spread_ms" in g
+
+    def test_deposits_into_armed_tracer(self):
+        comms.enable()
+
+        def host(r, handle):
+            return estimate_clock_offsets(
+                handle, rounds=2, registry=tmetrics.MetricsRegistry())
+
+        run_fleet(2, host)
+        offs = comms.get_tracer().clock_offsets
+        assert offs is not None and offs["rounds"] == 2
+
+
+class TestMergedTrace:
+    def test_merged_trace_well_formed(self):
+        def host(r, handle):
+            tl = telemetry.StepTimeline(capacity=64)
+            tr = comms.CommsTracer(registry=tmetrics.MetricsRegistry(),
+                                   timeline=tl)
+            col = comms.instrument(handle, tracer=tr)
+            with tl.phase("work"):
+                col.barrier()
+            instants = [{"event": "collective_slow",
+                         "wall_time": time.time(), "op": "barrier",
+                         "host": r}]
+            return export_fleet_trace(col, timeline=tl,
+                                      instant_events=instants)
+
+        outs = run_fleet(2, host)
+        for trace in outs:
+            json.dumps(trace)
+            evs = trace["traceEvents"]
+            x_pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+            assert x_pids == {0, 1}
+            for r in (0, 1):
+                barriers = [e for e in evs if e.get("ph") == "X"
+                            and e["pid"] == r
+                            and e["name"] == "collective:barrier"]
+                assert barriers
+                # bytes/ms attribution survives the merge
+                assert barriers[0]["args"]["payload_bytes"] == 0
+                assert barriers[0]["dur"] >= 0
+                assert any(e.get("ph") == "M"
+                           and e["name"] == "process_name"
+                           and e.get("pid") == r for e in evs)
+            instants = [e for e in evs if e.get("ph") == "i"]
+            assert {e["pid"] for e in instants} == {0, 1}
+            assert all(e["name"] == "collective_slow" for e in instants)
+            assert all(e["ts"] >= 0 for e in evs if "ts" in e)
+            od = trace["otherData"]
+            assert od["n_hosts"] == 2
+            assert set(od["clock_offsets_ms"]) == {"0", "1"}
+
+    def test_offset_correction_aligns_shared_instant(self):
+        # hosts with skewed clocks time the SAME barrier; after the
+        # offset shift the merged spans must land together (well under
+        # the injected skew)
+        skew = [0.0, 0.2]
+
+        def host(r, handle):
+            clk = lambda: time.perf_counter() + skew[r]   # noqa: E731
+            tl = telemetry.StepTimeline(capacity=64, clock=clk)
+            tr = comms.CommsTracer(registry=tmetrics.MetricsRegistry(),
+                                   timeline=tl, clock=clk)
+            col = comms.instrument(handle, tracer=tr)
+            off = estimate_clock_offsets(
+                col, rounds=5, clock=clk,
+                registry=tmetrics.MetricsRegistry())
+            col.barrier()                      # one shared fleet instant
+            return export_fleet_trace(col, timeline=tl, offsets=off)
+
+        outs = run_fleet(2, host)
+        evs = outs[0]["traceEvents"]
+        # the LAST collective:barrier span per host is the shared one
+        last = {}
+        for e in evs:
+            if e.get("ph") == "X" and e["name"] == "collective:barrier":
+                last[e["pid"]] = e["ts"]
+        assert set(last) == {0, 1}
+        assert abs(last[0] - last[1]) < 50e3   # < 50 ms, vs 200 ms skew
+
+    def test_disabled_timeline_host_contributes_metadata_only(self):
+        def host(r, handle):
+            tl = telemetry.StepTimeline(capacity=8, enabled=(r == 0))
+            if r == 0:
+                tl.record_span("step", tl.clock(), 0.001)
+            return export_fleet_trace(handle, timeline=tl,
+                                      instant_events=[])
+
+        outs = run_fleet(2, host)
+        evs = outs[0]["traceEvents"]
+        assert all(e["pid"] == 0 for e in evs if e.get("ph") == "X")
+        assert any(e.get("ph") == "M" and e.get("pid") == 1 for e in evs)
+
+
+class TestShardingIntrospection:
+    def test_fixed_keys_on_cpu_with_reason(self):
+        import jax
+        import jax.numpy as jnp
+
+        info = tsharding.jitted_shardings(
+            jax.jit(lambda x: x * 2.0), jnp.ones((8, 4), jnp.float32),
+            fn="double")
+        assert set(info) == set(tsharding.SHARDING_KEYS)
+        assert info["fn"] == "double"
+        assert info["inputs"] and info["outputs"]
+        # single-device CPU: no mesh, and the reason says so
+        if info["mesh"] is None:
+            assert info["sharding_reason"] is not None
+            assert "single-device" in info["sharding_reason"]
+        # per-device bytes are real: 8x4 f32 = 128 bytes each way
+        assert info["input_bytes_per_device"] == 128
+        assert info["output_bytes_per_device"] == 128
+        json.dumps(info)
+
+    def test_normalize_never_raises_on_junk(self):
+        out = tsharding.normalize_sharding(object())
+        assert out["kind"] == "object" and out["n_devices"] == 1
+        assert out["mesh"] is None
+
+    def test_executable_without_surface_gets_reason(self):
+        info = tsharding.executable_shardings(object(), fn="junk")
+        assert set(info) == set(tsharding.SHARDING_KEYS)
+        assert "no shardings" in info["sharding_reason"]
+
+    def test_lower_failure_gets_reason(self):
+        info = tsharding.jitted_shardings(object(), fn="junk")
+        assert "lower/compile failed" in info["sharding_reason"]
+
+    def test_publish_folds_into_snapshot_detail(self):
+        import jax
+        import jax.numpy as jnp
+
+        info = tsharding.jitted_shardings(
+            jax.jit(lambda x: x + 1.0), jnp.ones((4,), jnp.float32),
+            fn="inc")
+        tsharding.publish_shardings(info)
+        g = telemetry.registry().snapshot()["gauges"]
+        assert g['sharding_devices{fn="inc"}'] == 1.0
+        assert g['sharding_bytes_per_device{dir="input",fn="inc"}'] == 16.0
+        detail = telemetry.snapshot_detail()
+        assert detail["sharding"]["inc"]["fn"] == "inc"
+
+    def test_snapshot_detail_null_with_reason_when_unpublished(self):
+        detail = telemetry.snapshot_detail()
+        assert detail["sharding"] is None
+        assert "publish_shardings" in detail["sharding_reason"]
+
+
+class TestSection:
+    def test_disabled_marker(self):
+        s = comms.section()
+        assert s["enabled"] is False and "APEX_TPU_COMMS" in s["reason"]
+
+    def test_armed_summary(self):
+        comms.enable()
+        col = comms.instrument(NullCollective())
+        col.barrier()
+        s = comms.section()
+        assert s["enabled"] is True
+        assert s["ops"]["barrier"]["calls"] == 1

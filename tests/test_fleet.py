@@ -18,6 +18,7 @@ from apex_tpu import telemetry
 from apex_tpu.resilience.guard import LocalCollective, NullCollective
 from apex_tpu.telemetry import metrics as tmetrics
 from apex_tpu.telemetry.fleet import (
+    DEFAULT_SNAPSHOT_CAP_BYTES,
     FleetAggregator,
     gather_snapshots,
     merge_snapshots,
@@ -95,6 +96,49 @@ class TestGather:
         telemetry.registry().counter("c").inc(5)
         [got] = gather_snapshots(None)
         assert got["registry"]["counters"]["c"] == 5.0
+
+    def test_oversized_snapshot_rides_as_stub_not_silence(self):
+        """The gather cap regression: one host past ``max_bytes`` must
+        gather as a structured stub + ONE fleet_snapshot_truncated
+        event on that host — the other hosts' views stay intact and
+        the merge still works."""
+        regs = [tmetrics.MetricsRegistry() for _ in range(3)]
+
+        def host(r, handle):
+            snap = host_snapshot(r)
+            if r == 1:
+                snap["blob"] = "x" * 4096          # past the tiny cap
+            return gather_snapshots(handle, snap, max_bytes=1024,
+                                    registry=regs[r])
+
+        outs = run_fleet(3, host)
+        for got in outs:
+            # hosts 0/2 intact, host 1 a valid-shaped marked stub
+            assert got[0]["registry"]["counters"]["steps"] == 4.0
+            assert got[2]["registry"]["counters"]["steps"] == 4.0
+            stub = got[1]
+            assert stub["truncated"] is True
+            assert stub["replica_id"] == 1
+            assert stub["max_bytes"] == 1024
+            assert stub["original_bytes"] > 1024
+            assert stub["step_timeline"] is None
+            # the merge never chokes on the stub
+            fleet = merge_snapshots(got)
+            assert fleet["counters"]["steps"] == 8.0
+        # the event + counter landed on the oversized host ONLY
+        c1 = regs[1].snapshot()["counters"]
+        assert c1["fleet_snapshot_truncated_total"] == 1.0
+        assert c1['telemetry_events{event="fleet_snapshot_truncated"}'] \
+            == 1.0
+        for r in (0, 2):
+            assert "fleet_snapshot_truncated_total" \
+                not in regs[r].snapshot()["counters"]
+
+    def test_default_cap_admits_normal_snapshots(self):
+        outs = run_fleet(2, lambda r, h: gather_snapshots(
+            h, host_snapshot(r)))
+        assert all("truncated" not in s for got in outs for s in got)
+        assert DEFAULT_SNAPSHOT_CAP_BYTES == 4 << 20
 
 
 class TestMerge:

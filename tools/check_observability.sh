@@ -11,8 +11,13 @@
 # a one-replica bit_flip injected via APEX_TPU_FAULTS must produce a
 # committed flightrec_*.json black box on every host — trigger
 # replica_divergence, fleet snapshot summing both hosts' counters,
-# straggler gauges present, perfetto slice well-formed. Extra args
-# pass through to pytest.
+# straggler gauges present, perfetto slice well-formed — plus the
+# COMMS-PLANE smoke (docs/observability.md "Comms & sharding plane"):
+# disabled means instrument(col) IS col (zero wrapper), and the drill
+# must assert collective spans on both hosts, latch a collective_slow
+# escalation from the injected-delay fault clause, and commit ONE
+# offset-corrected merged perfetto trace this script structure-
+# validates. Extra args pass through to pytest.
 set -uo pipefail
 cd "$(dirname "$0")/.."
 export JAX_PLATFORMS=cpu
@@ -22,6 +27,7 @@ rc=0
 python -m pytest tests/test_telemetry.py tests/test_fleet.py \
     tests/test_flight.py tests/test_bench_baseline.py \
     tests/test_records.py tests/test_compiled.py tests/test_devmem.py \
+    tests/test_comms.py \
     "$@" -q -p no:cacheprovider || rc=1
 
 echo "== compile-tracker smoke: one forced retrace =="
@@ -135,6 +141,44 @@ assert make_train_step(opt, telemetry=None) is step
 print("disabled-is-step: OK")
 PY
 
+echo "== comms-plane structural guarantee =="
+python - <<'PY' || rc=1
+import numpy as np
+
+from apex_tpu import telemetry
+from apex_tpu.telemetry import comms
+from apex_tpu.resilience.guard import NullCollective
+
+telemetry.reset()
+# disabled means UNTOUCHED: the raw object, no wrapper in the path —
+# the make_train_step disabled-is-step discipline applied to the wire
+col = NullCollective()
+assert comms.instrument(col) is col, \
+    "disarmed instrument() must return the exact object passed in"
+assert not comms.enabled()
+
+# armed: the same call wraps, ops land on the registry, and the
+# bundle section flips from reason to summary
+tracer = comms.enable()
+wrapped = comms.instrument(col)
+assert isinstance(wrapped, comms.InstrumentedCollective)
+assert comms.instrument(wrapped) is wrapped, "re-wrap must be idempotent"
+out = wrapped.all_gather(np.ones(256, np.float32))
+assert np.array_equal(np.asarray(out)[0], np.ones(256, np.float32))
+wrapped.barrier()
+snap = telemetry.registry().snapshot()["counters"]
+key = 'collective_ops{impl="NullCollective",op="all_gather"}'
+assert snap.get(key) == 1.0, snap
+assert comms.section()["enabled"] is True
+ledger = {r["op"]: r for r in tracer.ledger()}
+assert ledger["all_gather"]["payload_bytes"] == 1024
+assert ledger["all_gather"]["wire_bytes"] == 1024  # n_replicas == 1
+telemetry.reset()
+assert comms.section()["enabled"] is False, \
+    "reset must disarm the comms plane"
+print("comms structural guarantees: OK")
+PY
+
 # Two-process jax.distributed fleet drill: rank 1 carries the bit_flip
 # fault; both hosts must leave a committed flight bundle (see
 # tools/fleet_drill.py for every asserted property).
@@ -163,6 +207,44 @@ else
              "in $bundle" >&2
         rc=1
     fi
+    # the armed comms plane rode the same bundle: the dump CLI's prom
+    # view must render collective_ops series + the comms summary line
+    dump="$(python tools/telemetry_dump.py "$bundle")"
+    if echo "$dump" | grep -q '^collective_ops{' \
+            && echo "$dump" | grep -Eq '^# comms: [0-9]+ collective ops'; then
+        echo "bundle comms section: OK"
+    else
+        echo "fleet drill FAILED: bundle dump carries no comms plane" >&2
+        rc=1
+    fi
+    # host 0 committed the offset-corrected merged perfetto trace;
+    # hold it to the structure the drill promised
+    python - "$drill_dir/merged_trace.json" <<'PY' || rc=1
+import json
+import sys
+
+with open(sys.argv[1]) as f:
+    trace = json.load(f)
+evs = trace["traceEvents"]
+pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+assert pids == {0, 1}, f"merged trace pids {pids}: want both hosts"
+for r in (0, 1):
+    c_evs = [e for e in evs if e.get("ph") == "X" and e["pid"] == r
+             and e["name"].startswith("collective:")]
+    assert c_evs, f"no collective spans for host {r}"
+    assert all("payload_bytes" in e["args"] and e["dur"] >= 0
+               for e in c_evs), f"host {r} spans lack bytes attribution"
+    names = [e for e in evs if e.get("ph") == "M"
+             and e["name"] == "process_name" and e.get("pid") == r]
+    assert names, f"no process_name track for host {r}"
+assert any(e.get("ph") == "i" and e["name"] == "collective_slow"
+           for e in evs), "no collective_slow instant in merged trace"
+assert all(e["ts"] >= 0 for e in evs if "ts" in e), "negative ts"
+od = trace["otherData"]
+assert od["n_hosts"] == 2 and "clock_offsets_ms" in od
+print(f"merged fleet trace: OK ({len(evs)} events, "
+      f"clock spread {od['clock_offset_spread_ms']}ms)")
+PY
 fi
 rm -rf "$drill_dir"
 

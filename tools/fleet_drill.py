@@ -24,6 +24,18 @@ divergence boundary must:
 After the loop both hosts verify the repair end state is bitwise
 identical across the fleet (an all-gather of the master buffer).
 
+The drill then exercises the COMMS plane (docs/observability.md
+"Comms & sharding plane"): the loop above ran with the comms tracer
+armed, so every guard gather/agree and quorum barrier crossed the
+instrumented ``KVStoreCollective`` — both hosts assert
+``collective_ops{...impl="KVStoreCollective"}`` counters and
+``collective:*`` timeline spans, warm the barrier EWMA and latch a
+``collective_slow`` escalation through the documented
+``collective_slow=<ms>`` fault clause, and merge both hosts'
+timelines into ONE offset-corrected perfetto trace
+(``fleet.export_fleet_trace``; host 0 commits it to
+``<workdir>/merged_trace.json`` for the orchestrator to validate).
+
 Usage (see check_observability.sh for the orchestration)::
 
     MASTER_ADDR=127.0.0.1 MASTER_PORT=29881 WORLD_SIZE=2 RANK=<r> \\
@@ -63,7 +75,8 @@ def main() -> int:
     from apex_tpu.parallel import multiproc
     from apex_tpu.resilience import (CheckpointManager, ConsistencyGuard,
                                      faults)
-    from apex_tpu.telemetry import flight
+    from apex_tpu.telemetry import comms, flight
+    from apex_tpu.telemetry import fleet as fleet_mod
 
     multiproc.initialize_distributed()          # env-driven, the ref way
     rank, world = multiproc.process_index(), multiproc.world_size()
@@ -73,8 +86,13 @@ def main() -> int:
     # its own registry, and O_EXCL claims never race across hosts
     records.RECORDS_DIR = os.path.join(workdir, f"records_{rank}")
 
+    # arm the comms tracer BEFORE the collective is built, so
+    # process_collective() hands back the instrumented wrapper
+    comms.enable()
     col = multiproc.process_collective()
     assert col.n_replicas == 2
+    assert isinstance(col, comms.InstrumentedCollective), type(col)
+    assert col.impl_name() == "KVStoreCollective", col.impl_name()
 
     tl = telemetry.enable(capacity=512)
     mgr = CheckpointManager(os.path.join(workdir, "ckpt"), keep=4,
@@ -177,6 +195,81 @@ def main() -> int:
     assert bundle["state_digests"], f"{tag} no state digests retained"
     assert all("xor" in d and "step" in d for d in bundle["state_digests"])
 
+    # -- comms plane: the loop's gathers/agrees/barriers all crossed
+    # the instrumented collective on this host
+    counters = reg.snapshot()["counters"]
+    kv_ops = {k: v for k, v in counters.items()
+              if k.startswith("collective_ops")
+              and 'impl="KVStoreCollective"' in k}
+    assert kv_ops and sum(kv_ops.values()) > 0, \
+        f"{tag} no traced collective ops on this host"
+    c_spans = [s for s in tl.spans() if s.category == "collective"]
+    assert c_spans and all(s.name.startswith("collective:")
+                           for s in c_spans), \
+        f"{tag} no collective:* spans in the timeline"
+    # the bundle carried the comms section (armed -> the full summary)
+    assert bundle["comms"]["enabled"] is True, \
+        f"{tag} flight bundle lost the comms section"
+    assert any(r["op"] == "all_gather" and r["calls"] > 0
+               for r in bundle["comms"]["ledger"]), \
+        f"{tag} bundle ledger has no all_gather row"
+
+    # escalation drill: warm the barrier EWMA past min_samples, then
+    # inject a delay through the DOCUMENTED clause grammar on both
+    # hosts — the next barrier must latch one collective_slow event
+    tr = comms.get_tracer()
+    for _ in range(tr.min_samples + 1):
+        col.barrier()
+    ewma = tr.op_stats()["barrier"]["ewma_ms"]
+    delay_ms = max(60.0, tr.slow_factor * 2.0 * ewma)
+    faults.install(faults.FaultInjector.from_env(
+        f"collective_slow={delay_ms:.3f}"))
+    try:
+        col.barrier()
+    finally:
+        faults.install(None)        # back to the env-driven plan
+    counters = reg.snapshot()["counters"]
+    assert counters.get('collective_slow_total{op="barrier"}', 0) >= 1, \
+        f"{tag} injected {delay_ms:.1f}ms barrier delay never escalated"
+    assert counters.get('telemetry_events{event="collective_slow"}',
+                        0) >= 1, f"{tag} no collective_slow event"
+    assert any(e.get("event") == "collective_slow"
+               for e in recorder.events), \
+        f"{tag} collective_slow missing from the flight ring"
+
+    # merged fleet trace: one offset-corrected perfetto timeline, both
+    # hosts' spans + the escalation instants; host 0 commits the file
+    trace_path = (os.path.join(workdir, "merged_trace.json")
+                  if rank == 0 else None)
+    merged = fleet_mod.export_fleet_trace(col, path=trace_path)
+    evs = merged["traceEvents"]
+    complete_pids = {e["pid"] for e in evs if e.get("ph") == "X"}
+    assert complete_pids == {0, 1}, \
+        f"{tag} merged trace pids {complete_pids} != both hosts"
+    for r in (0, 1):
+        c_evs = [e for e in evs if e.get("ph") == "X" and e["pid"] == r
+                 and e["name"].startswith("collective:")]
+        assert c_evs, \
+            f"{tag} merged trace has no collective spans for host {r}"
+        # every collective span carries its bytes/ms attribution
+        assert all("payload_bytes" in e["args"] and e["dur"] >= 0
+                   for e in c_evs), \
+            f"{tag} host {r} collective spans lost bytes attribution"
+        assert any(e.get("ph") == "M" and e["name"] == "process_name"
+                   and e["pid"] == r for e in evs), \
+            f"{tag} merged trace lacks host {r} process_name track"
+    assert any(e.get("ph") == "i" and e["name"] == "collective_slow"
+               for e in evs), \
+        f"{tag} merged trace lacks the collective_slow instant"
+    assert all(e["ts"] >= 0 for e in evs if "ts" in e), \
+        f"{tag} merged trace has negative ts after normalization"
+    n_hosts_merged = merged["otherData"]["n_hosts"]
+    assert n_hosts_merged == 2, f"{tag} merged {n_hosts_merged} hosts"
+
+    print(f"{tag} comms plane OK: {int(sum(kv_ops.values()))} traced "
+          f"ops, {len(c_spans)} collective spans, clock spread="
+          f"{merged['otherData']['clock_offset_spread_ms']}ms, "
+          f"{len(evs)} merged trace events", flush=True)
     print(f"{tag} divergence black box OK: trigger="
           f"{bundle['trigger']}, fleet drill_steps={fleet_steps}, "
           f"straggler spread={strag['step']['spread']}, "

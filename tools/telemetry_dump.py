@@ -31,6 +31,15 @@ hit rate, and the SLO window summary the monitor mirrored into
 ``info["slo_window"]`` — and Prometheus output adds one serving
 summary comment line (requests by outcome, tokens, queue depth, hit
 rate, SLO alerts).
+
+So does the COMMS plane (docs/observability.md "Comms & sharding
+plane"): JSON output appends a ``comms`` section — every
+``collective_*`` series plus the ``fleet_clock_offset*`` gauges, with
+the per-op payload bandwidth recomputed from the bytes/ms histogram
+sums (the measured column of the ledger) — and Prometheus output adds
+one comms summary comment line (op count, slow events, per-op
+bandwidth, clock spread). A snapshot whose comms plane never armed
+reports the explicit ``comms_reason`` instead.
 """
 
 import argparse
@@ -138,6 +147,53 @@ def serving_section(snap):
     return out
 
 
+_COMMS_PREFIXES = ("collective_", "fleet_clock_offset")
+
+
+def _series_labels(series: str):
+    """The label dict out of a snapshot series name
+    (``base{k="v",...}`` — metrics._series_name sorts and quotes)."""
+    if "{" not in series:
+        return {}
+    inner = series.split("{", 1)[1].rstrip("}")
+    out = {}
+    for part in inner.split(","):
+        if "=" in part:
+            k, v = part.split("=", 1)
+            out[k] = v.strip('"')
+    return out
+
+
+def comms_section(snap):
+    """The comms plane of a registry snapshot: every ``collective_*``
+    series plus the ``fleet_clock_offset*`` gauges, with the per-op
+    payload bandwidth recomputed from the bytes/ms histogram sums —
+    the measured column of the tracer's ledger, recoverable from any
+    scrape. A snapshot whose comms plane never armed gets the explicit
+    ``comms_reason`` (the null-with-reason contract)."""
+    out = _plane(snap, lambda base: base.startswith(_COMMS_PREFIXES))
+    hists = snap.get("histograms") or {}
+    bw = {}
+    for series, h in hists.items():
+        if _series_base(series) != "collective_bytes":
+            continue
+        op = _series_labels(series).get("op")
+        if not op:
+            continue
+        ms = (hists.get(f'collective_ms{{op="{op}"}}') or {}).get(
+            "sum", 0.0)
+        payload = (h or {}).get("sum", 0.0)
+        bw[op] = (round(payload / (ms / 1e3) / 1e6, 4)
+                  if ms and payload else None)
+    if any(out.get(k) for k in ("counters", "gauges", "histograms")):
+        out["collective_bandwidth_mbps"] = bw or None
+    else:
+        out["comms_reason"] = (
+            "no collective tracing in this snapshot "
+            "(telemetry.comms.enable() / APEX_TPU_COMMS=1)")
+    return out
+
+
 def plane_comments(snap) -> str:
     """One summary comment line per plane, appended to the Prometheus
     text (comments are legal exposition; the series themselves render
@@ -175,6 +231,21 @@ def plane_comments(snap) -> str:
             f"queue_depth={depth} prefix_hit_rate={rate} "
             + (f"slo_alerts={alerts} alerting={alerting}"
                if slo is not None else f"slo={sv.get('slo_reason')}"))
+    cm = comms_section(snap)
+    if "comms_reason" in cm:
+        lines.append(f"# comms: unavailable ({cm['comms_reason']})")
+    else:
+        n_ops = int(_counter_total(snap, "collective_ops"))
+        slow = int(_counter_total(snap, "collective_slow_total"))
+        bw = cm.get("collective_bandwidth_mbps") or {}
+        bw_s = " ".join(f"{op}={v}MB/s"
+                        for op, v in sorted(bw.items())
+                        if v is not None) or "n/a"
+        spread = (cm.get("gauges") or {}).get(
+            "fleet_clock_offset_spread_ms")
+        lines.append(f"# comms: {n_ops} collective ops, "
+                     f"slow_events={slow} bandwidth[{bw_s}] "
+                     f"clock_spread_ms={spread}")
     return "\n".join(lines) + "\n"
 
 
@@ -186,6 +257,7 @@ def _emit(snap, fmt, help_source=None) -> None:
         out["compile"] = compile_section(snap)
         out["devmem"] = devmem_section(snap)
         out["serving"] = serving_section(snap)
+        out["comms"] = comms_section(snap)
         print(json.dumps(out, indent=1, sort_keys=True))
         return
     if help_source is not None:
