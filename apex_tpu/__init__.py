@@ -58,6 +58,7 @@ from apex_tpu import rnn  # noqa: E402,F401
 from apex_tpu import fp16_utils  # noqa: E402,F401
 from apex_tpu import runtime  # noqa: E402,F401
 from apex_tpu import telemetry  # noqa: E402,F401  — before resilience (it publishes here)
+from apex_tpu import mesh  # noqa: E402,F401  — GSPMD substrate (needs telemetry)
 from apex_tpu import resilience  # noqa: E402,F401  — needs runtime first
 from apex_tpu import serving  # noqa: E402,F401  — needs telemetry + resilience
 from apex_tpu import profiler  # noqa: E402,F401

@@ -1,0 +1,79 @@
+"""GSPMD mesh substrate: one named mesh for training AND serving.
+
+ROADMAP item 1. Three modules:
+
+- :mod:`~apex_tpu.mesh.mesh` — the process-global named mesh
+  (``batch`` / ``model`` / ``pipe``), :class:`ShardingPlan`, and the
+  fused :class:`MeshTrainStep`; every entry point is identity on a
+  1-device mesh.
+- :mod:`~apex_tpu.mesh.annotate` — ``with_sharding_constraint`` hints
+  for the model interior plus the serving-side checkpoint/KV-pool
+  shardings; no-ops unless a >1-device mesh is armed.
+- :mod:`~apex_tpu.mesh.planner` — the AMP-style (dp, tp, pp) layout
+  search over ``telemetry/cost.py`` + the comms wire-bytes model,
+  returning a ranked :class:`LayoutPlan`.
+
+See ``docs/mesh.md`` for axis conventions, the planner objective, and
+the 1-chip identity guarantee; ``tools/check_mesh.sh`` proves the
+substrate on a forced-8-device CPU.
+"""
+
+from apex_tpu.mesh import annotate, planner
+from apex_tpu.mesh.mesh import (
+    BATCH_AXIS,
+    MESH_AXES,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    MeshTrainStep,
+    ShardingPlan,
+    SubstrateConflictError,
+    axis_sizes,
+    check_substrate_conflict,
+    current_mesh,
+    destroy_mesh,
+    initialize_mesh,
+    make_mesh_train_step,
+    mesh_initialized,
+    mesh_size,
+    plan_gpt,
+    shard_batch,
+    shard_params,
+    shard_state,
+)
+from apex_tpu.mesh.planner import (
+    LayoutPlan,
+    LayoutScore,
+    enumerate_layouts,
+    plan_for_config,
+    plan_layout,
+    publish_plan,
+)
+
+__all__ = [
+    "BATCH_AXIS",
+    "MESH_AXES",
+    "MODEL_AXIS",
+    "PIPE_AXIS",
+    "LayoutPlan",
+    "LayoutScore",
+    "MeshTrainStep",
+    "ShardingPlan",
+    "SubstrateConflictError",
+    "annotate",
+    "axis_sizes",
+    "check_substrate_conflict",
+    "current_mesh",
+    "destroy_mesh",
+    "enumerate_layouts",
+    "initialize_mesh",
+    "make_mesh_train_step",
+    "mesh_initialized",
+    "mesh_size",
+    "plan_for_config",
+    "plan_gpt",
+    "plan_layout",
+    "publish_plan",
+    "shard_batch",
+    "shard_params",
+    "shard_state",
+]

@@ -1,0 +1,318 @@
+"""Automatic (dp, tp, pp) layout planner — AMP-style analytic search.
+
+AMP ("Automatically Finding Model Parallel Strategies", PAPERS.md) and
+TorchTitan's composable 3-D parallelism both replace hand-picked
+parallel layouts with a search: enumerate the legal factorizations of
+the device count, score each against an analytic cost model, rank.
+This module is that search for the GSPMD mesh substrate
+(:mod:`~apex_tpu.mesh.mesh`), built from pieces the repo already owns:
+
+- per-chip peak FLOPs come from the MFU plane's table
+  (``backend_guard.chip_peak_tflops`` via ``telemetry/cost.py``'s
+  ``device_kind``), with an explicit ``peak_source: fallback`` marker
+  on backends the table doesn't know (the CPU CI);
+- collective traffic is priced with the PR-12 comms wire-bytes model
+  (``telemetry.comms.wire_bytes``) — the same analytic column the
+  bandwidth ledger reports, so a plan's predicted wire bytes and a
+  traced run's ledger line are directly comparable.
+
+The model is deliberately coarse (roofline compute + linear wire time
++ the classic ``(pp-1+m)/m`` pipeline bubble + a weights/optimizer/
+activation memory budget): its job is ORDERING layouts, not predicting
+milliseconds. The golden tests pin the orderings that matter (tp-heavy
+above dp-heavy when per-chip memory is tight; pure-dp degenerate on
+one device) and ``bench.py multichip`` records the planner's top
+choice against a hand-picked layout on a real forced-8-device run.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+# conservative CPU-fallback roofline constants: a planner on the CI
+# backend still has to ORDER layouts, so any consistent positive
+# numbers work; the sources are marked in the objective dict
+FALLBACK_PEAK_TFLOPS = 50.0
+FALLBACK_LINK_GBPS = 100.0      # ~one ICI link direction, v4-ish
+ASSUMED_MFU = 0.4
+# AMP-style alpha-beta transport: every collective pays a fixed launch
+# latency on top of bytes/bandwidth — this is what makes the 8*L
+# per-layer tensor-parallel reductions expensive relative to ONE
+# bucketed gradient all-reduce even when their byte counts are close
+COLLECTIVE_LATENCY_MS = 0.01
+# the dp gradient all-reduce overlaps the backward pass (bucketed,
+# DDP-style); tp/pp collectives sit on the critical path and don't
+DP_OVERLAP = 0.5
+FP32 = 4
+
+
+def enumerate_layouts(n_devices: int) -> List[Tuple[int, int, int]]:
+    """All ordered ``(dp, tp, pp)`` with ``dp*tp*pp == n_devices`` —
+    the exact tilings of the device count, nothing else."""
+    n = int(n_devices)
+    if n < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n}")
+    out = []
+    for dp in range(1, n + 1):
+        if n % dp:
+            continue
+        rest = n // dp
+        for tp in range(1, rest + 1):
+            if rest % tp:
+                continue
+            out.append((dp, tp, rest // tp))
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutScore:
+    """One scored layout. ``total_ms`` is the objective (bubble-scaled
+    compute + wire time); ``feasible`` False layouts carry ``reason``
+    and always rank below every feasible one."""
+
+    dp: int
+    tp: int
+    pp: int
+    compute_ms: float
+    comm_ms: float
+    wire_bytes: int
+    mem_bytes_per_device: int
+    feasible: bool
+    reason: Optional[str]
+
+    @property
+    def total_ms(self) -> float:
+        return self.compute_ms + self.comm_ms
+
+    def detail(self) -> Dict[str, Any]:
+        return {
+            "dp": self.dp, "tp": self.tp, "pp": self.pp,
+            "compute_ms": round(self.compute_ms, 4),
+            "comm_ms": round(self.comm_ms, 4),
+            "total_ms": round(self.total_ms, 4),
+            "wire_bytes": int(self.wire_bytes),
+            "mem_bytes_per_device": int(self.mem_bytes_per_device),
+            "feasible": self.feasible,
+            "reason": self.reason,
+        }
+
+
+@dataclasses.dataclass(frozen=True)
+class LayoutPlan:
+    """The ranked answer: ``scores[0]`` is the planner's choice."""
+
+    n_devices: int
+    scores: Tuple[LayoutScore, ...]
+    objective: Dict[str, Any]
+
+    @property
+    def best(self) -> LayoutScore:
+        return self.scores[0]
+
+    def detail(self) -> Dict[str, Any]:
+        """JSON-able plan for bench records / ``snapshot_detail()``."""
+        best = self.best
+        return {
+            "n_devices": self.n_devices,
+            "best": {"dp": best.dp, "tp": best.tp, "pp": best.pp},
+            "objective": dict(self.objective),
+            "scores": [s.detail() for s in self.scores],
+        }
+
+
+def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
+                vocab_size: int, ffn_hidden_size: Optional[int] = None,
+                global_batch: int, seq_len: int,
+                num_heads: Optional[int] = None,
+                mem_budget_bytes: Optional[int] = None,
+                link_gbps: Optional[float] = None,
+                peak_tflops: Optional[float] = None,
+                microbatches: int = 4) -> LayoutPlan:
+    """Score every legal ``(dp, tp, pp)`` tiling of ``n_devices`` for
+    one GPT-shaped training config and return them ranked.
+
+    The cost model, per layout:
+
+    - **compute** — dense-transformer step FLOPs
+      (``6 * tokens * params`` plus the quadratic attention term)
+      spread over all chips at ``peak * ASSUMED_MFU``, scaled by the
+      pipeline bubble ``(pp - 1 + m) / m``;
+    - **comm** — ``telemetry.comms.wire_bytes`` prices the gradient
+      all-reduce across ``dp``, per-layer activation reductions across
+      ``tp``, and microbatch boundary-slab p2p across ``pp``; each
+      plane pays bytes over the link rate plus
+      :data:`COLLECTIVE_LATENCY_MS` per collective (the alpha-beta
+      model), and the dp all-reduce is :data:`DP_OVERLAP`-hidden
+      behind the backward pass;
+    - **memory** — fp32 weights + master + Adam slots
+      (``16 * params / (tp * pp)``) plus an activation slab with the
+      sequence-parallel half split across ``tp``; a layout over
+      ``mem_budget_bytes`` is infeasible (``reason: "memory"``), as is
+      one whose ``tp`` does not divide the head count, ``pp`` over the
+      layer count, or ``dp`` over the global batch.
+    """
+    n = int(n_devices)
+    h = int(hidden_size)
+    L = int(num_layers)
+    v = int(vocab_size)
+    ffn = int(ffn_hidden_size) if ffn_hidden_size else 4 * h
+    B = int(global_batch)
+    S = int(seq_len)
+    m = max(int(microbatches), 1)
+
+    peak_source = "table"
+    if peak_tflops is None:
+        from apex_tpu.backend_guard import chip_peak_tflops
+        from apex_tpu.telemetry import cost as _cost
+
+        peak_tflops = chip_peak_tflops(_cost.device_kind())
+        if peak_tflops is None:
+            peak_tflops, peak_source = FALLBACK_PEAK_TFLOPS, "fallback"
+    else:
+        peak_source = "caller"
+    link_source = "caller"
+    if link_gbps is None:
+        link_gbps, link_source = FALLBACK_LINK_GBPS, "fallback"
+
+    # dense-GPT accounting (same shapes telemetry/cost.py's MFU
+    # denominator assumes): per-layer 4h^2 attn + 2*h*ffn MLP, plus
+    # the embedding/readout table
+    params = v * h + S * h + L * (4 * h * h + 2 * h * ffn + 9 * h)
+    tokens = B * S
+    step_flops = 6 * tokens * params + 12 * L * B * S * S * h
+    # one microbatch's boundary activation slab, and the full
+    # per-device activation residency (~8 live (B,S,h) tensors/layer)
+    act_slab = (B // m if B >= m else B) * S * h * FP32
+    act_total = 8 * B * S * h * L * FP32
+
+    from apex_tpu.telemetry.comms import wire_bytes as _wire
+
+    scores: List[LayoutScore] = []
+    for dp, tp, pp in enumerate_layouts(n):
+        reason = None
+        if num_heads is not None and num_heads % tp:
+            reason = f"tp={tp} does not divide num_heads={num_heads}"
+        elif pp > L:
+            reason = f"pp={pp} exceeds num_layers={L}"
+        elif dp > B:
+            reason = f"dp={dp} exceeds global_batch={B}"
+
+        # memory: weights(4) + master(4) + adam slots(8) live on every
+        # dp replica; activations split across dp*pp, with the
+        # sequence-parallel half further split across tp
+        weight_bytes = 16 * params // (tp * pp)
+        act_bytes = int(act_total * (0.5 + 0.5 / tp) / (dp * pp))
+        mem = weight_bytes + act_bytes
+        if reason is None and mem_budget_bytes is not None \
+                and mem > mem_budget_bytes:
+            reason = (f"memory {mem} exceeds per-chip budget "
+                      f"{int(mem_budget_bytes)}")
+
+        # compute: all chips at roofline, bubble-scaled for pp
+        flops_per_chip = step_flops / n
+        compute_ms = (flops_per_chip
+                      / (peak_tflops * 1e12 * ASSUMED_MFU) * 1e3)
+        compute_ms *= (pp - 1 + m) / m
+
+        # wire: the three planes, each priced with the ledger model,
+        # plus alpha (launch latency) per collective; the dp gradient
+        # all-reduce additionally overlaps the backward pass
+        wire = 0
+        comm_ms = 0.0
+        if dp > 1:                 # ring grad all-reduce ~= reduce-
+            grad_bytes = FP32 * params // (tp * pp)   # scatter + AG
+            dp_wire = 2 * _wire("all_gather", grad_bytes // dp, dp)
+            wire += dp_wire
+            comm_ms += (DP_OVERLAP * dp_wire / (link_gbps * 1e9) * 1e3
+                        + 2 * COLLECTIVE_LATENCY_MS)
+        if tp > 1:                 # 4 activation reductions/layer fwd
+            per = _wire("all_gather", act_slab // dp, tp) // tp  # +4 bwd
+            n_ops = 8 * (L // pp)
+            tp_wire = n_ops * per
+            wire += tp_wire
+            comm_ms += (tp_wire / (link_gbps * 1e9) * 1e3
+                        + n_ops * COLLECTIVE_LATENCY_MS)
+        if pp > 1:                 # boundary slab p2p, fwd + bwd
+            pp_wire = 2 * m * (act_slab // dp)
+            wire += pp_wire
+            comm_ms += (pp_wire / (link_gbps * 1e9) * 1e3
+                        + 2 * m * COLLECTIVE_LATENCY_MS)
+
+        scores.append(LayoutScore(
+            dp=dp, tp=tp, pp=pp, compute_ms=compute_ms,
+            comm_ms=comm_ms, wire_bytes=int(wire),
+            mem_bytes_per_device=int(mem),
+            feasible=reason is None, reason=reason))
+
+    scores.sort(key=lambda s: (not s.feasible, s.total_ms, s.pp, s.tp))
+    objective = {
+        "peak_tflops": float(peak_tflops), "peak_source": peak_source,
+        "link_gbps": float(link_gbps), "link_source": link_source,
+        "assumed_mfu": ASSUMED_MFU, "microbatches": m,
+        "params": int(params), "step_flops": int(step_flops),
+        "mem_budget_bytes": (int(mem_budget_bytes)
+                             if mem_budget_bytes is not None else None),
+        "model": {"hidden_size": h, "num_layers": L, "vocab_size": v,
+                  "ffn_hidden_size": ffn, "global_batch": B,
+                  "seq_len": S, "num_heads": num_heads},
+    }
+    return LayoutPlan(n_devices=n, scores=tuple(scores),
+                      objective=objective)
+
+
+def plan_for_config(cfg, n_devices: int, *, global_batch: int,
+                    **kwargs) -> LayoutPlan:
+    """:func:`plan_layout` from a ``GPTConfig``-shaped object (reads
+    ``hidden_size`` / ``num_layers`` / ``vocab_size`` /
+    ``ffn_hidden_size`` / ``num_heads``)."""
+    return plan_layout(
+        n_devices,
+        hidden_size=cfg.hidden_size,
+        num_layers=cfg.num_layers,
+        vocab_size=cfg.vocab_size,
+        ffn_hidden_size=getattr(cfg, "ffn_hidden_size", None),
+        global_batch=global_batch,
+        seq_len=kwargs.pop("seq_len", None)
+        or getattr(cfg, "max_seq_len", 512),
+        num_heads=(getattr(cfg, "num_heads", None)
+                   or getattr(cfg, "num_attention_heads", None)),
+        **kwargs)
+
+
+def publish_plan(plan: LayoutPlan, *, registry=None) -> Dict[str, Any]:
+    """Land the chosen plan on the telemetry plane: the
+    ``layout_plan`` info blob ``snapshot_detail()`` folds in, plus
+    ``layout_plan_axis{axis=}`` gauges and the predicted step time —
+    so a dashboard shows WHAT layout the planner chose next to the
+    ``sharding_devices{fn=}`` gauges showing what the compiler
+    actually did. Returns the published detail dict."""
+    from apex_tpu.telemetry import metrics as _metrics
+
+    reg = registry if registry is not None else _metrics.registry()
+    detail = plan.detail()
+    best = plan.best
+    axis_g = reg.gauge("layout_plan_axis",
+                       "planner-chosen parallel degree by axis")
+    axis_g.set(best.dp, axis="dp")
+    axis_g.set(best.tp, axis="tp")
+    axis_g.set(best.pp, axis="pp")
+    reg.gauge("layout_plan_total_ms",
+              "planner-predicted step ms of the chosen layout"
+              ).set(best.total_ms)
+    reg.set_info("layout_plan", detail)
+    return detail
+
+
+__all__ = [
+    "ASSUMED_MFU",
+    "FALLBACK_LINK_GBPS",
+    "FALLBACK_PEAK_TFLOPS",
+    "LayoutPlan",
+    "LayoutScore",
+    "enumerate_layouts",
+    "plan_for_config",
+    "plan_layout",
+    "publish_plan",
+]

@@ -36,6 +36,7 @@ from apex_tpu.transformer.tensor_parallel import (
     vocab_parallel_cross_entropy,
 )
 from apex_tpu.transformer.tensor_parallel.layers import _inside_axis
+from apex_tpu.mesh import annotate as _gspmd
 
 
 @dataclasses.dataclass(frozen=True)
@@ -165,6 +166,7 @@ class ParallelAttention(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="qkv",
         )(x)
+        qkv = _gspmd.constrain_column_parallel(qkv)
         s, b = qkv.shape[0], qkv.shape[1]
         qkv = qkv.reshape(s, b, kv_local, (group + 2) * head_dim)
         q, k, v = jnp.split(
@@ -183,6 +185,7 @@ class ParallelAttention(nn.Module):
                 sequence_parallel_enabled=cfg.sequence_parallel,
                 param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="proj",
             )(ctx)
+            out = _gspmd.constrain_hidden(out)
             return (out, kv_new) if return_kv else out
 
         if kv_ctx is not None:
@@ -307,12 +310,13 @@ class ParallelMLP(nn.Module):
             sequence_parallel_enabled=cfg.sequence_parallel,
             param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="fc1",
         )(x)
+        hcol = _gspmd.constrain_column_parallel(hcol)
         hcol = jax.nn.gelu(hcol, approximate=True)
-        return RowParallelLinear(
+        return _gspmd.constrain_hidden(RowParallelLinear(
             output_size=cfg.hidden_size, input_is_parallel=True,
             sequence_parallel_enabled=cfg.sequence_parallel,
             param_dtype=cfg.param_dtype, dtype=cfg.dtype, name="fc2",
-        )(hcol)
+        )(hcol))
 
 
 class GPTLayer(nn.Module):
@@ -421,8 +425,8 @@ class GPTModel(nn.Module):
             pos_emb = jnp.take(pos, positions, axis=0)
             if positions.ndim == 1:
                 pos_emb = pos_emb[None]                   # (1, s, h)
-        x = x + pos_emb.astype(cfg.dtype)
-        x = x.transpose(1, 0, 2)                          # (s, b, h)
+        x = _gspmd.constrain_batch_major(x + pos_emb.astype(cfg.dtype))
+        x = _gspmd.constrain_hidden(x.transpose(1, 0, 2))  # (s, b, h)
 
         if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
             from apex_tpu.transformer.tensor_parallel import (
@@ -485,10 +489,10 @@ class GPTModel(nn.Module):
             )
             x = copy_to_tensor_model_parallel_region(x)
         table = emb.variables["params"]["embedding"]
-        logits = jnp.einsum(
+        logits = _gspmd.constrain_logits(jnp.einsum(
             "sbh,vh->sbv", x.astype(jnp.float32),
             table.astype(jnp.float32),
-        )
+        ))
         if return_kv:
             return logits, kvs
         return logits

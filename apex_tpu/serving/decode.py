@@ -247,6 +247,18 @@ class DecodeStep:
             from apex_tpu.telemetry import compiled as _compiled
 
             _compiled.observe(fn, self._signature(fn, key))
+            from apex_tpu.mesh import mesh as _gspmd_mesh
+
+            if _gspmd_mesh.mesh_initialized() \
+                    and _gspmd_mesh.mesh_size() > 1:
+                # mesh-armed serving: introspect+publish this key's
+                # compiled shardings (sharding_devices{fn=}) BEFORE
+                # the donating dispatch consumes the args — one extra
+                # compile per NEW key, only when a real mesh is live
+                from apex_tpu.telemetry import sharding as _sharding
+
+                _sharding.publish_shardings(
+                    _sharding.jitted_shardings(jitted, *args, fn=fn))
             with _compiled.label(fn):
                 return jitted(*args)
         return jitted(*args)

@@ -168,6 +168,15 @@ def snapshot_detail() -> Dict[str, Any]:
         out["sharding_reason"] = (
             "no sharding introspection published in this process "
             "(telemetry.sharding.publish_shardings)")
+    # the planner's chosen layout, when one was published
+    plan = reg.get_info("layout_plan")
+    if plan:
+        out["layout_plan"] = plan
+    else:
+        out["layout_plan"] = None
+        out["layout_plan_reason"] = (
+            "no layout plan published in this process "
+            "(mesh.planner.publish_plan)")
     return out
 
 

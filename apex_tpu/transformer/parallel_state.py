@@ -62,6 +62,14 @@ def initialize_model_parallel(
     parallel_state.py:196-221), with the CP ring next-innermost.
     """
     global _MESH, _VIRTUAL_PP_RANK, _VIRTUAL_PP_WORLD_SIZE, _PIPELINE_SPLIT_RANK
+    # the two parallel substrates must refuse to half-coexist: a live
+    # GSPMD mesh (apex_tpu/mesh) makes this a structured
+    # SubstrateConflictError, not a silent double-initialization
+    # (lazy import — mesh is the newer plane and must stay optional
+    # here)
+    from apex_tpu.mesh import mesh as _gspmd_mesh
+
+    _gspmd_mesh.check_substrate_conflict("megatron")
     devs = list(devices if devices is not None else jax.devices())
     world = len(devs)
     tp, pp, ep, cp = (

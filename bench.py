@@ -1220,6 +1220,93 @@ def bench_fleet():
     }, "fleet")
 
 
+def bench_multichip():
+    """The multichip matrix record (docs/mesh.md): the AMP-style
+    layout planner's top (dp, tp, pp) choice vs the hand-picked layout
+    the dryrun family uses, both timed as REAL GSPMD train steps on
+    the same >= 8-device mesh (forced-8-device CPU when the backend
+    has fewer, so the record exists off-TPU). Headline: the planner
+    layout's step time; the in-record ``planner_over_manual`` ratio is
+    the acceptance surface (<= 1.0 means the planner at least matched
+    the hand-pick), and the full ranked ``layout_plan`` — per-layout
+    compute/comm/memory scores included — rides the detail, the same
+    plan ``publish_plan`` lands in ``snapshot_detail()``."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import mesh as _mesh
+    from apex_tpu.backend_guard import force_cpu_backend
+    from apex_tpu.models.gpt import GPTConfig, GPTModel
+    from apex_tpu.optimizers import FusedAdam
+
+    if jax.device_count() < 8:
+        force_cpu_backend(8)
+    n = jax.device_count()
+
+    cfg = GPTConfig(hidden_size=128, num_layers=4, num_heads=8,
+                    max_seq_len=64, vocab_size=512,
+                    dtype=jnp.float32, param_dtype=jnp.float32)
+    batch, seq, steps = 8, 64, 3
+    model = GPTModel(cfg)
+    rng = np.random.default_rng(0)
+    tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    # ONE param tree, built before any mesh is armed, shared by every
+    # layout — the comparison times layouts, not inits
+    params = model.init(jax.random.PRNGKey(0), tokens)
+
+    plan = _mesh.plan_for_config(cfg, n, global_batch=batch,
+                                 seq_len=seq)
+    best = plan.best
+    manual = (n // 2, 2, 1)        # the dryrun family's hand-pick
+    candidates = [("planner", (best.dp, best.tp, best.pp)),
+                  ("manual", manual)]
+
+    layouts = []
+    for source, (dp, tp, pp) in candidates:
+        _mesh.initialize_mesh(batch=dp, model=tp, pipe=pp)
+        try:
+            splan = _mesh.plan_gpt(params)
+            step = _mesh.make_mesh_train_step(
+                model, FusedAdam(lr=1e-3, impl="xla"), splan)
+            state = step.init(params)
+            state, loss = step(state, tokens, labels)   # compile
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, loss = step(state, tokens, labels)
+            jax.block_until_ready(loss)
+            ms = (time.perf_counter() - t0) / steps * 1e3
+        finally:
+            _mesh.destroy_mesh()
+        layouts.append({"layout_source": source, "dp": dp, "tp": tp,
+                        "pp": pp, "step_ms": round(ms, 3),
+                        "final_loss": float(loss)})
+
+    _mesh.publish_plan(plan)
+    planner_ms = layouts[0]["step_ms"]
+    manual_ms = layouts[1]["step_ms"]
+    emit({
+        "metric": "multichip_planner_step_ms",
+        "value": planner_ms,
+        "unit": ("ms per GSPMD train step, planner-chosen layout "
+                 "(lower is better)"),
+        "vs_baseline": None,     # filled from the prior run by emit()
+        "detail": {
+            "n_devices": n,
+            "timed_steps": steps,
+            "layouts": layouts,
+            "planner_over_manual": (round(planner_ms / manual_ms, 4)
+                                    if manual_ms else None),
+            "layout_plan": plan.detail(),
+            **backend_detail(),
+        },
+    }, "multichip")
+
+
 def _bench_serving_long_prompt():
     """The serving hot-path record (docs/serving.md "Chunked
     prefill"): a mixed long-prompt workload — ~10% of prompts at
@@ -2028,7 +2115,11 @@ if __name__ == "__main__":
         modes = {"moe": bench_moe, "gpt": bench_gpt, "attn": bench_attn,
                  "resnet": bench_resnet, "bert": bench_bert,
                  "resilience": bench_resilience, "fleet": bench_fleet,
-                 "serving": bench_serving}
+                 "serving": bench_serving,
+                 # LAST in the sweep: it may force the 8-device CPU
+                 # backend, which must not steal the accelerator from
+                 # the modes before it
+                 "multichip": bench_multichip}
         sweep = [("headline", main)] + list(modes.items())
 
         def run_all():

@@ -1,0 +1,156 @@
+"""Layout-planner cost-ordering tests (apex_tpu/mesh/planner.py).
+
+Golden orderings the ISSUE pins: tp-heavy above dp-heavy when per-chip
+memory is over budget (dp replicates weights + optimizer; tp shards
+them), pure-dp degenerate on 1 device; plus the tiling property —
+every emitted plan factorizes the device count exactly.
+"""
+
+import json
+
+import pytest
+
+from apex_tpu.mesh import planner
+
+
+def small_plan(n, **kw):
+    kw.setdefault("hidden_size", 256)
+    kw.setdefault("num_layers", 4)
+    kw.setdefault("vocab_size", 1024)
+    kw.setdefault("global_batch", 8)
+    kw.setdefault("seq_len", 128)
+    kw.setdefault("num_heads", 8)
+    return planner.plan_layout(n, **kw)
+
+
+class TestEnumerate:
+    @pytest.mark.parametrize("n", [1, 2, 4, 6, 8, 12, 16])
+    def test_every_layout_tiles_device_count(self, n):
+        layouts = planner.enumerate_layouts(n)
+        assert layouts, f"no layouts for n={n}"
+        for dp, tp, pp in layouts:
+            assert dp * tp * pp == n
+        assert len(set(layouts)) == len(layouts)
+
+    def test_counts(self):
+        # 8 = 2^3: ordered factorizations into 3 parts = C(3+2,2) = 10
+        assert len(planner.enumerate_layouts(8)) == 10
+        assert planner.enumerate_layouts(1) == [(1, 1, 1)]
+
+    def test_invalid(self):
+        with pytest.raises(ValueError):
+            planner.enumerate_layouts(0)
+
+
+class TestPlanProperties:
+    @pytest.mark.parametrize("n", [1, 2, 4, 8])
+    def test_plan_scores_every_tiling_exactly(self, n):
+        plan = small_plan(n)
+        assert plan.n_devices == n
+        assert len(plan.scores) == len(planner.enumerate_layouts(n))
+        for s in plan.scores:
+            assert s.dp * s.tp * s.pp == n
+            assert s.total_ms == pytest.approx(s.compute_ms + s.comm_ms)
+            assert s.mem_bytes_per_device > 0
+
+    def test_feasible_rank_above_infeasible(self):
+        plan = small_plan(8, mem_budget_bytes=20_000_000)
+        feas = [s.feasible for s in plan.scores]
+        # once the ranking crosses into infeasible it never comes back
+        assert feas == sorted(feas, reverse=True)
+        assert plan.best.feasible
+
+    def test_detail_is_json_able(self):
+        d = small_plan(8).detail()
+        json.dumps(d)
+        assert d["best"]["dp"] * d["best"]["tp"] * d["best"]["pp"] == 8
+        assert len(d["scores"]) == 10
+        assert d["objective"]["peak_source"] in ("table", "fallback",
+                                                 "caller")
+
+
+class TestGoldenOrderings:
+    def test_degenerates_to_pure_dp_on_one_device(self):
+        plan = small_plan(1)
+        assert (plan.best.dp, plan.best.tp, plan.best.pp) == (1, 1, 1)
+        assert plan.best.feasible
+
+    def test_dp_heavy_wins_when_memory_fits(self):
+        """Unconstrained, the ONE bucketed overlap-hidden gradient
+        all-reduce beats 8L per-layer tensor-parallel reductions."""
+        plan = small_plan(8)
+        assert plan.best.tp == 1
+        assert plan.best.dp > 1
+
+    def test_tp_heavy_above_dp_heavy_when_memory_over_budget(self):
+        """dp replicates weights + master + Adam slots on every chip;
+        a budget below that replicated footprint flips the order."""
+        unconstrained = small_plan(8)
+        dp_heavy = next(s for s in unconstrained.scores
+                        if (s.dp, s.tp, s.pp) == (8, 1, 1))
+        # budget between the tp-sharded and fully-replicated footprints
+        budget = dp_heavy.mem_bytes_per_device // 2
+        plan = small_plan(8, mem_budget_bytes=budget)
+
+        def rank(dp, tp, pp):
+            return next(i for i, s in enumerate(plan.scores)
+                        if (s.dp, s.tp, s.pp) == (dp, tp, pp))
+
+        assert rank(1, 8, 1) < rank(8, 1, 1)
+        dp8 = plan.scores[rank(8, 1, 1)]
+        assert not dp8.feasible
+        assert "budget" in dp8.reason
+        tp8 = plan.scores[rank(1, 8, 1)]
+        assert tp8.feasible
+
+    def test_tp_must_divide_heads(self):
+        plan = small_plan(8, num_heads=4)
+        bad = [s for s in plan.scores if s.tp == 8]
+        assert bad and not bad[0].feasible
+        assert "num_heads" in bad[0].reason
+
+    def test_pp_bounded_by_layers(self):
+        plan = small_plan(8, num_layers=4)
+        bad = [s for s in plan.scores if s.pp == 8]
+        assert bad and not bad[0].feasible
+        assert "num_layers" in bad[0].reason
+
+    def test_dp_bounded_by_global_batch(self):
+        plan = small_plan(8, global_batch=4)
+        bad = [s for s in plan.scores if s.dp == 8]
+        assert bad and not bad[0].feasible
+        assert "global_batch" in bad[0].reason
+
+
+class TestPublishPlan:
+    def test_publish_lands_in_snapshot_detail(self):
+        from apex_tpu import telemetry
+        from apex_tpu.telemetry import metrics as tmetrics
+
+        telemetry.reset()
+        try:
+            detail0 = telemetry.snapshot_detail()
+            assert detail0["layout_plan"] is None
+            assert "layout_plan_reason" in detail0
+
+            plan = small_plan(8)
+            out = planner.publish_plan(plan)
+            assert out == plan.detail()
+            g = tmetrics.registry().snapshot()["gauges"]
+            assert g['layout_plan_axis{axis="dp"}'] == plan.best.dp
+            assert g['layout_plan_axis{axis="tp"}'] == plan.best.tp
+            detail = telemetry.snapshot_detail()
+            assert detail["layout_plan"]["best"] == plan.detail()["best"]
+            assert "layout_plan_reason" not in detail
+        finally:
+            telemetry.reset()
+
+    def test_plan_for_config(self):
+        from apex_tpu.models.gpt import GPTConfig
+
+        cfg = GPTConfig(hidden_size=128, num_layers=4, num_heads=8,
+                        max_seq_len=64, vocab_size=512)
+        plan = planner.plan_for_config(cfg, 8, global_batch=8,
+                                       seq_len=64)
+        assert plan.n_devices == 8
+        assert plan.objective["model"]["num_heads"] == 8

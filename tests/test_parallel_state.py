@@ -87,6 +87,46 @@ class TestInitializeModelParallel:
         assert ps.get_data_parallel_world_size() == 2
 
 
+class TestSubstrateConflict:
+    """The two parallel substrates refuse to half-coexist: a live
+    GSPMD mesh (apex_tpu/mesh) makes initialize_model_parallel raise
+    the STRUCTURED SubstrateConflictError (never a bare assert), and
+    vice versa."""
+
+    def test_megatron_refused_while_gspmd_mesh_live(self):
+        from apex_tpu import mesh as gmesh
+
+        gmesh.initialize_mesh(model=2)
+        try:
+            with pytest.raises(gmesh.SubstrateConflictError) as ei:
+                ps.initialize_model_parallel(2, 1)
+            assert ei.value.active == "mesh"
+            assert ei.value.requested == "megatron"
+            assert ei.value.active_axes["model"] == 2
+            assert not ps.model_parallel_is_initialized()
+        finally:
+            gmesh.destroy_mesh()
+
+    def test_gspmd_mesh_refused_while_megatron_live(self):
+        from apex_tpu import mesh as gmesh
+
+        ps.initialize_model_parallel(2, 1)
+        with pytest.raises(gmesh.SubstrateConflictError) as ei:
+            gmesh.initialize_mesh(model=2)
+        assert ei.value.active == "megatron"
+        assert ei.value.requested == "mesh"
+        assert ei.value.active_axes["tensor"] == 2
+        assert not gmesh.mesh_initialized()
+
+    def test_clean_after_destroy(self):
+        from apex_tpu import mesh as gmesh
+
+        gmesh.initialize_mesh(model=2)
+        gmesh.destroy_mesh()
+        ps.initialize_model_parallel(2, 1)    # no conflict raised
+        assert ps.model_parallel_is_initialized()
+
+
 class TestPipelinePredicates:
     def test_first_last_stage(self):
         ps.initialize_model_parallel(1, 4)

@@ -14,7 +14,18 @@ SURFACE = {
     "apex_tpu": ["amp", "optimizers", "normalization", "parallel",
                  "transformer", "contrib", "multi_tensor", "moe", "rnn",
                  "fp16_utils", "runtime", "resilience", "serving",
-                 "profiler", "testing"],
+                 "profiler", "testing", "mesh"],
+    "apex_tpu.mesh": [
+        "BATCH_AXIS", "MODEL_AXIS", "PIPE_AXIS", "MESH_AXES",
+        "initialize_mesh", "destroy_mesh", "current_mesh",
+        "mesh_initialized", "mesh_size", "axis_sizes",
+        "SubstrateConflictError", "check_substrate_conflict",
+        "ShardingPlan", "plan_gpt", "shard_params", "shard_state",
+        "shard_batch", "MeshTrainStep", "make_mesh_train_step",
+        "annotate", "planner",
+        "LayoutPlan", "LayoutScore", "enumerate_layouts",
+        "plan_layout", "plan_for_config", "publish_plan",
+    ],
     "apex_tpu.resilience": [
         "CheckpointManager", "CheckpointError", "RestoredState",
         "NonfiniteWatchdog", "RollbackLimitExceeded", "FaultInjector",

@@ -1,0 +1,217 @@
+#!/usr/bin/env bash
+# Mesh-substrate smoke (CI / pre-merge, next to check_serving.sh and
+# check_telemetry.sh): the mesh unit tier (tests/test_mesh.py +
+# tests/test_mesh_planner.py), then three fresh-process drills on a
+# FORCED 8-device CPU backend proving docs/mesh.md's contracts:
+#  - PARITY: the same GPT train step, no mesh (single-device identity
+#    plan) vs dp=8 GSPMD, produces loss curves identical to fp32
+#    tolerance — the "one set of model code" guarantee,
+#  - SERVING: a model-sharded checkpoint + kv_heads-sharded paged pool
+#    through the real serving DecodeStep is TOKEN-IDENTICAL to the
+#    unsharded engine on the same greedy stream, and
+#  - COMPILE PLANE: with the PR-6 CompileTracker armed, the mesh train
+#    step and the sharded decode loop each mint exactly their warmup
+#    programs and hit ZERO hot-loop recompiles, and the train step
+#    publishes its layouts (sharding_devices{fn="mesh_train_step"}).
+# Extra args pass through to pytest.
+set -uo pipefail
+cd "$(dirname "$0")/.."
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
+
+rc=0
+
+python -m pytest tests/test_mesh.py tests/test_mesh_planner.py \
+    "$@" -q -p no:cacheprovider || rc=1
+
+echo "== parity: no-mesh reference vs dp=8 GSPMD train step =="
+python - <<'PY' || rc=1
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import mesh as gmesh
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.optimizers import FusedAdam
+
+assert jax.device_count() == 8, jax.device_count()
+cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=64,
+                num_layers=2, num_heads=4,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+rng = np.random.RandomState(0)
+toks = jnp.asarray(rng.randint(0, 128, (8, 16)), jnp.int32)
+labels = jnp.asarray(rng.randint(0, 128, (8, 16)), jnp.int32)
+
+
+def run(n_steps=4):
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    if gmesh.mesh_initialized():
+        plan = gmesh.plan_gpt(params)
+    else:
+        from jax.sharding import Mesh
+        one = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1),
+                   gmesh.MESH_AXES)
+        plan = gmesh.plan_gpt(params, mesh=one)
+        assert plan.is_identity()
+    step = gmesh.make_mesh_train_step(
+        model, FusedAdam(lr=1e-3, impl="xla"), plan)
+    state = step.init(params)
+    losses = []
+    for _ in range(n_steps):
+        state, loss = step(state, toks, labels)
+        losses.append(float(loss))
+    return losses
+
+
+ref = run()                                # identity plan, one device
+gmesh.initialize_mesh()                    # pure dp=8 over all devices
+try:
+    assert gmesh.axis_sizes() == {"batch": 8, "pipe": 1, "model": 1}
+    dp = run()
+finally:
+    gmesh.destroy_mesh()
+np.testing.assert_allclose(dp, ref, rtol=2e-5, atol=2e-5)
+assert dp[-1] < dp[0], "loss did not decrease"
+print(f"parity OK: 4 steps, ref {ref[0]:.6f}->{ref[-1]:.6f}, "
+      f"dp=8 matches to fp32 tolerance")
+PY
+
+echo "== serving: model-sharded decode vs unsharded, token identity =="
+python - <<'PY' || rc=1
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import mesh as gmesh
+from apex_tpu.mesh import annotate
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.serving import KVCache, make_decode_step
+
+cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=64,
+                num_layers=2, num_heads=4, num_kv_heads=2,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+model = GPTModel(cfg)
+prompt = jnp.asarray(
+    np.random.RandomState(0).randint(0, 128, (2, 8)), jnp.int32)
+params = model.init(jax.random.PRNGKey(0), prompt)
+
+
+def stream(params, shard_state, n_decode=8):
+    cache = KVCache.for_config(cfg, num_blocks=16, block_size=8)
+    state = shard_state(cache.init_state())
+    step = make_decode_step(model, cache)
+    for i in range(2):
+        cache.allocate(i, 8 + n_decode)
+    tables = cache.table_array([0, 1], width=4)
+    lengths = np.asarray([8, 8], np.int32)
+    out = step.prefill(params, state, prompt, lengths, tables)
+    state, tok = out.cache, out.next_token
+    toks = [np.asarray(tok)]
+    pos = lengths.copy()
+    for _ in range(n_decode - 1):
+        out = step.decode(params, state, np.asarray(tok), pos, tables)
+        state, tok = out.cache, out.next_token
+        pos = pos + 1
+        toks.append(np.asarray(tok))
+    return np.stack(toks)
+
+
+ref = stream(params, lambda s: s)
+gmesh.initialize_mesh(model=2)             # 4-way batch x 2-way model
+try:
+    sharded = stream(annotate.shard_params_for_serving(params),
+                     annotate.shard_kv_pool)
+finally:
+    gmesh.destroy_mesh()
+np.testing.assert_array_equal(sharded, ref)
+print(f"serving OK: {ref.shape[0]} greedy decode steps x "
+      f"{ref.shape[1]} sequences, model-sharded stream token-identical")
+PY
+
+echo "== compile plane: zero hot-loop recompiles, layouts published =="
+python - <<'PY' || rc=1
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import mesh as gmesh, telemetry
+from apex_tpu.mesh import annotate
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.serving import KVCache, make_decode_step
+from apex_tpu.telemetry import compiled as tcompiled
+from apex_tpu.telemetry import metrics as tmetrics
+
+cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=64,
+                num_layers=2, num_heads=4, num_kv_heads=2,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+model = GPTModel(cfg)
+rng = np.random.RandomState(0)
+toks = jnp.asarray(rng.randint(0, 128, (8, 16)), jnp.int32)
+labels = jnp.asarray(rng.randint(0, 128, (8, 16)), jnp.int32)
+
+telemetry.reset()
+gmesh.initialize_mesh()                    # dp=8
+tracker = tcompiled.enable()
+try:
+    params = model.init(jax.random.PRNGKey(0), toks)
+    step = gmesh.make_mesh_train_step(
+        model, FusedAdam(lr=1e-3, impl="xla"), gmesh.plan_gpt(params))
+    state = step.init(params)
+    state, _ = step(state, toks, labels)   # warmup: the one compile
+    for _ in range(10):                    # hot loop
+        state, loss = step(state, toks, labels)
+    del state
+
+    gmesh.destroy_mesh()
+    gmesh.initialize_mesh(model=2)         # sharded decode hot loop
+    cache = KVCache.for_config(cfg, num_blocks=16, block_size=8)
+    cstate = annotate.shard_kv_pool(cache.init_state())
+    sparams = annotate.shard_params_for_serving(params)
+    dstep = make_decode_step(model, cache)
+    for i in range(2):
+        cache.allocate(i, 8 + 12)
+    tables = cache.table_array([0, 1], width=4)
+    prompt = jnp.asarray(rng.randint(0, 128, (2, 8)), jnp.int32)
+    lengths = np.asarray([8, 8], np.int32)
+    out = dstep.prefill(sparams, cstate, prompt, lengths, tables)
+    cstate, tok = out.cache, out.next_token
+    pos = lengths.copy()
+    out = dstep.decode(sparams, cstate, np.asarray(tok), pos, tables)
+    cstate, tok = out.cache, out.next_token   # warmup: mints decode
+    pos = pos + 1
+    warm = dict(tracker.summary()["signatures"])
+    for _ in range(10):                    # hot loop: no new programs
+        out = dstep.decode(sparams, cstate, np.asarray(tok), pos, tables)
+        cstate, tok = out.cache, out.next_token
+        pos = pos + 1
+    jax.block_until_ready(out.next_token)
+
+    s = tracker.summary()
+    assert s["signatures"].get("mesh_train_step") == 1, s["signatures"]
+    assert s["signatures"].get("decode_step") == \
+        warm.get("decode_step"), (s["signatures"], warm)
+    assert s["recompiles"] == 0, f"hot-loop recompiles: {s}"
+    assert s["storms"] == 0, s
+    g = tmetrics.registry().snapshot()["gauges"]
+    assert g.get('sharding_devices{fn="mesh_train_step"}') == 8, \
+        {k: v for k, v in g.items() if "sharding" in k}
+    detail = telemetry.snapshot_detail()
+    assert "mesh_train_step" in (detail["sharding"] or {}), \
+        detail.get("sharding")
+    print(f"compile plane OK: signatures {s['signatures']}, "
+          f"{s['compiles']} compiles all warmup, zero recompiles, "
+          f"sharding_devices published for mesh_train_step")
+finally:
+    tcompiled.disable()
+    gmesh.destroy_mesh()
+    telemetry.reset()
+PY
+
+if [ "$rc" -ne 0 ]; then
+    echo "check_mesh: FAILED" >&2
+else
+    echo "check_mesh: OK"
+fi
+exit "$rc"

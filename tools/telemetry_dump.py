@@ -40,6 +40,14 @@ sums (the measured column of the ledger) — and Prometheus output adds
 one comms summary comment line (op count, slow events, per-op
 bandwidth, clock spread). A snapshot whose comms plane never armed
 reports the explicit ``comms_reason`` instead.
+
+And the MESH plane (docs/mesh.md): JSON output appends a ``mesh``
+section — the ``sharding_devices{fn=}`` / ``sharding_bytes_per_device``
+gauges the GSPMD train step and mesh-armed serving decode publish,
+the ``layout_plan_*`` gauges, and the planner's full ranked
+``layout_plan`` info blob — and Prometheus output adds one mesh
+summary comment line (chosen layout + publishing fns). A snapshot
+with neither published layouts nor a plan reports ``mesh_reason``.
 """
 
 import argparse
@@ -194,6 +202,27 @@ def comms_section(snap):
     return out
 
 
+_MESH_PREFIXES = ("sharding_", "layout_plan")
+
+
+def mesh_section(snap):
+    """The mesh/sharding plane of a registry snapshot (docs/mesh.md):
+    the ``sharding_devices{fn=}`` / ``sharding_bytes_per_device``
+    gauges next to the ``layout_plan_*`` gauges and the planner's
+    ranked ``layout_plan`` info blob — what the compiler DID beside
+    what the planner ASKED for. Null-with-``mesh_reason`` when the
+    snapshot holds neither."""
+    out = _plane(snap, lambda base: base.startswith(_MESH_PREFIXES))
+    plan = (snap.get("info") or {}).get("layout_plan")
+    if plan is not None:
+        out["layout_plan"] = plan
+    if not out.get("gauges") and plan is None:
+        out["mesh_reason"] = (
+            "no sharding layouts or layout plan published in this "
+            "snapshot (mesh.publish_plan / publish_shardings)")
+    return out
+
+
 def plane_comments(snap) -> str:
     """One summary comment line per plane, appended to the Prometheus
     text (comments are legal exposition; the series themselves render
@@ -246,6 +275,17 @@ def plane_comments(snap) -> str:
         lines.append(f"# comms: {n_ops} collective ops, "
                      f"slow_events={slow} bandwidth[{bw_s}] "
                      f"clock_spread_ms={spread}")
+    ms = mesh_section(snap)
+    if "mesh_reason" in ms:
+        lines.append(f"# mesh: unavailable ({ms['mesh_reason']})")
+    else:
+        best = (ms.get("layout_plan") or {}).get("best")
+        fns = sorted({_series_labels(k).get("fn")
+                      for k in (ms.get("gauges") or {})
+                      if _series_base(k) == "sharding_devices"}
+                     - {None})
+        lines.append(f"# mesh: plan={best} "
+                     f"sharding_fns=[{','.join(fns)}]")
     return "\n".join(lines) + "\n"
 
 
@@ -258,6 +298,7 @@ def _emit(snap, fmt, help_source=None) -> None:
         out["devmem"] = devmem_section(snap)
         out["serving"] = serving_section(snap)
         out["comms"] = comms_section(snap)
+        out["mesh"] = mesh_section(snap)
         print(json.dumps(out, indent=1, sort_keys=True))
         return
     if help_source is not None:
