@@ -312,10 +312,15 @@ def tpu_slot_lock(timeout: float = 3600.0):
     touches the non-CPU backend (bench modes, smoke/tune tools) takes
     this flock so runs serialize instead of corrupting each other.
     Reentrant within a process; a lock held by a dead process is
-    released by the OS automatically.
+    released by the OS automatically. A holder that re-execs part of
+    its run in a child process (bench multichip re-launching itself to
+    grow the simulated device count) marks the child with
+    ``APEX_TPU_SLOT_LOCK_HELD=1`` so the child rides the parent's slot
+    instead of deadlocking on the parent's flock.
     """
     path = os.environ.get(_LOCK_PATH_ENV, _DEFAULT_LOCK_PATH)
-    if getattr(tpu_slot_lock, "_held", False):
+    if getattr(tpu_slot_lock, "_held", False) \
+            or os.environ.get("APEX_TPU_SLOT_LOCK_HELD"):
         yield True
         return
     import fcntl

@@ -1,6 +1,6 @@
 """GSPMD mesh substrate: one named mesh for training AND serving.
 
-ROADMAP item 1. Three modules:
+ROADMAP items 1 and 2. Four modules:
 
 - :mod:`~apex_tpu.mesh.mesh` — the process-global named mesh
   (``batch`` / ``model`` / ``pipe``), :class:`ShardingPlan`, and the
@@ -9,16 +9,23 @@ ROADMAP item 1. Three modules:
 - :mod:`~apex_tpu.mesh.annotate` — ``with_sharding_constraint`` hints
   for the model interior plus the serving-side checkpoint/KV-pool
   shardings; no-ops unless a >1-device mesh is armed.
-- :mod:`~apex_tpu.mesh.planner` — the AMP-style (dp, tp, pp) layout
-  search over ``telemetry/cost.py`` + the comms wire-bytes model,
+- :mod:`~apex_tpu.mesh.pipeline` — pipeline schedules on the mesh's
+  ``pipe`` axis (GPipe / 1F1B / interleaved-1F1B, plus the
+  experimental async variant): :class:`PipelineSpec` and the
+  :class:`MeshPipelineTrainStep` that runs the scan-layers GPT over
+  the stages with per-stage ``bubble_fraction`` observability.
+- :mod:`~apex_tpu.mesh.planner` — the AMP-style
+  (dp, tp, pp, schedule, microbatches) layout search over
+  ``telemetry/cost.py`` + the comms wire-bytes model — with the link
+  beta calibrated from the live comms ledger when one is armed —
   returning a ranked :class:`LayoutPlan`.
 
-See ``docs/mesh.md`` for axis conventions, the planner objective, and
-the 1-chip identity guarantee; ``tools/check_mesh.sh`` proves the
-substrate on a forced-8-device CPU.
+See ``docs/mesh.md`` for axis conventions, the schedule diagrams, the
+planner objective, and the 1-chip identity guarantee;
+``tools/check_mesh.sh`` proves the substrate on a forced-8-device CPU.
 """
 
-from apex_tpu.mesh import annotate, planner
+from apex_tpu.mesh import annotate, pipeline, planner
 from apex_tpu.mesh.mesh import (
     BATCH_AXIS,
     MESH_AXES,
@@ -26,9 +33,7 @@ from apex_tpu.mesh.mesh import (
     PIPE_AXIS,
     MeshTrainStep,
     ShardingPlan,
-    SubstrateConflictError,
     axis_sizes,
-    check_substrate_conflict,
     current_mesh,
     destroy_mesh,
     initialize_mesh,
@@ -40,10 +45,19 @@ from apex_tpu.mesh.mesh import (
     shard_params,
     shard_state,
 )
+from apex_tpu.mesh.pipeline import (
+    SCHEDULES,
+    MeshPipelineTrainStep,
+    PipelineSpec,
+    bubble_fraction,
+    make_mesh_pipeline_train_step,
+    make_pipeline_loss_fn,
+)
 from apex_tpu.mesh.planner import (
     LayoutPlan,
     LayoutScore,
     enumerate_layouts,
+    measured_link_gbps,
     plan_for_config,
     plan_layout,
     publish_plan,
@@ -54,24 +68,31 @@ __all__ = [
     "MESH_AXES",
     "MODEL_AXIS",
     "PIPE_AXIS",
+    "SCHEDULES",
     "LayoutPlan",
     "LayoutScore",
+    "MeshPipelineTrainStep",
     "MeshTrainStep",
+    "PipelineSpec",
     "ShardingPlan",
-    "SubstrateConflictError",
     "annotate",
     "axis_sizes",
-    "check_substrate_conflict",
+    "bubble_fraction",
     "current_mesh",
     "destroy_mesh",
     "enumerate_layouts",
     "initialize_mesh",
+    "make_mesh_pipeline_train_step",
     "make_mesh_train_step",
+    "make_pipeline_loss_fn",
+    "measured_link_gbps",
     "mesh_initialized",
     "mesh_size",
+    "pipeline",
     "plan_for_config",
     "plan_gpt",
     "plan_layout",
+    "planner",
     "publish_plan",
     "shard_batch",
     "shard_params",

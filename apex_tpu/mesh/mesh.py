@@ -16,11 +16,13 @@ Three guarantees this module owns:
   entry point (`shard_params` / `shard_state` / `shard_batch`, the
   annotate hooks) returns its input object unchanged, so every
   pre-mesh test path and compiled program is untouched byte for byte.
-- **substrate exclusivity** — the GSPMD mesh and the legacy Megatron
-  group state refuse to half-coexist: initializing either while the
-  other is live raises a structured :class:`SubstrateConflictError`
-  (both directions; `parallel_state.initialize_model_parallel` calls
-  back into :func:`check_substrate_conflict`).
+- **one substrate for execution** — since PR-16 the mesh owns every
+  execution schedule (training, pipeline, serving); what remains of
+  `parallel_state` is trace-scoped explicit-collective layers
+  (shard_map tensor/context parallelism) whose axes only bind inside
+  their own traces, so the two may coexist in one process — the old
+  ``SubstrateConflictError`` exclusivity check is gone with the
+  legacy pipeline runtime that needed it.
 - **one compile, published** — :class:`MeshTrainStep` runs the
   fused-optimizer hot path as ONE donated GSPMD program per layout,
   with compile-plane observation (PR-6 tracker discipline) and its
@@ -45,26 +47,6 @@ PIPE_AXIS = "pipe"
 #: ICI-adjacent devices (the same discipline parallel_state applies to
 #: its "tensor" axis)
 MESH_AXES = (BATCH_AXIS, PIPE_AXIS, MODEL_AXIS)
-
-
-class SubstrateConflictError(RuntimeError):
-    """The GSPMD mesh and the legacy Megatron group state were asked
-    to coexist. Structured: ``active`` / ``requested`` name the
-    substrates (``"mesh"`` or ``"megatron"``), ``active_axes`` the
-    live mesh's axis sizes — enough for a driver to destroy the right
-    one and retry instead of parsing a message."""
-
-    def __init__(self, *, active: str, requested: str,
-                 active_axes: Dict[str, int]):
-        self.active = str(active)
-        self.requested = str(requested)
-        self.active_axes = dict(active_axes)
-        super().__init__(
-            f"cannot initialize the {self.requested!r} parallel substrate: "
-            f"the {self.active!r} substrate is already live with axes "
-            f"{self.active_axes} — the two must not half-coexist "
-            f"(destroy the active one first: mesh.destroy_mesh() / "
-            f"parallel_state.destroy_model_parallel())")
 
 
 # module-level state, the parallel_state._MESH shape
@@ -99,17 +81,6 @@ def axis_sizes() -> Dict[str, int]:
                                            _MESH.devices.shape)}
 
 
-def check_substrate_conflict(requested: str) -> None:
-    """Raise :class:`SubstrateConflictError` when a GSPMD mesh is live
-    and ``requested`` names the other substrate — the hook
-    ``parallel_state.initialize_model_parallel`` calls so the legacy
-    path refuses (structured, not a bare assert) to build groups over
-    a mesh-armed process."""
-    if _MESH is not None:
-        raise SubstrateConflictError(
-            active="mesh", requested=requested, active_axes=axis_sizes())
-
-
 def initialize_mesh(batch: Optional[int] = None, model: int = 1,
                     pipe: int = 1, *,
                     devices: Optional[Sequence] = None):
@@ -118,21 +89,12 @@ def initialize_mesh(batch: Optional[int] = None, model: int = 1,
     ``batch`` defaults to ``n_devices // (model * pipe)`` so the
     common call is ``initialize_mesh(model=2)``. A 1-device mesh is a
     legal, fully-supported degenerate case: every sharding becomes a
-    no-op and the annotate hooks stay disarmed. Refuses (structured)
-    while the legacy Megatron substrate is live.
+    no-op and the annotate hooks stay disarmed.
     """
     global _MESH
     import jax
     from jax.sharding import Mesh
 
-    from apex_tpu.transformer import parallel_state as _ps
-
-    if _ps.model_parallel_is_initialized():
-        legacy = _ps.get_mesh()
-        raise SubstrateConflictError(
-            active="megatron", requested="mesh",
-            active_axes={str(a): int(s) for a, s in
-                         zip(legacy.axis_names, legacy.devices.shape)})
     devs = list(devices if devices is not None else jax.devices())
     world = len(devs)
     model, pipe = int(model), int(pipe)
@@ -340,7 +302,23 @@ class MeshTrainStep:
 
     def init(self, params: Any) -> Any:
         """``opt.init`` then commit the state per the plan (identity
-        on 1 device)."""
+        on 1 device).
+
+        Params are re-replicated BEFORE the flat pack: the eager
+        ravel+pad+concatenate in ``FlatSpace.pack`` mis-propagates
+        mixed per-leaf shardings (the uneven concat can land as an
+        unreduced replica sum), so packing must always see one
+        uniform layout. The master is replicated on the mesh anyway
+        (``ShardingPlan.shard_state``); tensor-parallel layouts come
+        from the plan's activation/param constraints inside the jitted
+        program, not from the packed buffer."""
+        if not self.plan.is_identity():
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            rep = _named(self.plan.mesh, P())
+            params = jax.tree.map(lambda x: jax.device_put(x, rep),
+                                  params)
         return self.plan.shard_state(self.opt.init(params))
 
     def _jit_for(self, state) -> Any:
@@ -433,9 +411,7 @@ __all__ = [
     "MESH_AXES",
     "MeshTrainStep",
     "ShardingPlan",
-    "SubstrateConflictError",
     "axis_sizes",
-    "check_substrate_conflict",
     "current_mesh",
     "destroy_mesh",
     "initialize_mesh",

@@ -66,9 +66,20 @@ def enumerate_layouts(n_devices: int) -> List[Tuple[int, int, int]]:
     return out
 
 
+#: schedules the planner prices when pp > 1 — the ones
+#: :mod:`apex_tpu.mesh.pipeline` can actually run (the experimental
+#: async variant changes training semantics, so the planner does not
+#: auto-pick it)
+PLANNED_SCHEDULES = ("gpipe", "1f1b", "interleaved_1f1b")
+#: model chunks per stage the interleaved candidate assumes
+INTERLEAVE_CHUNKS = 2
+
+
 @dataclasses.dataclass(frozen=True)
 class LayoutScore:
-    """One scored layout. ``total_ms`` is the objective (bubble-scaled
+    """One scored layout — the BEST (schedule, microbatches) candidate
+    for its ``(dp, tp, pp)`` tiling (pp=1 rows carry
+    ``schedule="none"``). ``total_ms`` is the objective (bubble-scaled
     compute + wire time); ``feasible`` False layouts carry ``reason``
     and always rank below every feasible one."""
 
@@ -81,6 +92,11 @@ class LayoutScore:
     mem_bytes_per_device: int
     feasible: bool
     reason: Optional[str]
+    # trailing defaults keep every pre-PR-16 positional construction
+    # (and pickle) working
+    schedule: str = "none"
+    microbatches: int = 0
+    bubble_fraction: float = 0.0
 
     @property
     def total_ms(self) -> float:
@@ -89,6 +105,9 @@ class LayoutScore:
     def detail(self) -> Dict[str, Any]:
         return {
             "dp": self.dp, "tp": self.tp, "pp": self.pp,
+            "schedule": self.schedule,
+            "microbatches": self.microbatches,
+            "bubble_fraction": round(self.bubble_fraction, 6),
             "compute_ms": round(self.compute_ms, 4),
             "comm_ms": round(self.comm_ms, 4),
             "total_ms": round(self.total_ms, 4),
@@ -111,6 +130,14 @@ class LayoutPlan:
     def best(self) -> LayoutScore:
         return self.scores[0]
 
+    def rank_of(self, dp: int, tp: int, pp: int) -> int:
+        """Index of the ``(dp, tp, pp)`` tiling in the ranking (the
+        bench regression gate's lookup)."""
+        for i, s in enumerate(self.scores):
+            if (s.dp, s.tp, s.pp) == (dp, tp, pp):
+                return i
+        raise KeyError(f"no scored layout ({dp}, {tp}, {pp})")
+
     def detail(self) -> Dict[str, Any]:
         """JSON-able plan for bench records / ``snapshot_detail()``."""
         best = self.best
@@ -120,6 +147,49 @@ class LayoutPlan:
             "objective": dict(self.objective),
             "scores": [s.detail() for s in self.scores],
         }
+
+
+def measured_link_gbps() -> Optional[float]:
+    """Link rate calibrated from the live comms ledger, or ``None``.
+
+    Reads the armed :class:`~apex_tpu.telemetry.comms.CommsTracer`'s
+    bandwidth ledger and converts the best observed ``measured_mbps``
+    (MB/s of analytic wire bytes over wall time) to Gbit/s. The MAX
+    across ops is used deliberately: traced transfers overlap compute,
+    so every row is a LOWER bound on the link — the fastest row is the
+    least-masked observation. This is what lets :func:`plan_layout`'s
+    alpha-beta constants come from the machine instead of a datasheet
+    roofline (``link_source: "measured"``)."""
+    from apex_tpu.telemetry import comms as _comms
+
+    tracer = _comms.get_tracer()
+    if tracer is None:
+        return None
+    best = None
+    for row in tracer.ledger():
+        mbps = row.get("measured_mbps")
+        if mbps and (best is None or mbps > best):
+            best = float(mbps)
+    if best is None:
+        return None
+    return best * 8.0 / 1000.0           # MB/s -> Gbit/s
+
+
+def _microbatch_candidates(base_m: int, global_batch: int,
+                           pp: int) -> List[int]:
+    """Microbatch counts one tiling's schedule search tries: the
+    caller's ``microbatches`` and its 2x/4x deepenings, kept to exact
+    divisors of the global batch and at least ``pp`` (fewer
+    microbatches than stages leaves stages idle every tick)."""
+    cands = []
+    for mm in (base_m, 2 * base_m, 4 * base_m):
+        if mm < 1 or mm > global_batch or global_batch % mm:
+            continue
+        if mm < min(pp, global_batch):
+            continue
+        if mm not in cands:
+            cands.append(mm)
+    return cands or [min(base_m, global_batch)]
 
 
 def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
@@ -138,14 +208,27 @@ def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
     - **compute** — dense-transformer step FLOPs
       (``6 * tokens * params`` plus the quadratic attention term)
       spread over all chips at ``peak * ASSUMED_MFU``, scaled by the
-      pipeline bubble ``(pp - 1 + m) / m``;
+      chosen schedule's bubble;
+    - **schedule search** — each pp>1 tiling tries every
+      :data:`PLANNED_SCHEDULES` x microbatch-count candidate
+      (``microbatches`` and its 2x/4x deepenings that divide the
+      batch) and keeps the best; the bubble terms are the analytic
+      :func:`apex_tpu.mesh.pipeline.bubble_fraction` fractions —
+      GPipe/1F1B ``(pp-1)/(m+pp-1)``, interleaved
+      ``(pp-1)/(V*m+pp-1)`` — with 1F1B additionally capping the
+      in-flight activation residency at ``pp`` microbatches (the
+      memory schedule) and interleaved paying V x the boundary
+      traffic;
     - **comm** — ``telemetry.comms.wire_bytes`` prices the gradient
       all-reduce across ``dp``, per-layer activation reductions across
-      ``tp``, and microbatch boundary-slab p2p across ``pp``; each
-      plane pays bytes over the link rate plus
+      ``tp``, and microbatch boundary-slab p2p (``op="ppermute"``)
+      across ``pp``; each plane pays bytes over the link rate plus
       :data:`COLLECTIVE_LATENCY_MS` per collective (the alpha-beta
       model), and the dp all-reduce is :data:`DP_OVERLAP`-hidden
-      behind the backward pass;
+      behind the backward pass. With no caller ``link_gbps`` the beta
+      constant is CALIBRATED from the live comms ledger when one is
+      armed (:func:`measured_link_gbps`, ``link_source:
+      "measured"``), falling back to the datasheet constant;
     - **memory** — fp32 weights + master + Adam slots
       (``16 * params / (tp * pp)``) plus an activation slab with the
       sequence-parallel half split across ``tp``; a layout over
@@ -174,7 +257,11 @@ def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
         peak_source = "caller"
     link_source = "caller"
     if link_gbps is None:
-        link_gbps, link_source = FALLBACK_LINK_GBPS, "fallback"
+        link_gbps = measured_link_gbps()
+        if link_gbps is not None:
+            link_source = "measured"
+        else:
+            link_gbps, link_source = FALLBACK_LINK_GBPS, "fallback"
 
     # dense-GPT accounting (same shapes telemetry/cost.py's MFU
     # denominator assumes): per-layer 4h^2 attn + 2*h*ffn MLP, plus
@@ -184,69 +271,107 @@ def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
     step_flops = 6 * tokens * params + 12 * L * B * S * S * h
     # one microbatch's boundary activation slab, and the full
     # per-device activation residency (~8 live (B,S,h) tensors/layer)
-    act_slab = (B // m if B >= m else B) * S * h * FP32
     act_total = 8 * B * S * h * L * FP32
 
+    from apex_tpu.mesh.pipeline import bubble_fraction as _bubble
     from apex_tpu.telemetry.comms import wire_bytes as _wire
 
     scores: List[LayoutScore] = []
     for dp, tp, pp in enumerate_layouts(n):
-        reason = None
+        base_reason = None
         if num_heads is not None and num_heads % tp:
-            reason = f"tp={tp} does not divide num_heads={num_heads}"
+            base_reason = f"tp={tp} does not divide num_heads={num_heads}"
         elif pp > L:
-            reason = f"pp={pp} exceeds num_layers={L}"
+            base_reason = f"pp={pp} exceeds num_layers={L}"
         elif dp > B:
-            reason = f"dp={dp} exceeds global_batch={B}"
+            base_reason = f"dp={dp} exceeds global_batch={B}"
 
-        # memory: weights(4) + master(4) + adam slots(8) live on every
-        # dp replica; activations split across dp*pp, with the
-        # sequence-parallel half further split across tp
         weight_bytes = 16 * params // (tp * pp)
-        act_bytes = int(act_total * (0.5 + 0.5 / tp) / (dp * pp))
-        mem = weight_bytes + act_bytes
-        if reason is None and mem_budget_bytes is not None \
-                and mem > mem_budget_bytes:
-            reason = (f"memory {mem} exceeds per-chip budget "
-                      f"{int(mem_budget_bytes)}")
-
-        # compute: all chips at roofline, bubble-scaled for pp
         flops_per_chip = step_flops / n
-        compute_ms = (flops_per_chip
-                      / (peak_tflops * 1e12 * ASSUMED_MFU) * 1e3)
-        compute_ms *= (pp - 1 + m) / m
+        base_compute_ms = (flops_per_chip
+                           / (peak_tflops * 1e12 * ASSUMED_MFU) * 1e3)
 
-        # wire: the three planes, each priced with the ledger model,
-        # plus alpha (launch latency) per collective; the dp gradient
-        # all-reduce additionally overlaps the backward pass
-        wire = 0
-        comm_ms = 0.0
-        if dp > 1:                 # ring grad all-reduce ~= reduce-
-            grad_bytes = FP32 * params // (tp * pp)   # scatter + AG
-            dp_wire = 2 * _wire("all_gather", grad_bytes // dp, dp)
-            wire += dp_wire
-            comm_ms += (DP_OVERLAP * dp_wire / (link_gbps * 1e9) * 1e3
-                        + 2 * COLLECTIVE_LATENCY_MS)
-        if tp > 1:                 # 4 activation reductions/layer fwd
-            per = _wire("all_gather", act_slab // dp, tp) // tp  # +4 bwd
-            n_ops = 8 * (L // pp)
-            tp_wire = n_ops * per
-            wire += tp_wire
-            comm_ms += (tp_wire / (link_gbps * 1e9) * 1e3
-                        + n_ops * COLLECTIVE_LATENCY_MS)
-        if pp > 1:                 # boundary slab p2p, fwd + bwd
-            pp_wire = 2 * m * (act_slab // dp)
-            wire += pp_wire
-            comm_ms += (pp_wire / (link_gbps * 1e9) * 1e3
-                        + 2 * m * COLLECTIVE_LATENCY_MS)
+        # the schedule x microbatch candidates this tiling searches
+        if pp == 1:
+            cands = [("none", 0, 1)]
+        else:
+            cands = []
+            for mm in _microbatch_candidates(m, B, pp):
+                for sched in PLANNED_SCHEDULES:
+                    V = (INTERLEAVE_CHUNKS
+                         if sched == "interleaved_1f1b" else 1)
+                    if V > 1 and (mm % pp or L % (pp * V)):
+                        continue     # interleave needs m|pp, L|pp*V
+                    cands.append((sched, mm, V))
 
-        scores.append(LayoutScore(
-            dp=dp, tp=tp, pp=pp, compute_ms=compute_ms,
-            comm_ms=comm_ms, wire_bytes=int(wire),
-            mem_bytes_per_device=int(mem),
-            feasible=reason is None, reason=reason))
+        best = None
+        for sched, mm, V in cands:
+            reason = base_reason
+            bubble = _bubble(sched, pp, max(mm, 1), V) if pp > 1 else 0.0
+            # compute: all chips at roofline, schedule-bubble-scaled —
+            # busy/(busy+bubble) utilization is 1/(1-bubble) slowdown
+            compute_ms = base_compute_ms / (1.0 - bubble)
 
-    scores.sort(key=lambda s: (not s.feasible, s.total_ms, s.pp, s.tp))
+            # memory: weights(4) + master(4) + adam slots(8) live on
+            # every dp replica; activations split across dp*pp with
+            # the sequence-parallel half further split across tp.
+            # GPipe keeps ALL mm microbatches in flight; 1F1B (and
+            # interleaved) cap the residency at pp of them — the
+            # schedule IS a memory knob.
+            act_bytes = act_total * (0.5 + 0.5 / tp) / (dp * pp)
+            if sched in ("1f1b", "interleaved_1f1b") and mm > pp:
+                act_bytes *= pp / mm
+            mem = weight_bytes + int(act_bytes)
+            if reason is None and mem_budget_bytes is not None \
+                    and mem > mem_budget_bytes:
+                reason = (f"memory {mem} exceeds per-chip budget "
+                          f"{int(mem_budget_bytes)}")
+
+            # one microbatch's boundary slab for THIS mm
+            act_slab = (B // mm if 0 < mm <= B else B) * S * h * FP32
+
+            # wire: the three planes, each priced with the ledger
+            # model, plus alpha (launch latency) per collective; the
+            # dp gradient all-reduce additionally overlaps the
+            # backward pass
+            wire = 0
+            comm_ms = 0.0
+            if dp > 1:             # ring grad all-reduce ~= reduce-
+                grad_bytes = FP32 * params // (tp * pp)  # scatter + AG
+                dp_wire = 2 * _wire("all_gather", grad_bytes // dp, dp)
+                wire += dp_wire
+                comm_ms += (DP_OVERLAP * dp_wire / (link_gbps * 1e9)
+                            * 1e3 + 2 * COLLECTIVE_LATENCY_MS)
+            if tp > 1:             # 4 activation reductions/layer fwd
+                per = _wire("all_gather", act_slab // dp, tp) // tp
+                n_ops = 8 * (L // pp)                    # + 4 bwd
+                tp_wire = n_ops * per
+                wire += tp_wire
+                comm_ms += (tp_wire / (link_gbps * 1e9) * 1e3
+                            + n_ops * COLLECTIVE_LATENCY_MS)
+            if pp > 1:             # boundary slab rotations, fwd + bwd
+                n_ops = 2 * mm * V   # each chunk crossing pays a hop
+                pp_wire = n_ops * _wire("ppermute", act_slab // dp, pp)
+                wire += pp_wire
+                comm_ms += (pp_wire / (link_gbps * 1e9) * 1e3
+                            + n_ops * COLLECTIVE_LATENCY_MS)
+
+            cand = LayoutScore(
+                dp=dp, tp=tp, pp=pp, compute_ms=compute_ms,
+                comm_ms=comm_ms, wire_bytes=int(wire),
+                mem_bytes_per_device=int(mem),
+                feasible=reason is None, reason=reason,
+                schedule=sched, microbatches=mm,
+                bubble_fraction=float(bubble))
+            if best is None or (not cand.feasible, cand.total_ms,
+                                cand.mem_bytes_per_device) < \
+                    (not best.feasible, best.total_ms,
+                     best.mem_bytes_per_device):
+                best = cand
+        scores.append(best)
+
+    scores.sort(key=lambda s: (not s.feasible, s.total_ms, s.pp, s.tp,
+                               s.mem_bytes_per_device))
     objective = {
         "peak_tflops": float(peak_tflops), "peak_source": peak_source,
         "link_gbps": float(link_gbps), "link_source": link_source,
@@ -301,6 +426,13 @@ def publish_plan(plan: LayoutPlan, *, registry=None) -> Dict[str, Any]:
     reg.gauge("layout_plan_total_ms",
               "planner-predicted step ms of the chosen layout"
               ).set(best.total_ms)
+    if best.pp > 1:
+        reg.gauge("layout_plan_microbatches",
+                  "planner-chosen pipeline microbatch count"
+                  ).set(best.microbatches, schedule=best.schedule)
+        reg.gauge("layout_plan_bubble_fraction",
+                  "planner-predicted bubble of the chosen schedule"
+                  ).set(best.bubble_fraction, schedule=best.schedule)
     reg.set_info("layout_plan", detail)
     return detail
 
@@ -309,9 +441,12 @@ __all__ = [
     "ASSUMED_MFU",
     "FALLBACK_LINK_GBPS",
     "FALLBACK_PEAK_TFLOPS",
+    "INTERLEAVE_CHUNKS",
     "LayoutPlan",
     "LayoutScore",
+    "PLANNED_SCHEDULES",
     "enumerate_layouts",
+    "measured_link_gbps",
     "plan_for_config",
     "plan_layout",
     "publish_plan",

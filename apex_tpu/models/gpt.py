@@ -9,7 +9,8 @@ layers, so one module serves:
 
   - single device (plain apply; layers degrade to dense)
   - tensor parallel (+ sequence parallel) inside shard_map over "tensor"
-  - pipeline parallel via `spmd_pipeline` (layer stack as stage body)
+  - pipeline parallel on the GSPMD mesh's `pipe` axis (the scan-layers
+    stack split stage-major by `mesh.pipeline.PipelineSpec`)
 
 `gpt_param_specs` derives the PartitionSpec tree for the step boundary
 (the analog of the reference's per-layer process-group wiring).

@@ -1,321 +1,100 @@
-"""Full pretrain-step composition: DP x TP x SP x PP in one SPMD program.
+"""Full pretrain-step composition on the GSPMD mesh.
 
 The TPU answer to the reference's GPT pretraining path (ref:
-tests/L0/run_transformer/run_gpt_minimal_test.py +
-fwd_bwd_pipelining_without_interleaving.py): one `shard_map` over the
-(data, pipe, tensor) mesh containing microbatched pipeline forward,
-backward, data-parallel grad reduction, and the fused optimizer step —
-XLA schedules all collectives (grad psum over data, TP all-reduces,
-pipeline ppermutes) against compute.
+tests/L0/run_transformer/run_gpt_minimal_test.py). Pre-PR-16 this
+module drove a `shard_map` over the legacy (data, pipe, tensor) mesh
+with explicit collectives (grad psum, TP all-reduces, pipeline
+ppermutes); it is now a thin composition over the ONE mesh substrate:
 
-Layout:
-  - embedding / position / final norm / head: replicated over pipe;
-    their grads are psum'd over pipe (only the touching stages
-    contribute — the reference's embedding-group allreduce,
-    ref parallel_state.py:251-276).
-  - transformer layers: stacked (num_layers, ...) pytree, leading dim
-    sharded over pipe; each stage scans its local layers.
-  - TP sharding per gpt_param_specs; batch sharded over data; optimizer
-    state packed from LOCAL shards inside shard_map, so Adam/LAMB state
-    is TP/PP-sharded for free.
+- params are the standard scan-layers :class:`GPTModel` variables tree
+  (one layout for training, pipelining, and serving — no pipeline-
+  specific tree, no layer permutation helpers);
+- dp/tp come from the mesh axes via :func:`apex_tpu.mesh.plan_gpt`'s
+  NamedShardings and the model's annotate hints;
+- pp comes from a :class:`~apex_tpu.mesh.pipeline.PipelineSpec`
+  schedule on the ``pipe`` axis, with XLA inserting the stage-boundary
+  transfers (no ppermute in sight);
+- the optimizer is the fused flat-space step inside the same donated
+  program (:class:`~apex_tpu.mesh.mesh.MeshTrainStep`).
 """
 
 from __future__ import annotations
 
-import functools
-from typing import Any, Callable, Optional, Tuple
+from typing import Any, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
-from jax import lax
-from jax.sharding import Mesh
-from jax.sharding import PartitionSpec as P
 
-from apex_tpu._compat import shard_map
-
-from apex_tpu.models.gpt import GPTConfig, GPTLayer, gpt_param_specs
-from apex_tpu.normalization import FusedLayerNorm
+from apex_tpu.models.gpt import GPTConfig, GPTModel
 from apex_tpu.optimizers.fused import FlatFusedOptimizer
-from apex_tpu.transformer.parallel_state import (
-    DATA_AXIS,
-    PIPELINE_AXIS,
-    TENSOR_AXIS,
-)
-from apex_tpu.transformer.pipeline_parallel.schedules import (
-    forward_backward_pipelining_with_interleaving,
-    last_stage_value,
-    spmd_pipeline,
-)
-from apex_tpu.transformer.tensor_parallel import (
-    VocabParallelEmbedding,
-    copy_to_tensor_model_parallel_region,
-    vocab_parallel_cross_entropy,
-)
-from apex_tpu.transformer.tensor_parallel.layers import _inside_axis
 
 
 def init_gpt_pretrain_params(cfg: GPTConfig, key) -> Any:
-    """Initialize the pipeline-layout GPT param tree (full, unsharded)."""
-    k_emb, k_layers, k_norm = jax.random.split(key, 3)
+    """Initialize the GPT param tree for pretraining — the standard
+    ``GPTModel.init`` variables dict (``{"params": {embedding,
+    position_embedding, layers, final_norm}}``). Since PR-16 there is
+    no separate pipeline layout: the SAME tree feeds the plain mesh
+    step, every pipeline schedule, and the serving engine."""
     dummy_tokens = jnp.zeros((1, cfg.max_seq_len), jnp.int32)
-    emb = VocabParallelEmbedding(
-        num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
-        param_dtype=cfg.param_dtype, dtype=cfg.dtype,
-    )
-    emb_params = emb.init(k_emb, dummy_tokens)["params"]
-    pos = (
-        jax.random.normal(
-            jax.random.fold_in(k_emb, 1),
-            (cfg.max_seq_len, cfg.hidden_size), cfg.param_dtype,
-        )
-        * 0.02
-    )
-    layer = GPTLayer(cfg)
-    dummy_x = jnp.zeros((cfg.max_seq_len, 1, cfg.hidden_size), cfg.dtype)
-    layer_params = jax.vmap(lambda k: layer.init(k, dummy_x)["params"])(
-        jax.random.split(k_layers, cfg.num_layers)
-    )
-    norm_params = FusedLayerNorm(cfg.hidden_size).init(k_norm, dummy_x)["params"]
-    return {
-        "embedding": emb_params,
-        "position_embedding": pos,
-        "layers": layer_params,
-        "final_norm": norm_params,
-    }
-
-
-def gpt_pretrain_param_specs(params: Any) -> Any:
-    """PartitionSpecs for the pipeline-layout tree: TP specs per
-    gpt_param_specs, layers sharded over pipe on the stacked dim."""
-    tp = gpt_param_specs({"params": {
-        "embedding": params["embedding"],
-        "layer_0": params["layers"],
-        "final_norm": params["final_norm"],
-    }})["params"]
-    layers = jax.tree.map(lambda s: P(PIPELINE_AXIS, *s), tp["layer_0"])
-    return {
-        "embedding": tp["embedding"],
-        "position_embedding": P(),
-        "layers": layers,
-        "final_norm": jax.tree.map(lambda _: P(), params["final_norm"]),
-    }
-
-
-def _local_shapes(params: Any, specs: Any, mesh: Mesh) -> Any:
-    """Per-device shard shapes implied by the specs."""
-
-    def one(leaf, spec):
-        shape = list(leaf.shape)
-        for i, ax in enumerate(spec):
-            if ax is None:
-                continue
-            for nm in (ax if isinstance(ax, tuple) else (ax,)):
-                shape[i] //= mesh.shape[nm]
-        return jax.ShapeDtypeStruct(tuple(shape), leaf.dtype)
-
-    return jax.tree.map(one, params, specs,
-                        is_leaf=lambda x: isinstance(x, P))
-
-
-def _opt_state_specs(optimizer: FlatFusedOptimizer, local_params: Any) -> Any:
-    """Specs for the FlatOptState produced inside shard_map: big flat
-    buffers are distinct per device -> sharded jointly over all mesh
-    axes on dim 0; scalars (count, found_inf, flags) are replicated."""
-    state_shape = jax.eval_shape(optimizer.init, local_params)
-    joint = P((DATA_AXIS, PIPELINE_AXIS, TENSOR_AXIS))
-    return jax.tree.map(
-        lambda l: joint if l.ndim >= 1 else P(), state_shape
-    )
-
-
-def interleaved_layer_permutation(num_layers: int, pp: int,
-                                  vpp: int) -> np.ndarray:
-    """Stacked-layer-dim permutation for the interleaved schedule.
-
-    Virtual stage j holds layers [j*L/(pp*vpp), (j+1)*L/(pp*vpp)); rank s
-    hosts virtual stages {c*pp + s}. Sharding the stacked (L, ...) layer
-    tree over the pipe axis hands rank s a CONTIGUOUS block, so the
-    stack must be pre-permuted so that block is exactly rank s's chunks
-    in chunk order — the functional analog of the reference's
-    model-chunk list construction (ref schedules/common.py:30-151 with
-    virtual_pipeline_model_parallel_size).
-    """
-    per_vstage = num_layers // (pp * vpp)
-    order = []
-    for s in range(pp):
-        for c in range(vpp):
-            v = c * pp + s
-            order.extend(range(v * per_vstage, (v + 1) * per_vstage))
-    return np.asarray(order)
+    return GPTModel(cfg).init(key, dummy_tokens)
 
 
 def make_gpt_pretrain_step(
     cfg: GPTConfig,
-    mesh: Mesh,
     optimizer: FlatFusedOptimizer,
     *,
-    num_microbatches: int = 1,
+    schedule: str = "1f1b",
+    num_microbatches: int = 4,
     remat: bool = True,
     num_model_chunks: int = 1,
+    mesh=None,
 ):
-    """Build the jitted full-parallel train step.
+    """Build the mesh-native pretrain step factory.
 
-    Returns (init_opt_fn, step_fn, param_specs):
-      init_opt_fn(params_global) -> opt_state (sharded)
-      step_fn(params, opt_state, tokens, labels) -> (params, opt_state, loss)
-    tokens/labels: (global_batch, seq) int32.
+    Returns ``build(params) -> (step, state)``: ``step`` is a
+    :class:`~apex_tpu.mesh.mesh.MeshTrainStep` (pipe axis 1) or
+    :class:`~apex_tpu.mesh.pipeline.MeshPipelineTrainStep` (pipe axis
+    > 1, running ``schedule`` with ``num_microbatches``), and
+    ``state`` is its committed, DONATED optimizer state — drive the
+    loop as ``state, loss = step(state, tokens, labels)``.
 
-    ``num_model_chunks > 1`` selects the interleaved (virtual-pipeline)
-    schedule. The CALLER owns the layer layout: a stacked layer tree in
-    global order (e.g. a ported checkpoint) must be permuted with
-    :func:`interleaved_layer_permutation` before use so each rank's
-    contiguous pipe shard holds its vpp chunks in chunk order —
-    ``init_gpt_pretrain_params`` does NOT permute (fresh i.i.d. init
-    needs no permutation; ordering only matters for pre-trained
-    weights). The returned specs are unchanged either way.
+    ``mesh`` defaults to the live GSPMD mesh
+    (:func:`apex_tpu.mesh.initialize_mesh` first); with none armed the
+    build degenerates to the identity single-device plan — the same
+    code path, byte-identical programs (the mesh module's 1-chip
+    guarantee). ``num_model_chunks > 1`` selects the interleaved-1F1B
+    schedule regardless of ``schedule``.
     """
-    layer = GPTLayer(cfg)
-    emb_mod = VocabParallelEmbedding(
-        num_embeddings=cfg.vocab_size, embedding_dim=cfg.hidden_size,
-        param_dtype=cfg.param_dtype, dtype=cfg.dtype,
-    )
-    norm_mod = FusedLayerNorm(cfg.hidden_size)
-    pp = mesh.shape[PIPELINE_AXIS]
-    vpp = num_model_chunks
-    if cfg.num_layers % (pp * vpp):
-        raise ValueError(
-            "num_layers must be divisible by pipeline size x model chunks")
+    from apex_tpu import mesh as gmesh
 
-    def pre_fn(params, mb_tokens):
-        x = emb_mod.apply({"params": params["embedding"]}, mb_tokens)
-        s = mb_tokens.shape[1]
-        x = x + params["position_embedding"][:s][None].astype(cfg.dtype)
-        x = x.transpose(1, 0, 2)  # (s, mb, h)
-        if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
-            from apex_tpu.transformer.tensor_parallel import (
-                scatter_to_sequence_parallel_region,
-            )
-            x = scatter_to_sequence_parallel_region(x)
-        return x
+    model = GPTModel(cfg)
 
-    def stage_fn(params, x):
-        def body(h, lp):
-            return layer.apply({"params": lp}, h), None
-
-        y, _ = lax.scan(body, x, params["layers"])
-        return y
-
-    def stage_fn_chunk(params, x, chunk_id):
-        # vpp: this rank's local (L/pp)-layer stack is its vpp chunks in
-        # chunk order (interleaved_layer_permutation layout); scan the
-        # chunk_id-th slice
-        per = cfg.num_layers // (pp * vpp)
-        chunk_layers = jax.tree.map(
-            lambda l: lax.dynamic_slice_in_dim(l, chunk_id * per, per, 0),
-            params["layers"])
-
-        def body(h, lp):
-            return layer.apply({"params": lp}, h), None
-
-        y, _ = lax.scan(body, x, chunk_layers)
-        return y
-
-    def loss_fn_mb(params, y, mb_labels):
-        y = norm_mod.apply({"params": params["final_norm"]}, y)
-        if cfg.sequence_parallel and _inside_axis(TENSOR_AXIS):
-            from apex_tpu.transformer.tensor_parallel import (
-                gather_from_sequence_parallel_region,
-            )
-            y = gather_from_sequence_parallel_region(
-                y, tensor_parallel_output_grad=True
-            )
-        if _inside_axis(TENSOR_AXIS):
-            y = copy_to_tensor_model_parallel_region(y)
-        table = params["embedding"]["embedding"]
-        logits = jnp.einsum(
-            "sbh,vh->sbv", y.astype(jnp.float32), table.astype(jnp.float32)
-        )
-        labels_sb = mb_labels.transpose(1, 0)
-        if _inside_axis(TENSOR_AXIS):
-            losses = vocab_parallel_cross_entropy(logits, labels_sb)
+    def build(params) -> Tuple[Any, Any]:
+        if mesh is not None:
+            plan = gmesh.plan_gpt(params, mesh=mesh)
+        elif gmesh.mesh_initialized():
+            plan = gmesh.plan_gpt(params)
         else:
-            # fused xentropy: saves only the logsumexp residual instead
-            # of re-deriving softmax grads through the XLA lse graph
-            # (ref apex.contrib.xentropy memory story)
-            from apex_tpu.ops import softmax_cross_entropy_loss
+            from jax.sharding import Mesh
+            import numpy as np
 
-            losses = softmax_cross_entropy_loss(logits, labels_sb)
-        return jnp.mean(losses)
-
-    def local_loss(params, tokens, labels):
-        m = num_microbatches
-        mb_tok = tokens.reshape(m, tokens.shape[0] // m, -1)
-        mb_lab = labels.reshape(m, labels.shape[0] // m, -1)
-        # embedding and loss fold INTO the pipeline ticks (stage-0 /
-        # last-stage respectively) and the tick scan is chunk-
-        # checkpointed: saved state ~O(pipeline depth), never all-M
-        # embeddings or logits (see schedules.spmd_pipeline docstring)
-        loss_sum = spmd_pipeline(
-            stage_fn, params, mb_tok, axis_name=PIPELINE_AXIS, remat=remat,
-            pre_fn=pre_fn,
-            loss_fn=lambda y, l: loss_fn_mb(params, y, l),
-            loss_batches=mb_lab,
-        )
-        return loss_sum / m
-
-    def local_loss_vpp(params, tokens, labels):
-        """Interleaved (virtual-pipeline) loss+grads via the staggered
-        tick-scan schedule; loss head takes params so the tied-embedding
-        projection's grads flow."""
-        loss, grads = forward_backward_pipelining_with_interleaving(
-            stage_fn_chunk,
-            lambda p, y, b: loss_fn_mb(p, y, b["labels"]),
-            lambda p, b: pre_fn(p, b["tokens"]),
-            params, {"tokens": tokens, "labels": labels},
-            num_microbatches=num_microbatches, num_model_chunks=vpp,
-            remat=remat, loss_takes_params=True,
-        )
-        return loss, grads
-
-    def step(params, opt_state, tokens, labels):
-        if vpp > 1:
-            loss, grads = local_loss_vpp(params, tokens, labels)
+            one = Mesh(np.asarray(jax.devices()[:1]).reshape(1, 1, 1),
+                       gmesh.MESH_AXES)
+            plan = gmesh.plan_gpt(params, mesh=one)
+        sizes = dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))
+        pp = int(sizes.get(gmesh.PIPE_AXIS, 1))
+        if pp > 1:
+            spec = gmesh.PipelineSpec(
+                schedule=("interleaved_1f1b" if num_model_chunks > 1
+                          else schedule),
+                num_stages=pp,
+                num_microbatches=num_microbatches,
+                num_model_chunks=max(num_model_chunks, 1))
+            step = gmesh.make_mesh_pipeline_train_step(
+                model, optimizer, plan, spec, remat=remat)
         else:
-            loss, grads = jax.value_and_grad(local_loss)(
-                params, tokens, labels)
-        for name in ("embedding", "position_embedding", "final_norm"):
-            grads[name] = jax.tree.map(
-                lambda g: lax.psum(g, PIPELINE_AXIS), grads[name]
-            )
-        grads = jax.tree.map(lambda g: lax.pmean(g, DATA_AXIS), grads)
-        params, opt_state = optimizer.step(opt_state, grads)
-        # reported loss: average over data shards, broadcast from the
-        # last pipeline stage (ref average_losses_across_data_parallel_group)
-        loss = lax.pmean(loss, DATA_AXIS)
-        return params, opt_state, last_stage_value(loss, PIPELINE_AXIS)
-
-    def params_specs(params):
-        return gpt_pretrain_param_specs(params)
-
-    def build(params):
-        specs = params_specs(params)
-        local_params = _local_shapes(params, specs, mesh)
-        opt_specs = _opt_state_specs(optimizer, local_params)
-        init_opt = jax.jit(
-            shard_map(
-                optimizer.init, mesh=mesh, in_specs=(specs,),
-                out_specs=opt_specs, check_vma=False,
-            )
-        )
-        step_fn = jax.jit(
-            shard_map(
-                step, mesh=mesh,
-                in_specs=(specs, opt_specs, P(DATA_AXIS), P(DATA_AXIS)),
-                out_specs=(specs, opt_specs, P()),
-                check_vma=False,
-            )
-        )
-        return init_opt, step_fn, specs
+            step = gmesh.make_mesh_train_step(model, optimizer, plan)
+        state = step.init(params)
+        return step, state
 
     return build

@@ -80,6 +80,8 @@ def wire_bytes(op: str, payload_bytes: int, n_replicas: int) -> int:
         return 4 * n                         # one int32 gathered
     if op == "barrier":
         return 0
+    if op == "ppermute":
+        return int(payload_bytes)            # ring rotation: one hop out
     return int(payload_bytes)                # broadcast_from: src's copy
 
 

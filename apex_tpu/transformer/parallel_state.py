@@ -13,18 +13,23 @@ axes):
     TP group        -> axis "tensor"   (innermost: rides ICI neighbors)
     PP group        -> axis "pipe"
     model group     -> axes ("pipe", "tensor")
-    embedding group -> first/last pp stages (a slice of "pipe")
     sequence-parallel "group" -> same axis as TP (Megatron SP shares it)
     expert-parallel  -> axis "expert" (optional; carved out of "data")
 
-Virtual-pipeline rank bookkeeping for interleaved schedules keeps the
-reference's global-state shape (ref: parallel_state.py:163-176,560-575)
-since it is host-side schedule state, not device state.
+Since PR-16 this module carries NO pipeline schedule state: pipeline
+execution lives on the GSPMD mesh (:mod:`apex_tpu.mesh.pipeline`), and
+the virtual-pp rank bookkeeping / stage predicates / ring-neighbor
+helpers the retired explicit-collective schedules consumed are gone
+with them. What remains — the mesh, world sizes, and in-trace rank
+queries — serves the surviving trace-scoped explicit-collective layers
+(tensor/context/expert parallel), which bind their axes only inside
+their own `shard_map` traces and therefore coexist freely with a live
+GSPMD mesh.
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence, Tuple
+from typing import Optional, Sequence
 
 import jax
 import numpy as np
@@ -39,16 +44,11 @@ CONTEXT_AXIS = "context"
 # module-level state mirroring the reference's group globals
 # (ref: parallel_state.py:33-79)
 _MESH: Optional[Mesh] = None
-_VIRTUAL_PP_RANK: Optional[int] = None
-_VIRTUAL_PP_WORLD_SIZE: Optional[int] = None
-_PIPELINE_SPLIT_RANK: Optional[int] = None
 
 
 def initialize_model_parallel(
     tensor_model_parallel_size: int = 1,
     pipeline_model_parallel_size: int = 1,
-    virtual_pipeline_model_parallel_size: Optional[int] = None,
-    pipeline_model_parallel_split_rank: Optional[int] = None,
     expert_model_parallel_size: int = 1,
     context_parallel_size: int = 1,
     *,
@@ -61,15 +61,7 @@ def initialize_model_parallel(
     (the reference achieves the same by making TP ranks consecutive,
     parallel_state.py:196-221), with the CP ring next-innermost.
     """
-    global _MESH, _VIRTUAL_PP_RANK, _VIRTUAL_PP_WORLD_SIZE, _PIPELINE_SPLIT_RANK
-    # the two parallel substrates must refuse to half-coexist: a live
-    # GSPMD mesh (apex_tpu/mesh) makes this a structured
-    # SubstrateConflictError, not a silent double-initialization
-    # (lazy import — mesh is the newer plane and must stay optional
-    # here)
-    from apex_tpu.mesh import mesh as _gspmd_mesh
-
-    _gspmd_mesh.check_substrate_conflict("megatron")
+    global _MESH
     devs = list(devices if devices is not None else jax.devices())
     world = len(devs)
     tp, pp, ep, cp = (
@@ -84,16 +76,6 @@ def initialize_model_parallel(
             f"tp({tp}) x pp({pp}) x ep({ep}) x cp({cp})"
         )
     dp = world // (tp * pp * ep * cp)
-    if virtual_pipeline_model_parallel_size is not None:
-        if pp <= 2 and virtual_pipeline_model_parallel_size > 1:
-            # interleaving requires >2 stages (ref: parallel_state.py:155-160)
-            raise RuntimeError(
-                "pipeline-model-parallel size should be greater than 2 with "
-                "interleaved schedule"
-            )
-        _VIRTUAL_PP_RANK = 0
-        _VIRTUAL_PP_WORLD_SIZE = virtual_pipeline_model_parallel_size
-    _PIPELINE_SPLIT_RANK = pipeline_model_parallel_split_rank
 
     # context sits just outside tensor so the CP ring (ppermute of KV
     # chunks) also rides ICI-adjacent devices (the reference has no CP;
@@ -165,11 +147,8 @@ def get_mesh() -> Mesh:
 
 def destroy_model_parallel() -> None:
     """ref: parallel_state.py:640-669."""
-    global _MESH, _VIRTUAL_PP_RANK, _VIRTUAL_PP_WORLD_SIZE, _PIPELINE_SPLIT_RANK
+    global _MESH
     _MESH = None
-    _VIRTUAL_PP_RANK = None
-    _VIRTUAL_PP_WORLD_SIZE = None
-    _PIPELINE_SPLIT_RANK = None
 
 
 # -- world sizes (host-side, from mesh shape) ------------------------------
@@ -227,56 +206,3 @@ def get_expert_model_parallel_rank():
 
 def get_context_parallel_rank():
     return jax.lax.axis_index(CONTEXT_AXIS)
-
-
-# -- pipeline-stage predicates (host-side, by stage id) --------------------
-
-
-def is_pipeline_first_stage(stage: int, ignore_virtual: bool = False) -> bool:
-    """ref: parallel_state.py:508-527. ``stage`` is the pp index; in the
-    SPMD schedule the caller iterates stages explicitly."""
-    if not ignore_virtual and _VIRTUAL_PP_WORLD_SIZE is not None:
-        if _VIRTUAL_PP_RANK != 0:
-            return False
-    return stage == 0
-
-
-def is_pipeline_last_stage(stage: int, ignore_virtual: bool = False) -> bool:
-    if not ignore_virtual and _VIRTUAL_PP_WORLD_SIZE is not None:
-        if _VIRTUAL_PP_RANK != (_VIRTUAL_PP_WORLD_SIZE - 1):
-            return False
-    return stage == get_pipeline_model_parallel_world_size() - 1
-
-
-def get_pipeline_model_parallel_next_rank(stage: int) -> int:
-    """ref: parallel_state.py:609-616 (modular neighbors on the pp axis)."""
-    return (stage + 1) % get_pipeline_model_parallel_world_size()
-
-
-def get_pipeline_model_parallel_prev_rank(stage: int) -> int:
-    return (stage - 1) % get_pipeline_model_parallel_world_size()
-
-
-# -- virtual pipeline (interleaving) state ---------------------------------
-
-
-def get_virtual_pipeline_model_parallel_rank() -> Optional[int]:
-    return _VIRTUAL_PP_RANK
-
-
-def set_virtual_pipeline_model_parallel_rank(rank: int) -> None:
-    global _VIRTUAL_PP_RANK
-    _VIRTUAL_PP_RANK = rank
-
-
-def get_virtual_pipeline_model_parallel_world_size() -> Optional[int]:
-    return _VIRTUAL_PP_WORLD_SIZE
-
-
-def get_pipeline_model_parallel_split_rank() -> Optional[int]:
-    return _PIPELINE_SPLIT_RANK
-
-
-def set_pipeline_model_parallel_split_rank(rank: int) -> None:
-    global _PIPELINE_SPLIT_RANK
-    _PIPELINE_SPLIT_RANK = rank

@@ -1,27 +1,18 @@
-"""Pipeline-parallel library (ref: apex/transformer/pipeline_parallel)."""
+"""Pipeline-parallel library (ref: apex/transformer/pipeline_parallel).
+
+Since PR-16 this package holds only the SCHEDULE-AGNOSTIC pieces —
+microbatch-count calculators, microbatch slicing, LM masks, and the
+Timers harness. The explicit-collective schedules and their p2p ring
+(``schedules.py`` / ``p2p_communication.py``) are retired: pipeline
+EXECUTION lives on the GSPMD mesh as :mod:`apex_tpu.mesh.pipeline`
+(GPipe / 1F1B / interleaved-1F1B / async over the mesh's ``pipe``
+axis), where XLA inserts the stage-boundary transfers.
+"""
 
 from apex_tpu.transformer.pipeline_parallel.microbatches import (
     ConstantNumMicroBatches,
     RampupBatchsizeNumMicroBatches,
     build_num_microbatches_calculator,
-)
-from apex_tpu.transformer.pipeline_parallel.p2p_communication import (
-    recv_backward,
-    recv_forward,
-    send_backward,
-    send_backward_recv_backward,
-    send_backward_recv_forward,
-    send_forward,
-    send_forward_recv_backward,
-    send_forward_recv_forward,
-)
-from apex_tpu.transformer.pipeline_parallel.schedules import (
-    forward_backward_no_pipelining,
-    forward_backward_pipelining_with_interleaving,
-    forward_backward_pipelining_without_interleaving,
-    get_forward_backward_func,
-    last_stage_value,
-    spmd_pipeline,
 )
 from apex_tpu.transformer.pipeline_parallel.utils import (
     Timers,

@@ -1099,7 +1099,9 @@ def bench_fleet():
     fleet view — the detected straggler spread, and (docs/
     observability.md "Comms & sharding plane") the per-op collective
     bandwidth ledger + clock-offset spread measured over the same
-    protocol."""
+    protocol. Each simulated host also carries one pipeline stage's
+    ``pipeline_bubble_fraction`` gauge — the merge must keep its
+    ``{schedule=,stage=}`` labels intact per host."""
     import threading
 
     from apex_tpu.resilience.guard import LocalCollective
@@ -1118,9 +1120,17 @@ def bench_fleet():
         # one synthetic host: a private registry + timeline the way a
         # real host's process-global ones would look after sim_steps,
         # with the last host deterministically slow
+        from apex_tpu.mesh.pipeline import bubble_fraction as _bubble
+
         reg = _tmetrics.MetricsRegistry()
         reg.counter("fleet_bench_steps").inc(sim_steps)
         reg.gauge("prefetch_queue_depth").set(2 + r)
+        # each host owns one pipeline stage: its per-stage bubble gauge
+        # (mesh/pipeline.py) must survive the fleet merge label-intact
+        reg.gauge("pipeline_bubble_fraction",
+                  "analytic bubble of the stage this host runs").set(
+            _bubble("1f1b", n_hosts, 8, 1),
+            schedule="1f1b", stage=str(r))
         h = reg.histogram("step_seconds")
         tl = StepTimeline(capacity=4 * sim_steps)
         base = 0.010 * (straggle_factor if r == straggler_host else 1.0)
@@ -1183,6 +1193,13 @@ def bench_fleet():
     strag = fleet["straggler"]["phases"]["step"]
     counters_ok = (fleet["counters"]["fleet_bench_steps"]
                    == n_hosts * sim_steps)
+    # the per-stage pipeline gauge must come through the merge with
+    # its {schedule=,stage=} labels intact, one stage per host
+    pipe_gauges = {k: v for k, v in fleet["gauges"].items()
+                   if k.startswith("pipeline_bubble_fraction")}
+    assert len(pipe_gauges) == n_hosts, (
+        f"expected {n_hosts} per-stage pipeline bubble gauges in the "
+        f"fleet merge, got {sorted(pipe_gauges)}")
     ledger = tracer_out[0].ledger()
     off = offsets_out[0] or {}
     comms_detail = {
@@ -1214,6 +1231,9 @@ def bench_fleet():
             "injected_straggler": {"host": str(straggler_host),
                                    "factor": straggle_factor},
             "fleet_counters_sum_ok": bool(counters_ok),
+            "pipeline_bubble_fraction_fleet": {
+                k: v.get("per_host") for k, v in
+                sorted(pipe_gauges.items())},
             "comms": comms_detail,
             **backend_detail(),
         },
@@ -1221,16 +1241,26 @@ def bench_fleet():
 
 
 def bench_multichip():
-    """The multichip matrix record (docs/mesh.md): the AMP-style
-    layout planner's top (dp, tp, pp) choice vs the hand-picked layout
-    the dryrun family uses, both timed as REAL GSPMD train steps on
-    the same >= 8-device mesh (forced-8-device CPU when the backend
-    has fewer, so the record exists off-TPU). Headline: the planner
-    layout's step time; the in-record ``planner_over_manual`` ratio is
-    the acceptance surface (<= 1.0 means the planner at least matched
-    the hand-pick), and the full ranked ``layout_plan`` — per-layout
-    compute/comm/memory scores included — rides the detail, the same
+    """The multichip matrix record (docs/mesh.md): the schedule-aware
+    layout planner's top (dp, tp, pp, schedule, microbatches) choice
+    vs a rival-layout field — the dryrun family's hand-pick, the
+    dp-only tiling, and a pipelined tiling — all timed as REAL GSPMD
+    train steps (pp>1 rivals run the actual
+    :class:`MeshPipelineTrainStep` schedule the planner scored for
+    that tiling) on the same >= 8-device mesh (forced-8-device CPU
+    when the backend has fewer, so the record exists off-TPU).
+    Headline: the planner layout's median-of-3 step time. Two standing
+    acceptance surfaces ride the detail: ``regression_gate`` — no
+    rival the planner ranked WORSE may beat its pick by more than 5%
+    (``rank_of`` is the lookup) — and ``schedule_family``, which runs
+    gpipe / 1f1b / interleaved_1f1b on ONE fixed dp x pp=2 layout and
+    asserts the interleaved bubble (the ``pipeline_bubble_fraction``
+    gauge, cross-checked against ``step.last_bubble_fraction``) lands
+    strictly below GPipe's. The full ranked ``layout_plan`` — per-
+    layout compute/comm/memory/bubble scores — rides along, the same
     plan ``publish_plan`` lands in ``snapshot_detail()``."""
+    import statistics
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1239,15 +1269,38 @@ def bench_multichip():
     from apex_tpu.backend_guard import force_cpu_backend
     from apex_tpu.models.gpt import GPTConfig, GPTModel
     from apex_tpu.optimizers import FusedAdam
+    from apex_tpu.telemetry import metrics as _tmetrics
 
     if jax.device_count() < 8:
         force_cpu_backend(8)
     n = jax.device_count()
+    if n < 8:
+        # the backend came up small before this mode ran (the sweep's
+        # earlier modes init jax) and this jax cannot grow a live CPU
+        # client (XLA_FLAGS is parsed once per process): re-exec this
+        # ONE mode in a fresh process with the 8-device CPU backend
+        # forced from the environment, riding the parent's TPU slot
+        import os
+        import subprocess
+
+        if os.environ.get("APEX_TPU_MULTICHIP_SUBPROC"):
+            raise RuntimeError(
+                f"multichip needs >= 8 devices, have {n} even in the "
+                f"forced-8-device subprocess")
+        flags = (os.environ.get("XLA_FLAGS", "")
+                 + " --xla_force_host_platform_device_count=8").strip()
+        env = dict(os.environ, XLA_FLAGS=flags, JAX_PLATFORMS="cpu",
+                   APEX_TPU_MULTICHIP_SUBPROC="1",
+                   APEX_TPU_SLOT_LOCK_HELD="1")
+        subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "multichip"],
+            env=env, check=True, timeout=1200)
+        return
 
     cfg = GPTConfig(hidden_size=128, num_layers=4, num_heads=8,
                     max_seq_len=64, vocab_size=512,
                     dtype=jnp.float32, param_dtype=jnp.float32)
-    batch, seq, steps = 8, 64, 3
+    batch, seq, steps, reps = 8, 64, 3, 3
     model = GPTModel(cfg)
     rng = np.random.default_rng(0)
     tokens = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
@@ -1261,46 +1314,128 @@ def bench_multichip():
     plan = _mesh.plan_for_config(cfg, n, global_batch=batch,
                                  seq_len=seq)
     best = plan.best
-    manual = (n // 2, 2, 1)        # the dryrun family's hand-pick
-    candidates = [("planner", (best.dp, best.tp, best.pp)),
-                  ("manual", manual)]
 
-    layouts = []
-    for source, (dp, tp, pp) in candidates:
+    def time_layout(dp, tp, pp, schedule=None, microbatches=None):
+        """Median-of-``reps`` step time of one layout, run the way the
+        planner priced it: plain fused mesh step at pp=1, the scored
+        pipeline schedule at pp>1."""
         _mesh.initialize_mesh(batch=dp, model=tp, pipe=pp)
         try:
             splan = _mesh.plan_gpt(params)
-            step = _mesh.make_mesh_train_step(
-                model, FusedAdam(lr=1e-3, impl="xla"), splan)
+            opt = FusedAdam(lr=1e-3, impl="xla")
+            if pp > 1:
+                spec = _mesh.PipelineSpec(
+                    schedule=schedule, num_stages=pp,
+                    num_microbatches=microbatches,
+                    num_model_chunks=(2 if schedule == "interleaved_1f1b"
+                                      else 1))
+                step = _mesh.make_mesh_pipeline_train_step(
+                    model, opt, splan, spec)
+            else:
+                step = _mesh.make_mesh_train_step(model, opt, splan)
             state = step.init(params)
             state, loss = step(state, tokens, labels)   # compile
             jax.block_until_ready(loss)
-            t0 = time.perf_counter()
-            for _ in range(steps):
-                state, loss = step(state, tokens, labels)
-            jax.block_until_ready(loss)
-            ms = (time.perf_counter() - t0) / steps * 1e3
+            times = []
+            for _ in range(reps):
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    state, loss = step(state, tokens, labels)
+                jax.block_until_ready(loss)
+                times.append((time.perf_counter() - t0) / steps * 1e3)
+            bubble = getattr(step, "last_bubble_fraction", None)
         finally:
             _mesh.destroy_mesh()
-        layouts.append({"layout_source": source, "dp": dp, "tp": tp,
-                        "pp": pp, "step_ms": round(ms, 3),
-                        "final_loss": float(loss)})
+        return statistics.median(times), float(loss), bubble
+
+    def sched_args(dp, tp, pp):
+        """The (schedule, microbatches) the planner scored for this
+        tiling — pp>1 rivals are timed as the pipeline the planner
+        actually priced, not a strawman."""
+        if pp <= 1:
+            return {}
+        row = plan.scores[plan.rank_of(dp, tp, pp)]
+        return {"schedule": (row.schedule if row.schedule != "none"
+                             else "1f1b"),
+                "microbatches": row.microbatches or 4}
+
+    rivals = [("planner", (best.dp, best.tp, best.pp)),
+              ("manual", (n // 2, 2, 1)),   # the dryrun family's pick
+              ("dp_only", (n, 1, 1)),
+              ("pipelined", (n // 2, 1, 2))]
+    seen, layouts = set(), []
+    for source, (dp, tp, pp) in rivals:
+        if (dp, tp, pp) in seen:
+            continue               # planner's pick may BE a rival row
+        seen.add((dp, tp, pp))
+        extra = sched_args(dp, tp, pp)
+        ms, loss, bubble = time_layout(dp, tp, pp, **extra)
+        layouts.append({
+            "layout_source": source, "dp": dp, "tp": tp, "pp": pp,
+            **({"schedule": extra["schedule"],
+                "microbatches": extra["microbatches"],
+                "bubble_fraction": bubble} if extra else {}),
+            "rank": plan.rank_of(dp, tp, pp),
+            "step_ms": round(ms, 3), "final_loss": round(loss, 6)})
+
+    # standing regression gate: a rival the planner ranked WORSE must
+    # not beat the planner's timed pick by more than 5%
+    planner_row = layouts[0]
+    planner_ms = planner_row["step_ms"]
+    violations = [
+        {"layout_source": r["layout_source"], "dp": r["dp"],
+         "tp": r["tp"], "pp": r["pp"], "rank": r["rank"],
+         "speedup_over_planner": round(planner_ms / r["step_ms"], 4)}
+        for r in layouts[1:]
+        if r["rank"] > planner_row["rank"]
+        and r["step_ms"] * 1.05 < planner_ms]
+    gate = {"threshold": 1.05, "ok": not violations,
+            "violations": violations}
+    assert gate["ok"], f"planner pick beaten by >5%: {violations}"
+
+    # schedule family on ONE fixed dp x pp=2 layout: same tiling, same
+    # microbatch count — only the schedule (and so the bubble) moves
+    fam_layout = {"dp": n // 2, "tp": 1, "pp": 2, "microbatches": 4}
+    family = []
+    for sched in ("gpipe", "1f1b", "interleaved_1f1b"):
+        ms, loss, bubble = time_layout(
+            fam_layout["dp"], 1, 2, schedule=sched, microbatches=4)
+        family.append({"schedule": sched, "step_ms": round(ms, 3),
+                       "bubble_fraction": bubble,
+                       "final_loss": round(loss, 6)})
+    bubbles = {f["schedule"]: f["bubble_fraction"] for f in family}
+    # the tentpole's acceptance inequality, on measured gauges: the
+    # per-stage pipeline_bubble_fraction gauge each run emitted must
+    # agree with the step's own bubble, and interleaving must win
+    gauges = _tmetrics.registry().snapshot()["gauges"]
+    for f in family:
+        key = (f'pipeline_bubble_fraction{{schedule="{f["schedule"]}"'
+               f',stage="0"}}')
+        assert gauges.get(key) == f["bubble_fraction"], (
+            f"bubble gauge missing/mismatched for {key}")
+    assert bubbles["interleaved_1f1b"] < bubbles["gpipe"], (
+        f"interleaved bubble {bubbles['interleaved_1f1b']} not below "
+        f"gpipe {bubbles['gpipe']}")
 
     _mesh.publish_plan(plan)
-    planner_ms = layouts[0]["step_ms"]
-    manual_ms = layouts[1]["step_ms"]
+    manual_ms = next((r["step_ms"] for r in layouts
+                      if r["layout_source"] == "manual"), None)
     emit({
         "metric": "multichip_planner_step_ms",
         "value": planner_ms,
-        "unit": ("ms per GSPMD train step, planner-chosen layout "
-                 "(lower is better)"),
+        "unit": ("ms per GSPMD train step, planner-chosen layout, "
+                 "median of 3 timed windows (lower is better)"),
         "vs_baseline": None,     # filled from the prior run by emit()
         "detail": {
             "n_devices": n,
             "timed_steps": steps,
+            "repeats": reps,
             "layouts": layouts,
             "planner_over_manual": (round(planner_ms / manual_ms, 4)
                                     if manual_ms else None),
+            "regression_gate": gate,
+            "schedule_family": {**fam_layout, "schedules": family,
+                                "interleaved_below_gpipe": True},
             "layout_plan": plan.detail(),
             **backend_detail(),
         },

@@ -13,9 +13,15 @@ import sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+    flags = (flags + " --xla_force_host_platform_device_count=8").strip()
+# The tier-1 budget is wall-clock-bound and the suite is dominated by
+# XLA:CPU compile time (~1000 programs); the tests assert numerics and
+# program structure, not generated-code quality, so skip the backend
+# optimization pipeline. Callers who want optimized code (perf smokes)
+# can pre-set the flag themselves.
+if "xla_backend_optimization_level" not in flags:
+    flags = (flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = flags
 
 # The axon TPU-tunnel plugin (injected via sitecustomize at interpreter
 # start) hooks jax backend lookup and blocks CPU-only runs on tunnel
@@ -54,8 +60,29 @@ if not hasattr(jax, "shard_map"):
 
     jax.shard_map = _compat_shard_map
 
+import gc  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# Keep the cyclic-GC young: the suite compiles thousands of programs, and
+# the jaxpr/executable graphs the jit caches keep alive push the gen-2
+# heap into the millions of objects — every full collection then scans
+# all of them, and by mid-suite each test runs ~3x slower than it does
+# standalone (the tier-1 budget is wall-clock-bound on 1-core CPU
+# runners). Freeze the import graph out of collection now, and have the
+# module-scope fixture below drop each module's compiled programs and
+# re-freeze the survivors, so gen-2 scans stay proportional to one
+# module's allocations rather than the whole session's.
+gc.freeze()
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _jax_cache_hygiene():
+    yield
+    jax.clear_caches()
+    gc.collect()
+    gc.freeze()
 
 
 @pytest.fixture

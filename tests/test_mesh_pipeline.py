@@ -1,0 +1,518 @@
+"""Mesh-native pipeline schedule tests (PR-16 tentpole).
+
+The `pipe` axis lights up: GPipe / 1F1B / interleaved-1F1B run the
+scan-layers GPT over the mesh's pipeline axis inside ONE GSPMD program
+(apex_tpu/mesh/pipeline.py). Pinned here:
+
+- spec validation + the analytic bubble algebra;
+- loss parity: every sync schedule reproduces the plain GPTModel loss
+  bit-for-bitwise-stably (pp=2 forced-8-device mesh vs pp=1 reference);
+- the jitted MeshPipelineTrainStep: parity with the plain mesh step,
+  bubble gauge within the analytic bound, compile-plane discipline,
+  per-stage spans + ``pipeline`` info blob + ppermute ledger pricing;
+- the async near-zero-bubble variant (carried boundary buffer);
+- schedule-aware planner pricing (microbatch search dimension,
+  measured-bandwidth calibration);
+- the schedule-agnostic toolbox migrated from the retired
+  explicit-collective suite (microbatch calculators, LM masks).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from apex_tpu import mesh as gmesh
+from apex_tpu.mesh import planner
+from apex_tpu.mesh.pipeline import (
+    SCHEDULES,
+    MeshPipelineTrainStep,
+    PipelineSpec,
+    bubble_fraction,
+    make_mesh_pipeline_train_step,
+    make_pipeline_loss_fn,
+)
+from apex_tpu.models.gpt import GPTConfig, GPTModel, gpt_loss_fn
+from apex_tpu.optimizers import FusedAdam
+
+
+def tiny_cfg(layers=4):
+    return GPTConfig(
+        vocab_size=64, max_seq_len=16, hidden_size=32,
+        num_layers=layers, num_heads=4, dtype=jnp.float32,
+    )
+
+
+def tiny_data(batch=4, seq=16, vocab=64, seed=7):
+    toks = np.random.RandomState(seed).randint(0, vocab, (batch, seq + 1))
+    toks = jnp.asarray(toks, jnp.int32)
+    return toks[:, :-1], toks[:, 1:]
+
+
+@pytest.fixture(autouse=True)
+def clean_mesh():
+    gmesh.destroy_mesh()
+    yield
+    gmesh.destroy_mesh()
+
+
+class TestPipelineSpec:
+    def test_schedules_tuple(self):
+        assert SCHEDULES == ("gpipe", "1f1b", "interleaved_1f1b",
+                             "async_1f1b")
+
+    def test_unknown_schedule_raises(self):
+        with pytest.raises(ValueError, match="unknown schedule"):
+            PipelineSpec(schedule="zb-h1")
+
+    def test_interleaved_needs_chunks(self):
+        with pytest.raises(ValueError, match="num_model_chunks"):
+            PipelineSpec(schedule="interleaved_1f1b", num_stages=2,
+                         num_microbatches=4, num_model_chunks=1)
+
+    def test_interleaved_needs_divisible_microbatches(self):
+        with pytest.raises(ValueError, match="divisible"):
+            PipelineSpec(schedule="interleaved_1f1b", num_stages=4,
+                         num_microbatches=6, num_model_chunks=2)
+
+    def test_non_interleaved_rejects_chunks(self):
+        with pytest.raises(ValueError, match="one model chunk"):
+            PipelineSpec(schedule="1f1b", num_stages=2,
+                         num_microbatches=4, num_model_chunks=2)
+
+    def test_ticks_and_busy(self):
+        s = PipelineSpec(schedule="1f1b", num_stages=4, num_microbatches=8)
+        assert s.ticks == 11               # m + S - 1
+        assert s.busy_ticks_per_stage == 8
+        v = PipelineSpec(schedule="interleaved_1f1b", num_stages=4,
+                         num_microbatches=8, num_model_chunks=2)
+        assert v.ticks == 19               # V*m + S - 1
+        assert v.busy_ticks_per_stage == 16
+        a = PipelineSpec(schedule="async_1f1b", num_stages=4,
+                         num_microbatches=8)
+        assert a.ticks == 8                # steady state: m ticks/step
+
+    def test_stage_layers(self):
+        s = PipelineSpec(schedule="interleaved_1f1b", num_stages=2,
+                         num_microbatches=4, num_model_chunks=2)
+        assert s.stage_layers(8) == 2
+        with pytest.raises(ValueError, match="num_layers"):
+            s.stage_layers(6)
+
+    def test_detail_is_jsonable(self):
+        import json
+
+        d = PipelineSpec(schedule="gpipe", num_stages=2,
+                         num_microbatches=4).detail()
+        assert json.loads(json.dumps(d)) == d
+        assert d["bubble_fraction"] == pytest.approx(1 / 5)
+
+
+class TestBubbleAlgebra:
+    def test_gpipe_equals_1f1b(self):
+        # same fill/drain geometry; 1f1b differs in MEMORY, not bubble
+        assert bubble_fraction("gpipe", 4, 8) == \
+            bubble_fraction("1f1b", 4, 8) == pytest.approx(3 / 11)
+
+    def test_interleaving_strictly_shrinks_bubble(self):
+        for s, m in [(2, 4), (4, 8), (8, 16)]:
+            assert bubble_fraction("interleaved_1f1b", s, m, 2) < \
+                bubble_fraction("1f1b", s, m)
+
+    def test_more_microbatches_shrink_bubble(self):
+        assert bubble_fraction("1f1b", 4, 16) < bubble_fraction("1f1b", 4, 4)
+
+    def test_async_and_degenerate_are_zero(self):
+        assert bubble_fraction("async_1f1b", 4, 8) == 0.0
+        assert bubble_fraction("1f1b", 1, 8) == 0.0
+
+
+@pytest.fixture(scope="module")
+def parity_losses():
+    """Eager (un-jitted) pipeline loss of every sync schedule on a live
+    pp=2 mesh, against the plain GPTModel loss on the SAME params."""
+    gmesh.destroy_mesh()
+    cfg = tiny_cfg(layers=4)
+    x, y = tiny_data()
+    gmesh.initialize_mesh(pipe=2)       # dp=4 x pp=2
+    try:
+        model = GPTModel(cfg)
+        params = model.init(jax.random.PRNGKey(0), x)
+        ref = float(gpt_loss_fn(model.apply(params, x), y))
+        out = {"ref": ref}
+        for name, spec in [
+            ("gpipe", PipelineSpec("gpipe", 2, 2)),
+            ("1f1b", PipelineSpec("1f1b", 2, 2)),
+            ("1f1b_m4", PipelineSpec("1f1b", 2, 4)),
+            ("interleaved", PipelineSpec("interleaved_1f1b", 2, 2, 2)),
+        ]:
+            lf = make_pipeline_loss_fn(model, spec)
+            out[name] = float(lf(params, x, y))
+            out[name + "_again"] = float(lf(params, x, y))
+        yield out
+    finally:
+        gmesh.destroy_mesh()
+
+
+class TestLossFnParity:
+    @pytest.mark.parametrize("name", ["gpipe", "1f1b", "interleaved"])
+    def test_matches_plain_model(self, parity_losses, name):
+        np.testing.assert_allclose(parity_losses[name],
+                                   parity_losses["ref"], rtol=2e-5)
+
+    def test_gpipe_1f1b_bitwise_equal(self, parity_losses):
+        # 1f1b = gpipe + chunked remat: identical VALUES by construction
+        assert parity_losses["gpipe"] == parity_losses["1f1b"]
+
+    def test_microbatch_accumulation_stable(self, parity_losses):
+        # re-running the same decomposition is bitwise stable, and the
+        # microbatch count only redistributes the mean
+        for name in ("gpipe", "1f1b", "interleaved"):
+            assert parity_losses[name] == parity_losses[name + "_again"]
+        np.testing.assert_allclose(parity_losses["1f1b_m4"],
+                                   parity_losses["1f1b"], rtol=2e-5)
+
+
+@pytest.fixture(scope="module")
+def step_run():
+    """ONE jitted MeshPipelineTrainStep run (dp=4 x pp=2, 1f1b) next to
+    the pp=1 plain-mesh reference, with the full observability plane
+    armed — module-scoped so the two XLA compiles happen once."""
+    from apex_tpu import telemetry
+    from apex_tpu.telemetry import comms as tcomms
+    from apex_tpu.telemetry import compiled as tcompiled
+    from apex_tpu.telemetry import metrics as tmetrics
+    from apex_tpu.telemetry import timeline as ttimeline
+
+    gmesh.destroy_mesh()
+    telemetry.reset()
+    cfg = tiny_cfg(layers=2)
+    x, y = tiny_data(batch=8)           # divisible by the dp=8 reference
+    out = {"cfg": cfg, "batch": 8}
+
+    # pp=1 reference (dp=8)
+    gmesh.initialize_mesh()
+    model = GPTModel(cfg)
+    params = model.init(jax.random.PRNGKey(1), x)
+    params = jax.device_get(params)     # host copy, reused on both meshes
+    rstep = gmesh.make_mesh_train_step(
+        model, FusedAdam(lr=1e-3, impl="xla"), gmesh.plan_gpt(params))
+    rstate = rstep.init(params)
+    ref_losses = []
+    for _ in range(3):
+        rstate, loss = rstep(rstate, x, y)
+        ref_losses.append(float(loss))
+    out["ref_losses"] = ref_losses
+    gmesh.destroy_mesh()
+
+    # pp=2 pipelined run, telemetry armed
+    gmesh.initialize_mesh(pipe=2)
+    try:
+        ttimeline.enable()
+        tcomms.enable()
+        tracker = tcompiled.enable()
+        step = make_mesh_pipeline_train_step(
+            model, FusedAdam(lr=1e-3, impl="xla"), gmesh.plan_gpt(params),
+            schedule="1f1b", num_microbatches=2)
+        out["spec"] = step.spec
+        state = step.init(params)
+        pipe_losses = []
+        for _ in range(3):
+            state, loss = step(state, x, y)
+            pipe_losses.append(float(loss))
+        out["pipe_losses"] = pipe_losses
+        out["bubble"] = step.last_bubble_fraction
+        out["compiled"] = tracker.summary()
+        out["gauges"] = tmetrics.registry().snapshot()["gauges"]
+        out["info"] = tmetrics.registry().snapshot()["info"]
+        out["ledger"] = tcomms.get_tracer().ledger()
+        out["spans"] = [s for s in ttimeline.get_timeline().spans()
+                        if s.category == "pipeline"]
+        # regression (PR-16): init() must tolerate params that arrive
+        # COMMITTED with mixed per-leaf shardings — the flat pack once
+        # mis-propagated them into a corrupt master
+        plan = gmesh.plan_gpt(params)
+        state2 = step.init(plan.shard_params(
+            jax.tree.map(jnp.asarray, params)))
+        _, loss2 = step(state2, x, y)
+        out["presharded_first_loss"] = float(loss2)
+        yield out
+    finally:
+        telemetry.reset()
+        gmesh.destroy_mesh()
+
+
+class TestMeshPipelineTrainStep:
+    def test_losses_match_pp1_reference(self, step_run):
+        np.testing.assert_allclose(step_run["pipe_losses"],
+                                   step_run["ref_losses"], rtol=2e-5)
+
+    def test_bubble_gauge_within_analytic_bound(self, step_run):
+        spec = step_run["spec"]
+        assert step_run["bubble"] == pytest.approx(spec.bubble)
+        g = step_run["gauges"]
+        for s in range(spec.num_stages):
+            key = ('pipeline_bubble_fraction'
+                   f'{{schedule="1f1b",stage="{s}"}}')
+            assert g[key] == pytest.approx(spec.bubble)
+        assert g['pipeline_ticks{schedule="1f1b"}'] == spec.ticks
+
+    def test_compile_plane_zero_hot_recompiles(self, step_run):
+        s = step_run["compiled"]
+        assert s["signatures"].get("mesh_pipeline_step") == 1
+        assert s["recompiles"] == 0
+
+    def test_stage_spans_and_info_blob(self, step_run):
+        spec = step_run["spec"]
+        names = {s.name for s in step_run["spans"]}
+        assert names == {f"pipeline:stage{i}"
+                         for i in range(spec.num_stages)}
+        info = step_run["info"]["pipeline"]
+        assert info["schedule"] == "1f1b"
+        assert info["num_stages"] == spec.num_stages
+        assert len(info["stages"]) == spec.num_stages
+        assert info["step_ms"] > 0
+
+    def test_boundary_transfers_priced(self, step_run):
+        rows = [r for r in step_run["ledger"] if r["op"] == "ppermute"]
+        assert rows, "no ppermute pricing rows in the comms ledger"
+        cfg, spec = step_run["cfg"], step_run["spec"]
+        mbs = step_run["batch"] // spec.num_microbatches
+        slab = 16 * mbs * cfg.hidden_size * 4
+        # the ledger aggregates per op: one record per step, each
+        # pricing `ticks` rotations of one boundary slab
+        row = rows[0]
+        assert row["wire_bytes"] == slab * spec.ticks * row["calls"]
+        assert row["measured_mbps"] is None or row["measured_mbps"] > 0
+
+    def test_init_accepts_presharded_params(self, step_run):
+        np.testing.assert_allclose(step_run["presharded_first_loss"],
+                                   step_run["ref_losses"][0], rtol=2e-5)
+
+
+class TestAsyncSchedule:
+    def test_trains_and_resets(self, rng):
+        cfg = tiny_cfg(layers=2)
+        x, y = tiny_data(seed=3)
+        gmesh.initialize_mesh(pipe=2)
+        step = make_mesh_pipeline_train_step(
+            GPTModel(cfg), FusedAdam(lr=2e-3, impl="xla"),
+            gmesh.plan_gpt(
+                GPTModel(cfg).init(jax.random.PRNGKey(0), x)),
+            schedule="async_1f1b", num_microbatches=2)
+        params = GPTModel(cfg).init(jax.random.PRNGKey(0), x)
+        state = step.init(params)
+        losses = []
+        for _ in range(6):
+            state, loss = step(state, x, y)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        # warm-up ticks are masked out of the mean, so even step 0 is a
+        # valid (finite, decreasing-trend) loss
+        assert losses[-1] < losses[0]
+        assert step.last_bubble_fraction == 0.0
+        assert step._pipe_buf is not None
+        step.reset_pipeline()
+        assert step._pipe_buf is None
+        state, loss = step(state, x, y)     # re-warms cleanly
+        assert np.isfinite(float(loss))
+
+
+class TestPlannerSchedules:
+    HEAVY = dict(hidden_size=4096, num_layers=32, num_heads=32,
+                 vocab_size=50257, seq_len=2048, global_batch=64,
+                 mem_budget_bytes=16 * 2**30)
+
+    def test_pp_candidates_carry_schedule(self):
+        plan = planner.plan_layout(8, **self.HEAVY)
+        pp_scores = [s for s in plan.scores if s.pp > 1]
+        assert pp_scores
+        for s in pp_scores:
+            assert s.schedule in planner.PLANNED_SCHEDULES
+            assert s.microbatches > 0
+            assert 0.0 < s.bubble_fraction < 1.0
+            assert s.bubble_fraction == pytest.approx(bubble_fraction(
+                s.schedule, s.pp, s.microbatches,
+                planner.INTERLEAVE_CHUNKS
+                if s.schedule == "interleaved_1f1b" else 1))
+
+    def test_dp_only_layouts_have_no_schedule(self):
+        plan = planner.plan_layout(8, **self.HEAVY)
+        for s in plan.scores:
+            if s.pp == 1:
+                assert s.schedule == "none"
+                assert s.bubble_fraction == 0.0
+
+    def test_score_count_still_matches_enumeration(self):
+        # the schedule x microbatch search collapses to the best
+        # candidate per tiling — the score list stays one row per layout
+        plan = planner.plan_layout(8, **self.HEAVY)
+        assert len(plan.scores) == len(planner.enumerate_layouts(8))
+
+    def test_rank_of(self):
+        plan = planner.plan_layout(8, **self.HEAVY)
+        best = plan.best
+        assert plan.rank_of(best.dp, best.tp, best.pp) == 0
+        with pytest.raises(KeyError):
+            plan.rank_of(3, 3, 3)
+
+    def test_measured_link_calibration(self):
+        from apex_tpu.telemetry import comms as tcomms
+
+        tcomms.disable()
+        assert planner.measured_link_gbps() is None
+        tracer = tcomms.enable()
+        try:
+            # synthetic 1 GB in 1 s => 8 Gbps
+            tracer.record("all_reduce", "gspmd", 10**9, 10**9, 0.0, 1.0)
+            gbps = planner.measured_link_gbps()
+            assert gbps == pytest.approx(8.0, rel=1e-3)
+            plan = planner.plan_layout(8, **self.HEAVY)
+            obj = plan.detail()["objective"]
+            assert obj["link_source"] == "measured"
+            assert obj["link_gbps"] == pytest.approx(gbps, rel=1e-3)
+        finally:
+            tcomms.disable()
+
+    def test_publish_plan_pipeline_gauges(self):
+        from apex_tpu import telemetry
+        from apex_tpu.telemetry import metrics as tmetrics
+
+        telemetry.reset()
+        try:
+            plan = planner.plan_layout(8, **self.HEAVY)
+            planner.publish_plan(plan)
+            g = tmetrics.registry().snapshot()["gauges"]
+            if plan.best.pp > 1:
+                sched = plan.best.schedule
+                assert g['layout_plan_microbatches'
+                         f'{{schedule="{sched}"}}'] == \
+                    plan.best.microbatches
+                assert g['layout_plan_bubble_fraction'
+                         f'{{schedule="{sched}"}}'] == \
+                    pytest.approx(plan.best.bubble_fraction)
+            assert g['layout_plan_axis{axis="pp"}'] == plan.best.pp
+        finally:
+            telemetry.reset()
+
+
+# -- migrated from the retired explicit-collective suite ----------------
+# (tests/test_pipeline_parallel.py): the schedule-agnostic toolbox that
+# survives in apex_tpu/transformer/pipeline_parallel
+
+
+class TestMicrobatches:
+    def test_constant(self):
+        from apex_tpu.transformer.pipeline_parallel import (
+            ConstantNumMicroBatches,
+        )
+
+        c = ConstantNumMicroBatches(64, 4, 2)
+        assert c.get() == 8
+        assert c.get_current_global_batch_size() == 64
+
+    def test_constant_indivisible_raises(self):
+        from apex_tpu.transformer.pipeline_parallel import (
+            ConstantNumMicroBatches,
+        )
+
+        with pytest.raises(ValueError):
+            ConstantNumMicroBatches(65, 4, 2)
+
+    def test_rampup(self):
+        from apex_tpu.transformer.pipeline_parallel import (
+            RampupBatchsizeNumMicroBatches,
+        )
+
+        r = RampupBatchsizeNumMicroBatches(
+            start_batch_size=16, batch_size_increment=16,
+            ramup_samples=1000, global_batch_size=64, micro_batch_size=4,
+            data_parallel_size=2,
+        )
+        assert r.get_current_global_batch_size() == 16
+        r.update(500, False)  # 500/(1000/3) -> 1 increment
+        assert r.get_current_global_batch_size() == 32
+        r.update(2000, False)
+        assert r.get_current_global_batch_size() == 64
+        assert r.get() == 8
+
+    def test_kth_microbatch(self, rng):
+        from apex_tpu.transformer.pipeline_parallel import (
+            get_kth_microbatch,
+        )
+
+        batch = {"x": jnp.asarray(rng.randn(12, 3), jnp.float32)}
+        mb = get_kth_microbatch(batch, 2, 4)
+        np.testing.assert_allclose(
+            np.asarray(mb["x"]), np.asarray(batch["x"][8:12])
+        )
+
+
+class TestLtorMasks:
+    def test_causal_mask(self):
+        from apex_tpu.transformer.pipeline_parallel import (
+            get_ltor_masks_and_position_ids,
+        )
+
+        data = jnp.asarray([[5, 3, 7, 1]], jnp.int32)
+        mask, loss_mask, pos = get_ltor_masks_and_position_ids(data)
+        assert mask.shape == (1, 1, 4, 4)
+        m = np.asarray(mask[0, 0])
+        assert not m[2, 1] and m[1, 2]  # can attend backward, not forward
+        np.testing.assert_array_equal(np.asarray(pos[0]), [0, 1, 2, 3])
+        np.testing.assert_array_equal(np.asarray(loss_mask[0]), [1, 1, 1, 1])
+
+    def test_eod_resets(self):
+        from apex_tpu.transformer.pipeline_parallel import (
+            get_ltor_masks_and_position_ids,
+        )
+
+        data = jnp.asarray([[5, 0, 7, 1]], jnp.int32)  # EOD token = 0
+        mask, loss_mask, pos = get_ltor_masks_and_position_ids(
+            data, eod_token=0, reset_position_ids=True,
+            reset_attention_mask=True, eod_mask_loss=True,
+        )
+        np.testing.assert_array_equal(np.asarray(loss_mask[0]), [1, 0, 1, 1])
+        # positions restart after EOD (EOD belongs to first segment)
+        np.testing.assert_array_equal(np.asarray(pos[0]), [0, 1, 0, 1])
+        m = np.asarray(mask[0, 0])
+        assert m[2, 0]  # token 2 (new doc) cannot see token 0
+
+
+@pytest.mark.slow
+class TestDeepPipelines:
+    """Heavier grids in the slow tier: interleaved end-to-end training
+    and a 4-deep pipeline."""
+
+    def test_interleaved_step_trains(self, rng):
+        cfg = tiny_cfg(layers=4)
+        x, y = tiny_data(seed=5)
+        gmesh.initialize_mesh(pipe=2)
+        model = GPTModel(cfg)
+        params = model.init(jax.random.PRNGKey(0), x)
+        step = make_mesh_pipeline_train_step(
+            model, FusedAdam(lr=2e-3, impl="xla"), gmesh.plan_gpt(params),
+            schedule="interleaved_1f1b", num_microbatches=2,
+            num_model_chunks=2)
+        state = step.init(params)
+        losses = []
+        for _ in range(5):
+            state, loss = step(state, x, y)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0]
+        assert step.last_bubble_fraction == pytest.approx(1 / 5)
+
+    def test_pp4_matches_reference(self, rng):
+        cfg = tiny_cfg(layers=4)
+        x, y = tiny_data(batch=8, seed=9)
+        gmesh.initialize_mesh(pipe=4)   # dp=2 x pp=4
+        model = GPTModel(cfg)
+        params = model.init(jax.random.PRNGKey(2), x)
+        ref = float(gpt_loss_fn(model.apply(params, x), y))
+        step = make_mesh_pipeline_train_step(
+            model, FusedAdam(lr=1e-3, impl="xla"), gmesh.plan_gpt(params),
+            schedule="1f1b", num_microbatches=4)
+        state = step.init(params)
+        _, loss = step(state, x, y)
+        np.testing.assert_allclose(float(loss), ref, rtol=2e-5)

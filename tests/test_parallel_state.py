@@ -66,90 +66,46 @@ class TestInitializeModelParallel:
         got = [d.id for d in np.asarray(mesh.devices).ravel()]
         assert got == [d.id for d in devs]
 
-    def test_virtual_pp(self):
-        ps.initialize_model_parallel(
-            1, 4, virtual_pipeline_model_parallel_size=2
-        )
-        assert ps.get_virtual_pipeline_model_parallel_world_size() == 2
-        assert ps.get_virtual_pipeline_model_parallel_rank() == 0
-        ps.set_virtual_pipeline_model_parallel_rank(1)
-        assert ps.get_virtual_pipeline_model_parallel_rank() == 1
-
-    def test_virtual_pp_requires_deep_pipeline(self):
-        with pytest.raises(RuntimeError):
-            ps.initialize_model_parallel(
-                1, 2, virtual_pipeline_model_parallel_size=2
-            )
-
     def test_expert_parallel(self):
         ps.initialize_model_parallel(2, 1, expert_model_parallel_size=2)
         assert ps.get_expert_model_parallel_world_size() == 2
         assert ps.get_data_parallel_world_size() == 2
 
+    def test_virtual_pp_param_retired(self):
+        """PR-16: the interleaved schedule is a mesh.pipeline
+        PipelineSpec property, not topology state — the old
+        virtual-pp kwarg is gone from the signature."""
+        with pytest.raises(TypeError):
+            ps.initialize_model_parallel(
+                1, 4, virtual_pipeline_model_parallel_size=2
+            )
 
-class TestSubstrateConflict:
-    """The two parallel substrates refuse to half-coexist: a live
-    GSPMD mesh (apex_tpu/mesh) makes initialize_model_parallel raise
-    the STRUCTURED SubstrateConflictError (never a bare assert), and
-    vice versa."""
 
-    def test_megatron_refused_while_gspmd_mesh_live(self):
+class TestSubstrateCoexistence:
+    """PR-16 retired the exclusivity contract (SubstrateConflictError):
+    with pipeline execution on the GSPMD mesh, the legacy mesh is just
+    a trace-scoped shard_map tool (cp/ep kernels) and may coexist with
+    a live GSPMD mesh."""
+
+    def test_conflict_error_retired(self):
+        from apex_tpu import mesh as gmesh
+
+        assert not hasattr(gmesh, "SubstrateConflictError")
+        assert not hasattr(gmesh, "check_substrate_conflict")
+
+    def test_both_substrates_live(self):
         from apex_tpu import mesh as gmesh
 
         gmesh.initialize_mesh(model=2)
         try:
-            with pytest.raises(gmesh.SubstrateConflictError) as ei:
-                ps.initialize_model_parallel(2, 1)
-            assert ei.value.active == "mesh"
-            assert ei.value.requested == "megatron"
-            assert ei.value.active_axes["model"] == 2
-            assert not ps.model_parallel_is_initialized()
+            mesh = ps.initialize_model_parallel(2, 1)
+            assert ps.model_parallel_is_initialized()
+            assert gmesh.mesh_initialized()
+            assert mesh.axis_names == (
+                "data", "expert", "pipe", "context", "tensor")
+            assert gmesh.axis_sizes()["model"] == 2
         finally:
             gmesh.destroy_mesh()
-
-    def test_gspmd_mesh_refused_while_megatron_live(self):
-        from apex_tpu import mesh as gmesh
-
-        ps.initialize_model_parallel(2, 1)
-        with pytest.raises(gmesh.SubstrateConflictError) as ei:
-            gmesh.initialize_mesh(model=2)
-        assert ei.value.active == "megatron"
-        assert ei.value.requested == "mesh"
-        assert ei.value.active_axes["tensor"] == 2
-        assert not gmesh.mesh_initialized()
-
-    def test_clean_after_destroy(self):
-        from apex_tpu import mesh as gmesh
-
-        gmesh.initialize_mesh(model=2)
-        gmesh.destroy_mesh()
-        ps.initialize_model_parallel(2, 1)    # no conflict raised
-        assert ps.model_parallel_is_initialized()
-
-
-class TestPipelinePredicates:
-    def test_first_last_stage(self):
-        ps.initialize_model_parallel(1, 4)
-        assert ps.is_pipeline_first_stage(0)
-        assert not ps.is_pipeline_first_stage(1)
-        assert ps.is_pipeline_last_stage(3)
-        assert not ps.is_pipeline_last_stage(0)
-
-    def test_virtual_stage_predicates(self):
-        ps.initialize_model_parallel(1, 4, virtual_pipeline_model_parallel_size=2)
-        ps.set_virtual_pipeline_model_parallel_rank(0)
-        assert ps.is_pipeline_first_stage(0)
-        assert not ps.is_pipeline_last_stage(3)  # vpp rank 0 != last chunk
-        ps.set_virtual_pipeline_model_parallel_rank(1)
-        assert not ps.is_pipeline_first_stage(0)
-        assert ps.is_pipeline_last_stage(3)
-        assert ps.is_pipeline_first_stage(0, ignore_virtual=True)
-
-    def test_next_prev(self):
-        ps.initialize_model_parallel(1, 4)
-        assert ps.get_pipeline_model_parallel_next_rank(0) == 1
-        assert ps.get_pipeline_model_parallel_next_rank(3) == 0
-        assert ps.get_pipeline_model_parallel_prev_rank(0) == 3
 
 
 class TestRankQueriesInShardMap:

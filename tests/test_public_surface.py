@@ -19,12 +19,18 @@ SURFACE = {
         "BATCH_AXIS", "MODEL_AXIS", "PIPE_AXIS", "MESH_AXES",
         "initialize_mesh", "destroy_mesh", "current_mesh",
         "mesh_initialized", "mesh_size", "axis_sizes",
-        "SubstrateConflictError", "check_substrate_conflict",
         "ShardingPlan", "plan_gpt", "shard_params", "shard_state",
         "shard_batch", "MeshTrainStep", "make_mesh_train_step",
-        "annotate", "planner",
+        "annotate", "planner", "pipeline",
+        # PR-16: pipe-axis schedules (the legacy SubstrateConflictError
+        # / check_substrate_conflict exclusivity pins are retired with
+        # the explicit-collective pipeline path)
+        "PipelineSpec", "MeshPipelineTrainStep",
+        "make_mesh_pipeline_train_step", "make_pipeline_loss_fn",
+        "SCHEDULES", "bubble_fraction",
         "LayoutPlan", "LayoutScore", "enumerate_layouts",
         "plan_layout", "plan_for_config", "publish_plan",
+        "measured_link_gbps",
     ],
     "apex_tpu.resilience": [
         "CheckpointManager", "CheckpointError", "RestoredState",
@@ -70,7 +76,11 @@ SURFACE = {
         "VocabParallelEmbedding", "vocab_parallel_cross_entropy",
     ],
     "apex_tpu.transformer.pipeline_parallel": [
-        "get_forward_backward_func", "Timers",
+        # PR-16: the explicit-collective schedules are retired; what
+        # survives is the schedule-agnostic toolbox
+        "Timers", "ConstantNumMicroBatches",
+        "RampupBatchsizeNumMicroBatches", "get_kth_microbatch",
+        "get_ltor_masks_and_position_ids",
     ],
     "apex_tpu.transformer.functional": [
         "FusedScaleMaskSoftmax", "fused_apply_rotary_pos_emb",

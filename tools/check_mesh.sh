@@ -1,8 +1,9 @@
 #!/usr/bin/env bash
 # Mesh-substrate smoke (CI / pre-merge, next to check_serving.sh and
 # check_telemetry.sh): the mesh unit tier (tests/test_mesh.py +
-# tests/test_mesh_planner.py), then three fresh-process drills on a
-# FORCED 8-device CPU backend proving docs/mesh.md's contracts:
+# tests/test_mesh_planner.py + tests/test_mesh_pipeline.py), then four
+# fresh-process drills on a FORCED 8-device CPU backend proving
+# docs/mesh.md's contracts:
 #  - PARITY: the same GPT train step, no mesh (single-device identity
 #    plan) vs dp=8 GSPMD, produces loss curves identical to fp32
 #    tolerance — the "one set of model code" guarantee,
@@ -12,7 +13,11 @@
 #  - COMPILE PLANE: with the PR-6 CompileTracker armed, the mesh train
 #    step and the sharded decode loop each mint exactly their warmup
 #    programs and hit ZERO hot-loop recompiles, and the train step
-#    publishes its layouts (sharding_devices{fn="mesh_train_step"}).
+#    publishes its layouts (sharding_devices{fn="mesh_train_step"}),
+#  - PIPELINE: a pp=2 interleaved-1F1B schedule on the pipe axis
+#    matches the dp-only loss curve to fp32 tolerance, mints ONE
+#    program with zero hot-loop recompiles, and publishes its
+#    per-stage bubble_fraction gauges.
 # Extra args pass through to pytest.
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -22,6 +27,7 @@ export XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=8"
 rc=0
 
 python -m pytest tests/test_mesh.py tests/test_mesh_planner.py \
+    tests/test_mesh_pipeline.py \
     "$@" -q -p no:cacheprovider || rc=1
 
 echo "== parity: no-mesh reference vs dp=8 GSPMD train step =="
@@ -206,6 +212,80 @@ try:
 finally:
     tcompiled.disable()
     gmesh.destroy_mesh()
+    telemetry.reset()
+PY
+
+echo "== pipeline: pp=2 interleaved-1F1B parity, zero recompiles, bubble =="
+python - <<'PY' || rc=1
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from apex_tpu import mesh as gmesh, telemetry
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.optimizers import FusedAdam
+from apex_tpu.telemetry import compiled as tcompiled
+from apex_tpu.telemetry import metrics as tmetrics
+
+assert jax.device_count() == 8, jax.device_count()
+cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=64,
+                num_layers=4, num_heads=4,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+rng = np.random.RandomState(0)
+toks = jnp.asarray(rng.randint(0, 128, (8, 16)), jnp.int32)
+labels = jnp.asarray(rng.randint(0, 128, (8, 16)), jnp.int32)
+model = GPTModel(cfg)
+
+
+def run(pipe, n_steps=6):
+    gmesh.initialize_mesh(pipe=pipe)
+    try:
+        params = model.init(jax.random.PRNGKey(0), toks)
+        plan = gmesh.plan_gpt(params)
+        opt = FusedAdam(lr=1e-3, impl="xla")
+        if pipe > 1:
+            spec = gmesh.PipelineSpec(
+                schedule="interleaved_1f1b", num_stages=pipe,
+                num_microbatches=4, num_model_chunks=2)
+            step = gmesh.make_mesh_pipeline_train_step(
+                model, opt, plan, spec)
+        else:
+            step = gmesh.make_mesh_train_step(model, opt, plan)
+        state = step.init(params)
+        losses = []
+        for _ in range(n_steps):
+            state, loss = step(state, toks, labels)
+            losses.append(float(loss))
+        return losses, step
+    finally:
+        gmesh.destroy_mesh()
+
+
+ref, _ = run(1)                          # dp=8, the no-pipeline curve
+telemetry.reset()
+tracker = tcompiled.enable()
+try:
+    pipe, step = run(2)                  # dp=4 x pp=2, V=2 interleaved
+    np.testing.assert_allclose(pipe, ref, rtol=2e-5, atol=2e-5)
+    assert pipe[-1] < pipe[0], "loss did not decrease"
+
+    s = tracker.summary()
+    assert s["signatures"].get("mesh_pipeline_step") == 1, s["signatures"]
+    assert s["recompiles"] == 0, f"hot-loop recompiles: {s}"
+
+    bubble = step.last_bubble_fraction
+    assert bubble == step.spec.bubble, (bubble, step.spec.bubble)
+    g = tmetrics.registry().snapshot()["gauges"]
+    for stage in range(2):
+        key = ('pipeline_bubble_fraction{schedule="interleaved_1f1b"'
+               f',stage="{stage}"}}')
+        assert g.get(key) == bubble, {k: v for k, v in g.items()
+                                      if "pipeline" in k}
+    print(f"pipeline OK: 6 steps dp=4 x pp=2 interleaved-1F1B match "
+          f"dp=8 to fp32 tolerance, 1 program, zero recompiles, "
+          f"bubble_fraction={bubble:.4f} published per stage")
+finally:
+    tcompiled.disable()
     telemetry.reset()
 PY
 

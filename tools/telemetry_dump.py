@@ -48,6 +48,14 @@ the ``layout_plan_*`` gauges, and the planner's full ranked
 ``layout_plan`` info blob — and Prometheus output adds one mesh
 summary comment line (chosen layout + publishing fns). A snapshot
 with neither published layouts nor a plan reports ``mesh_reason``.
+
+And the PIPELINE plane (docs/mesh.md "Pipeline schedules on the pipe
+axis"): JSON output appends a ``pipeline`` section — the per-stage
+``pipeline_bubble_fraction{schedule=,stage=}`` / ``pipeline_ticks``
+gauges and the ``pipeline`` info blob the mesh pipeline train step
+publishes (schedule, microbatches, per-stage activity windows, step
+wall time) — and Prometheus output adds one pipeline summary comment
+line. A snapshot where no schedule ran reports ``pipeline_reason``.
 """
 
 import argparse
@@ -223,6 +231,28 @@ def mesh_section(snap):
     return out
 
 
+_PIPELINE_PREFIX = "pipeline_"
+
+
+def pipeline_section(snap):
+    """The pipeline plane of a registry snapshot (docs/mesh.md
+    "Pipeline schedules on the pipe axis"): the per-stage
+    ``pipeline_bubble_fraction{schedule=,stage=}`` / ``pipeline_ticks``
+    gauges next to the ``pipeline`` info blob (the PipelineSpec plus
+    the last step's wall time and per-stage activity windows) the mesh
+    pipeline train step publishes each step.
+    Null-with-``pipeline_reason`` when no schedule ran."""
+    out = _plane(snap, lambda base: base.startswith(_PIPELINE_PREFIX))
+    blob = (snap.get("info") or {}).get("pipeline")
+    if blob is not None:
+        out["pipeline"] = blob
+    if not out.get("gauges") and blob is None:
+        out["pipeline_reason"] = (
+            "no pipeline schedule ran in this snapshot "
+            "(mesh.make_mesh_pipeline_train_step)")
+    return out
+
+
 def plane_comments(snap) -> str:
     """One summary comment line per plane, appended to the Prometheus
     text (comments are legal exposition; the series themselves render
@@ -286,6 +316,20 @@ def plane_comments(snap) -> str:
                      - {None})
         lines.append(f"# mesh: plan={best} "
                      f"sharding_fns=[{','.join(fns)}]")
+    pl = pipeline_section(snap)
+    if "pipeline_reason" in pl:
+        lines.append(f"# pipeline: none ({pl['pipeline_reason']})")
+    else:
+        blob = pl.get("pipeline") or {}
+        bub = {_series_labels(k).get("stage"): v
+               for k, v in (pl.get("gauges") or {}).items()
+               if _series_base(k) == "pipeline_bubble_fraction"}
+        bub_s = " ".join(f"s{s}={bub[s]}" for s in sorted(bub)) or "n/a"
+        lines.append(
+            f"# pipeline: schedule={blob.get('schedule')} "
+            f"stages={blob.get('num_stages')} "
+            f"microbatches={blob.get('num_microbatches')} "
+            f"step_ms={blob.get('step_ms')} bubble[{bub_s}]")
     return "\n".join(lines) + "\n"
 
 
@@ -299,6 +343,7 @@ def _emit(snap, fmt, help_source=None) -> None:
         out["serving"] = serving_section(snap)
         out["comms"] = comms_section(snap)
         out["mesh"] = mesh_section(snap)
+        out["pipeline"] = pipeline_section(snap)
         print(json.dumps(out, indent=1, sort_keys=True))
         return
     if help_source is not None:
