@@ -145,6 +145,34 @@ Serving sites (apex_tpu/serving/scheduler.py, docs/serving.md):
                                  report a signature mismatch at these
                                  0-based swap indices — drills the
                                  structured-rejection path end to end
+
+Fleet-router sites (apex_tpu/serving/fleet.py, docs/serving.md
+"Fleet"):
+
+- ``engine_crash=<steps>``       :class:`EngineCrash` out of the
+                                 router's per-engine step dispatch at
+                                 these ROUTER steps — a router-visible
+                                 hard engine death the router must
+                                 fence (never retry) and recover from
+- ``engine_crash_engine=<i>``    which engine (0-based join order)
+                                 ``engine_crash`` kills (default: 0)
+- ``engine_stall_ms=<ms>``       sleep ``ms`` inside the target
+                                 engine's step dispatch — its
+                                 heartbeat goes stale while the engine
+                                 stays ALIVE; the router must hedge
+                                 its queued work, not fence it
+- ``engine_stall_engine=<i>``    which engine stalls (default: 0)
+- ``engine_stall_at=<steps>``    restrict the stall to these router
+                                 steps (default: every step)
+- ``router_snapshot_missing=<idx>`` the router's recovery number
+                                 ``idx`` (0-based, per router) finds
+                                 NO usable drain snapshot — forcing
+                                 the replay-from-prompt+generated
+                                 recovery path
+- ``io:fleet_router``            transient ``FaultError`` at the
+                                 router's per-engine step site (call
+                                 indexed) — absorbed by the router's
+                                 ``resilience.retry`` backoff
 """
 
 from __future__ import annotations
@@ -166,6 +194,13 @@ class FaultError(OSError):
 class SimulatedCrash(RuntimeError):
     """An injected process death (kill-and-resume tests raise and catch
     this where a real run would be SIGKILLed / preempted)."""
+
+
+class EngineCrash(RuntimeError):
+    """An injected router-visible hard engine death (the
+    ``engine_crash`` clause). Deliberately NOT an ``OSError``: the
+    fleet router's transient-retry policy must never retry it — a dead
+    engine is fenced and its work recovered, immediately."""
 
 
 def _int_set(val: str) -> FrozenSet[int]:
@@ -210,6 +245,13 @@ class FaultInjector:
     decode_nonfinite_lane: int = 0
     snapshot_corrupt_indices: FrozenSet[int] = frozenset()
     weight_swap_mismatch_indices: FrozenSet[int] = frozenset()
+    # fleet-router sites (apex_tpu/serving/fleet.py)
+    engine_crash_steps: FrozenSet[int] = frozenset()
+    engine_crash_engine: int = 0
+    engine_stall_ms: float = 0.0
+    engine_stall_engine: int = 0
+    engine_stall_at: FrozenSet[int] = frozenset()
+    router_snapshot_missing: FrozenSet[int] = frozenset()
 
     def __post_init__(self):
         self._counts: Dict[str, int] = {}
@@ -387,6 +429,38 @@ class FaultInjector:
         per engine) must report a forced signature mismatch."""
         return int(index) in self.weight_swap_mismatch_indices
 
+    # -- fleet-router sites ------------------------------------------------
+
+    def maybe_engine_crash(self, step: int, engine: int) -> None:
+        """Raise :class:`EngineCrash` out of the fleet router's step
+        dispatch for engine ``engine`` (0-based join order) at planned
+        ROUTER steps — the deterministic hard-death drill behind the
+        router's fence-and-recover path."""
+        if (int(step) in self.engine_crash_steps
+                and int(engine) == self.engine_crash_engine):
+            raise EngineCrash(
+                f"injected engine crash: engine {int(engine)} at "
+                f"router step {int(step)}")
+
+    def engine_stall_s(self, step: int, engine: int) -> float:
+        """Seconds of injected stall for engine ``engine``'s step
+        dispatch at router step ``step`` (``engine_stall_at`` empty
+        means every step once ``engine_stall_ms`` is set). The engine
+        stays alive — its heartbeat just goes stale, so the router
+        must hedge, not fence. 0.0 off-plan."""
+        if (self.engine_stall_ms <= 0.0
+                or int(engine) != self.engine_stall_engine):
+            return 0.0
+        if self.engine_stall_at and int(step) not in self.engine_stall_at:
+            return 0.0
+        return self.engine_stall_ms / 1e3
+
+    def should_skip_router_snapshot(self, index: int) -> bool:
+        """True when the fleet router's recovery number ``index``
+        (0-based, per router) must behave as if NO drain snapshot were
+        usable — forcing the replay-from-prompt+generated path."""
+        return int(index) in self.router_snapshot_missing
+
     def maybe_sigterm(self, step: int) -> None:
         """Deliver a REAL SIGTERM to this process at planned steps —
         the deterministic stand-in for the scheduler's preemption
@@ -456,6 +530,18 @@ class FaultInjector:
                 kw["snapshot_corrupt_indices"] = _int_set(val)
             elif key == "weight_swap_mismatch":
                 kw["weight_swap_mismatch_indices"] = _int_set(val)
+            elif key == "engine_crash":
+                kw["engine_crash_steps"] = _int_set(val)
+            elif key == "engine_crash_engine":
+                kw["engine_crash_engine"] = int(val)
+            elif key == "engine_stall_ms":
+                kw["engine_stall_ms"] = float(val)
+            elif key == "engine_stall_engine":
+                kw["engine_stall_engine"] = int(val)
+            elif key == "engine_stall_at":
+                kw["engine_stall_at"] = _int_set(val)
+            elif key == "router_snapshot_missing":
+                kw["router_snapshot_missing"] = _int_set(val)
             elif key.startswith("io:"):
                 kw["io_errors"][key[len("io:"):]] = _int_set(val)
             elif key.startswith("io_permanent:"):
@@ -605,15 +691,34 @@ def should_weight_swap_mismatch(index: int) -> bool:
     return inj is not None and inj.should_weight_swap_mismatch(index)
 
 
+def maybe_engine_crash(step: int, engine: int) -> None:
+    inj = active()
+    if inj is not None:
+        inj.maybe_engine_crash(step, engine)
+
+
+def engine_stall_s(step: int, engine: int) -> float:
+    inj = active()
+    return 0.0 if inj is None else inj.engine_stall_s(step, engine)
+
+
+def should_skip_router_snapshot(index: int) -> bool:
+    inj = active()
+    return inj is not None and inj.should_skip_router_snapshot(index)
+
+
 __all__ = [
-    "ENV_KNOB", "FaultError", "FaultInjector", "SimulatedCrash",
-    "active", "check", "collective_delay_s", "flip_bits", "inject",
+    "ENV_KNOB", "EngineCrash", "FaultError", "FaultInjector",
+    "SimulatedCrash",
+    "active", "check", "collective_delay_s", "engine_stall_s",
+    "flip_bits", "inject",
     "install", "maybe_crash", "should_corrupt_collective",
     "maybe_crash_before_commit", "maybe_decode_exception",
-    "maybe_prefill_chunk_exception",
+    "maybe_engine_crash", "maybe_prefill_chunk_exception",
     "maybe_sigterm", "nonfinite_lane_at", "poison_grads",
     "shard_truncate_target", "should_pool_exhaust",
-    "should_range_timeout", "should_snapshot_corrupt",
+    "should_range_timeout", "should_skip_router_snapshot",
+    "should_snapshot_corrupt",
     "should_truncate", "should_weight_swap_mismatch",
     "should_world_mismatch",
 ]

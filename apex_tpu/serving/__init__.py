@@ -46,6 +46,15 @@ resume), ``SLOMonitor`` watches TTFT/TPOT/goodput/queue-depth
 objectives with multi-window burn-rate alerting and feeds the
 ``should_shed()`` admission hook, and ``ContinuousBatcher.introspect``
 (rendered by ``tools/serving_top.py``) is the live view.
+
+The fleet plane (``serving/fleet.py``, docs/serving.md "Fleet"):
+``FleetRouter`` fronts N engines behind one submit/step/merge surface
+— prefix-affinity placement over each engine's hash-chain prefix
+index, SLO-shed deprioritization with a structured fleet-wide refusal,
+kill/replace failover that recovers a dead engine's work via drain
+snapshots (or prompt+generated replay) with token-identical streams
+and trace continuity across engines, bounded hedging for stalled
+engines, and elastic ``add_engine`` / ``remove_engine`` membership.
 """
 
 from apex_tpu.serving.decode import (
@@ -94,8 +103,20 @@ from apex_tpu.serving.tracing import (
     RequestTracer,
 )
 
+# imported LAST: fleet.py consumes the scheduler/resilience/tracing
+# modules above at import time (the router fronts all of them)
+from apex_tpu.serving.fleet import (  # noqa: E402
+    ENGINE_STATES,
+    EngineHandle,
+    FleetRouter,
+    fleet_serve_loop,
+)
+
 __all__ = [
     "ContinuousBatcher",
+    "ENGINE_STATES",
+    "EngineHandle",
+    "FleetRouter",
     "DecodeStep",
     "KVCache",
     "KVCacheState",
@@ -114,6 +135,7 @@ __all__ = [
     "append_kv_prefill",
     "apply_copies",
     "bucket",
+    "fleet_serve_loop",
     "gather_kv",
     "greedy_sampling",
     "latest_snapshot",

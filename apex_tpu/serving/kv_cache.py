@@ -497,6 +497,28 @@ class KVCache:
             self.prefix_tokens_saved = 0
             return n
 
+    def prefix_match_len(self, prompt: Sequence[int]) -> int:
+        """How many leading prompt tokens the prefix index could hand
+        out by REFERENCE right now: full published, non-dirty blocks
+        along the prompt's sha256 hash chain (capped at
+        ``len(prompt) - 1`` like :meth:`allocate_prefix`; COW-fork
+        partial rows are not counted — this is a cheap placement
+        probe, not a reservation). Read-only: nothing is referenced,
+        revived, or evicted. The fleet router's prefix-affinity score
+        (serving/fleet.py): the engine whose pool already holds the
+        longest prefix wins the request."""
+        prompt = tuple(int(t) for t in prompt)
+        with self._lock:
+            hashes = self._chain_hashes(prompt)
+            max_full = (len(prompt) - 1) // self.block_size
+            m = 0
+            for i in range(min(len(hashes), max_full)):
+                blk = self._index.get(hashes[i])
+                if blk is None or blk in self._dirty:
+                    break
+                m += 1
+            return m * self.block_size
+
     def prefix_stats(self) -> Dict[str, int]:
         """Prefix-cache accounting for gauges/flight bundles."""
         with self._lock:

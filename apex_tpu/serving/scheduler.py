@@ -183,7 +183,15 @@ class Request:
 class RequestResult:
     """A finished request: generated tokens + the latency the serving
     bench reports (TTFT = submit -> first token; TPOT = mean
-    inter-token interval after the first)."""
+    inter-token interval after the first).
+
+    ``reason`` is the machine-readable refusal code set when an
+    ADMISSION is refused (``finish_reason == "error"`` with no work
+    done): ``"draining"`` (submit on a draining engine, or preempted
+    with no snapshot), ``"shedding"`` (fleet-wide SLO shed,
+    serving/fleet.py), or ``"oversized"`` (the request can never fit
+    the pool). None for every other outcome — routers must branch on
+    this field, never string-match ``error``."""
 
     id: Any
     tokens: List[int]
@@ -192,6 +200,8 @@ class RequestResult:
     # "length" | "eos" | "error" | "deadline_exceeded"
     finish_reason: str
     error: Optional[str] = None
+    # "draining" | "shedding" | "oversized" | None
+    reason: Optional[str] = None
 
 
 @dataclasses.dataclass
@@ -384,17 +394,20 @@ class ContinuousBatcher:
             id=fl.req.id, tokens=list(fl.generated), ttft_s=ttft,
             tpot_s=tpot, finish_reason=reason, error=error))
 
-    def _reject(self, req: Request, msg: str) -> None:
+    def _reject(self, req: Request, msg: str, *,
+                reason: str = "oversized") -> None:
         ev = self._registry.event("serving_request_error",
-                                  request=str(req.id), error=msg)
+                                  request=str(req.id), error=msg,
+                                  reason=reason)
         from apex_tpu.telemetry import flight as _flight
 
         _flight.notify("serving_request_error",
                        error=RuntimeError(msg), fleet=False,
-                       extra={"request": str(req.id), "event": ev})
+                       extra={"request": str(req.id), "reason": reason,
+                              "event": ev})
         self._push_result(RequestResult(
             id=req.id, tokens=[], ttft_s=None, tpot_s=None,
-            finish_reason="error", error=msg))
+            finish_reason="error", error=msg, reason=reason))
 
     # -- API -----------------------------------------------------------------
 
@@ -488,10 +501,27 @@ class ContinuousBatcher:
                 id=request.id, tokens=[], ttft_s=None, tpot_s=None,
                 finish_reason="error",
                 error="engine draining (preemption): resubmit to the "
-                      "resumed engine"))
+                      "resumed engine",
+                reason="draining"))
             return
         with self._lock:
             self.queue.append((request, now))
+
+    def take_queued(self, max_n: Optional[int] = None
+                    ) -> List[Tuple[Request, float]]:
+        """Withdraw up to ``max_n`` queued (NOT yet admitted) requests
+        from the tail of the queue — newest first, so the oldest
+        arrivals keep their admission order — and return them as
+        ``[(request, t_submit)]``. The engine forgets them entirely
+        (no result, no trace transition: the caller owns both now).
+        The fleet router's bounded-hedge hook: work a stalled engine
+        hasn't started can move to a healthy peer; in-flight work
+        stays put (serving/fleet.py)."""
+        out: List[Tuple[Request, float]] = []
+        with self._lock:
+            while self.queue and (max_n is None or len(out) < max_n):
+                out.append(self.queue.pop())
+        return out
 
     def idle(self) -> bool:
         with self._lock:
@@ -815,7 +845,8 @@ class ContinuousBatcher:
                 self._reject(req, (
                     "preempted before admission and no drain snapshot "
                     + (f"(save failed: {save_error})" if save_error
-                       else "(no snapshot_dir configured)")))
+                       else "(no snapshot_dir configured)")),
+                    reason="draining")
         report["drained"] = True
         report["snapshot"] = path
         r = self._registry

@@ -56,9 +56,11 @@ from collections import deque
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 # terminal outcomes a trace can end with (the RequestResult
-# finish_reason vocabulary plus the two engine-side terminals)
+# finish_reason vocabulary plus the engine-side terminals; `rerouted`
+# closes one ENGINE's segment when the fleet router hands the request
+# to a peer — the next segment continues the same trace id)
 OUTCOMES = ("length", "eos", "error", "deadline_exceeded", "rejected",
-            "drained")
+            "drained", "rerouted")
 
 
 class RequestTrace:
@@ -316,11 +318,14 @@ class RequestTracer:
         """The request plane as Chrome-trace JSON — the SAME "JSON
         Array Format" ``StepTimeline.export_trace`` emits (complete
         ``"ph": "X"`` events, µs ``ts``/``dur`` relative to the tracer
-        origin), but with ONE TRACK (tid) PER REQUEST, labeled
-        ``<request_id> (<trace_id>)`` via thread-name metadata. Marks
-        ride as zero-duration events. Loadable at ui.perfetto.dev /
-        chrome://tracing, side by side with the engine timeline when
-        both use the default ``perf_counter`` clock."""
+        origin), but with ONE TRACK (tid) PER TRACE ID, labeled
+        ``<request_id> (<trace_id>)`` via thread-name metadata — so the
+        segments of a drained/resumed request, or one handed across
+        engines by the fleet router, land on a single track telling the
+        request's whole story. Marks ride as zero-duration events.
+        Loadable at ui.perfetto.dev / chrome://tracing, side by side
+        with the engine timeline when both use the default
+        ``perf_counter`` clock."""
         with self._lock:
             traces = list(self._done) + list(self._live.values())
         if request_ids is not None:
@@ -332,7 +337,14 @@ class RequestTracer:
         def us(t: float) -> float:
             return round((t - self._origin) * 1e6, 3)
 
-        for tid, tr in enumerate(traces):
+        # one track (tid) per TRACE ID, not per trace object: a
+        # drain/resume or a fleet-router handoff produces several
+        # segments with the same trace id, and they must land on ONE
+        # perfetto track — the request's whole story, crossing engines
+        tids: Dict[str, int] = {}
+        labels: Dict[int, str] = {}
+        for tr in traces:
+            tid = tids.setdefault(tr.trace_id, len(tids))
             # an unfinished trace still shows its open decode window
             spans = list(tr.spans)
             if tr._decode is not None:
@@ -360,6 +372,8 @@ class RequestTracer:
             label = f"{tr.request_id} ({tr.trace_id})"
             if tr.resumed_from:
                 label += f" resumed_from={tr.resumed_from}"
+            labels[tid] = label  # last segment wins
+        for tid, label in labels.items():
             events.append({
                 "name": "thread_name", "ph": "M", "pid": pid,
                 "tid": tid, "args": {"name": label},

@@ -61,6 +61,13 @@ trigger                fired by
                        snapshot, so the latency postmortem opens with
                        the slow requests' timelines in hand
                        (host-local; one bundle per violation episode)
+``fleet_engine_lost``  the fleet router fenced a dead or wedged
+                       serving engine (``serving.fleet.FleetRouter``,
+                       host-local); the bundle's ``extra`` embeds the
+                       victim's LAST ``introspect()`` plus the
+                       structured recovery plan — snapshot vs replay
+                       source, snapshot path, and the survivor each
+                       recovered request was rerouted to
 ====================== ====================================================
 
 Fleet-level triggers (the guard's, the shutdown's) fire on EVERY

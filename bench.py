@@ -1625,6 +1625,168 @@ def _bench_serving_long_prompt():
     }, "serving_long_prompt")
 
 
+def _bench_serving_fleet():
+    """The fleet-router record (docs/serving.md "Fleet"): the same
+    burst workload through a 3-engine ``FleetRouter`` twice — clean,
+    then with one engine killed (``engine_crash``) at T/2 of the
+    clean run's router steps. Headline: generated tokens/sec UNDER
+    the kill; the clean run rides in detail with
+    ``tokens_per_sec_vs_clean`` (the failover tax) and p99 TTFT for
+    both, plus ``fleet_failover_ms`` — kill to first recovered token
+    (the router's fence+recover wall time plus the first recovered
+    request's TTFT on the survivor) — and the recovery source
+    (snapshot vs replay). The recovered streams are asserted
+    bitwise-identical to the clean run before anything is emitted.
+    Knob: ``APEX_TPU_SERVING_FLEET_REQUESTS`` (default 96 CPU / 192
+    TPU)."""
+    import os
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from apex_tpu import serving, telemetry
+    from apex_tpu.models.gpt import GPTConfig, GPTModel
+    from apex_tpu.resilience import faults
+
+    on_cpu = jax.default_backend() == "cpu"
+    if on_cpu:
+        cfg = GPTConfig(vocab_size=512, max_seq_len=128, hidden_size=128,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+        n_requests, max_batch = 96, 8
+    else:
+        cfg = GPTConfig(vocab_size=32768, max_seq_len=2048,
+                        hidden_size=1024, num_layers=12, num_heads=16,
+                        num_kv_heads=4, dtype=jnp.bfloat16)
+        n_requests, max_batch = 192, 16
+    n_requests = int(os.environ.get("APEX_TPU_SERVING_FLEET_REQUESTS",
+                                    n_requests))
+    n_engines = 3
+    rng = np.random.RandomState(0)
+    model = GPTModel(cfg)
+    params = model.init(
+        jax.random.PRNGKey(0),
+        jnp.asarray(rng.randint(0, cfg.vocab_size, (1, 8)), jnp.int32))
+    # one step_fn: geometry-bound, cache-instance-independent — the
+    # engines share it, so programs compile once fleet-wide
+    geom = serving.KVCache.for_config(cfg, num_blocks=max_batch * 8,
+                                      block_size=16)
+    step_fn = serving.make_decode_step(model, geom)
+
+    def make_requests():
+        r = np.random.RandomState(7)
+        return [serving.Request(
+            id=i,
+            prompt=r.randint(0, cfg.vocab_size, (int(r.randint(4, 25)),)),
+            max_new_tokens=int(r.randint(4, 41)))
+            for i in range(n_requests)]
+
+    snapdirs = []
+
+    def fleet():
+        import tempfile
+
+        reg = telemetry.MetricsRegistry()
+        snapdirs.append(tempfile.mkdtemp(prefix="bench_fleet_snap_"))
+        router = serving.FleetRouter(registry=reg, stall_after_s=60.0,
+                                     placement="least_queue",
+                                     snapshot_dir=snapdirs[-1])
+        for i in range(n_engines):
+            cache = serving.KVCache.for_config(
+                cfg, num_blocks=max_batch * 8, block_size=16)
+            b = serving.ContinuousBatcher(
+                model, params, cache, step_fn=step_fn,
+                max_batch=max_batch, min_seq_bucket=32, registry=reg)
+            # warm BOTH seq buckets: recovered requests re-prefill
+            # prompt+generated (up to ~64 tokens here), one bucket
+            # above anything the clean workload touches — without
+            # this the "failover" number is mostly a one-time XLA
+            # compile, not failover (docs/serving.md warmup
+            # discipline). step_fn is shared, so engine 0 pays once.
+            router.add_engine(
+                f"e{i}", b, cache.init_state(), warm=(i == 0),
+                warmup_kwargs={"seq_buckets": [32, 64]})
+        return router
+
+    def run(router):
+        reqs = make_requests()
+        for r in reqs:
+            router.submit(r)
+        t0 = time.perf_counter()
+        results = []
+        while not router.idle():
+            router.step()
+            results.extend(router.merge_results())
+        results.extend(router.merge_results())
+        wall = time.perf_counter() - t0
+        toks = sum(len(r.tokens) for r in results)
+        ttft = [r.ttft_s for r in results if r.ttft_s is not None]
+        return results, {
+            "tokens": toks,
+            "wall_s": round(wall, 3),
+            "tokens_per_sec": round(toks / wall, 1),
+            "p99_ttft_ms": round(
+                float(np.percentile(ttft, 99)) * 1e3, 3) if ttft else None,
+            "router_steps": router.step_idx,
+            "errors": sum(r.finish_reason == "error" for r in results),
+        }
+
+    run(fleet())     # discarded warm pass: absorb first-touch costs
+    router0 = fleet()
+    base_res, clean = run(router0)
+    baseline = {r.id: r.tokens for r in base_res}
+
+    kill_step = max(clean["router_steps"] // 2, 1)
+    router1 = fleet()
+    with faults.inject(engine_crash_steps=frozenset({kill_step}),
+                       engine_crash_engine=1):
+        kill_res, killed = run(router1)
+
+    got = {r.id: r.tokens for r in kill_res}
+    assert got == baseline, "recovered streams diverged from clean run"
+    [fo] = router1.failovers
+    by_id = {r.id: r for r in kill_res}
+    rec_ttft = [by_id[i].ttft_s for i in fo["recovered"]
+                if by_id[i].ttft_s is not None]
+    # kill -> first recovered token: the router's fence+recover wall
+    # (snapshot/replay + resubmission) plus the fastest recovered
+    # request's TTFT on its survivor engine
+    failover_ms = round(
+        (fo["recover_s"] + (min(rec_ttft) if rec_ttft else 0.0)) * 1e3, 3)
+    emit({
+        "metric": "serving_fleet_failover_tokens_per_sec",
+        "value": killed["tokens_per_sec"],
+        "unit": ("generated tokens/sec across a 3-engine fleet with "
+                 "one engine killed at T/2 (greedy decode, burst "
+                 "arrivals)"),
+        "vs_baseline": None,     # filled from the prior run by emit()
+        "detail": {
+            "n_requests": n_requests,
+            "n_engines": n_engines,
+            "max_batch": max_batch,
+            "clean": clean,
+            "under_kill": killed,
+            "tokens_per_sec_vs_clean": round(
+                killed["tokens_per_sec"] / clean["tokens_per_sec"], 4),
+            "p99_ttft_under_kill_vs_clean": (
+                round(killed["p99_ttft_ms"] / clean["p99_ttft_ms"], 4)
+                if killed["p99_ttft_ms"] and clean["p99_ttft_ms"]
+                else None),
+            "kill_step": kill_step,
+            "fleet_failover_ms": failover_ms,
+            "recovery_source": fo["source"],
+            "recovered_requests": len(fo["recovered"]),
+            "recovery_bitwise": True,    # asserted above
+            "compile_keys": step_fn.compile_keys(),
+            **backend_detail(),
+        },
+    }, "serving_fleet")
+    import shutil
+    for d in snapdirs:
+        shutil.rmtree(d, ignore_errors=True)
+
+
 def bucket_pow2(n, minimum=1):
     """Next power of two >= n (the serving shape bucket)."""
     b = max(int(minimum), 1)
@@ -1810,6 +1972,7 @@ def bench_serving():
         },
     }
     _bench_serving_long_prompt()
+    _bench_serving_fleet()
     emit({
         "metric": "serving_continuous_batching_tokens_per_sec",
         "value": cb["tokens_per_sec"],

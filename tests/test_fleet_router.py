@@ -1,0 +1,616 @@
+"""Fleet router (apex_tpu/serving/fleet.py, docs/serving.md "Fleet").
+
+Anchors:
+
+- fault grammar: ``engine_crash`` / ``engine_stall_ms`` /
+  ``router_snapshot_missing`` clauses (+ companions) parse from the
+  ``APEX_TPU_FAULTS`` env grammar and drive their injector methods;
+- structured refusals: the machine-readable ``reason`` field
+  (``oversized`` / ``draining`` / ``shedding``) on refusal results —
+  routers branch on it, never string-match;
+- placement goldens: prefix affinity routes repeats of a shared
+  prefix to the engine holding it (beating round-robin's hit rate),
+  falling back to least queue depth; shed-latched engines are
+  deprioritized and a fleet-wide shed refuses with a structured
+  result;
+- failover: an injected hard death fences the engine and recovers its
+  work onto survivors — snapshot path AND forced replay path
+  (``router_snapshot_missing``) — with every recovered stream
+  bitwise-identical to the uninterrupted run, the same trace id
+  spanning both engines (``resumed_from`` set, ONE perfetto track),
+  and a ``fleet_engine_lost`` bundle embedding the victim's last
+  introspect + the recovery plan;
+- hedge-not-kill: an injected stall (alive, heartbeat-stale) moves
+  queued work to a peer without fencing — zero failovers, zero
+  bundles, streams still exact;
+- elastic membership: join + leave under load through the same
+  drain/resume machinery, zero lost or duplicated streams;
+- ``io:fleet_router`` transients are absorbed by the step retry.
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu import serving, telemetry  # noqa: E402
+from apex_tpu.models.gpt import GPTConfig, GPTModel  # noqa: E402
+from apex_tpu.resilience import faults  # noqa: E402
+from apex_tpu.serving.kv_cache import KVCache  # noqa: E402
+
+VOCAB, SEQ, HID, LAYERS, HEADS, KV = 64, 64, 32, 2, 4, 2
+BLOCKS, BS = 24, 4
+
+
+def tiny_config(**kw):
+    base = dict(vocab_size=VOCAB, max_seq_len=SEQ, hidden_size=HID,
+                num_layers=LAYERS, num_heads=HEADS, num_kv_heads=KV,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def fresh_cache(num_blocks=BLOCKS, block_size=BS):
+    return KVCache(LAYERS, KV, HID // HEADS, num_blocks=num_blocks,
+                   block_size=block_size, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = GPTModel(tiny_config())
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, VOCAB, (1, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def step_fn(model_and_params):
+    model, _ = model_and_params
+    return serving.make_decode_step(model, fresh_cache())
+
+
+class FakeSLO:
+    """A latchable stand-in for SLOMonitor: exactly the surface the
+    batcher + router consume, with ``should_shed`` under test
+    control."""
+
+    def __init__(self):
+        self.shed = False
+
+    def attach(self, **kw):
+        pass
+
+    def should_shed(self):
+        return self.shed
+
+    def alerting(self):
+        return ["fake"] if self.shed else []
+
+    def observe(self, *a, **kw):
+        pass
+
+    def observe_request(self, *a, **kw):
+        pass
+
+    def tick(self, **kw):
+        pass
+
+    def summary(self):
+        return {"shed": self.shed}
+
+
+def make_engine(model, params, step_fn, reg, **kw):
+    cache = fresh_cache()
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("max_prefill_batch", 4)
+    b = serving.ContinuousBatcher(model, params, cache, step_fn=step_fn,
+                                  registry=reg, **kw)
+    return b, cache
+
+
+def make_fleet(model, params, step_fn, n, *, engine_kw=None,
+               slos=None, **router_kw):
+    # a first-use XLA compile can blow any tight stall threshold on
+    # CPU; tests exercising the stall path opt in explicitly
+    router_kw.setdefault("stall_after_s", 30.0)
+    reg = telemetry.MetricsRegistry()
+    sink = telemetry.InMemorySink()
+    reg.add_sink(sink)
+    tracer = serving.RequestTracer()
+    router = serving.FleetRouter(registry=reg, tracer=tracer,
+                                 **router_kw)
+    for i in range(n):
+        kw = dict(engine_kw or {})
+        if slos is not None:
+            kw["slo"] = slos[i]
+        b, cache = make_engine(model, params, step_fn, reg, **kw)
+        router.add_engine(f"e{i}", b, cache.init_state())
+    return router, reg, sink, tracer
+
+
+def run_clean(model, params, step_fn, requests):
+    """Token streams per id from an uninterrupted single-engine run."""
+    reg = telemetry.MetricsRegistry()
+    eng, cache = make_engine(model, params, step_fn, reg)
+    _, results = serving.serve_loop(eng, cache.init_state(), requests)
+    return {r.id: r.tokens for r in results}
+
+
+def drive(router):
+    """Step the fleet to idle, collecting merged results."""
+    out = []
+    while not router.idle():
+        router.step()
+        out.extend(router.merge_results())
+    out.extend(router.merge_results())
+    return out
+
+
+def mk_requests(n, rng, **kw):
+    return [serving.Request(
+        id=i, prompt=rng.randint(0, VOCAB, (int(rng.randint(2, 9)),)),
+        max_new_tokens=int(rng.randint(3, 7)), **kw) for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# fault grammar
+# ---------------------------------------------------------------------------
+
+
+class TestFaultGrammar:
+    def test_env_grammar_parses_fleet_clauses(self):
+        inj = faults.FaultInjector.from_env(
+            "engine_crash=5,9;engine_crash_engine=1;"
+            "engine_stall_ms=250;engine_stall_engine=2;"
+            "engine_stall_at=3;router_snapshot_missing=0,2;"
+            "io:fleet_router=1")
+        assert inj.engine_crash_steps == frozenset({5, 9})
+        assert inj.engine_crash_engine == 1
+        assert inj.engine_stall_ms == 250.0
+        assert inj.engine_stall_engine == 2
+        assert inj.engine_stall_at == frozenset({3})
+        assert inj.router_snapshot_missing == frozenset({0, 2})
+        assert inj.io_errors["fleet_router"] == frozenset({1})
+
+    def test_engine_crash_is_engine_and_step_scoped(self):
+        inj = faults.FaultInjector(engine_crash_steps=frozenset({5}),
+                                   engine_crash_engine=1)
+        inj.maybe_engine_crash(5, 0)           # wrong engine: no-op
+        inj.maybe_engine_crash(4, 1)           # wrong step: no-op
+        with pytest.raises(faults.EngineCrash):
+            inj.maybe_engine_crash(5, 1)
+        # deliberately NOT an OSError: the router's transient-retry
+        # policy must never swallow a death
+        assert not issubclass(faults.EngineCrash, OSError)
+
+    def test_engine_stall_plan(self):
+        inj = faults.FaultInjector(engine_stall_ms=200.0,
+                                   engine_stall_engine=0,
+                                   engine_stall_at=frozenset({2}))
+        assert inj.engine_stall_s(2, 0) == pytest.approx(0.2)
+        assert inj.engine_stall_s(3, 0) == 0.0
+        assert inj.engine_stall_s(2, 1) == 0.0
+        # empty step set = every step once armed
+        every = faults.FaultInjector(engine_stall_ms=100.0)
+        assert every.engine_stall_s(7, 0) == pytest.approx(0.1)
+
+    def test_router_snapshot_missing(self):
+        inj = faults.FaultInjector(
+            router_snapshot_missing=frozenset({1}))
+        assert not inj.should_skip_router_snapshot(0)
+        assert inj.should_skip_router_snapshot(1)
+
+
+# ---------------------------------------------------------------------------
+# structured refusals (the machine-readable `reason` field)
+# ---------------------------------------------------------------------------
+
+
+class TestStructuredRefusals:
+    def test_oversized_reason(self, model_and_params, step_fn):
+        model, params = model_and_params
+        reg = telemetry.MetricsRegistry()
+        eng, cache = make_engine(model, params, step_fn, reg)
+        state = cache.init_state()
+        eng.submit(serving.Request(id="big", prompt=[1] * 60,
+                                   max_new_tokens=60))
+        state, _ = eng.step(state)
+        res = eng.drain()
+        assert res[0].finish_reason == "error"
+        assert res[0].reason == "oversized"
+
+    def test_draining_reason(self, model_and_params, step_fn):
+        model, params = model_and_params
+        reg = telemetry.MetricsRegistry()
+        eng, cache = make_engine(model, params, step_fn, reg)
+        eng.draining = True
+        eng.submit(serving.Request(id="late", prompt=[1],
+                                   max_new_tokens=1))
+        res = eng.drain()
+        assert res[0].finish_reason == "error"
+        assert res[0].reason == "draining"
+        # normal completions carry no refusal reason
+        eng2, cache2 = make_engine(model, params, step_fn, reg)
+        s2 = cache2.init_state()
+        eng2.submit(serving.Request(id="ok", prompt=[1, 2],
+                                    max_new_tokens=2))
+        while not eng2.idle():
+            s2, _ = eng2.step(s2)
+        assert eng2.drain()[0].reason is None
+
+    def test_take_queued_withdraws_newest_first(self, model_and_params,
+                                                step_fn):
+        model, params = model_and_params
+        reg = telemetry.MetricsRegistry()
+        eng, _ = make_engine(model, params, step_fn, reg)
+        for i in range(3):
+            eng.submit(serving.Request(id=i, prompt=[1, 2],
+                                       max_new_tokens=1))
+        moved = eng.take_queued(2)
+        assert [r.id for r, _ in moved] == [2, 1]
+        assert [r.id for r, _ in eng.queue] == [0]
+        assert eng.drain() == []        # the engine forgot them cleanly
+
+
+# ---------------------------------------------------------------------------
+# placement
+# ---------------------------------------------------------------------------
+
+
+class TestPlacement:
+    def _affinity_workload(self, rng):
+        # two prefix families, each prefix spanning full blocks so the
+        # hash-chain index can match it after publication
+        pa = list(rng.randint(0, VOCAB, (2 * BS,)))
+        pb = list(rng.randint(0, VOCAB, (2 * BS,)))
+        return pa, pb
+
+    def _run(self, model, params, step_fn, placement):
+        rng = np.random.RandomState(31)
+        pa, pb = self._affinity_workload(rng)
+        router, reg, _, _ = make_fleet(model, params, step_fn, 2,
+                                       placement=placement)
+        # seed round: one request per family lands somewhere and
+        # publishes its prefix
+        seeds = {}
+        seeds["a"] = router.submit(serving.Request(
+            id="seed-a", prompt=pa + [1], max_new_tokens=2))
+        seeds["b"] = router.submit(serving.Request(
+            id="seed-b", prompt=pb + [2], max_new_tokens=2))
+        drive(router)
+        # repeat round: 4 requests per family
+        # each family submitted as a contiguous run, so round-robin
+        # necessarily splits every family across both engines
+        routed = {"a": [], "b": []}
+        for i in range(4):
+            routed["a"].append(router.submit(serving.Request(
+                id=f"a{i}", prompt=pa + [3 + i], max_new_tokens=2)))
+        for i in range(4):
+            routed["b"].append(router.submit(serving.Request(
+                id=f"b{i}", prompt=pb + [10 + i], max_new_tokens=2)))
+        drive(router)
+        misses = reg.counter("serving_prefix_cache_hits").value(
+            outcome="miss")
+        return seeds, routed, misses, reg
+
+    def test_affinity_beats_round_robin(self, model_and_params,
+                                        step_fn):
+        model, params = model_and_params
+        seeds, routed, miss_aff, reg = self._run(model, params, step_fn,
+                                                 "affinity")
+        # every repeat went to the engine holding its family's prefix
+        assert set(routed["a"]) == {seeds["a"]}
+        assert set(routed["b"]) == {seeds["b"]}
+        assert reg.counter("fleet_prefix_affinity_hits").value() >= 8
+        _, _, miss_rr, reg_rr = self._run(model, params, step_fn,
+                                          "round_robin")
+        assert reg_rr.counter("fleet_prefix_affinity_hits").value() == 0
+        # the golden: affinity pays each family's prefix prefill ONCE
+        # fleet-wide (only the seeds miss); round-robin replicates it
+        # onto every engine, so extra misses = duplicated prefill work
+        assert miss_aff == 2
+        assert miss_rr > miss_aff
+
+    def test_least_queue_fallback_spreads(self, model_and_params,
+                                          step_fn):
+        model, params = model_and_params
+        router, _, _, _ = make_fleet(model, params, step_fn, 2,
+                                     placement="least_queue")
+        names = [router.submit(r)
+                 for r in mk_requests(4, np.random.RandomState(32))]
+        assert names == ["e0", "e1", "e0", "e1"]
+        drive(router)
+
+    def test_shed_deprioritized_then_fleet_refusal(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        slos = [FakeSLO(), FakeSLO()]
+        router, reg, sink, tracer = make_fleet(
+            model, params, step_fn, 2, slos=slos,
+            placement="least_queue")
+        slos[0].shed = True
+        # e0 sheds: every placement avoids it while e1 lives
+        for i in range(3):
+            assert router.submit(serving.Request(
+                id=f"s{i}", prompt=[1, 2, 3], max_new_tokens=2)) == "e1"
+        drive(router)
+        # fleet-wide shed: structured refusal, never a silent drop
+        slos[1].shed = True
+        assert router.submit(serving.Request(
+            id="refused", prompt=[5, 6], max_new_tokens=2)) is None
+        res = router.merge_results()
+        assert len(res) == 1
+        assert res[0].id == "refused"
+        assert res[0].finish_reason == "error"
+        assert res[0].reason == "shedding"
+        assert reg.counter("fleet_shed").value() == 1
+        assert "fleet_shed" in [e["event"] for e in sink.events]
+        tr = [d for d in tracer.trace_dicts()
+              if d["request_id"] == "refused"]
+        assert tr and tr[-1]["outcome"] == "rejected"
+
+
+# ---------------------------------------------------------------------------
+# failover: kill -> recover, bitwise
+# ---------------------------------------------------------------------------
+
+
+class TestFailover:
+    def _crash_run(self, model, params, step_fn, tmp_path, *,
+                   snapshot_dir, extra_faults=None):
+        rng = np.random.RandomState(41)
+        reqs = mk_requests(6, rng)
+        clean = run_clean(model, params, step_fn, reqs)
+        router, reg, sink, tracer = make_fleet(
+            model, params, step_fn, 2, placement="least_queue",
+            snapshot_dir=snapshot_dir)
+        plan = dict(engine_crash_steps=frozenset({2}),
+                    engine_crash_engine=0)
+        plan.update(extra_faults or {})
+        with faults.inject(**plan):
+            for r in mk_requests(6, np.random.RandomState(41)):
+                router.submit(r)
+            results = drive(router)
+        return clean, results, router, reg, sink, tracer
+
+    def test_crash_recovers_bitwise_snapshot_path(
+            self, model_and_params, step_fn, tmp_path, monkeypatch):
+        from apex_tpu import records
+        from apex_tpu.telemetry import flight
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path / "r"))
+        model, params = model_and_params
+        flight.enable()
+        try:
+            clean, results, router, reg, _, tracer = self._crash_run(
+                model, params, step_fn, tmp_path,
+                snapshot_dir=str(tmp_path / "snaps"))
+        finally:
+            flight.disable()
+        got = {r.id: r.tokens for r in results}
+        # zero dropped, zero duplicated, every stream bitwise-identical
+        assert len(results) == 6
+        assert got == clean
+        assert all(r.finish_reason in ("length", "eos")
+                   for r in results)
+        [fo] = router.failovers
+        assert fo["engine"] == "e0" and fo["cause"] == "crash"
+        assert fo["source"] == "snapshot" and fo["snapshot"]
+        assert fo["recovered"]           # work really moved
+        assert reg.counter("fleet_failovers").value(cause="crash") == 1
+        assert reg.counter("fleet_requests_rerouted").value(
+            cause="crash") == len(fo["recovered"])
+        [h0] = [h for h in router.engines() if h.name == "e0"]
+        assert h0.status == "fenced"
+        # the bundle embeds the victim's last introspect + the plan
+        rec = records.latest_record(flight.FLIGHT_KIND,
+                                    require_backend=None)
+        assert rec["payload"]["trigger"] == "fleet_engine_lost"
+        extra = rec["payload"]["extra"]
+        assert extra["plan"]["source"] == "snapshot"
+        assert extra["last_introspect"] is not None
+        assert set(extra["plan"]["targets"].values()) == {"e1"}
+        # trace continuity: same trace id on both engines, resumed_from
+        # set, ONE perfetto track for the whole story
+        rid = fo["recovered"][0]
+        segs = [d for d in tracer.trace_dicts()
+                if d["request_id"] == str(rid)]
+        assert len(segs) == 2
+        assert len({d["trace_id"] for d in segs}) == 1
+        assert segs[0]["outcome"] == "drained"
+        assert segs[1]["outcome"] in ("length", "eos")
+        assert segs[1]["resumed_from"]
+        engines_seen = {m["args"]["engine"] for d in segs
+                        for m in d["marks"] if m["name"] == "routed"}
+        assert engines_seen == {"e0", "e1"}
+        trace = tracer.export_trace()
+        tcid = segs[0]["trace_id"]
+        tids = {e["tid"] for e in trace["traceEvents"]
+                if e.get("cat") == "request"
+                and e["args"].get("trace_id") == tcid}
+        assert len(tids) == 1
+        metas = [e for e in trace["traceEvents"] if e.get("ph") == "M"
+                 and e["tid"] in tids]
+        assert len(metas) == 1
+        assert "resumed_from=" in metas[0]["args"]["name"]
+
+    def test_crash_recovers_bitwise_forced_replay_path(
+            self, model_and_params, step_fn, tmp_path, monkeypatch):
+        from apex_tpu import records
+        from apex_tpu.telemetry import flight
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path / "r"))
+        model, params = model_and_params
+        flight.enable()
+        try:
+            clean, results, router, reg, _, _ = self._crash_run(
+                model, params, step_fn, tmp_path,
+                # snapshot_dir IS configured: the clause must force
+                # the replay branch anyway
+                snapshot_dir=str(tmp_path / "snaps"),
+                extra_faults=dict(
+                    router_snapshot_missing=frozenset({0})))
+        finally:
+            flight.disable()
+        assert {r.id: r.tokens for r in results} == clean
+        [fo] = router.failovers
+        assert fo["source"] == "replay" and fo["snapshot"] is None
+        rec = records.latest_record(flight.FLIGHT_KIND,
+                                    require_backend=None)
+        assert rec["payload"]["extra"]["plan"]["source"] == "replay"
+
+    def test_transient_router_fault_absorbed(self, model_and_params,
+                                             step_fn):
+        model, params = model_and_params
+        rng = np.random.RandomState(43)
+        reqs = mk_requests(4, rng)
+        clean = run_clean(model, params, step_fn, reqs)
+        router, reg, _, _ = make_fleet(model, params, step_fn, 2,
+                                       placement="least_queue",
+                                       retry_base_delay=0.0)
+        with faults.inject(io_errors={"fleet_router": frozenset({1})}):
+            for r in mk_requests(4, np.random.RandomState(43)):
+                router.submit(r)
+            results = drive(router)
+        assert {r.id: r.tokens for r in results} == clean
+        assert router.failovers == []
+        assert reg.counter("fleet_failovers").value() == 0
+
+    def test_wedged_engine_fenced_after_consecutive_failures(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        rng = np.random.RandomState(44)
+        reqs = mk_requests(4, rng)
+        clean = run_clean(model, params, step_fn, reqs)
+        router, reg, _, _ = make_fleet(model, params, step_fn, 2,
+                                       placement="least_queue",
+                                       max_step_failures=2,
+                                       step_retries=0,
+                                       retry_base_delay=0.0)
+        [h0] = [h for h in router.engines() if h.name == "e0"]
+        for r in mk_requests(4, np.random.RandomState(44)):
+            router.submit(r)
+        boom = [0]
+        real_step = h0.batcher.step
+
+        def wedged(state):
+            boom[0] += 1
+            raise RuntimeError("wedged engine")
+
+        h0.batcher.step = wedged
+        router.step()                       # failure 1: still seated
+        assert h0.status == "active" and h0.step_failures == 1
+        router.step()                       # failure 2: fence + recover
+        assert h0.status == "fenced"
+        h0.batcher.step = real_step
+        results = drive(router) + router.merge_results()
+        assert {r.id: r.tokens for r in results} == clean
+        [fo] = router.failovers
+        assert fo["cause"] == "wedged"
+        assert reg.counter("fleet_engine_step_errors").value(
+            engine="e0") == 2
+
+
+# ---------------------------------------------------------------------------
+# hedge, not kill
+# ---------------------------------------------------------------------------
+
+
+class TestHedge:
+    def test_stalled_engine_hedges_and_survives(self, model_and_params,
+                                                step_fn):
+        model, params = model_and_params
+        rng = np.random.RandomState(51)
+        reqs = mk_requests(8, rng)
+        clean = run_clean(model, params, step_fn, reqs)
+        router, reg, _, tracer = make_fleet(
+            model, params, step_fn, 2, placement="least_queue",
+            stall_after_s=0.25, hedge_max=2,
+            engine_kw=dict(max_batch=2, max_prefill_batch=2))
+        # queues back up behind max_batch=2, so e0 has NOT-yet-admitted
+        # work to hedge when its stall lands at router step 1
+        with faults.inject(engine_stall_ms=600.0,
+                           engine_stall_engine=0,
+                           engine_stall_at=frozenset({1})):
+            for r in mk_requests(8, np.random.RandomState(51)):
+                router.submit(r)
+            results = drive(router)
+        assert {r.id: r.tokens for r in results} == clean
+        [h0] = [h for h in router.engines() if h.name == "e0"]
+        # a slow-but-alive engine is never fenced: bounded hedge only
+        assert h0.status in ("active", "stalled")
+        assert router.failovers == []
+        assert reg.counter("fleet_failovers").value() == 0
+        assert 0 < h0.hedged <= 2
+        assert reg.counter("fleet_requests_rerouted").value(
+            cause="hedge") == h0.hedged
+        # a hedged request's old segment closed `rerouted`; the same
+        # trace id finished on the peer
+        rerouted = [d for d in tracer.trace_dicts()
+                    if d["outcome"] == "rerouted"]
+        assert rerouted
+        done = [d for d in tracer.trace_dicts()
+                if d["trace_id"] == rerouted[0]["trace_id"]
+                and d["outcome"] in ("length", "eos")]
+        assert done
+
+
+# ---------------------------------------------------------------------------
+# elastic membership under load
+# ---------------------------------------------------------------------------
+
+
+class TestMembership:
+    def test_join_and_leave_under_load(self, model_and_params, step_fn,
+                                       tmp_path):
+        model, params = model_and_params
+        rng = np.random.RandomState(61)
+        reqs = mk_requests(8, rng)
+        clean = run_clean(model, params, step_fn, reqs)
+        router, reg, _, _ = make_fleet(
+            model, params, step_fn, 2, placement="least_queue",
+            snapshot_dir=str(tmp_path))
+        results = []
+        for r in mk_requests(8, np.random.RandomState(61)):
+            router.submit(r)
+        for _ in range(2):
+            router.step()
+            results.extend(router.merge_results())
+        # join: warmup off the hot path, then admit
+        regsink = telemetry.MetricsRegistry()
+        b2, cache2 = make_engine(model, params, step_fn, regsink)
+        h2 = router.add_engine("e2", b2, cache2.init_state(), warm=True)
+        assert h2.status == "active"
+        assert b2.tracer is router.tracer   # one request plane
+        # leave under load: e0's work snapshots and redistributes
+        out = router.remove_engine("e0")
+        assert out["source"] == "snapshot"
+        results.extend(drive(router))
+        got = {r.id: r.tokens for r in results}
+        assert got == clean                 # zero lost, zero duplicated
+        [h0] = [h for h in router.engines() if h.name == "e0"]
+        assert h0.status == "removed"
+        # a planned exit is not a loss
+        assert router.failovers == []
+        assert reg.counter("fleet_failovers").value() == 0
+        assert reg.counter("fleet_requests_rerouted").value(
+            cause="remove") == len(out["recovered"])
+        with pytest.raises(ValueError):
+            router.remove_engine("e0")
+        assert reg.gauge("fleet_engines").value(state="removed") == 1
+
+    def test_introspect_fleet_view(self, model_and_params, step_fn):
+        model, params = model_and_params
+        router, _, _, _ = make_fleet(model, params, step_fn, 2)
+        router.submit(serving.Request(id="x", prompt=[1, 2, 3],
+                                      max_new_tokens=2))
+        intro = router.introspect()
+        assert set(intro["engines"]) == {"e0", "e1"}
+        e0 = intro["engines"]["e0"]
+        assert e0["status"] == "active"
+        assert e0["engine"]["pool"]["num_blocks"] == BLOCKS
+        assert intro["placement"] == "affinity"
+        assert intro["failovers"] == []
+        drive(router)

@@ -19,8 +19,12 @@ as one terminal screenful, from either
 
 File shapes are resolved by structure, not name (the
 telemetry_dump.py discipline): a records wrapper (``payload``), a
-flight bundle (``trigger``), or a bare introspection dict
-(``requests`` + ``pool``) all work.
+flight bundle (``trigger``), a fleet view (``engines`` +
+``placement`` — ``FleetRouter.introspect()``, rendered by
+``render_fleet`` with per-engine health rows, the failover log, and
+each engine's nested screen; ``fleet_engine_lost`` bundles render the
+victim's last introspect + the recovery plan), or a bare
+single-engine introspection dict (``requests`` + ``pool``) all work.
 """
 
 import argparse
@@ -116,6 +120,59 @@ def render(intro: Dict[str, Any]) -> str:
     return "\n".join(lines) + "\n"
 
 
+def render_fleet(intro: Dict[str, Any]) -> str:
+    """A ``FleetRouter.introspect()`` dict as a fleet screen: one
+    health row per engine (state, heartbeat age, last step, failures,
+    hedges, queue/prefill/decode load, shed flag), the failover log,
+    then each live engine's own screen nested below."""
+    lines: List[str] = []
+    engines = intro.get("engines") or {}
+    lines.append(
+        f"serving fleet  step={intro.get('step')}  "
+        f"placement={intro.get('placement')}  "
+        f"engines={len(engines)}  orphans={intro.get('orphans')}  "
+        f"refused_pending={intro.get('refused_pending')}")
+    lines.append(f"{'ENGINE':<12}{'STATE':<10}{'BEAT_S':>8}{'STEP_S':>8}"
+                 f"{'FAILS':>6}{'HEDGED':>7}{'Q':>4}{'PRE':>5}{'DEC':>5}"
+                 "  FLAGS")
+    for name in sorted(engines):
+        e = engines[name]
+        nested = e.get("engine") or {}
+        flags = []
+        if e.get("shedding"):
+            flags.append("SHED")
+        if e.get("error"):
+            flags.append(str(e["error"])[:40])
+        lines.append(
+            f"{name[:11]:<12}{str(e.get('status')):<10}"
+            f"{_fmt(e.get('heartbeat_age_s'), 2):>8}"
+            f"{_fmt(e.get('last_step_s'), 3):>8}"
+            f"{e.get('step_failures', 0):>6}{e.get('hedged', 0):>7}"
+            f"{_fmt(nested.get('queue_depth')):>4}"
+            f"{_fmt(nested.get('prefilling')):>5}"
+            f"{_fmt(nested.get('in_flight')):>5}"
+            f"  {' '.join(flags) or '-'}")
+    failovers = intro.get("failovers") or []
+    if failovers:
+        lines.append("")
+        lines.append(f"{'FAILOVER':<12}{'CAUSE':<9}{'SOURCE':<10}"
+                     f"{'STEP':>6}{'RECOV_MS':>10}  RECOVERED")
+        for f in failovers:
+            rec = f.get("recovered") or []
+            lines.append(
+                f"{str(f.get('engine'))[:11]:<12}"
+                f"{str(f.get('cause')):<9}{str(f.get('source')):<10}"
+                f"{_fmt(f.get('router_step')):>6}"
+                f"{_fmt((f.get('recover_s') or 0) * 1e3, 1):>10}"
+                f"  {', '.join(map(str, rec)) or '-'}")
+    out = "\n".join(lines) + "\n"
+    for name in sorted(engines):
+        nested = engines[name].get("engine")
+        if isinstance(nested, dict):
+            out += f"\n--- {name} ---\n" + render(nested)
+    return out
+
+
 def _trace_table(traces: List[Dict[str, Any]]) -> str:
     lines = [f"{'REQUEST':<14}{'TRACE':<22}{'OUTCOME':<18}"
              f"{'SPANS':>6}{'CHUNKS':>7}{'TTFT_S':>9}{'WALL_S':>9}"
@@ -157,6 +214,19 @@ def render_bundle(obj: Dict[str, Any]) -> str:
     intro = extra.get("introspect")
     if isinstance(intro, dict):
         out += "\n" + render(intro)
+    # fleet_engine_lost: the victim's final state + the recovery plan
+    last = extra.get("last_introspect")
+    if isinstance(last, dict):
+        out += (f"\nlost engine {extra.get('engine')} "
+                f"(cause={extra.get('cause')}) — last introspect:\n")
+        out += render(last)
+    plan = extra.get("plan")
+    if isinstance(plan, dict):
+        targets = plan.get("targets") or {}
+        out += (f"\nrecovery plan  source={plan.get('source')}  "
+                f"snapshot={plan.get('snapshot') or '-'}\n")
+        for rid, tgt in targets.items():
+            out += f"  {rid} -> {tgt or 'ORPHANED'}\n"
     traces = extra.get("traces")
     if traces:
         out += "\n" + _trace_table(traces)
@@ -190,6 +260,8 @@ def main(argv=None) -> int:
         return 2
     if "trigger" in payload:
         sys.stdout.write(render_bundle(payload))
+    elif "engines" in payload and "placement" in payload:
+        sys.stdout.write(render_fleet(payload))
     elif "requests" in payload and "pool" in payload:
         sys.stdout.write(render(payload))
     else:
