@@ -128,7 +128,7 @@ def write_record(kind: str, payload: Dict[str, Any],
 
         return retry_call(attempt, retries=3, base_delay=0.02,
                           max_delay=0.25, deadline=2.0,
-                          retry_on=(OSError,))
+                          retry_on=(OSError,), site="record_write")
     except Exception:  # noqa: BLE001
         return None
 

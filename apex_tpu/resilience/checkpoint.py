@@ -405,7 +405,7 @@ class CheckpointManager:
         # original OSError
         retry_call(self._write_once, target, buf, manifest,
                    retries=3, base_delay=0.05, max_delay=0.5, deadline=5.0,
-                   retry_on=(OSError,))
+                   retry_on=(OSError,), site="checkpoint_write")
         if faults.should_truncate(step):
             # simulated on-disk corruption of the FINALIZED checkpoint
             # (what latest_valid must skip): chop the payload in half
@@ -458,7 +458,7 @@ class CheckpointManager:
         commit.update(self._commit_extra(step, final, shas))
         retry_call(self._write_commit_once, final, commit,
                    retries=3, base_delay=0.05, max_delay=0.5, deadline=5.0,
-                   retry_on=(OSError,))
+                   retry_on=(OSError,), site="checkpoint_commit")
 
     def _commit_extra(self, step: int, final: str,
                       shas: Dict[str, str]) -> Dict[str, Any]:

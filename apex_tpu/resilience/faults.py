@@ -173,6 +173,36 @@ Fleet-router sites (apex_tpu/serving/fleet.py, docs/serving.md
                                  router's per-engine step site (call
                                  indexed) — absorbed by the router's
                                  ``resilience.retry`` backoff
+
+KV-handoff sites (apex_tpu/serving/fleet.py disaggregated
+prefill/decode, docs/serving.md "Disaggregated prefill/decode"):
+
+- ``kv_transfer_corrupt=<idx>``  flip ONE byte of the received KV
+                                 payload at these 0-based transfer
+                                 attempts (each attempt advances the
+                                 counter) — the per-block sha256
+                                 verify must refuse the install and
+                                 the retry re-sends the SAME manifest
+- ``kv_transfer_timeout=<idx>``  the transfer attempt raises a
+                                 transient ``FaultError`` before any
+                                 bytes move (a hung wire) — absorbed
+                                 by the handoff's ``resilience.retry``
+                                 backoff
+- ``kv_transfer_partial=<idx>``  zero the received payload's tail
+                                 block at these transfer attempts — a
+                                 torn transfer the block-by-block
+                                 verify must catch BEFORE install
+- ``handoff_orphan=<idx>``       abandon handoff number ``idx``
+                                 after export (as if the decode
+                                 target died holding the payload) —
+                                 the source's exported blocks must be
+                                 freed and scrubbed under the
+                                 dirty-block rule and the request
+                                 re-prefilled on a survivor
+- ``io:kv_handoff=<idx>``        transient ``FaultError`` at the
+                                 handoff transfer site (call indexed)
+                                 — the generic transient-wire drill,
+                                 absorbed by the same retry policy
 """
 
 from __future__ import annotations
@@ -252,6 +282,11 @@ class FaultInjector:
     engine_stall_engine: int = 0
     engine_stall_at: FrozenSet[int] = frozenset()
     router_snapshot_missing: FrozenSet[int] = frozenset()
+    # kv-handoff sites (apex_tpu/serving/fleet.py disaggregation)
+    kv_transfer_corrupt: FrozenSet[int] = frozenset()
+    kv_transfer_timeout: FrozenSet[int] = frozenset()
+    kv_transfer_partial: FrozenSet[int] = frozenset()
+    handoff_orphan: FrozenSet[int] = frozenset()
 
     def __post_init__(self):
         self._counts: Dict[str, int] = {}
@@ -461,6 +496,38 @@ class FaultInjector:
         usable — forcing the replay-from-prompt+generated path."""
         return int(index) in self.router_snapshot_missing
 
+    # -- kv-handoff sites --------------------------------------------------
+
+    def kv_transfer_fault(self) -> Optional[str]:
+        """Fault planned for THIS KV handoff transfer attempt (each
+        call advances the 0-based transfer-attempt index): one of
+        ``"corrupt"`` (flip one received byte — verify must refuse),
+        ``"timeout"`` (raise before any bytes move), ``"partial"``
+        (zero the received tail block — verify must refuse), or None
+        off-plan. Retries advance the counter too, so a single planned
+        index is absorbed by one idempotent re-send."""
+        with self._lock:
+            idx = self._counts.get("kv_transfer", 0)
+            self._counts["kv_transfer"] = idx + 1
+        if idx in self.kv_transfer_corrupt:
+            return "corrupt"
+        if idx in self.kv_transfer_timeout:
+            return "timeout"
+        if idx in self.kv_transfer_partial:
+            return "partial"
+        return None
+
+    def should_orphan_handoff(self) -> bool:
+        """True when THIS handoff (each call advances the 0-based
+        handoff index) must be abandoned after export — as if the
+        decode target died holding the payload. The router must free
+        and scrub the exported source blocks under the dirty-block
+        rule and re-prefill the request on a survivor."""
+        with self._lock:
+            idx = self._counts.get("handoff_orphan", 0)
+            self._counts["handoff_orphan"] = idx + 1
+        return idx in self.handoff_orphan
+
     def maybe_sigterm(self, step: int) -> None:
         """Deliver a REAL SIGTERM to this process at planned steps —
         the deterministic stand-in for the scheduler's preemption
@@ -542,6 +609,14 @@ class FaultInjector:
                 kw["engine_stall_at"] = _int_set(val)
             elif key == "router_snapshot_missing":
                 kw["router_snapshot_missing"] = _int_set(val)
+            elif key == "kv_transfer_corrupt":
+                kw["kv_transfer_corrupt"] = _int_set(val)
+            elif key == "kv_transfer_timeout":
+                kw["kv_transfer_timeout"] = _int_set(val)
+            elif key == "kv_transfer_partial":
+                kw["kv_transfer_partial"] = _int_set(val)
+            elif key == "handoff_orphan":
+                kw["handoff_orphan"] = _int_set(val)
             elif key.startswith("io:"):
                 kw["io_errors"][key[len("io:"):]] = _int_set(val)
             elif key.startswith("io_permanent:"):
@@ -707,12 +782,23 @@ def should_skip_router_snapshot(index: int) -> bool:
     return inj is not None and inj.should_skip_router_snapshot(index)
 
 
+def kv_transfer_fault() -> Optional[str]:
+    inj = active()
+    return None if inj is None else inj.kv_transfer_fault()
+
+
+def should_orphan_handoff() -> bool:
+    inj = active()
+    return inj is not None and inj.should_orphan_handoff()
+
+
 __all__ = [
     "ENV_KNOB", "EngineCrash", "FaultError", "FaultInjector",
     "SimulatedCrash",
     "active", "check", "collective_delay_s", "engine_stall_s",
     "flip_bits", "inject",
-    "install", "maybe_crash", "should_corrupt_collective",
+    "install", "kv_transfer_fault", "maybe_crash",
+    "should_corrupt_collective", "should_orphan_handoff",
     "maybe_crash_before_commit", "maybe_decode_exception",
     "maybe_engine_crash", "maybe_prefill_chunk_exception",
     "maybe_sigterm", "nonfinite_lane_at", "poison_grads",

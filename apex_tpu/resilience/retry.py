@@ -28,6 +28,13 @@ Design points:
   deterministic: the bytes on disk will hash the same on every
   attempt) must not burn the deadline pretending to be a transient
   disk hiccup.
+- **named sites are visible**: passing ``site="..."`` publishes every
+  retry sleep as ``retry_attempts{site=}`` plus a structured ``retry``
+  event (which rides the flight ring into bundles), and the terminal
+  outcomes as ``retry_exhausted{site=}`` / ``retry_give_up{site=}`` —
+  all on the process-default registry, all best-effort: telemetry can
+  never turn a retried call into a failed one. Without ``site`` the
+  call is as silent (and as cheap) as before.
 """
 
 from __future__ import annotations
@@ -44,6 +51,41 @@ _RNG = random.Random()
 # (interrupts, shutdown). Extended per call via ``give_up_on``.
 NON_RETRYABLE: Tuple[Type[BaseException], ...] = (
     KeyboardInterrupt, SystemExit)
+
+
+def _note_retry(site: str, attempt: int, exc: BaseException,
+                delay: float) -> None:
+    """One retry sleep at a named site: counter + flight-ring event.
+    Best-effort — telemetry must never fail the retried call."""
+    try:
+        from apex_tpu.telemetry import metrics as _metrics
+
+        reg = _metrics.registry()
+        reg.counter("retry_attempts",
+                    "retry_call sleeps (re-attempts) by site").inc(
+                        site=site)
+        reg.event("retry", site=site, attempt=int(attempt),
+                  delay_s=round(float(delay), 6),
+                  error=f"{type(exc).__name__}: {exc}")
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        pass
+
+
+def _note_terminal(site: str, outcome: str, exc: BaseException) -> None:
+    """A retry loop's terminal failure at a named site: ``outcome`` is
+    ``"exhausted"`` (budget burned) or ``"give_up"`` (non-retryable
+    pass-through). Best-effort, like :func:`_note_retry`."""
+    try:
+        from apex_tpu.telemetry import metrics as _metrics
+
+        reg = _metrics.registry()
+        reg.counter(f"retry_{outcome}",
+                    f"retry_call {outcome} terminal failures by "
+                    "site").inc(site=site)
+        reg.event(f"retry_{outcome}", site=site,
+                  error=f"{type(exc).__name__}: {exc}")
+    except Exception:  # noqa: BLE001 — observability is best-effort
+        pass
 
 
 def backoff_delays(retries: int, *, base_delay: float = 0.05,
@@ -77,6 +119,7 @@ def retry_call(
     sleep: Callable[[float], None] = time.sleep,
     monotonic: Callable[[], float] = time.monotonic,
     rng: Optional[random.Random] = None,
+    site: Optional[str] = None,
     **kwargs,
 ):
     """Call ``fn(*args, **kwargs)``, retrying ``retry_on`` exceptions up
@@ -90,7 +133,12 @@ def retry_call(
     re-raise from the FIRST attempt even when they also match
     ``retry_on`` — the escape hatch for deterministic failures dressed
     as I/O errors (e.g. a ``CheckpointError`` raised on validation:
-    the same bytes fail the same way on every retry)."""
+    the same bytes fail the same way on every retry).
+
+    ``site`` names the call site for telemetry (module docstring):
+    ``retry_attempts{site=}`` per sleep plus a ``retry`` event, and
+    ``retry_exhausted{site=}`` / ``retry_give_up{site=}`` on terminal
+    failure. ``None`` (the default) publishes nothing."""
     rng = rng if rng is not None else _RNG
     no_retry = NON_RETRYABLE + tuple(give_up_on)
     start = monotonic()
@@ -100,8 +148,12 @@ def retry_call(
             return fn(*args, **kwargs)
         except retry_on as e:
             if isinstance(e, no_retry):
+                if site is not None:
+                    _note_terminal(site, "give_up", e)
                 raise
             if attempt >= retries:
+                if site is not None:
+                    _note_terminal(site, "exhausted", e)
                 raise
             delay = min(max_delay, base_delay * (factor ** attempt))
             if jitter:
@@ -110,8 +162,12 @@ def retry_call(
             if deadline is not None:
                 remaining = deadline - (monotonic() - start)
                 if remaining <= 0:
+                    if site is not None:
+                        _note_terminal(site, "exhausted", e)
                     raise
                 delay = min(delay, remaining)
+            if site is not None:
+                _note_retry(site, attempt, e, delay)
             if on_retry is not None:
                 on_retry(attempt, e, delay)
             sleep(delay)

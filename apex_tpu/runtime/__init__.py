@@ -355,7 +355,8 @@ class PrefetchLoader:
                             retries=self._transfer_retries,
                             base_delay=self._retry_base_delay,
                             retry_on=(Exception,),
-                            on_retry=count_retry)
+                            on_retry=count_retry,
+                            site="device_put")
                     except Exception as e:  # noqa: BLE001 — death notice
                         put(_TransferFailure(e))
                         return

@@ -55,6 +55,12 @@ kill/replace failover that recovers a dead engine's work via drain
 snapshots (or prompt+generated replay) with token-identical streams
 and trace continuity across engines, bounded hedging for stalled
 engines, and elastic ``add_engine`` / ``remove_engine`` membership.
+``add_engine(role=...)`` splits the fleet into disaggregated
+``prefill`` / ``decode`` seats (DistServe-style): prefill-complete
+streams move over a manifest-verified KV-block handoff
+(``KVCache.export_blocks`` / ``import_blocks``) with retries, crash
+replay, orphan scrub, and a colocated-fallback latch behind it — zero
+dropped requests on every failure rung.
 """
 
 from apex_tpu.serving.decode import (
@@ -106,6 +112,7 @@ from apex_tpu.serving.tracing import (
 # imported LAST: fleet.py consumes the scheduler/resilience/tracing
 # modules above at import time (the router fronts all of them)
 from apex_tpu.serving.fleet import (  # noqa: E402
+    ENGINE_ROLES,
     ENGINE_STATES,
     EngineHandle,
     FleetRouter,
@@ -114,6 +121,7 @@ from apex_tpu.serving.fleet import (  # noqa: E402
 
 __all__ = [
     "ContinuousBatcher",
+    "ENGINE_ROLES",
     "ENGINE_STATES",
     "EngineHandle",
     "FleetRouter",

@@ -570,6 +570,51 @@ class KVCache:
                 out[i, :len(t)] = t
         return out
 
+    # -- disaggregated handoff (serving/fleet.py) --------------------------
+
+    def export_blocks(self, state: KVCacheState, seq_id, *,
+                      length: Optional[int] = None
+                      ) -> Tuple[List[int], np.ndarray, np.ndarray]:
+        """Extract a sequence's KV rows to the host for a cross-engine
+        handoff: ``(blocks, k, v)`` where ``blocks`` is the sequence's
+        block table (source indices, for the manifest) and ``k``/``v``
+        are ``(num_layers, n, block_size, kv_heads, head_dim)`` host
+        arrays. ``length`` bounds the export to the blocks that
+        actually hold tokens (``blocks_for(length)``) so the wire never
+        carries the unwritten decode-span tail; ``None`` exports the
+        whole reservation. Read-only on both the table (a locked copy)
+        and the pool — shared prefix blocks export fine."""
+        table = self.table(seq_id)           # locked copy; raises unknown
+        if length is not None:
+            table = table[:self.blocks_for(length)]
+        idx = np.asarray(table, np.int32)
+        return (list(table), np.asarray(state.k[:, idx]),
+                np.asarray(state.v[:, idx]))
+
+    def import_blocks(self, state: KVCacheState, seq_id, k,
+                      v) -> KVCacheState:
+        """Install exported KV rows into THIS pool's blocks for an
+        already-allocated ``seq_id`` (the receiving side of a handoff):
+        row block ``i`` of the payload lands in the sequence's block
+        ``table[i]``. The verify-before-install discipline is the
+        CALLER's (serving/fleet.py hashes every block against the
+        manifest first) — this method trusts its inputs. Returns the
+        new device state; the table/refcounts are untouched."""
+        import jax.numpy as jnp
+
+        table = self.table(seq_id)
+        k = np.asarray(k)
+        v = np.asarray(v)
+        n = k.shape[1]
+        if n > len(table):
+            raise ValueError(
+                f"import_blocks: payload holds {n} blocks but sequence "
+                f"{seq_id!r} reserves only {len(table)}")
+        idx = jnp.asarray(table[:n], jnp.int32)
+        return KVCacheState(
+            k=state.k.at[:, idx].set(jnp.asarray(k, state.k.dtype)),
+            v=state.v.at[:, idx].set(jnp.asarray(v, state.v.dtype)))
+
 
 def bucket(n: int, minimum: int = 1) -> int:
     """Next power of two >= max(n, minimum) — the shape-bucketing that

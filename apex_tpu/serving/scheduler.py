@@ -185,13 +185,24 @@ class RequestResult:
     bench reports (TTFT = submit -> first token; TPOT = mean
     inter-token interval after the first).
 
-    ``reason`` is the machine-readable refusal code set when an
-    ADMISSION is refused (``finish_reason == "error"`` with no work
-    done): ``"draining"`` (submit on a draining engine, or preempted
-    with no snapshot), ``"shedding"`` (fleet-wide SLO shed,
-    serving/fleet.py), or ``"oversized"`` (the request can never fit
-    the pool). None for every other outcome — routers must branch on
-    this field, never string-match ``error``."""
+    ``reason`` is the machine-readable code for every NON-normal
+    terminal outcome — routers (and the fleet's handoff path,
+    serving/fleet.py) must branch on this field, never string-match
+    ``error``:
+
+    - ``"draining"`` — submit on a draining engine, or preempted with
+      no snapshot (refusal, no work done)
+    - ``"shedding"`` — fleet-wide SLO shed (serving/fleet.py)
+    - ``"oversized"`` — the request can never fit the pool
+    - ``"handoff_degraded"`` — refused while the fleet's
+      colocated-fallback latch is closed (serving/fleet.py)
+    - ``"deadline_queued"`` / ``"deadline_prefilling"`` /
+      ``"deadline_in_flight"`` — TTL reaps, by the state the request
+      died in (``finish_reason == "deadline_exceeded"``)
+    - ``"quarantined"`` — per-request fault isolation
+      (``finish_reason == "error"``)
+
+    None for the normal outcomes (``length`` / ``eos``)."""
 
     id: Any
     tokens: List[int]
@@ -200,7 +211,7 @@ class RequestResult:
     # "length" | "eos" | "error" | "deadline_exceeded"
     finish_reason: str
     error: Optional[str] = None
-    # "draining" | "shedding" | "oversized" | None
+    # structured terminal-outcome code (docstring); None when normal
     reason: Optional[str] = None
 
 
@@ -371,7 +382,8 @@ class ContinuousBatcher:
 
     def _finish(self, fl: _InFlight, reason: str,
                 error: Optional[str] = None, *, dirty: bool = False,
-                clean_blocks: Sequence[int] = ()) -> None:
+                clean_blocks: Sequence[int] = (),
+                reason_code: Optional[str] = None) -> None:
         self._pending_copies.pop(fl.seq_id, None)
         self.cache.free(fl.seq_id, dirty=dirty, clean_blocks=clean_blocks)
         n = len(fl.generated)
@@ -392,7 +404,8 @@ class ContinuousBatcher:
                         ).observe(tpot)
         self._push_result(RequestResult(
             id=fl.req.id, tokens=list(fl.generated), ttft_s=ttft,
-            tpot_s=tpot, finish_reason=reason, error=error))
+            tpot_s=tpot, finish_reason=reason, error=error,
+            reason=reason_code))
 
     def _reject(self, req: Request, msg: str, *,
                 reason: str = "oversized") -> None:
@@ -522,6 +535,72 @@ class ContinuousBatcher:
             while self.queue and (max_n is None or len(out) < max_n):
                 out.append(self.queue.pop())
         return out
+
+    # -- disaggregated handoff hooks (serving/fleet.py) ----------------------
+
+    def take_prefilled(self, max_n: Optional[int] = None
+                       ) -> List[_InFlight]:
+        """Surrender up to ``max_n`` prefill-COMPLETE in-flight
+        sequences (prompt fully cached, first token sampled, decode
+        not started here) — the prefill side of a disaggregated
+        handoff (serving/fleet.py). The engine forgets each request
+        (no result, no trace transition: the caller owns both now)
+        but its KV reservation STAYS allocated: the caller must export
+        the blocks and then ``cache.free`` the sequence — on success
+        AND on failure — or the pool leaks. Engine-thread only, like
+        ``step``."""
+        out: List[_InFlight] = []
+        keep: List[_InFlight] = []
+        for f in self.running:
+            if ((max_n is None or len(out) < max_n)
+                    and f.prefilled >= len(f.req.prompt)
+                    and f.generated):
+                out.append(f)
+            else:
+                keep.append(f)
+        self.running = keep
+        for f in out:
+            self._pending_copies.pop(f.seq_id, None)
+        return out
+
+    def install_prefilled(self, state, req: Request,
+                          generated: Sequence[int], k, v, *,
+                          t_submit: float,
+                          t_first: Optional[float] = None,
+                          t_last: Optional[float] = None):
+        """Adopt a handed-off, prefill-complete request: reserve its
+        FULL decode span (prompt + max_new — the can-never-die-
+        mid-decode invariant holds from the first local step), install
+        the already-VERIFIED KV payload into the fresh blocks
+        (``KVCache.import_blocks``; verification is the caller's job,
+        before this is called), publish the prompt blocks into the
+        local prefix index, and join ``running`` directly — no queue,
+        no prefill. ``t_submit``/``t_first``/``t_last`` carry the
+        SOURCE engine's timestamps so TTFT/TPOT stay end-to-end
+        truthful. Raises :class:`PoolExhausted` (reserving nothing)
+        when the local pool cannot hold the span; returns the new
+        device state. Engine-thread only, like ``step``."""
+        total = len(req.prompt) + req.max_new_tokens
+        with self._lock:
+            self._seq_counter += 1
+            seq_id = ("h", self._seq_counter, req.id)
+        self.cache.allocate(seq_id, total)
+        try:
+            state = self.cache.import_blocks(state, seq_id, k, v)
+        except Exception:
+            self.cache.free(seq_id)
+            raise
+        fl = _InFlight(req=req, seq_id=seq_id,
+                       generated=[int(t) for t in generated],
+                       t_submit=t_submit, t_first=t_first,
+                       t_last=(t_last if t_last is not None else t_first),
+                       prefilled=len(req.prompt))
+        self.running.append(fl)
+        self.cache.publish_prefix(seq_id, req.prompt)
+        tr = self.tracer
+        if tr is not None and tr.enabled:
+            tr.decoding(req.id)
+        return state
 
     def idle(self) -> bool:
         with self._lock:
@@ -688,7 +767,8 @@ class ContinuousBatcher:
                 id=req.id, tokens=[], ttft_s=None, tpot_s=None,
                 finish_reason="deadline_exceeded",
                 error=f"deadline {req.deadline_ms:g}ms elapsed before "
-                      "admission"))
+                      "admission",
+                reason="deadline_queued"))
             ids.append(req.id)
         if expired_pre:
             gone = {id(f) for f in expired_pre}
@@ -700,7 +780,8 @@ class ContinuousBatcher:
                     where="prefilling")
                 self._finish(f, "deadline_exceeded",
                              error=f"deadline {f.req.deadline_ms:g}ms "
-                                   "elapsed mid-prefill")
+                                   "elapsed mid-prefill",
+                             reason_code="deadline_prefilling")
                 ids.append(f.req.id)
         if expired_run:
             gone = {id(f) for f in expired_run}
@@ -712,7 +793,8 @@ class ContinuousBatcher:
                     where="in_flight")
                 self._finish(f, "deadline_exceeded",
                              error=f"deadline {f.req.deadline_ms:g}ms "
-                                   "elapsed mid-decode")
+                                   "elapsed mid-decode",
+                             reason_code="deadline_in_flight")
                 ids.append(f.req.id)
         # flight-safe: the event rides the recorder's ring via the
         # registry sink — no bundle per expiry (deadlines are routine)
@@ -777,7 +859,8 @@ class ContinuousBatcher:
                 self.tracer.mark(f.req.id, "quarantine", self.clock(),
                                  reason=msg, step=idx)
             self._finish(f, "error", error=f"quarantined: {msg}",
-                         dirty=True, clean_blocks=excl)
+                         dirty=True, clean_blocks=excl,
+                         reason_code="quarantined")
             report["finished"].append(f.req.id)
         report.setdefault("quarantined", []).extend(
             f.req.id for f, _ in quarantined)

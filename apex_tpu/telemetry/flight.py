@@ -68,6 +68,15 @@ trigger                fired by
                        structured recovery plan — snapshot vs replay
                        source, snapshot path, and the survivor each
                        recovered request was rerouted to
+``kv_handoff_failed``  a disaggregated KV handoff exhausted its wire
+                       retries or the verified install was refused
+                       (``serving.fleet.FleetRouter``, host-local);
+                       the bundle's ``extra`` carries the transfer's
+                       sha256 manifest (root + per-block hashes), the
+                       LAST attempt's block-by-block verify status,
+                       the source/destination engines, and the
+                       attempt count — the stream itself survives on
+                       the source (colocated degradation)
 ====================== ====================================================
 
 Fleet-level triggers (the guard's, the shutdown's) fire on EVERY

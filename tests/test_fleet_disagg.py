@@ -1,0 +1,425 @@
+"""Disaggregated prefill/decode (apex_tpu/serving/fleet.py,
+docs/serving.md "Disaggregated prefill/decode").
+
+Anchors:
+
+- fault grammar: the ``kv_transfer_corrupt`` / ``kv_transfer_timeout``
+  / ``kv_transfer_partial`` / ``handoff_orphan`` clauses (+
+  ``io:kv_handoff``) parse from the env grammar and sequence
+  deterministically per transfer attempt / per handoff;
+- roles are POLICY, not capability: a prefill-only fleet decodes its
+  own streams, a decode-only fleet admits — zero drops outranks the
+  split, always bitwise vs a colocated run;
+- the clean split: prefill engines run admission + prefill then hand
+  the KV blocks to a decode engine over the manifested wire — every
+  stream bitwise, a ``handoff`` span per shipped request, ONE perfetto
+  track end to end;
+- verify-before-install: corrupt / timed-out / partial wire payloads
+  are refused against the sha256 manifest and re-sent (idempotent,
+  same root hash); the stream never sees a poisoned block;
+- the failure ladder: exhausted retries keep the stream decoding on
+  the SOURCE; repeated failures latch colocated fallback
+  (``reason="handoff_degraded"``, a ``kv_handoff_failed`` bundle with
+  the manifest + per-block verify log) and a clean health probe
+  unlatches it;
+- orphaned exports free dirty (scrub-before-reuse) and replay on the
+  same trace id; a decode engine dying MID-HANDOFF is fenced and the
+  victim re-prefills on a survivor — still bitwise.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from apex_tpu import serving, telemetry  # noqa: E402
+from apex_tpu.models.gpt import GPTConfig, GPTModel  # noqa: E402
+from apex_tpu.resilience import faults  # noqa: E402
+from apex_tpu.serving.kv_cache import KVCache  # noqa: E402
+
+VOCAB, SEQ, HID, LAYERS, HEADS, KV = 64, 64, 32, 2, 4, 2
+BLOCKS, BS = 24, 4
+
+
+def tiny_config(**kw):
+    base = dict(vocab_size=VOCAB, max_seq_len=SEQ, hidden_size=HID,
+                num_layers=LAYERS, num_heads=HEADS, num_kv_heads=KV,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+    base.update(kw)
+    return GPTConfig(**base)
+
+
+def fresh_cache(num_blocks=BLOCKS, block_size=BS):
+    return KVCache(LAYERS, KV, HID // HEADS, num_blocks=num_blocks,
+                   block_size=block_size, dtype=jnp.float32)
+
+
+@pytest.fixture(scope="module")
+def model_and_params():
+    model = GPTModel(tiny_config())
+    rng = np.random.RandomState(0)
+    toks = jnp.asarray(rng.randint(0, VOCAB, (1, 8)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), toks)
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def step_fn(model_and_params):
+    model, _ = model_and_params
+    return serving.make_decode_step(model, fresh_cache())
+
+
+def make_engine(model, params, step_fn, reg):
+    cache = fresh_cache()
+    b = serving.ContinuousBatcher(model, params, cache, step_fn=step_fn,
+                                  registry=reg, max_batch=4,
+                                  max_prefill_batch=4)
+    return b, cache
+
+
+def make_fleet(model, params, step_fn, roles, **router_kw):
+    router_kw.setdefault("stall_after_s", 30.0)
+    router_kw.setdefault("retry_base_delay", 0.0)
+    reg = telemetry.MetricsRegistry()
+    sink = telemetry.InMemorySink()
+    reg.add_sink(sink)
+    tracer = serving.RequestTracer()
+    router = serving.FleetRouter(registry=reg, tracer=tracer,
+                                 **router_kw)
+    for i, role in enumerate(roles):
+        b, cache = make_engine(model, params, step_fn, reg)
+        router.add_engine(f"e{i}", b, cache.init_state(), role=role)
+    return router, reg, sink, tracer
+
+
+def drive(router):
+    out = []
+    n = 0
+    while not router.idle():
+        router.step()
+        out.extend(router.merge_results())
+        n += 1
+        assert n < 500, "fleet did not converge"
+    out.extend(router.merge_results())
+    return out
+
+
+def mk_requests(n, rng, **kw):
+    return [serving.Request(
+        id=i, prompt=rng.randint(0, VOCAB, (int(rng.randint(2, 9)),)),
+        max_new_tokens=int(rng.randint(3, 7)), **kw) for i in range(n)]
+
+
+def run_clean(model, params, step_fn, requests):
+    """Token streams per id from an uninterrupted single-engine run."""
+    reg = telemetry.MetricsRegistry()
+    eng, cache = make_engine(model, params, step_fn, reg)
+    _, results = serving.serve_loop(eng, cache.init_state(), requests)
+    return {r.id: r.tokens for r in results}
+
+
+def run_disagg(model, params, step_fn, roles, requests, **router_kw):
+    router, reg, sink, tracer = make_fleet(model, params, step_fn,
+                                           roles, **router_kw)
+    for r in requests:
+        router.submit(serving.Request(id=r.id, prompt=r.prompt,
+                                      max_new_tokens=r.max_new_tokens))
+    results = drive(router)
+    return {r.id: r.tokens for r in results}, router, reg, sink, tracer
+
+
+# ---------------------------------------------------------------------------
+# fault grammar
+# ---------------------------------------------------------------------------
+
+
+class TestHandoffGrammar:
+    def test_env_grammar_parses_handoff_clauses(self):
+        inj = faults.FaultInjector.from_env(
+            "kv_transfer_corrupt=0,3;kv_transfer_timeout=1;"
+            "kv_transfer_partial=5;handoff_orphan=2;io:kv_handoff=4")
+        assert inj.kv_transfer_corrupt == frozenset({0, 3})
+        assert inj.kv_transfer_timeout == frozenset({1})
+        assert inj.kv_transfer_partial == frozenset({5})
+        assert inj.handoff_orphan == frozenset({2})
+        assert inj.io_errors["kv_handoff"] == frozenset({4})
+
+    def test_kv_transfer_fault_sequences_per_attempt(self):
+        inj = faults.FaultInjector(
+            kv_transfer_corrupt=frozenset({0}),
+            kv_transfer_timeout=frozenset({1}),
+            kv_transfer_partial=frozenset({2}))
+        # 0-based global attempt counter: one draw per wire transfer
+        assert inj.kv_transfer_fault() == "corrupt"
+        assert inj.kv_transfer_fault() == "timeout"
+        assert inj.kv_transfer_fault() == "partial"
+        assert inj.kv_transfer_fault() is None
+
+    def test_orphan_sequences_per_handoff(self):
+        inj = faults.FaultInjector(handoff_orphan=frozenset({1}))
+        assert not inj.should_orphan_handoff()
+        assert inj.should_orphan_handoff()
+        assert not inj.should_orphan_handoff()
+
+    def test_role_is_validated(self, model_and_params, step_fn):
+        model, params = model_and_params
+        assert serving.ENGINE_ROLES == ("prefill", "decode", "colocated")
+        router, reg, _, _ = make_fleet(model, params, step_fn, [])
+        b, cache = make_engine(model, params, step_fn, reg)
+        with pytest.raises(ValueError, match="role"):
+            router.add_engine("bad", b, cache.init_state(),
+                              role="prefetcher")
+
+
+# ---------------------------------------------------------------------------
+# the clean split: roles as policy, bitwise streams, one track
+# ---------------------------------------------------------------------------
+
+
+class TestDisaggClean:
+    def test_split_is_bitwise_with_handoff_spans(self, model_and_params,
+                                                 step_fn):
+        model, params = model_and_params
+        reqs = mk_requests(12, np.random.RandomState(7))
+        clean = run_clean(model, params, step_fn, reqs)
+        got, router, reg, _, tracer = run_disagg(
+            model, params, step_fn, ["prefill", "decode", "decode"],
+            reqs)
+        assert got == clean
+        intro = router.introspect()
+        ho = intro["handoff"]
+        assert ho["ok"] > 0, "no handoffs in a prefill/decode split"
+        assert ho["failed"] == 0 and ho["orphan"] == 0
+        assert ho["bytes"] > 0
+        assert not ho["fallback"]["latched"]
+        assert intro["engines"]["e0"]["role"] == "prefill"
+        assert intro["engines"]["e1"]["role"] == "decode"
+        assert intro["engines"]["e0"]["handoffs_out"] == ho["ok"]
+        assert (intro["engines"]["e1"]["handoffs_in"]
+                + intro["engines"]["e2"]["handoffs_in"]) == ho["ok"]
+        assert reg.counter("fleet_handoffs").value(outcome="ok") \
+            == ho["ok"]
+        assert reg.counter("fleet_handoff_bytes").value() == ho["bytes"]
+        # a `handoff` span per shipped request, on a SINGLE live
+        # segment — the perfetto export stays one track per request
+        done = tracer.completed()
+        spans = [s for t in done for s in t.spans
+                 if s["name"] == "handoff"]
+        assert len(spans) == ho["ok"]
+        assert all(s["args"]["src"] == "e0" for s in spans)
+        trace = tracer.export_trace()
+        metas = [e for e in trace["traceEvents"] if e.get("ph") == "M"]
+        assert len(metas) == len(reqs)
+
+    def test_prefill_only_fleet_decodes_locally(self, model_and_params,
+                                                step_fn):
+        # no decode seat anywhere: the role is routing policy, not a
+        # capability — the prefill engine IS the colocated floor
+        model, params = model_and_params
+        reqs = mk_requests(6, np.random.RandomState(8))
+        clean = run_clean(model, params, step_fn, reqs)
+        got, router, _, _, _ = run_disagg(
+            model, params, step_fn, ["prefill"], reqs)
+        assert got == clean
+        assert router.introspect()["handoff"]["ok"] == 0
+
+    def test_decode_only_fleet_admits(self, model_and_params, step_fn):
+        # admission prefers non-decode seats but never refuses for
+        # role purity: a decode-only fleet still serves everything
+        model, params = model_and_params
+        reqs = mk_requests(6, np.random.RandomState(9))
+        clean = run_clean(model, params, step_fn, reqs)
+        got, _, _, _, _ = run_disagg(
+            model, params, step_fn, ["decode", "decode"], reqs)
+        assert got == clean
+
+
+# ---------------------------------------------------------------------------
+# the failure ladder
+# ---------------------------------------------------------------------------
+
+
+class TestHandoffFaults:
+    def test_transient_wire_faults_absorbed_bitwise(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        reqs = mk_requests(12, np.random.RandomState(7))
+        clean = run_clean(model, params, step_fn, reqs)
+        with faults.inject(kv_transfer_corrupt=frozenset({0, 3}),
+                           kv_transfer_timeout=frozenset({1}),
+                           kv_transfer_partial=frozenset({5})):
+            got, router, reg, _, _ = run_disagg(
+                model, params, step_fn, ["prefill", "decode"], reqs)
+        # every refused payload was re-sent under the same manifest
+        # root; nothing corrupt ever installed
+        assert got == clean
+        ho = router.introspect()["handoff"]
+        assert ho["retries"] > 0
+        assert ho["failed"] == 0
+        assert reg.counter("fleet_handoff_retries").value() \
+            == ho["retries"]
+
+    def test_persistent_corrupt_latches_with_bundle(
+            self, model_and_params, step_fn, tmp_path, monkeypatch):
+        from apex_tpu import records
+        from apex_tpu.telemetry import flight
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path / "r"))
+        model, params = model_and_params
+        reqs = mk_requests(12, np.random.RandomState(7))
+        clean = run_clean(model, params, step_fn, reqs)
+        flight.enable()
+        try:
+            with faults.inject(
+                    kv_transfer_corrupt=frozenset(range(10000))):
+                got, router, reg, sink, _ = run_disagg(
+                    model, params, step_fn, ["prefill", "decode"],
+                    reqs, fallback_after=2)
+        finally:
+            flight.disable()
+        # zero dropped: every stream decoded locally on the source,
+        # bitwise — degraded mode costs the split, never a request
+        assert got == clean
+        ho = router.introspect()["handoff"]
+        assert ho["ok"] == 0 and ho["failed"] >= 2
+        assert ho["fallback"]["latched"]
+        assert ho["fallback"]["consecutive_failures"] >= 2
+        assert reg.gauge("fleet_colocated_fallback_latched").value() == 1
+        assert reg.counter("fleet_colocated_fallback").value(
+            transition="latched") == 1
+        assert reg.counter("fleet_requests_rerouted").value(
+            cause="handoff_degraded") == ho["failed"]
+        evs = [e for e in sink.events
+               if e.get("event") == "fleet_colocated_fallback"]
+        assert any(e.get("transition") == "latched"
+                   and e.get("reason") == "handoff_degraded"
+                   for e in evs)
+        assert "kv_handoff_failed" in [e["event"] for e in sink.events]
+        # the bundle embeds the manifest + the last attempt's verify
+        rec = records.latest_record(flight.FLIGHT_KIND,
+                                    require_backend=None)
+        assert rec["payload"]["trigger"] == "kv_handoff_failed"
+        extra = rec["payload"]["extra"]
+        assert extra["src"] == "e0" and extra["dst"] == "e1"
+        assert extra["attempts"] == 3          # handoff_retries=2 + 1
+        assert len(extra["manifest"]["root"]) == 64
+        assert extra["manifest"]["blocks"]
+        assert any(not v["ok"] for v in extra["verify"])
+
+    def test_clean_probe_unlatches(self, model_and_params, step_fn):
+        model, params = model_and_params
+        reqs = mk_requests(6, np.random.RandomState(7))
+        clean = run_clean(model, params, step_fn, reqs)
+        # the first four transfers corrupt, then the wire heals: with
+        # fallback_after=1 and no retries the first handoff latches,
+        # later probes fail until attempt 4, then one clean probe
+        # reopens the split for the remaining work
+        with faults.inject(kv_transfer_corrupt=frozenset(range(4))):
+            got, router, reg, sink, _ = run_disagg(
+                model, params, step_fn, ["prefill", "decode"], reqs,
+                fallback_after=1, handoff_retries=0)
+        assert {i: got[i] for i in got} == {i: clean[i] for i in got}
+        lat = [e.get("transition") for e in sink.events
+               if e.get("event") == "fleet_colocated_fallback"]
+        assert "latched" in lat and "unlatched" in lat
+        assert not router.introspect()["handoff"]["fallback"]["latched"]
+        assert reg.counter("fleet_handoff_probes").value(
+            outcome="ok") >= 1
+        assert reg.counter("fleet_handoff_probes").value(
+            outcome="failed") >= 1
+        assert reg.gauge("fleet_colocated_fallback_latched").value() == 0
+
+    def test_orphaned_export_scrubbed_and_replayed(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        reqs = mk_requests(12, np.random.RandomState(7))
+        clean = run_clean(model, params, step_fn, reqs)
+        with faults.inject(handoff_orphan=frozenset({0})):
+            got, router, reg, sink, tracer = run_disagg(
+                model, params, step_fn, ["prefill", "decode"], reqs)
+        assert got == clean
+        ho = router.introspect()["handoff"]
+        assert ho["orphan"] == 1
+        assert reg.counter("fleet_handoffs").value(outcome="orphan") == 1
+        assert reg.counter("fleet_requests_rerouted").value(
+            cause="handoff_orphan") == 1
+        assert "fleet_handoff_orphan" in [e["event"] for e in sink.events]
+        # the orphan's stream replayed under its ORIGINAL trace id
+        done = tracer.completed()
+        resumed = [t for t in done
+                   if t.resumed_from
+                   and t.resumed_from.startswith("handoff_")]
+        assert len(resumed) == 1
+        rerouted = [t for t in done if t.outcome == "rerouted"
+                    and t.trace_id == resumed[0].trace_id]
+        assert rerouted
+
+    def test_decode_crash_mid_handoff_replays_bitwise(
+            self, model_and_params, step_fn):
+        model, params = model_and_params
+        reqs = mk_requests(12, np.random.RandomState(7))
+        clean = run_clean(model, params, step_fn, reqs)
+        # at router step 0 the decode seat is still idle — the crash
+        # fires inside the transfer attempt, not the engine loop
+        with faults.inject(engine_crash_steps=frozenset({0}),
+                           engine_crash_engine=1):
+            got, router, reg, _, tracer = run_disagg(
+                model, params, step_fn,
+                ["prefill", "decode", "decode"], reqs)
+        assert got == clean
+        ho = router.introspect()["handoff"]
+        assert ho["dst_crash"] >= 1
+        assert router.failovers and router.failovers[0]["cause"] == "crash"
+        [h1] = [h for h in router.engines() if h.name == "e1"]
+        assert h1.status == "fenced"
+        assert reg.counter("fleet_requests_rerouted").value(
+            cause="handoff_dst_crash") >= 1
+        # still one perfetto track per request, crash and all
+        trace = tracer.export_trace()
+        by_req = {}
+        for ev in trace["traceEvents"]:
+            if ev.get("ph") == "M":
+                continue
+            rid = ev["args"].get("trace_id")
+            by_req.setdefault(rid, set()).add(ev["tid"])
+        assert by_req and all(len(t) == 1 for t in by_req.values())
+
+
+# ---------------------------------------------------------------------------
+# take_queued under concurrent submit (the withdraw/hedge edge)
+# ---------------------------------------------------------------------------
+
+
+class TestTakeQueuedRace:
+    def test_take_queued_races_concurrent_submit(self, model_and_params,
+                                                 step_fn):
+        # the router withdraws queued work (hedges, recovery) while the
+        # frontend keeps submitting: every request ends up EXACTLY once
+        # — either withdrawn or still queued, never both, never lost
+        model, params = model_and_params
+        reg = telemetry.MetricsRegistry()
+        eng, _ = make_engine(model, params, step_fn, reg)
+        N = 200
+        taken = []
+        stop = threading.Event()
+
+        def hammer():
+            while not stop.is_set():
+                taken.extend(eng.take_queued(2))
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            for i in range(N):
+                eng.submit(serving.Request(id=i, prompt=[1, 2],
+                                           max_new_tokens=1))
+        finally:
+            stop.set()
+            t.join()
+        taken.extend(eng.take_queued())
+        left = [r.id for r, _ in eng.queue]
+        got = sorted([r.id for r, _ in taken] + left)
+        assert got == list(range(N))

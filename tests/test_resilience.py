@@ -155,6 +155,63 @@ class TestRetry:
                           sleep=lambda d: None) == "ok"
         assert calls["n"] == 3
 
+    def test_named_site_publishes_attempts_and_terminals(
+            self, monkeypatch):
+        from apex_tpu import telemetry
+        from apex_tpu.telemetry import metrics as tmetrics
+
+        reg = telemetry.MetricsRegistry()
+        sink = telemetry.InMemorySink()
+        reg.add_sink(sink)
+        monkeypatch.setattr(tmetrics, "_REGISTRY", reg)
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert retry_call(flaky, retries=4, base_delay=0.0, jitter=0.0,
+                          sleep=lambda d: None, site="disk") == "ok"
+        # one counter bump + one flight-ring event per SLEEP, labelled
+        # by site, with the attempt index and the error on the event
+        assert reg.counter("retry_attempts").value(site="disk") == 2
+        evs = [e for e in sink.events if e["event"] == "retry"]
+        assert [e["attempt"] for e in evs] == [0, 1]
+        assert all(e["site"] == "disk" for e in evs)
+        assert all("transient" in e["error"] for e in evs)
+        # exhaustion: terminal counter + event, original exception kept
+        with pytest.raises(OSError, match="dead"):
+            retry_call(lambda: (_ for _ in ()).throw(OSError("dead")),
+                       retries=1, base_delay=0.0,
+                       sleep=lambda d: None, site="disk")
+        assert reg.counter("retry_exhausted").value(site="disk") == 1
+        assert "retry_exhausted" in [e["event"] for e in sink.events]
+        # give-up pass-through: its own terminal, zero extra attempts
+        def fatal():
+            raise CheckpointError("bad bytes")
+
+        with pytest.raises(CheckpointError):
+            retry_call(fatal, retries=3, retry_on=(Exception,),
+                       give_up_on=(CheckpointError,), base_delay=0.0,
+                       sleep=lambda d: None, site="ckpt")
+        assert reg.counter("retry_give_up").value(site="ckpt") == 1
+        assert reg.counter("retry_attempts").value(site="ckpt") == 0
+
+    def test_siteless_calls_publish_nothing(self, monkeypatch):
+        from apex_tpu import telemetry
+        from apex_tpu.telemetry import metrics as tmetrics
+
+        reg = telemetry.MetricsRegistry()
+        monkeypatch.setattr(tmetrics, "_REGISTRY", reg)
+        with pytest.raises(OSError):
+            retry_call(lambda: (_ for _ in ()).throw(OSError("x")),
+                       retries=1, base_delay=0.0, sleep=lambda d: None)
+        snap = reg.snapshot()
+        assert not any(n.startswith("retry")
+                       for n in snap.get("counters", {}))
+
     def test_keyboard_interrupt_never_retried(self):
         from apex_tpu.resilience.retry import NON_RETRYABLE
 

@@ -140,6 +140,64 @@ class TestAllocator:
         assert c.free("nope") == 0
 
 
+class TestPrefixProbe:
+    """prefix_match_len — the router's placement probe — at its edges:
+    degenerate prompts, a probe spanning the whole pool, and the
+    read-only contract (a probe never references, revives, or evicts
+    anything the admission path would then miss)."""
+
+    def _publish(self, c, seq, prompt, total):
+        c.allocate(seq, total)
+        c.publish_prefix(seq, prompt)
+
+    def test_empty_and_single_token_prompts(self):
+        c = fresh_cache()
+        assert c.prefix_match_len([]) == 0
+        assert c.prefix_match_len([5]) == 0
+        # still 0 when that very block IS published: the last prompt
+        # token always prefills (the first-token logits must exist),
+        # so a one-token prompt can never match
+        self._publish(c, "a", [5] * BS, 2 * BS)
+        assert c.prefix_match_len([5]) == 0
+        assert c.prefix_match_len([5] * BS) == 0        # cap len - 1
+        assert c.prefix_match_len([5] * (BS + 1)) == BS
+
+    def test_full_pool_probe_caps_at_len_minus_one(self):
+        c = fresh_cache()                # BLOCKS blocks, all published
+        prompt = [int(x) for x in np.random.RandomState(2).randint(
+            0, VOCAB, BLOCKS * BS)]
+        self._publish(c, "a", prompt, BLOCKS * BS)
+        c.free("a")                      # zero-ref: all blocks cached
+        # probing the exact published prompt leaves its own last token
+        # to prefill; one token more matches every published block
+        assert c.prefix_match_len(prompt) == (BLOCKS - 1) * BS
+        assert c.prefix_match_len(prompt + [7]) == BLOCKS * BS
+        # divergence in the first block: nothing matches
+        assert c.prefix_match_len([prompt[0] + 1] + prompt[1:]) == 0
+
+    def test_probe_never_mutates(self):
+        c = fresh_cache()
+        prompt = [int(x) for x in np.random.RandomState(3).randint(
+            0, VOCAB, 3 * BS)]
+        self._publish(c, "a", prompt, 4 * BS)
+        tbl = c.table("a")
+        c.free("a")
+        before = c.prefix_stats()
+        free_before = c.free_blocks
+        refs_before = [c.block_ref(b) for b in tbl]
+        for _ in range(3):
+            assert c.prefix_match_len(prompt) == 2 * BS
+        # read-only: no stats moved (hits/misses belong to admission),
+        # no block referenced, nothing evicted or freed
+        assert c.prefix_stats() == before
+        assert c.free_blocks == free_before
+        assert [c.block_ref(b) for b in tbl] == refs_before
+        # and the real reservation still finds what the probe promised
+        m = c.allocate_prefix("b", prompt, 4 * BS)
+        assert m.shared_blocks == 2
+        assert m.matched >= 2 * BS
+
+
 # ---------------------------------------------------------------------------
 # pool ops: append + gather is bitwise
 # ---------------------------------------------------------------------------

@@ -113,6 +113,7 @@ class TestDeadlines:
         res = eng.drain()
         assert len(res) == 1
         assert res[0].finish_reason == "deadline_exceeded"
+        assert res[0].reason == "deadline_queued"
         assert res[0].tokens == []
         assert reg.counter("serving_deadline_exceeded").value(
             where="queued") == 1
@@ -144,6 +145,7 @@ class TestDeadlines:
         assert rep["decoded"] == ["ok"]
         res = {r.id: r for r in eng.drain()}
         assert res["ttl"].finish_reason == "deadline_exceeded"
+        assert res["ttl"].reason == "deadline_in_flight"
         assert len(res["ttl"].tokens) == n_before
         assert reg.counter("serving_deadline_exceeded").value(
             where="in_flight") == 1
@@ -152,6 +154,34 @@ class TestDeadlines:
             state, _ = eng.step(state)
         out = eng.drain()
         assert out[0].id == "ok" and out[0].finish_reason == "length"
+        assert out[0].reason is None
+        assert cache.blocks_in_use == 0
+
+    def test_prefilling_deadline_reaps_mid_chunks(self, model_and_params,
+                                                  step_fn):
+        # a chunked long prompt expiring BETWEEN chunks reaps from the
+        # prefilling list with its own reason code — routers can tell
+        # "never admitted" from "died mid-prefill" from "died decoding"
+        model, params = model_and_params
+        cache = fresh_cache()
+        t = [0.0]
+        eng, reg, _ = make_batcher(model, params, step_fn, cache,
+                                   clock=lambda: t[0], prefill_chunk=4)
+        state = cache.init_state()
+        eng.submit(serving.Request(id="slow", prompt=[1] * 16,
+                                   max_new_tokens=4, deadline_ms=100.0))
+        state, rep = eng.step(state)     # first chunk in; 3 to go
+        assert rep["admitted"] == ["slow"]
+        assert not eng.idle()
+        t[0] = 0.5                       # expires mid-prefill
+        state, rep = eng.step(state)
+        assert rep["expired"] == ["slow"]
+        res = eng.drain()
+        assert res[0].finish_reason == "deadline_exceeded"
+        assert res[0].reason == "deadline_prefilling"
+        assert res[0].tokens == []       # never reached decode
+        assert reg.counter("serving_deadline_exceeded").value(
+            where="prefilling") == 1
         assert cache.blocks_in_use == 0
 
     def test_no_deadline_never_expires(self, model_and_params, step_fn):
@@ -232,6 +262,7 @@ class TestQuarantine:
             flight.disable()
         res = {r.id: r for r in eng.drain()}
         assert res[1].finish_reason == "error"
+        assert res[1].reason == "quarantined"
         assert "nonfinite" in res[1].error
         assert res[1].tokens == clean[1][:len(res[1].tokens)]
         # the survivors' full streams match the fault-free run exactly
@@ -276,9 +307,11 @@ class TestQuarantine:
             state, _ = eng.step(state)
         res = {r.id: r for r in eng.drain()}
         assert res[1].finish_reason == "error"
+        assert res[1].reason == "quarantined"
         assert "poisoned sequence" in res[1].error
         for i in (0, 2, 3):
             assert res[i].finish_reason == "length"
+            assert res[i].reason is None
             assert res[i].tokens == clean[i]
         assert reg.counter("serving_quarantined").value(
             reason="exception") == 1
@@ -342,6 +375,7 @@ class TestDrainResume:
         eng.submit(serving.Request(id="late", prompt=[1], max_new_tokens=1))
         late = eng.drain()
         assert late[0].finish_reason == "error"
+        assert late[0].reason == "draining"
         assert "draining" in late[0].error
 
         path = sresil.latest_snapshot(str(tmp_path))

@@ -49,7 +49,14 @@
 #    no-kill run, ZERO dropped or duplicated streams (every recovered
 #    stream bitwise-identical), traces continuous across engines (same
 #    trace id, resumed_from set), and tools/serving_top.py must render
-#    the fleet introspection.
+#    the fleet introspection, and
+#  - the DISAGG chaos soak: 300 requests through a 1-prefill/2-decode
+#    fleet with engine_crash + engine_stall_ms + kv_transfer_corrupt
+#    injected in ONE run — goodput >= 0.99 of the no-fault disagg run,
+#    ZERO dropped or duplicated streams, every stream bitwise-identical
+#    to the no-fault baseline, corrupt wire payloads absorbed by
+#    verified re-send (handoff retries > 0, nothing corrupt installed),
+#    and one continuous perfetto track per request across the handoff.
 # Extra args pass through to pytest.
 set -uo pipefail
 cd "$(dirname "$0")/.."
@@ -59,7 +66,7 @@ rc=0
 
 python -m pytest tests/test_serving.py tests/test_serving_resilience.py \
     tests/test_serving_hotpath.py tests/test_serving_request_plane.py \
-    tests/test_fleet_router.py \
+    tests/test_fleet_router.py tests/test_fleet_disagg.py \
     "$@" -q -p no:cacheprovider || rc=1
 
 echo "== 200-request smoke: continuous batching vs static batch =="
@@ -949,6 +956,127 @@ print(f"router chaos OK: killed e1 at step {fo['router_step']}, "
       f"survivors, replacement e3 joined warm; goodput {goodput:.3f}, "
       f"prefix hit-rate {rate1:.3f} vs {rate0:.3f} no-kill, "
       f"{len(metas)} continuous tracks")
+PY
+
+echo "== disagg chaos soak: 300 requests, 1 prefill + 2 decode, crash + stall + corrupt wire =="
+python - <<'PY' || rc=1
+import tempfile
+import shutil
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from apex_tpu import serving, telemetry
+from apex_tpu.models.gpt import GPTConfig, GPTModel
+from apex_tpu.resilience import faults
+
+cfg = GPTConfig(vocab_size=512, max_seq_len=128, hidden_size=128,
+                num_layers=2, num_heads=4, num_kv_heads=2,
+                dtype=jnp.float32, param_dtype=jnp.float32)
+model = GPTModel(cfg)
+rng = np.random.RandomState(0)
+params = model.init(jax.random.PRNGKey(0),
+                    jnp.asarray(rng.randint(0, 512, (1, 8)), jnp.int32))
+MAX_BATCH = 8
+N = 300
+_geom = serving.KVCache.for_config(cfg, num_blocks=MAX_BATCH * 8,
+                                   block_size=16)
+step_fn = serving.make_decode_step(model, _geom)
+
+
+def make_requests():
+    r = np.random.RandomState(7)
+    return [serving.Request(
+        id=i, prompt=list(r.randint(0, 512, (int(r.randint(4, 25)),))),
+        max_new_tokens=int(r.randint(4, 25))) for i in range(N)]
+
+
+def fleet(reg, tracer):
+    snapdir = tempfile.mkdtemp(prefix="apex_tpu_disagg_")
+    router = serving.FleetRouter(
+        registry=reg, tracer=tracer, stall_after_s=30.0,
+        snapshot_dir=snapdir)
+    for i, role in enumerate(["prefill", "decode", "decode"]):
+        cache = serving.KVCache.for_config(cfg, num_blocks=MAX_BATCH * 8,
+                                           block_size=16)
+        b = serving.ContinuousBatcher(
+            model, params, cache, step_fn=step_fn, max_batch=MAX_BATCH,
+            min_seq_bucket=32, registry=reg)
+        router.add_engine(f"{role[0]}{i}", b, cache.init_state(),
+                          warm=(i == 0), role=role)
+    return router, snapdir
+
+
+def drive(router, reqs):
+    for r in reqs:
+        router.submit(r)
+    results = []
+    while not router.idle():
+        router.step()
+        results.extend(router.merge_results())
+    results.extend(router.merge_results())
+    return results
+
+
+# no-fault disagg reference: the bitwise baseline and the goodput bar
+reg0 = telemetry.MetricsRegistry()
+router0, snap0 = fleet(reg0, serving.RequestTracer(keep=2 * N))
+base = {r.id: r.tokens for r in drive(router0, make_requests())}
+assert len(base) == N
+assert router0.handoff_stats["ok"] > 0, "no handoffs in clean disagg run"
+base_toks = sum(len(t) for t in base.values())
+
+# combined-fault run, everything in ONE injection: a decode engine
+# crashes mid-load, the other decode engine stalls for a stretch, and
+# the first six handoff wire transfers arrive corrupt — the first
+# handoff exhausts its retries (decodes locally on the prefill seat),
+# the second absorbs two corrupt sends and lands on the third attempt
+reg1 = telemetry.MetricsRegistry()
+tr1 = serving.RequestTracer(keep=2 * N)
+router1, snap1 = fleet(reg1, tr1)
+with faults.inject(engine_crash_steps=frozenset({14}),
+                   engine_crash_engine=2,
+                   engine_stall_ms=40.0, engine_stall_engine=1,
+                   engine_stall_at=frozenset({5, 6, 7}),
+                   kv_transfer_corrupt=frozenset(range(6))):
+    got_res = drive(router1, make_requests())
+
+# zero dropped, zero duplicated
+ids = [r.id for r in got_res]
+assert sorted(ids) == list(range(N)), (
+    f"dropped={set(range(N)) - set(ids)} dup={len(ids) - len(set(ids))}")
+assert router1.failovers and router1.failovers[0]["cause"] == "crash"
+
+# every stream bitwise-identical to the no-fault run: corrupt payloads
+# were refused before install, crash victims re-prefilled exactly
+got = {r.id: r.tokens for r in got_res}
+mismatch = [i for i in got if got[i] != base[i]]
+assert not mismatch, f"non-bitwise recovery for ids {mismatch[:5]}"
+ok_toks = sum(len(r.tokens) for r in got_res
+              if r.finish_reason in ("length", "eos"))
+goodput = ok_toks / base_toks
+assert goodput >= 0.99, f"goodput {goodput:.3f} < 0.99"
+
+ho = router1.handoff_stats
+assert ho["ok"] > 0, "no successful handoffs under fault load"
+assert ho["retries"] > 0, "corrupt wire never re-sent"
+
+# one continuous perfetto track per request — handoffs keep the live
+# segment, crash replays continue the same trace id
+trace = tr1.export_trace()
+metas = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+assert len(metas) == N, f"expected {N} tracks, got {len(metas)}"
+spans = [e for e in trace["traceEvents"]
+         if e.get("ph") == "X" and e.get("name") == "handoff"]
+assert spans, "no handoff spans in the exported trace"
+
+shutil.rmtree(snap0, ignore_errors=True)
+shutil.rmtree(snap1, ignore_errors=True)
+print(f"disagg chaos OK: {ho['ok']} handoffs ({ho['retries']} re-sends, "
+      f"{ho['failed']} fell back to local decode), crash on d2 replayed "
+      f"{len(router1.failovers[0]['recovered'])} streams; goodput "
+      f"{goodput:.3f}, {len(metas)} continuous tracks, all bitwise")
 PY
 
 if [ "$rc" -ne 0 ]; then

@@ -21,8 +21,10 @@ File shapes are resolved by structure, not name (the
 telemetry_dump.py discipline): a records wrapper (``payload``), a
 flight bundle (``trigger``), a fleet view (``engines`` +
 ``placement`` — ``FleetRouter.introspect()``, rendered by
-``render_fleet`` with per-engine health rows, the failover log, and
-each engine's nested screen; ``fleet_engine_lost`` bundles render the
+``render_fleet`` with per-engine health rows — disaggregation role
+and handoff counts included — the KV-handoff/colocated-fallback
+summary, the failover log, and each engine's nested screen;
+``fleet_engine_lost`` bundles render the
 victim's last introspect + the recovery plan), or a bare
 single-engine introspection dict (``requests`` + ``pool``) all work.
 """
@@ -122,18 +124,35 @@ def render(intro: Dict[str, Any]) -> str:
 
 def render_fleet(intro: Dict[str, Any]) -> str:
     """A ``FleetRouter.introspect()`` dict as a fleet screen: one
-    health row per engine (state, heartbeat age, last step, failures,
-    hedges, queue/prefill/decode load, shed flag), the failover log,
+    health row per engine (state, disaggregation role, heartbeat age,
+    last step, failures, hedges, handoffs, queue/prefill/decode load,
+    shed flag), the KV-handoff/fallback summary, the failover log,
     then each live engine's own screen nested below."""
     lines: List[str] = []
     engines = intro.get("engines") or {}
-    lines.append(
+    ho = intro.get("handoff") or {}
+    fb = ho.get("fallback") or {}
+    head = (
         f"serving fleet  step={intro.get('step')}  "
         f"placement={intro.get('placement')}  "
         f"engines={len(engines)}  orphans={intro.get('orphans')}  "
         f"refused_pending={intro.get('refused_pending')}")
-    lines.append(f"{'ENGINE':<12}{'STATE':<10}{'BEAT_S':>8}{'STEP_S':>8}"
-                 f"{'FAILS':>6}{'HEDGED':>7}{'Q':>4}{'PRE':>5}{'DEC':>5}"
+    if fb.get("latched"):
+        head += (f"  COLOCATED-FALLBACK(since step "
+                 f"{fb.get('since_step')})")
+    lines.append(head)
+    if ho:
+        lines.append(
+            f"handoffs  ok={ho.get('ok', 0)}  "
+            f"failed={ho.get('failed', 0)}  "
+            f"orphan={ho.get('orphan', 0)}  "
+            f"dst_crash={ho.get('dst_crash', 0)}  "
+            f"retries={ho.get('retries', 0)}  "
+            f"bytes={ho.get('bytes', 0)}")
+    lines.append(f"{'ENGINE':<12}{'STATE':<10}{'ROLE':<11}"
+                 f"{'BEAT_S':>8}{'STEP_S':>8}"
+                 f"{'FAILS':>6}{'HEDGED':>7}{'HO>':>5}{'>HO':>5}"
+                 f"{'Q':>4}{'PRE':>5}{'DEC':>5}"
                  "  FLAGS")
     for name in sorted(engines):
         e = engines[name]
@@ -145,9 +164,11 @@ def render_fleet(intro: Dict[str, Any]) -> str:
             flags.append(str(e["error"])[:40])
         lines.append(
             f"{name[:11]:<12}{str(e.get('status')):<10}"
+            f"{str(e.get('role', '-')):<11}"
             f"{_fmt(e.get('heartbeat_age_s'), 2):>8}"
             f"{_fmt(e.get('last_step_s'), 3):>8}"
             f"{e.get('step_failures', 0):>6}{e.get('hedged', 0):>7}"
+            f"{e.get('handoffs_out', 0):>5}{e.get('handoffs_in', 0):>5}"
             f"{_fmt(nested.get('queue_depth')):>4}"
             f"{_fmt(nested.get('prefilling')):>5}"
             f"{_fmt(nested.get('in_flight')):>5}"
