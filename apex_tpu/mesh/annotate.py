@@ -93,6 +93,29 @@ def constrain_batch_major(x):
     return constrain(x, BATCH_AXIS)
 
 
+def constrain_experts(x):
+    """An array whose LEADING dim is experts (the ``w1``/``w2`` expert
+    weights, the capacity path's (E, C, h) dispatch buffer): expert dim
+    split on ``model``, everything else replicated. Pinning the
+    dispatch buffer this way after the token-major scatter is what
+    makes XLA lower the MoE dispatch/combine to the token all-to-all
+    (docs/moe.md) — the GSPMD analog of the legacy shard_map
+    ``lax.all_to_all`` in :class:`~apex_tpu.moe.ExpertParallelMLP`."""
+    from apex_tpu.mesh.mesh import MODEL_AXIS
+
+    return constrain(x, MODEL_AXIS)
+
+
+def constrain_replicated(x):
+    """Pin fully replicated. The dropless MoE group-GEMM's ragged
+    per-expert groups align to NO mesh axis — GSPMD cannot partition
+    ``lax.ragged_dot`` correctly when its operands carry sharding
+    seeds (the global group sizes don't survive a split of either the
+    expert or the token dim) — so its endpoints are pinned replicated
+    and the capacity impl carries the EP scaling (docs/moe.md)."""
+    return constrain(x)
+
+
 def constrain_logits(x):
     """(s, b, vocab) logits: batch split, vocab replicated — the
     compiler inserts the row-parallel reduce upstream when the
@@ -154,8 +177,10 @@ __all__ = [
     "constrain",
     "constrain_batch_major",
     "constrain_column_parallel",
+    "constrain_experts",
     "constrain_hidden",
     "constrain_logits",
+    "constrain_replicated",
     "mesh_active",
     "serving_param_shardings",
     "shard_kv_pool",

@@ -286,7 +286,8 @@ class MeshTrainStep:
     FN = "mesh_train_step"
 
     def __init__(self, model, optimizer, plan: ShardingPlan, *,
-                 loss_fn=None):
+                 loss_fn=None, loss_has_aux: bool = False,
+                 aux_sink=None):
         self.model = model
         self.opt = optimizer
         self.plan = plan
@@ -297,8 +298,17 @@ class MeshTrainStep:
                 return gpt_loss_fn(model.apply(p, tokens), labels)
 
         self._loss_fn = loss_fn
+        # loss_has_aux: loss_fn returns (scalar, aux_pytree) — the MoE
+        # path's per-step stats. The public step signature stays
+        # (new_state, loss); aux lands on self.last_aux and is pushed
+        # through aux_sink(aux) each step (telemetry/moe.py's
+        # publish_moe_step is the standard sink).
+        self._has_aux = bool(loss_has_aux)
+        self._aux_sink = aux_sink
+        self.last_aux: Any = None
         self._jitted: Dict[Any, Any] = {}      # per-FlatSpace program
         self._seen: set = set()                # (space, seg_meta, shape)
+        self._step_count = 0                   # for the moe_* fault plan
 
     def init(self, params: Any) -> Any:
         """``opt.init`` then commit the state per the plan (identity
@@ -329,12 +339,19 @@ class MeshTrainStep:
         import jax
 
         opt = self.opt
-        vg = state.space.grad_fn(self._loss_fn, with_value=True)
+        vg = state.space.grad_fn(self._loss_fn, with_value=True,
+                                 has_aux=self._has_aux)
 
-        def step(state, tokens, labels):
-            loss, g = vg(state.master, tokens, labels)
-            _, new_state = opt.step_flat(state, g)
-            return new_state, loss
+        if self._has_aux:
+            def step(state, tokens, labels):
+                (loss, aux), g = vg(state.master, tokens, labels)
+                _, new_state = opt.step_flat(state, g)
+                return new_state, loss, aux
+        else:
+            def step(state, tokens, labels):
+                loss, g = vg(state.master, tokens, labels)
+                _, new_state = opt.step_flat(state, g)
+                return new_state, loss
 
         if self.plan.is_identity():
             jitted = jax.jit(step, donate_argnums=(0,))
@@ -346,12 +363,44 @@ class MeshTrainStep:
             state_sh = jax.tree.map(lambda _: rep, state)
             # pinned in/out state shardings: the donated carry keeps
             # the exact layout across steps, so the hot loop never
-            # re-lays-out (and AOT-published shardings stay honest)
+            # re-lays-out (and AOT-published shardings stay honest).
+            # The aux pytree (when present) replicates — rep is a
+            # legal pytree prefix for the whole subtree.
+            out_sh = ((state_sh, rep, rep) if self._has_aux
+                      else (state_sh, rep))
             jitted = jax.jit(step, donate_argnums=(0,),
                              in_shardings=(state_sh, bsh, bsh),
-                             out_shardings=(state_sh, rep))
+                             out_shardings=out_sh)
         self._jitted[key] = jitted
         return jitted
+
+    def _apply_moe_faults(self, state):
+        """The moe_router_collapse / moe_expert_dead drills
+        (resilience/faults.py): edit the flat master through the
+        space's unpack/pack round trip BEFORE the dispatch — data-level
+        poisoning through the REAL routing program, the
+        decode_nonfinite idiom applied to params. No-op (the same
+        state object) off-plan."""
+        from apex_tpu.resilience import faults as _faults
+
+        inj = _faults.active()
+        if inj is None:
+            return state
+        collapse = inj.should_collapse_router(self._step_count)
+        dead = inj.dead_expert()
+        if not collapse and dead is None:
+            return state
+        from apex_tpu.moe import poison_moe_params
+
+        tree = poison_moe_params(state.space.unpack(state.master),
+                                 collapse=collapse, dead_expert=dead)
+        master = state.space.pack(tree, dtype=state.master.dtype)
+        if not self.plan.is_identity():
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            master = jax.device_put(master, _named(self.plan.mesh, P()))
+        return state._replace(master=master)
 
     def _signature(self, state, tokens) -> Dict[str, Any]:
         return {"fn": self.FN, "space_total": int(state.space.total),
@@ -366,9 +415,13 @@ class MeshTrainStep:
 
     def step(self, state, tokens, labels) -> Tuple[Any, Any]:
         """One fused step; ``state`` is DONATED — rebind it. Returns
-        ``(new_state, loss)``."""
+        ``(new_state, loss)`` (aux, when the loss carries one, lands
+        on ``last_aux`` / the aux sink — the loop signature never
+        changes)."""
         import jax.numpy as jnp
 
+        state = self._apply_moe_faults(state)
+        self._step_count += 1
         tokens = self.plan.shard_batch(jnp.asarray(tokens, jnp.int32))
         labels = self.plan.shard_batch(jnp.asarray(labels, jnp.int32))
         jitted = self._jit_for(state)
@@ -387,21 +440,35 @@ class MeshTrainStep:
             _sharding.publish_shardings(_sharding.jitted_shardings(
                 jitted, state, tokens, labels, fn=self.FN))
             with _compiled.label(self.FN):
-                return jitted(state, tokens, labels)
-        return jitted(state, tokens, labels)
+                out = jitted(state, tokens, labels)
+        else:
+            out = jitted(state, tokens, labels)
+        if self._has_aux:
+            new_state, loss, aux = out
+            self.last_aux = aux
+            if self._aux_sink is not None:
+                self._aux_sink(aux)
+            return new_state, loss
+        return out
 
     __call__ = step
 
 
 def make_mesh_train_step(model, optimizer, plan: ShardingPlan, *,
-                         loss_fn=None) -> MeshTrainStep:
+                         loss_fn=None, loss_has_aux: bool = False,
+                         aux_sink=None) -> MeshTrainStep:
     """Build the GSPMD train step for ``model`` over ``plan``.
 
     ``loss_fn(params, tokens, labels) -> scalar`` defaults to the GPT
     LM loss (``gpt_loss_fn(model.apply(params, tokens), labels)``).
-    The returned step's ``init`` commits the optimizer state per the
-    plan and ``step``/``__call__`` donates it."""
-    return MeshTrainStep(model, optimizer, plan, loss_fn=loss_fn)
+    With ``loss_has_aux=True`` the loss returns ``(scalar, aux)`` and
+    each step deposits ``aux`` on ``step.last_aux`` / pushes it
+    through ``aux_sink`` (the MoE stats path, docs/moe.md) — the loop
+    signature stays ``state, loss = step(...)``. The returned step's
+    ``init`` commits the optimizer state per the plan and
+    ``step``/``__call__`` donates it."""
+    return MeshTrainStep(model, optimizer, plan, loss_fn=loss_fn,
+                         loss_has_aux=loss_has_aux, aux_sink=aux_sink)
 
 
 __all__ = [

@@ -97,13 +97,18 @@ class LayoutScore:
     schedule: str = "none"
     microbatches: int = 0
     bubble_fraction: float = 0.0
+    # MoE expert parallelism (PR-19): the dispatch/combine all-to-all
+    # bytes this tiling pays on the ``model`` axis, and the expert
+    # count it was priced for (0 = dense, no EP terms)
+    ep_wire_bytes: int = 0
+    num_experts: int = 0
 
     @property
     def total_ms(self) -> float:
         return self.compute_ms + self.comm_ms
 
     def detail(self) -> Dict[str, Any]:
-        return {
+        out = {
             "dp": self.dp, "tp": self.tp, "pp": self.pp,
             "schedule": self.schedule,
             "microbatches": self.microbatches,
@@ -116,6 +121,10 @@ class LayoutScore:
             "feasible": self.feasible,
             "reason": self.reason,
         }
+        if self.num_experts > 0:
+            out["ep_wire_bytes"] = int(self.ep_wire_bytes)
+            out["num_experts"] = int(self.num_experts)
+        return out
 
 
 @dataclasses.dataclass(frozen=True)
@@ -199,7 +208,10 @@ def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
                 mem_budget_bytes: Optional[int] = None,
                 link_gbps: Optional[float] = None,
                 peak_tflops: Optional[float] = None,
-                microbatches: int = 4) -> LayoutPlan:
+                microbatches: int = 4,
+                num_experts: int = 0, moe_top_k: int = 2,
+                moe_layer_freq: int = 1,
+                capacity_factor: float = 1.25) -> LayoutPlan:
     """Score every legal ``(dp, tp, pp)`` tiling of ``n_devices`` for
     one GPT-shaped training config and return them ranked.
 
@@ -234,7 +246,17 @@ def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
       sequence-parallel half split across ``tp``; a layout over
       ``mem_budget_bytes`` is infeasible (``reason: "memory"``), as is
       one whose ``tp`` does not divide the head count, ``pp`` over the
-      layer count, or ``dp`` over the global batch.
+      layer count, or ``dp`` over the global batch;
+    - **expert parallelism** (``num_experts > 0``, docs/moe.md) —
+      every ``moe_layer_freq``-th layer's dense MLP becomes
+      ``num_experts`` expert MLPs sharded on the SAME ``model`` axis
+      as tp. Weight memory grows by the full expert table, compute by
+      only the ``moe_top_k`` active experts per token (the MoE deal),
+      and each MoE layer pays dispatch + combine token all-to-alls
+      (fwd + bwd, ``op="all_to_all"`` on the PR-12 wire model) whose
+      payload scales with ``capacity_factor * top_k`` token copies. A
+      ``tp`` that does not divide ``num_experts`` leaves orphan
+      experts and is infeasible.
     """
     n = int(n_devices)
     h = int(hidden_size)
@@ -267,8 +289,17 @@ def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
     # denominator assumes): per-layer 4h^2 attn + 2*h*ffn MLP, plus
     # the embedding/readout table
     params = v * h + S * h + L * (4 * h * h + 2 * h * ffn + 9 * h)
+    E = max(int(num_experts), 0)
+    k = max(int(moe_top_k), 1)
+    n_moe = (L // max(int(moe_layer_freq), 1)) if E > 0 else 0
+    # MoE layers hold E expert MLPs + the gate (memory) but each token
+    # only runs top_k of them (flops) — params splits into the table
+    # the chips STORE vs the params a token TOUCHES
+    params += n_moe * ((E - 1) * 2 * h * ffn + h * E)
+    params_active = (v * h + S * h + L * (4 * h * h + 2 * h * ffn + 9 * h)
+                     + n_moe * ((k - 1) * 2 * h * ffn + h * E))
     tokens = B * S
-    step_flops = 6 * tokens * params + 12 * L * B * S * S * h
+    step_flops = 6 * tokens * params_active + 12 * L * B * S * S * h
     # one microbatch's boundary activation slab, and the full
     # per-device activation residency (~8 live (B,S,h) tensors/layer)
     act_total = 8 * B * S * h * L * FP32
@@ -281,6 +312,8 @@ def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
         base_reason = None
         if num_heads is not None and num_heads % tp:
             base_reason = f"tp={tp} does not divide num_heads={num_heads}"
+        elif E > 0 and tp > 1 and E % tp:
+            base_reason = f"tp={tp} does not divide num_experts={E}"
         elif pp > L:
             base_reason = f"pp={pp} exceeds num_layers={L}"
         elif dp > B:
@@ -355,6 +388,17 @@ def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
                 wire += pp_wire
                 comm_ms += (pp_wire / (link_gbps * 1e9) * 1e3
                             + n_ops * COLLECTIVE_LATENCY_MS)
+            ep_wire = 0
+            if E > 0 and tp > 1:   # MoE dispatch/combine all-to-alls:
+                # 2 per layer fwd + 2 bwd; payload = the shard's token
+                # copies (capacity_factor * top_k duplication) x hidden
+                n_ops = 4 * max(n_moe // pp, 1)
+                payload = int(capacity_factor * k
+                              * (B * S // max(dp, 1)) * h) * FP32
+                ep_wire = n_ops * _wire("all_to_all", payload, tp)
+                wire += ep_wire
+                comm_ms += (ep_wire / (link_gbps * 1e9) * 1e3
+                            + n_ops * COLLECTIVE_LATENCY_MS)
 
             cand = LayoutScore(
                 dp=dp, tp=tp, pp=pp, compute_ms=compute_ms,
@@ -362,7 +406,8 @@ def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
                 mem_bytes_per_device=int(mem),
                 feasible=reason is None, reason=reason,
                 schedule=sched, microbatches=mm,
-                bubble_fraction=float(bubble))
+                bubble_fraction=float(bubble),
+                ep_wire_bytes=int(ep_wire), num_experts=E)
             if best is None or (not cand.feasible, cand.total_ms,
                                 cand.mem_bytes_per_device) < \
                     (not best.feasible, best.total_ms,
@@ -383,6 +428,13 @@ def plan_layout(n_devices: int, *, hidden_size: int, num_layers: int,
                   "ffn_hidden_size": ffn, "global_batch": B,
                   "seq_len": S, "num_heads": num_heads},
     }
+    if E > 0:
+        objective["moe"] = {
+            "num_experts": E, "top_k": k,
+            "moe_layer_freq": int(moe_layer_freq),
+            "capacity_factor": float(capacity_factor),
+            "moe_layers": n_moe, "params_active": int(params_active),
+        }
     return LayoutPlan(n_devices=n, scores=tuple(scores),
                       objective=objective)
 
@@ -391,7 +443,8 @@ def plan_for_config(cfg, n_devices: int, *, global_batch: int,
                     **kwargs) -> LayoutPlan:
     """:func:`plan_layout` from a ``GPTConfig``-shaped object (reads
     ``hidden_size`` / ``num_layers`` / ``vocab_size`` /
-    ``ffn_hidden_size`` / ``num_heads``)."""
+    ``ffn_hidden_size`` / ``num_heads``, plus the MoE knobs when the
+    config carries them)."""
     return plan_layout(
         n_devices,
         hidden_size=cfg.hidden_size,
@@ -403,6 +456,14 @@ def plan_for_config(cfg, n_devices: int, *, global_batch: int,
         or getattr(cfg, "max_seq_len", 512),
         num_heads=(getattr(cfg, "num_heads", None)
                    or getattr(cfg, "num_attention_heads", None)),
+        num_experts=kwargs.pop("num_experts", None)
+        or getattr(cfg, "num_experts", 0) or 0,
+        moe_top_k=kwargs.pop("moe_top_k", None)
+        or getattr(cfg, "moe_top_k", 2),
+        moe_layer_freq=kwargs.pop("moe_layer_freq", None)
+        or getattr(cfg, "moe_layer_freq", 1),
+        capacity_factor=kwargs.pop("capacity_factor", None)
+        or getattr(cfg, "moe_capacity_factor", 1.25),
         **kwargs)
 
 

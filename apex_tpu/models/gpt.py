@@ -74,6 +74,22 @@ class GPTConfig:
     # round-3 bench_bert/gpt compile crashes). False restores per-layer
     # param names ("layer_{i}") for name-addressed checkpoints.
     scan_layers: bool = True
+    # Mixture-of-Experts (docs/moe.md): num_experts=0 is the dense
+    # model — every knob below is inert and the param tree is
+    # byte-identical to a pre-MoE checkpoint. num_experts>0 swaps the
+    # dense ParallelMLP for apex_tpu.moe.MoEMLP on designated layers
+    # (layer i is MoE iff i % moe_layer_freq == moe_layer_freq - 1;
+    # scan_layers needs freq 1 — homogeneous scan bodies).
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_layer_freq: int = 1
+    # "dropless" (sort + group-GEMM, no drops) or "capacity"
+    # (GShard (E, C) buffers; the mesh all-to-all EP path)
+    moe_impl: str = "dropless"
+    moe_capacity_factor: float = 1.25
+    # Switch aux-loss weight folded into the training loss by
+    # make_gpt_pretrain_step (0 trains without load balancing)
+    moe_aux_loss_weight: float = 0.01
 
     def __post_init__(self):
         if self.num_kv_heads is not None and self.num_kv_heads < 1:
@@ -95,6 +111,27 @@ class GPTConfig:
             raise ValueError(
                 "GQA (num_kv_heads != num_heads) is not supported by the "
                 "ring backend")
+        if self.num_experts < 0:
+            raise ValueError(
+                f"num_experts must be >= 0, got {self.num_experts}")
+        if self.num_experts > 0:
+            if self.moe_impl not in ("dropless", "capacity"):
+                raise ValueError(
+                    "moe_impl must be 'dropless' or 'capacity', got "
+                    f"{self.moe_impl!r}")
+            if not (1 <= self.moe_top_k <= self.num_experts):
+                raise ValueError(
+                    f"moe_top_k ({self.moe_top_k}) must be in "
+                    f"[1, num_experts={self.num_experts}]")
+            if self.moe_layer_freq < 1:
+                raise ValueError(
+                    f"moe_layer_freq must be >= 1, got "
+                    f"{self.moe_layer_freq}")
+            if self.scan_layers and self.moe_layer_freq != 1:
+                raise ValueError(
+                    "scan_layers requires homogeneous layers: "
+                    f"moe_layer_freq={self.moe_layer_freq} needs "
+                    "scan_layers=False (or set moe_layer_freq=1)")
 
     @property
     def kv_heads(self) -> int:
@@ -103,6 +140,26 @@ class GPTConfig:
     @property
     def ffn(self) -> int:
         return self.ffn_hidden_size or 4 * self.hidden_size
+
+    def is_moe_layer(self, i: int) -> bool:
+        """Layer ``i`` runs the MoE MLP (every ``moe_layer_freq``-th
+        layer, counting so freq 2 puts MoE on the odd layers)."""
+        return (self.num_experts > 0
+                and i % self.moe_layer_freq == self.moe_layer_freq - 1)
+
+    def moe_cfg(self):
+        """The :class:`~apex_tpu.moe.MoEConfig` this config's MoE
+        layers run."""
+        from apex_tpu.moe import MoEConfig
+
+        return MoEConfig(
+            hidden_size=self.hidden_size,
+            ffn_hidden_size=self.ffn,
+            num_experts=self.num_experts,
+            top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+            dtype=self.dtype,
+            param_dtype=self.param_dtype)
 
     # GPT-2 345M (BASELINE configs[3]: ref run_gpt_minimal_test.py)
     @staticmethod
@@ -324,9 +381,17 @@ class GPTLayer(nn.Module):
     """Pre-LN transformer block (ref ParallelTransformerLayer).
 
     ``kv_ctx``/``return_kv`` pass through to
-    :class:`ParallelAttention` (the serving decode/prefill hooks)."""
+    :class:`ParallelAttention` (the serving decode/prefill hooks).
+
+    ``moe`` selects the MLP: None lets the config decide (every layer
+    when ``num_experts>0`` with ``moe_layer_freq=1`` — the scan case);
+    the unrolled :class:`GPTModel` path passes
+    ``cfg.is_moe_layer(i)`` explicitly. The MoE MLP keeps the dense
+    block's ``mlp`` submodule name, so a dense config's param tree is
+    untouched (docs/moe.md)."""
 
     config: GPTConfig
+    moe: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x, *, positions=None, deterministic=True,
@@ -343,9 +408,18 @@ class GPTLayer(nn.Module):
         if cfg.hidden_dropout > 0.0 and not deterministic:
             a = nn.Dropout(rate=cfg.hidden_dropout)(a, deterministic=False)
         x = x + a
-        m = ParallelMLP(cfg, name="mlp")(
-            FusedLayerNorm(cfg.hidden_size, name="post_norm")(x)
-        )
+        use_moe = (self.moe if self.moe is not None
+                   else cfg.is_moe_layer(0) and cfg.moe_layer_freq == 1)
+        if use_moe:
+            from apex_tpu.moe import MoEMLP
+
+            m = MoEMLP(cfg.moe_cfg(), impl=cfg.moe_impl, name="mlp")(
+                FusedLayerNorm(cfg.hidden_size, name="post_norm")(x)
+            )
+        else:
+            m = ParallelMLP(cfg, name="mlp")(
+                FusedLayerNorm(cfg.hidden_size, name="post_norm")(x)
+            )
         if cfg.hidden_dropout > 0.0 and not deterministic:
             m = nn.Dropout(rate=cfg.hidden_dropout)(m, deterministic=False)
         y = x + m
@@ -441,7 +515,7 @@ class GPTModel(nn.Module):
             if serving:
                 scan = nn.scan(
                     _GPTScanBlockKV,
-                    variable_axes={"params": 0},
+                    variable_axes={"params": 0, "intermediates": 0},
                     split_rngs={"params": True, "dropout": True},
                     length=cfg.num_layers,
                     in_axes=((0 if kv_ctx is not None else nn.broadcast),
@@ -452,7 +526,7 @@ class GPTModel(nn.Module):
             else:
                 scan = nn.scan(
                     _GPTScanBlock,
-                    variable_axes={"params": 0},
+                    variable_axes={"params": 0, "intermediates": 0},
                     split_rngs={"params": True, "dropout": True},
                     length=cfg.num_layers,
                     in_axes=nn.broadcast,
@@ -463,7 +537,8 @@ class GPTModel(nn.Module):
             for i in range(cfg.num_layers):
                 ctx = (None if kv_ctx is None else
                        (kv_ctx[0][i], kv_ctx[1][i], ctx_mask))
-                x = GPTLayer(cfg, name=f"layer_{i}")(
+                x = GPTLayer(cfg, moe=cfg.is_moe_layer(i),
+                             name=f"layer_{i}")(
                     x, positions=positions, deterministic=deterministic,
                     kv_ctx=ctx, return_kv=serving)
                 if serving:
@@ -534,6 +609,12 @@ def gpt_param_specs(params: Any) -> Any:
             spec = P(TENSOR_AXIS)
         elif ("proj" in joined or "fc2" in joined) and names[-1] == "kernel":
             spec = P(None, TENSOR_AXIS)
+        elif names[-1] in ("w1", "w2"):
+            # MoE expert weights (E, h, ffn) / (E, ffn, h): shard the
+            # EXPERT dim on the model axis — expert parallelism rides
+            # the same mesh axis tensor parallelism does (docs/moe.md);
+            # the router gate stays replicated (falls through to P())
+            spec = P(TENSOR_AXIS, None, None)
         else:
             return P()
         if "layers" in names:

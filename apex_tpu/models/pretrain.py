@@ -64,6 +64,16 @@ def make_gpt_pretrain_step(
     code path, byte-identical programs (the mesh module's 1-chip
     guarantee). ``num_model_chunks > 1`` selects the interleaved-1F1B
     schedule regardless of ``schedule``.
+
+    MoE configs (``cfg.num_experts > 0``, docs/moe.md) swap in a loss
+    that applies the model with ``mutable=["intermediates"]``, folds
+    ``cfg.moe_aux_loss_weight x`` the Switch aux loss into the scalar,
+    and threads the per-step stats (aux loss, (E,) expert load,
+    dropped copies) out as the step's aux — published each step as the
+    ``moe_*`` gauges through
+    :func:`apex_tpu.telemetry.moe.publish_moe_step` (which also runs
+    the ``moe_imbalance`` EWMA latch). MoE + pipe>1 is not wired yet
+    and raises.
     """
     from apex_tpu import mesh as gmesh
 
@@ -84,6 +94,10 @@ def make_gpt_pretrain_step(
         sizes = dict(zip(plan.mesh.axis_names, plan.mesh.devices.shape))
         pp = int(sizes.get(gmesh.PIPE_AXIS, 1))
         if pp > 1:
+            if cfg.num_experts > 0:
+                raise NotImplementedError(
+                    "MoE over the pipe axis is not wired yet: run MoE "
+                    "configs with pipe=1 (dp x ep/tp on batch x model)")
             spec = gmesh.PipelineSpec(
                 schedule=("interleaved_1f1b" if num_model_chunks > 1
                           else schedule),
@@ -92,6 +106,24 @@ def make_gpt_pretrain_step(
                 num_model_chunks=max(num_model_chunks, 1))
             step = gmesh.make_mesh_pipeline_train_step(
                 model, optimizer, plan, spec, remat=remat)
+        elif cfg.num_experts > 0:
+            from apex_tpu.models.gpt import gpt_loss_fn
+            from apex_tpu.moe import collect_moe_stats
+            from apex_tpu.telemetry import moe as _tmoe
+
+            def moe_loss_fn(p, tokens, labels):
+                logits, mut = model.apply(
+                    p, tokens, mutable=["intermediates"])
+                stats = collect_moe_stats(
+                    mut, num_experts=cfg.num_experts)
+                lm = gpt_loss_fn(logits, labels)
+                total = lm + (cfg.moe_aux_loss_weight
+                              * stats["aux_loss"])
+                return total, {"lm_loss": lm, **stats}
+
+            step = gmesh.make_mesh_train_step(
+                model, optimizer, plan, loss_fn=moe_loss_fn,
+                loss_has_aux=True, aux_sink=_tmoe.publish_moe_step)
         else:
             step = gmesh.make_mesh_train_step(model, optimizer, plan)
         state = step.init(params)

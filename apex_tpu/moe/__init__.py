@@ -1,10 +1,10 @@
-"""Mixture-of-Experts: group-GEMM ops + expert-parallel layer.
+"""Mixture-of-Experts: group-GEMM ops + expert-parallel layers.
 
 The reference has no MoE module, but BASELINE configs[4] specifies a
 "group-GEMM / fused_dense MoE-style expert-parallel microbench" built
 from the fused-dense analogs (ref: apex/fused_dense/fused_dense.py,
 csrc/fused_dense_cuda.cu — cublasLt grouped/batched GEMMs). The TPU
-design provides two complementary paths:
+design provides three complementary paths (docs/moe.md):
 
   - **Dropless (megablocks-style)** — :func:`group_gemm` wraps
     ``lax.ragged_dot`` (the TPU group-GEMM primitive: one MXU pass over
@@ -17,20 +17,29 @@ design provides two complementary paths:
     (experts, capacity) buffer via one-hot/cumsum masks, runs batched
     expert matmuls, and — inside ``shard_map`` over the "expert" mesh
     axis — exchanges the expert dimension with ``lax.all_to_all`` so
-    each device computes only its local experts. This is the
-    all-to-all EP pattern that rides ICI.
+    each device computes only its local experts. This is the legacy
+    explicit-collective toolbox variant of the all-to-all EP pattern.
+  - **Mesh-native (GSPMD)** — :class:`MoEMLP` is the
+    :class:`~apex_tpu.models.gpt.GPTLayer` drop-in: expert params
+    shard on the mesh's ``model`` axis via NamedShardings
+    (``gpt_param_specs``) and in-jit ``with_sharding_constraint``
+    hints, so XLA lowers the capacity dispatch/combine layout changes
+    to the token all-to-all — no shard_map anywhere on this path
+    (docs/mesh.md). Both ``impl="dropless"`` and ``impl="capacity"``
+    ride it.
 """
 
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import flax.linen as nn
 import jax
 import jax.numpy as jnp
 from jax import lax
 
+from apex_tpu.mesh import annotate as _gspmd
 from apex_tpu.transformer.parallel_state import EXPERT_AXIS
 from apex_tpu.transformer.tensor_parallel.layers import _inside_axis
 
@@ -48,11 +57,14 @@ def group_gemm(
     padding tokens to per-expert capacity — the group-GEMM of the
     reference's cublasLt grouped-batched path (ref: setup.py:376-388
     fused_dense_cuda).
+
+    No ``preferred_element_type`` here: ``ragged_dot``'s transpose
+    rule emits cotangents in the accumulator dtype, so a f32
+    accumulator under bf16 operands breaks the backward pass with a
+    dtype mismatch. The MXU accumulates bf16 matmuls in f32
+    regardless.
     """
-    return lax.ragged_dot(
-        tokens, weights, group_sizes,
-        preferred_element_type=jnp.float32,
-    ).astype(tokens.dtype)
+    return lax.ragged_dot(tokens, weights, group_sizes)
 
 
 def router_topk(
@@ -82,6 +94,13 @@ def load_balancing_loss(probs: jax.Array, expert_ids: jax.Array) -> jax.Array:
     return E * jnp.sum(f * p)
 
 
+def expert_load(expert_ids: jax.Array, num_experts: int) -> jax.Array:
+    """(E,) fp32 count of (token, choice) assignments per expert — the
+    in-jit histogram behind the ``moe_expert_load{expert=}`` gauges."""
+    onehot = jax.nn.one_hot(expert_ids, num_experts, dtype=jnp.float32)
+    return jnp.sum(onehot.reshape(-1, num_experts), axis=0)
+
+
 @dataclasses.dataclass(frozen=True)
 class MoEConfig:
     hidden_size: int
@@ -93,14 +112,80 @@ class MoEConfig:
     param_dtype: Any = jnp.float32
 
 
+def _dropless_experts(x, weights, ids, w1, w2, cfg: MoEConfig):
+    """Sort + group-GEMM expert compute over (n, h) tokens; returns
+    (combined (n, h), dropped scalar — always 0: dropless)."""
+    n, h = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    # flatten k copies, stable-sort by expert so groups are contiguous
+    flat_ids = ids.reshape(-1)                     # (n*k,)
+    order = jnp.argsort(flat_ids, stable=True)
+    inv = jnp.argsort(order)
+    tok_rep = jnp.repeat(x, k, axis=0)[order]      # (n*k, h) sorted
+    group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
+
+    h1 = group_gemm(tok_rep.astype(cfg.dtype), w1.astype(cfg.dtype),
+                    group_sizes)
+    h1 = jax.nn.gelu(h1, approximate=True)
+    h2 = group_gemm(h1, w2.astype(cfg.dtype), group_sizes)
+
+    out = h2[inv].reshape(n, k, h)                 # back to token order
+    out = jnp.sum(out * weights[..., None].astype(cfg.dtype), axis=1)
+    return out, jnp.zeros((), jnp.float32)
+
+
+def _capacity_dispatch(x, weights, ids, cfg: MoEConfig):
+    """The GShard dispatch bookkeeping over (n, h) tokens: scatter the
+    token copies into an (E, C, h) buffer. Returns
+    (buf, dest (n*k,), keep (n, k), capacity)."""
+    n, h = x.shape
+    E, k = cfg.num_experts, cfg.top_k
+    C = max(1, int(cfg.capacity_factor * n * k / E))
+    # position of each (token, choice) within its expert's buffer:
+    # cumsum over the flattened (choice-major) one-hot stream so
+    # earlier tokens / lower k win capacity slots. O(n*k*E) ints —
+    # the (expert, capacity) buffers below are built by scatter /
+    # gather instead of dispatch-mask einsums, so nothing of size
+    # (n, E, C) is ever materialized (C grows with n).
+    onehot = jax.nn.one_hot(ids, E, dtype=jnp.int32)   # (n, k, E)
+    flat = onehot.transpose(1, 0, 2).reshape(k * n, E)
+    pos_flat = jnp.cumsum(flat, axis=0) - 1            # (k*n, E)
+    pos = (pos_flat * flat).sum(-1).reshape(k, n).transpose(1, 0)  # (n,k)
+    keep = pos < C
+
+    # scatter token copies into the (E*C, h) buffer; dropped copies
+    # get an out-of-range destination and fall away (mode="drop")
+    dest = jnp.where(keep, ids * C + pos, E * C).reshape(-1)   # (n*k,)
+    x_rep = jnp.repeat(x.astype(cfg.dtype), k, axis=0)         # (n*k, h)
+    buf = jnp.zeros((E * C, h), cfg.dtype).at[dest].add(
+        x_rep, mode="drop").reshape(E, C, h)
+    return buf, dest, keep, C
+
+
+def _capacity_combine(h2, dest, keep, weights, n: int, cfg: MoEConfig):
+    """Gather each token copy's expert output and combine with the
+    router weights (dropped copies contribute zero)."""
+    E, k = cfg.num_experts, cfg.top_k
+    h = h2.shape[-1]
+    C = h2.shape[1]
+    out = jnp.take(h2.reshape(E * C, h), jnp.minimum(dest, E * C - 1),
+                   axis=0)                                     # (n*k, h)
+    w = (weights.reshape(-1) * keep.reshape(-1)).astype(cfg.dtype)
+    return jnp.sum((out * w[:, None]).reshape(n, k, h), axis=1)
+
+
 class GroupedMLP(nn.Module):
     """Dropless MoE MLP via sort + group-GEMM (single device, or the
-    per-shard compute of a dropless EP layer). Input (n, h) tokens."""
+    per-shard compute of a dropless EP layer). Input (n, h) tokens.
+
+    ``return_stats=True`` additionally returns the per-call stats dict
+    (``aux_loss`` scalar, ``expert_load`` (E,), ``dropped`` scalar —
+    always 0 here, ``keep`` (n, k) all-True mask)."""
 
     config: MoEConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, return_stats: bool = False):
         cfg = self.config
         n, h = x.shape
         E, k = cfg.num_experts, cfg.top_k
@@ -112,23 +197,16 @@ class GroupedMLP(nn.Module):
                         (E, cfg.ffn_hidden_size, h), cfg.param_dtype)
 
         weights, ids, probs = router_topk(x, gate.astype(cfg.dtype), k)
-        self.sow("intermediates", "aux_loss",
-                 load_balancing_loss(probs, ids))
+        aux = load_balancing_loss(probs, ids)
+        self.sow("intermediates", "aux_loss", aux)
 
-        # flatten k copies, stable-sort by expert so groups are contiguous
-        flat_ids = ids.reshape(-1)                     # (n*k,)
-        order = jnp.argsort(flat_ids, stable=True)
-        inv = jnp.argsort(order)
-        tok_rep = jnp.repeat(x, k, axis=0)[order]      # (n*k, h) sorted
-        group_sizes = jnp.bincount(flat_ids, length=E).astype(jnp.int32)
-
-        h1 = group_gemm(tok_rep.astype(cfg.dtype), w1.astype(cfg.dtype),
-                        group_sizes)
-        h1 = jax.nn.gelu(h1, approximate=True)
-        h2 = group_gemm(h1, w2.astype(cfg.dtype), group_sizes)
-
-        out = h2[inv].reshape(n, k, h)                 # back to token order
-        return jnp.sum(out * weights[..., None].astype(cfg.dtype), axis=1)
+        out, dropped = _dropless_experts(x, weights, ids, w1, w2, cfg)
+        if return_stats:
+            return out, {"aux_loss": aux,
+                         "expert_load": expert_load(ids, E),
+                         "dropped": dropped,
+                         "keep": jnp.ones((n, k), bool)}
+        return out
 
 
 class ExpertParallelMLP(nn.Module):
@@ -141,16 +219,20 @@ class ExpertParallelMLP(nn.Module):
     the dispatched buffer expert-major -> token-major and back.
     Tokens over a full expert's capacity are dropped (their output is
     the zero vector), matching Switch/GShard semantics.
-    """
+
+    Drops are never silent: the count is sown as the ``moe_dropped``
+    intermediate, and ``return_stats=True`` returns the full stats
+    dict — ``aux_loss``, ``expert_load`` (E,), ``dropped`` scalar, and
+    the per-(token, choice) ``keep`` (n, k) drop mask — so callers can
+    publish ``moe_dropped_tokens`` (telemetry/moe.py)."""
 
     config: MoEConfig
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, return_stats: bool = False):
         cfg = self.config
         n, h = x.shape
         E, k = cfg.num_experts, cfg.top_k
-        C = max(1, int(cfg.capacity_factor * n * k / E))
         gate = self.param("gate", nn.initializers.normal(stddev=0.02),
                           (h, E), cfg.param_dtype)
         inside = _inside_axis(EXPERT_AXIS)
@@ -164,28 +246,10 @@ class ExpertParallelMLP(nn.Module):
                         (e_local, cfg.ffn_hidden_size, h), cfg.param_dtype)
 
         weights, ids, probs = router_topk(x, gate.astype(cfg.dtype), k)
-        self.sow("intermediates", "aux_loss",
-                 load_balancing_loss(probs, ids))
+        aux = load_balancing_loss(probs, ids)
+        self.sow("intermediates", "aux_loss", aux)
 
-        # position of each (token, choice) within its expert's buffer:
-        # cumsum over the flattened (choice-major) one-hot stream so
-        # earlier tokens / lower k win capacity slots. O(n*k*E) ints —
-        # the (expert, capacity) buffers below are built by scatter /
-        # gather instead of dispatch-mask einsums, so nothing of size
-        # (n, E, C) is ever materialized (C grows with n).
-        onehot = jax.nn.one_hot(ids, E, dtype=jnp.int32)   # (n, k, E)
-        flat = onehot.transpose(1, 0, 2).reshape(k * n, E)
-        pos_flat = jnp.cumsum(flat, axis=0) - 1            # (k*n, E)
-        pos = (pos_flat * flat).sum(-1).reshape(k, n).transpose(1, 0)  # (n,k)
-        keep = pos < C
-
-        # scatter token copies into the (E*C, h) buffer; dropped copies
-        # get an out-of-range destination and fall away (mode="drop")
-        dest = jnp.where(keep, ids * C + pos, E * C).reshape(-1)   # (n*k,)
-        x_rep = jnp.repeat(x.astype(cfg.dtype), k, axis=0)         # (n*k, h)
-        buf = jnp.zeros((E * C, h), cfg.dtype).at[dest].add(
-            x_rep, mode="drop").reshape(E, C, h)
-
+        buf, dest, keep, C = _capacity_dispatch(x, weights, ids, cfg)
         if inside:
             # (E, C, h) = (ep * e_local, C, h) -> gather every device's
             # slots for MY experts: (e_local, ep * C, h)
@@ -200,18 +264,207 @@ class ExpertParallelMLP(nn.Module):
             h2 = lax.all_to_all(h2, EXPERT_AXIS, split_axis=1,
                                 concat_axis=0, tiled=True)
 
-        # combine: gather each copy's expert output and weight it
-        out = jnp.take(h2.reshape(E * C, h), jnp.minimum(dest, E * C - 1),
-                       axis=0)                                     # (n*k, h)
-        w = (weights.reshape(-1) * keep.reshape(-1)).astype(cfg.dtype)
-        return jnp.sum((out * w[:, None]).reshape(n, k, h), axis=1)
+        out = _capacity_combine(h2, dest, keep, weights, n, cfg)
+        dropped = jnp.sum(1.0 - keep.astype(jnp.float32))
+        self.sow("intermediates", "moe_dropped", dropped)
+        if return_stats:
+            return out, {"aux_loss": aux,
+                         "expert_load": expert_load(ids, E),
+                         "dropped": dropped,
+                         "keep": keep}
+        return out
+
+
+class MoEMLP(nn.Module):
+    """Mesh-native MoE MLP — the :class:`~apex_tpu.models.gpt.GPTLayer`
+    drop-in replacing :class:`~apex_tpu.models.gpt.ParallelMLP` on MoE
+    layers (docs/moe.md).
+
+    Input is the block's seq-major ``(s, b, h)`` activation. Tokens
+    flatten batch-major to ``(b*s, h)`` — preserving the mesh's
+    ``batch`` split through the flatten — then :func:`router_topk`
+    picks ``top_k`` experts per token and one of two implementations
+    computes the expert outputs:
+
+    - ``impl="dropless"`` — sort + :func:`group_gemm`
+      (:class:`GroupedMLP`'s path): no token is ever dropped. On a
+      >1-``model`` mesh the group-GEMM runs replicated
+      (``constrain_replicated``): its ragged per-expert groups align
+      to no mesh axis, and GSPMD cannot partition ``ragged_dot``
+      correctly once a sharding seed touches it — expert weights stay
+      expert-sharded at rest and gather at use; the capacity impl is
+      the EP-scaled compute path.
+    - ``impl="capacity"`` — GShard/Switch ``(E, C)`` buffers built by
+      scatter. The buffer's expert dim carries a ``model``-axis
+      sharding hint (``annotate.constrain_experts``), so on a
+      >1-``model`` mesh XLA lowers the dispatch/combine layout changes
+      to the token all-to-all — GSPMD, no shard_map (the legacy
+      shard_map variant lives in :class:`ExpertParallelMLP`).
+
+    Expert params — ``gate (h, E)`` replicated, ``w1 (E, h, ffn)`` /
+    ``w2 (E, ffn, h)`` sharded on the expert dim — ride
+    ``gpt_param_specs`` into training plans and serving checkpoints.
+
+    Each call sows three "intermediates" leaves — ``moe_aux_loss``,
+    ``moe_expert_load`` (E,), ``moe_dropped`` — collected by
+    :func:`collect_moe_stats` under ``mutable=["intermediates"]``. A
+    non-mutable apply (``model.init``, the serving decode path) makes
+    the sows no-ops, keeping the checkpoint signature and the compiled
+    decode program identical to a stats-blind forward."""
+
+    config: MoEConfig
+    impl: str = "dropless"
+
+    @nn.compact
+    def __call__(self, x):
+        cfg = self.config
+        if self.impl not in ("dropless", "capacity"):
+            raise ValueError(
+                f"MoEMLP impl must be 'dropless' or 'capacity', got "
+                f"{self.impl!r}")
+        s, b, h = x.shape
+        E, k = cfg.num_experts, cfg.top_k
+        gate = self.param("gate", nn.initializers.normal(stddev=0.02),
+                          (h, E), cfg.param_dtype)
+        w1 = self.param("w1", nn.initializers.lecun_normal(),
+                        (E, h, cfg.ffn_hidden_size), cfg.param_dtype)
+        w2 = self.param("w2", nn.initializers.lecun_normal(),
+                        (E, cfg.ffn_hidden_size, h), cfg.param_dtype)
+        if self.impl == "capacity":
+            w1 = _gspmd.constrain_experts(w1)
+            w2 = _gspmd.constrain_experts(w2)
+
+        # (s, b, h) -> (b*s, h) batch-major: the leading dim keeps the
+        # mesh's batch split, so routing stays a local matmul
+        tokens = _gspmd.constrain_batch_major(
+            x.transpose(1, 0, 2).reshape(b * s, h))
+        n = b * s
+        weights, ids, probs = router_topk(tokens, gate.astype(cfg.dtype), k)
+        aux = load_balancing_loss(probs, ids)
+        load = expert_load(ids, E)
+
+        if self.impl == "dropless":
+            # ragged groups align to NO mesh axis: GSPMD cannot
+            # partition ragged_dot correctly (the global group sizes
+            # don't survive a split of the expert or token dim), so
+            # the group-GEMM endpoints pin fully replicated — the
+            # capacity impl is the EP-scaled path
+            out, dropped = _dropless_experts(
+                _gspmd.constrain_replicated(tokens), weights, ids,
+                _gspmd.constrain_replicated(w1),
+                _gspmd.constrain_replicated(w2), cfg)
+            out = _gspmd.constrain_replicated(out)
+        else:
+            buf, dest, keep, C = _capacity_dispatch(tokens, weights, ids,
+                                                    cfg)
+            # pin the buffer's expert dim on `model`: this layout
+            # change from the token-major scatter IS the dispatch
+            # all-to-all once XLA partitions it
+            buf = _gspmd.constrain_experts(buf)
+            h1 = jnp.einsum(
+                "ech,ehf->ecf", buf, w1.astype(cfg.dtype),
+                preferred_element_type=jnp.float32).astype(cfg.dtype)
+            h1 = jax.nn.gelu(h1, approximate=True)
+            h2 = jnp.einsum(
+                "ecf,efh->ech", h1, w2.astype(cfg.dtype),
+                preferred_element_type=jnp.float32).astype(cfg.dtype)
+            h2 = _gspmd.constrain_experts(h2)
+            out = _capacity_combine(h2, dest, keep, weights, n, cfg)
+            dropped = jnp.sum(1.0 - keep.astype(jnp.float32))
+
+        self.sow("intermediates", "moe_aux_loss", aux)
+        self.sow("intermediates", "moe_expert_load", load)
+        self.sow("intermediates", "moe_dropped", dropped)
+        y = out.reshape(b, s, h).transpose(1, 0, 2)
+        return _gspmd.constrain_hidden(y)
+
+
+# -- stats collection ------------------------------------------------------
+
+
+def collect_moe_stats(variables: Any,
+                      num_experts: Optional[int] = None) -> Dict[str, Any]:
+    """Fold the sown MoE intermediates of one apply into a flat stats
+    dict: ``aux_loss`` (mean over MoE layers), ``expert_load`` ((E,)
+    summed over layers), ``dropped`` (scalar sum).
+
+    ``variables`` is the mutated-variables dict a
+    ``model.apply(..., mutable=["intermediates"])`` returns (or the
+    "intermediates" collection itself); scan-stacked leaves ((L, ...)
+    from ``variable_axes={"intermediates": 0}``) and per-layer leaves
+    both fold. Pure jnp — callable inside a jitted loss. With no MoE
+    sows present, returns zeros ((``num_experts``,) load when given,
+    else (0,))."""
+    aux, load, dropped = [], [], []
+    flat = jax.tree_util.tree_flatten_with_path(variables)[0]
+    for path, leaf in flat:
+        names = [str(getattr(p, "key", getattr(p, "idx", p)))
+                 for p in path]
+        if "moe_aux_loss" in names:
+            aux.append(leaf)
+        elif "moe_expert_load" in names:
+            load.append(leaf)
+        elif "moe_dropped" in names:
+            dropped.append(leaf)
+    if not aux:
+        E = int(num_experts or 0)
+        return {"aux_loss": jnp.zeros((), jnp.float32),
+                "expert_load": jnp.zeros((E,), jnp.float32),
+                "dropped": jnp.zeros((), jnp.float32)}
+    n_layers = sum(int(a.size) for a in aux)
+    aux_mean = sum(jnp.sum(a.astype(jnp.float32)) for a in aux) / n_layers
+    load_sum = sum(
+        jnp.sum(l.astype(jnp.float32).reshape(-1, l.shape[-1]), axis=0)
+        for l in load)
+    dropped_sum = (sum(jnp.sum(d.astype(jnp.float32)) for d in dropped)
+                   if dropped else jnp.zeros((), jnp.float32))
+    return {"aux_loss": aux_mean, "expert_load": load_sum,
+            "dropped": dropped_sum}
+
+
+# -- fault drills (resilience/faults.py moe_* clauses) ---------------------
+
+
+def poison_moe_params(params: Any, *, collapse: bool = False,
+                      dead_expert: Optional[int] = None) -> Any:
+    """Apply the MoE fault drills to a param tree (docs/resilience.md).
+
+    ``collapse=True`` zeroes every router ``gate`` leaf: all logits tie
+    and ``lax.top_k``'s deterministic lowest-index tie-break routes
+    EVERY token to experts ``0..top_k-1`` — the router-collapse load
+    signature the ``moe_imbalance`` latch must catch (note the Switch
+    aux loss stays at its balanced value 1.0 under uniform probs — the
+    histogram, not the loss, is the detector).
+
+    ``dead_expert=<idx>`` zeroes expert idx's slice of every ``w2``
+    down-projection: the expert keeps receiving traffic but contributes
+    the zero vector."""
+    if not collapse and dead_expert is None:
+        return params
+
+    def edit(path, leaf):
+        name = str(getattr(path[-1], "key", path[-1]))
+        if collapse and name == "gate":
+            return jnp.zeros_like(leaf)
+        if dead_expert is not None and name == "w2" and leaf.ndim >= 3:
+            # (E, ffn, h), or scan-stacked (L, E, ffn, h)
+            sl = ((slice(None),) * (leaf.ndim - 3)
+                  + (int(dead_expert),))
+            return leaf.at[sl].set(0.0)
+        return leaf
+
+    return jax.tree_util.tree_map_with_path(edit, params)
 
 
 __all__ = [
     "ExpertParallelMLP",
     "GroupedMLP",
     "MoEConfig",
+    "MoEMLP",
+    "collect_moe_stats",
+    "expert_load",
     "group_gemm",
     "load_balancing_loss",
+    "poison_moe_params",
     "router_topk",
 ]

@@ -203,6 +203,28 @@ prefill/decode, docs/serving.md "Disaggregated prefill/decode"):
                                  handoff transfer site (call indexed)
                                  — the generic transient-wire drill,
                                  absorbed by the same retry policy
+
+MoE workload-plane sites (apex_tpu/mesh/mesh.py MeshTrainStep,
+docs/moe.md):
+
+- ``moe_router_collapse=<steps>`` zero every MoE gate kernel in the
+                                 flat master BEFORE the train-step
+                                 dispatch at these steps — all router
+                                 logits tie, top-k's deterministic
+                                 tie-break routes EVERY token to
+                                 experts 0..k-1. The Switch aux loss
+                                 stays at its balanced value (uniform
+                                 probs), so the drill proves the
+                                 ``moe_expert_load`` histogram + the
+                                 ``moe_imbalance`` EWMA latch are the
+                                 detector, not the loss
+- ``moe_expert_dead=<idx>``      zero expert ``idx``'s down-projection
+                                 (``w2``) in the flat master before
+                                 every dispatch while the plan is
+                                 active — the expert still receives
+                                 its tokens and contributes nothing
+                                 (a dead shard host); loss degrades
+                                 while routing stays balanced
 """
 
 from __future__ import annotations
@@ -287,6 +309,9 @@ class FaultInjector:
     kv_transfer_timeout: FrozenSet[int] = frozenset()
     kv_transfer_partial: FrozenSet[int] = frozenset()
     handoff_orphan: FrozenSet[int] = frozenset()
+    # MoE workload-plane sites (mesh/mesh.py MeshTrainStep)
+    moe_router_collapse_steps: FrozenSet[int] = frozenset()
+    moe_expert_dead: Optional[int] = None
 
     def __post_init__(self):
         self._counts: Dict[str, int] = {}
@@ -528,6 +553,19 @@ class FaultInjector:
             self._counts["handoff_orphan"] = idx + 1
         return idx in self.handoff_orphan
 
+    # -- MoE workload-plane sites ------------------------------------------
+
+    def should_collapse_router(self, step: int) -> bool:
+        """True when the MoE train step at ``step`` must zero every
+        gate kernel before dispatch — the deterministic router-collapse
+        drill behind the ``moe_imbalance`` latch."""
+        return int(step) in self.moe_router_collapse_steps
+
+    def dead_expert(self) -> Optional[int]:
+        """Expert index whose ``w2`` down-projection the MoE train
+        step zeroes before each dispatch, or None."""
+        return self.moe_expert_dead
+
     def maybe_sigterm(self, step: int) -> None:
         """Deliver a REAL SIGTERM to this process at planned steps —
         the deterministic stand-in for the scheduler's preemption
@@ -617,6 +655,10 @@ class FaultInjector:
                 kw["kv_transfer_partial"] = _int_set(val)
             elif key == "handoff_orphan":
                 kw["handoff_orphan"] = _int_set(val)
+            elif key == "moe_router_collapse":
+                kw["moe_router_collapse_steps"] = _int_set(val)
+            elif key == "moe_expert_dead":
+                kw["moe_expert_dead"] = int(val)
             elif key.startswith("io:"):
                 kw["io_errors"][key[len("io:"):]] = _int_set(val)
             elif key.startswith("io_permanent:"):
@@ -792,17 +834,29 @@ def should_orphan_handoff() -> bool:
     return inj is not None and inj.should_orphan_handoff()
 
 
+def should_collapse_router(step: int) -> bool:
+    inj = active()
+    return inj is not None and inj.should_collapse_router(step)
+
+
+def dead_expert() -> Optional[int]:
+    inj = active()
+    return None if inj is None else inj.dead_expert()
+
+
 __all__ = [
     "ENV_KNOB", "EngineCrash", "FaultError", "FaultInjector",
     "SimulatedCrash",
-    "active", "check", "collective_delay_s", "engine_stall_s",
+    "active", "check", "collective_delay_s", "dead_expert",
+    "engine_stall_s",
     "flip_bits", "inject",
     "install", "kv_transfer_fault", "maybe_crash",
     "should_corrupt_collective", "should_orphan_handoff",
     "maybe_crash_before_commit", "maybe_decode_exception",
     "maybe_engine_crash", "maybe_prefill_chunk_exception",
     "maybe_sigterm", "nonfinite_lane_at", "poison_grads",
-    "shard_truncate_target", "should_pool_exhaust",
+    "shard_truncate_target", "should_collapse_router",
+    "should_pool_exhaust",
     "should_range_timeout", "should_skip_router_snapshot",
     "should_snapshot_corrupt",
     "should_truncate", "should_weight_swap_mismatch",

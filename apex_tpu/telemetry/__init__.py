@@ -3,7 +3,7 @@
 flight recorder.
 
 The observability layer the rest of the runtime reports through
-(docs/observability.md). Nine parts:
+(docs/observability.md). The parts:
 
 - :mod:`~apex_tpu.telemetry.metrics` — process-global registry of
   counters / gauges / fixed-bucket histograms with labeled series,
@@ -44,6 +44,12 @@ The observability layer the rest of the runtime reports through
   input/output shardings, mesh axes, and per-device buffer bytes
   normalized to a fixed-key dict (``sharding_reason`` nulls on
   meshless backends) + ``sharding_devices{fn=}`` gauges.
+- :mod:`~apex_tpu.telemetry.moe` — the MoE workload plane:
+  ``publish_moe_step`` lands each training step's in-jit expert
+  histogram as ``moe_expert_load{expert=}`` / ``moe_aux_loss`` /
+  ``moe_dropped_tokens`` gauges and runs the ``moe_imbalance`` EWMA
+  latch (event + flight bundle embedding the load histogram);
+  ``fleet_expert_load`` folds merged snapshots into fleet totals.
 - :mod:`~apex_tpu.telemetry.flight` — the crash flight recorder:
   bounded rings of recent events / timeline spans / state digests,
   dumped as a self-contained ``flightrec_*.json`` postmortem bundle on
@@ -79,6 +85,7 @@ from apex_tpu.telemetry import (
     fleet,
     flight,
     metrics,
+    moe,
     sharding,
     slo,
     timeline,
@@ -188,6 +195,7 @@ def reset() -> None:
     compiled.disable()
     devmem.disable()
     comms.disable()
+    moe.reset()
     metrics.reset()
     timeline.disable()
 
@@ -228,6 +236,7 @@ __all__ = [
     "global_enabled",
     "merge_snapshots",
     "metrics",
+    "moe",
     "registry",
     "reset",
     "sharding",

@@ -82,6 +82,10 @@ def wire_bytes(op: str, payload_bytes: int, n_replicas: int) -> int:
         return 0
     if op == "ppermute":
         return int(payload_bytes)            # ring rotation: one hop out
+    if op == "all_to_all":
+        # MoE dispatch/combine: each host keeps its own 1/n shard and
+        # ships the other (n-1)/n of its payload, per direction
+        return int(payload_bytes) * (n - 1) // n
     return int(payload_bytes)                # broadcast_from: src's copy
 
 

@@ -68,6 +68,13 @@ trigger                fired by
                        structured recovery plan — snapshot vs replay
                        source, snapshot path, and the survivor each
                        recovered request was rerouted to
+``moe_imbalance``      the MoE expert-load EWMA latch fired
+                       (``telemetry.moe.MoEImbalanceDetector`` —
+                       max/mean load ratio past ``factor``, e.g. a
+                       collapsed router; host-local, one bundle per
+                       excursion); the bundle's ``extra`` embeds the
+                       offending per-expert load histogram and the
+                       hot expert's index
 ``kv_handoff_failed``  a disaggregated KV handoff exhausted its wire
                        retries or the verified install was refused
                        (``serving.fleet.FleetRouter``, host-local);

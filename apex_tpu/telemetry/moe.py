@@ -1,0 +1,186 @@
+"""MoE observability: per-expert load gauges + the imbalance latch.
+
+The MoE workload plane's telemetry (docs/moe.md). The training step
+computes the per-step expert histogram IN the jitted program (the
+``moe_expert_load`` intermediate — one (E,) reduction, no host-side
+re-derivation) and hands it here through the step's aux:
+
+- :func:`publish_moe_step` lands one step's stats on the registry —
+  ``moe_aux_loss`` / ``moe_dropped_tokens`` gauges, a cumulative
+  ``moe_dropped_tokens_total`` counter, and one
+  ``moe_expert_load{expert=}`` gauge per expert (what the fleet
+  aggregator merges per-host and ``tools/telemetry_dump.py``'s ``moe``
+  section reads) — then runs the imbalance detector.
+- :class:`MoEImbalanceDetector` rides the straggler-detector idiom
+  (:class:`~apex_tpu.telemetry.fleet.FleetAggregator`): an EWMA of the
+  load histogram's max/mean ratio (1.0 = perfectly balanced), flagged
+  when it exceeds ``factor`` after ``min_samples`` warm steps, latched
+  once per excursion — a persistently collapsed router raises ONE
+  ``moe_imbalance`` event + flight trigger per episode, not one per
+  step. The flight bundle's ``extra`` embeds the offending load
+  histogram, so the postmortem carries WHICH experts went hot without
+  any dashboard round trip.
+- :func:`fleet_expert_load` folds a
+  :func:`~apex_tpu.telemetry.fleet.merge_snapshots` result's per-host
+  ``moe_expert_load`` gauges into fleet-total per-expert counts.
+
+Host-side only; nothing here adds one byte to a jitted program.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from apex_tpu.telemetry import metrics as _metrics
+
+
+class MoEImbalanceDetector:
+    """EWMA latch over the expert-load histogram's max/mean ratio.
+
+    Same knobs and validation as the fleet straggler detector
+    (``factor`` > 1, ``ewma_alpha`` in (0, 1]); ``min_samples`` warm
+    steps gate the first flag so one noisy init step cannot fire it.
+    ``observe(load)`` returns True on the step an episode LATCHES —
+    the event/flight bundle fire exactly once per excursion, and the
+    latch re-arms when the EWMA recovers below ``factor``.
+    """
+
+    def __init__(self, *, factor: float = 2.0, ewma_alpha: float = 0.25,
+                 min_samples: int = 5, registry=None):
+        if factor <= 1.0:
+            raise ValueError(f"factor must be > 1, got {factor}")
+        if not (0.0 < ewma_alpha <= 1.0):
+            raise ValueError(
+                f"ewma_alpha must be in (0, 1], got {ewma_alpha}")
+        self.factor = float(factor)
+        self.ewma_alpha = float(ewma_alpha)
+        self.min_samples = int(min_samples)
+        self._registry = registry
+        self.ewma: Optional[float] = None
+        self.samples = 0
+        self.latched = False
+
+    def observe(self, load) -> bool:
+        """Fold one step's (E,) load histogram; True iff the imbalance
+        episode latched ON THIS step (event + flight bundle fired)."""
+        load = np.asarray(load, dtype=float)
+        if load.size == 0:
+            return False
+        mean = float(load.mean())
+        if mean <= 0.0:
+            return False
+        ratio = float(load.max()) / mean
+        self.ewma = (ratio if self.ewma is None
+                     else self.ewma_alpha * ratio
+                     + (1.0 - self.ewma_alpha) * self.ewma)
+        self.samples += 1
+        reg = (self._registry if self._registry is not None
+               else _metrics.registry())
+        reg.gauge("moe_imbalance_ratio",
+                  "EWMA of max/mean expert load (1.0 = balanced)"
+                  ).set(self.ewma)
+        if self.samples < self.min_samples:
+            return False
+        if self.ewma <= self.factor:
+            self.latched = False            # excursion over: re-arm
+            return False
+        if self.latched:
+            return False
+        self.latched = True
+        hot = int(np.argmax(load))
+        detail = {"ratio": round(ratio, 4),
+                  "ewma": round(self.ewma, 4),
+                  "factor": self.factor,
+                  "hot_expert": hot,
+                  "expert_load": [round(float(v), 2) for v in load]}
+        reg.event("moe_imbalance", **detail)
+        from apex_tpu.telemetry import flight as _flight
+
+        # host-local trigger: every host sees its own shard's routing,
+        # so a fleet barrier here would hang single-host drills
+        _flight.notify("moe_imbalance", fleet=False, extra=detail)
+        return True
+
+
+_DETECTOR: Optional[MoEImbalanceDetector] = None
+
+
+def get_detector() -> MoEImbalanceDetector:
+    """The process-global imbalance detector (created on first use)."""
+    global _DETECTOR
+    if _DETECTOR is None:
+        _DETECTOR = MoEImbalanceDetector()
+    return _DETECTOR
+
+
+def reset() -> None:
+    """Drop the process-global detector (telemetry.reset())."""
+    global _DETECTOR
+    _DETECTOR = None
+
+
+def publish_moe_step(aux: Dict[str, Any], *, registry=None,
+                     detector: Optional[MoEImbalanceDetector] = None
+                     ) -> None:
+    """Land one training step's MoE aux stats on the metrics plane and
+    run the imbalance latch. ``aux`` is the step's aux dict
+    (``aux_loss`` scalar, ``expert_load`` (E,), ``dropped`` scalar —
+    what ``make_gpt_pretrain_step``'s MoE loss returns); device arrays
+    are fine (this is the one host sync point of MoE observability).
+    Unknown keys are ignored, missing ones skipped — a partial aux
+    never raises out of the training loop."""
+    if not isinstance(aux, dict):
+        return
+    reg = registry if registry is not None else _metrics.registry()
+    if aux.get("aux_loss") is not None:
+        reg.gauge("moe_aux_loss",
+                  "Switch load-balancing aux loss of the last step"
+                  ).set(float(np.asarray(aux["aux_loss"])))
+    if aux.get("dropped") is not None:
+        dropped = float(np.asarray(aux["dropped"]))
+        reg.gauge("moe_dropped_tokens",
+                  "token copies dropped to capacity overflow, last step"
+                  ).set(dropped)
+        if dropped > 0:
+            reg.counter("moe_dropped_tokens_total",
+                        "cumulative capacity-overflow drops").inc(dropped)
+    load = aux.get("expert_load")
+    if load is None:
+        return
+    load = np.asarray(load, dtype=float)
+    g = reg.gauge("moe_expert_load",
+                  "per-expert (token, choice) assignments of the last "
+                  "step")
+    for e in range(load.size):
+        g.set(float(load[e]), expert=str(e))
+    det = detector if detector is not None else get_detector()
+    det.observe(load)
+
+
+def fleet_expert_load(merged: Dict[str, Any]) -> Dict[str, float]:
+    """Fleet-total per-expert load from a
+    :func:`~apex_tpu.telemetry.fleet.merge_snapshots` result: the
+    per-host ``moe_expert_load{expert=}`` gauges summed across hosts
+    (each host's gauge is ITS shard's routing counts, so the sum — not
+    the per-host mean — is the fleet histogram). ``{}`` when no host
+    published MoE gauges."""
+    out: Dict[str, float] = {}
+    for series, entry in (merged.get("gauges") or {}).items():
+        if not series.startswith("moe_expert_load{"):
+            continue
+        expert = series.split('expert="', 1)[-1].rstrip('"}')
+        out[expert] = (out.get(expert, 0.0)
+                       + sum(float(v) for v in
+                             (entry.get("per_host") or {}).values()))
+    return out
+
+
+__all__ = [
+    "MoEImbalanceDetector",
+    "fleet_expert_load",
+    "get_detector",
+    "publish_moe_step",
+    "reset",
+]

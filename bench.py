@@ -1417,6 +1417,61 @@ def bench_multichip():
         f"interleaved bubble {bubbles['interleaved_1f1b']} not below "
         f"gpipe {bubbles['gpipe']}")
 
+    # expert-parallel row (docs/moe.md): the same dims with a 4-expert
+    # MoE MLP every layer, experts sharded on the `model` axis (dp x
+    # ep), timed as the REAL aux-carrying MoE train step — per-expert
+    # load gauges read back, the planner's EP all-to-all pricing along
+    from apex_tpu.models.pretrain import make_gpt_pretrain_step
+    from apex_tpu.telemetry import moe as _tmoe
+
+    moe_cfg = GPTConfig(hidden_size=128, num_layers=4, num_heads=8,
+                        max_seq_len=64, vocab_size=512,
+                        num_experts=4, moe_top_k=2,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+    moe_plan = _mesh.plan_for_config(moe_cfg, n, global_batch=batch,
+                                     seq_len=seq)
+    _mesh.initialize_mesh(model=2)
+    try:
+        from apex_tpu.models.pretrain import init_gpt_pretrain_params
+
+        moe_params = init_gpt_pretrain_params(moe_cfg,
+                                              jax.random.PRNGKey(0))
+        step, state = make_gpt_pretrain_step(
+            moe_cfg, FusedAdam(lr=1e-3, impl="xla"))(moe_params)
+        state, loss = step(state, tokens, labels)       # compile
+        jax.block_until_ready(loss)
+        times = []
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, loss = step(state, tokens, labels)
+            jax.block_until_ready(loss)
+            times.append((time.perf_counter() - t0) / steps * 1e3)
+        moe_ms = statistics.median(times)
+        assert np.isfinite(float(loss)), "MoE EP row non-finite loss"
+    finally:
+        _mesh.destroy_mesh()
+    gauges = _tmetrics.registry().snapshot()["gauges"]
+    ep_load = {k.split('expert="')[1].rstrip('"}'): v
+               for k, v in gauges.items()
+               if k.startswith("moe_expert_load{")}
+    assert len(ep_load) == moe_cfg.num_experts, (
+        f"expected {moe_cfg.num_experts} per-expert load gauges, "
+        f"got {sorted(ep_load)}")
+    ep_best = moe_plan.scores[moe_plan.rank_of(n // 2, 2, 1)]
+    assert ep_best.feasible and ep_best.ep_wire_bytes > 0, ep_best
+    moe_ep = {
+        "dp": n // 2, "ep": 2, "num_experts": moe_cfg.num_experts,
+        "top_k": moe_cfg.moe_top_k, "impl": moe_cfg.moe_impl,
+        "step_ms": round(moe_ms, 3), "final_loss": round(float(loss), 6),
+        "expert_load": {e: ep_load[e] for e in sorted(ep_load, key=int)},
+        "aux_loss": gauges.get("moe_aux_loss"),
+        "dropped_tokens": gauges.get("moe_dropped_tokens"),
+        "imbalance_ewma": gauges.get("moe_imbalance_ratio"),
+        "planner_ep": ep_best.detail(),
+        "planner_moe_objective": moe_plan.objective.get("moe"),
+    }
+
     _mesh.publish_plan(plan)
     manual_ms = next((r["step_ms"] for r in layouts
                       if r["layout_source"] == "manual"), None)
@@ -1436,6 +1491,7 @@ def bench_multichip():
             "regression_gate": gate,
             "schedule_family": {**fam_layout, "schedules": family,
                                 "interleaved_below_gpipe": True},
+            "moe_ep": moe_ep,
             "layout_plan": plan.detail(),
             **backend_detail(),
         },

@@ -206,6 +206,14 @@ class TestWireBytes:
         assert comms.wire_bytes("barrier", 0, 4) == 0
         assert comms.wire_bytes("agree_any", 4, 4) == 16
 
+    def test_all_to_all(self):
+        # MoE dispatch/combine (docs/moe.md): each host keeps its own
+        # 1/n shard and ships the other (n-1)/n of its payload
+        assert comms.wire_bytes("all_to_all", 1000, 4) == 750
+        assert comms.wire_bytes("all_to_all", 1000, 2) == 500
+        assert comms.wire_bytes("all_to_all", 1024, 8) == 896
+        assert comms.wire_bytes("all_to_all", 1000, 1) == 0
+
     def test_degenerate_world(self):
         assert comms.wire_bytes("all_gather", 100, 0) == 100
 

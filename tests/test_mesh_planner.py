@@ -154,3 +154,81 @@ class TestPublishPlan:
                                        seq_len=64)
         assert plan.n_devices == 8
         assert plan.objective["model"]["num_heads"] == 8
+
+
+class TestExpertParallel:
+    """MoE pricing (docs/moe.md): EP all-to-all wire on tp>1 tilings,
+    active-param FLOPs, tp | num_experts feasibility."""
+
+    def test_ep_wire_on_tp_tilings_only(self):
+        plan = small_plan(8, num_experts=4, moe_top_k=2)
+        for s in plan.scores:
+            if s.tp > 1 and s.feasible:
+                assert s.ep_wire_bytes > 0, s
+            if s.tp == 1:
+                assert s.ep_wire_bytes == 0, s
+            assert s.num_experts == 4
+
+    def test_dense_has_no_ep_terms(self):
+        plan = small_plan(8)
+        assert all(s.ep_wire_bytes == 0 and s.num_experts == 0
+                   for s in plan.scores)
+        assert "moe" not in plan.objective
+        assert "ep_wire_bytes" not in plan.scores[0].detail()
+
+    def test_tp_must_divide_experts(self):
+        plan = small_plan(8, num_experts=3, moe_top_k=1)
+        bad = [s for s in plan.scores if s.tp == 2]
+        assert bad
+        assert all(not s.feasible and "num_experts" in s.reason
+                   for s in bad)
+        # tp=1 tilings stay feasible: EP is optional, not mandatory
+        assert any(s.feasible for s in plan.scores if s.tp == 1)
+
+    def test_active_params_in_objective(self):
+        """top_k of E experts run per token: the compute term uses
+        ACTIVE params (k experts' FFN), strictly below total params,
+        and the objective's moe blob says so."""
+        plan = small_plan(8, num_experts=8, moe_top_k=2)
+        moe = plan.objective["moe"]
+        assert moe["num_experts"] == 8 and moe["top_k"] == 2
+        assert moe["moe_layers"] == 4
+        assert moe["params_active"] < plan.objective["params"]
+        dense = small_plan(8)
+        assert plan.objective["params"] > dense.objective["params"]
+
+    def test_ep_wire_prices_all_to_all(self):
+        """More experts per layer don't change the dispatch payload
+        (it's token-count-bound), but a bigger tp slice ships a larger
+        all-to-all fraction: (n-1)/n."""
+        from apex_tpu.telemetry import comms
+
+        plan = small_plan(8, num_experts=4, moe_top_k=2)
+        tp2 = next(s for s in plan.scores
+                   if s.tp == 2 and s.pp == 1 and s.feasible)
+        tp4 = next(s for s in plan.scores
+                   if s.tp == 4 and s.pp == 1 and s.feasible)
+        # same per-shard token payload, 4 ops per MoE layer; the wire
+        # model is comms.wire_bytes("all_to_all", ...) exactly
+        assert comms.wire_bytes("all_to_all", 999, 4) == \
+            999 * 3 // 4
+        assert tp4.ep_wire_bytes > tp2.ep_wire_bytes
+
+    def test_detail_carries_ep_fields(self):
+        plan = small_plan(8, num_experts=4, moe_top_k=2)
+        row = next(s for s in plan.scores if s.tp > 1 and s.feasible)
+        d = row.detail()
+        assert d["ep_wire_bytes"] == row.ep_wire_bytes > 0
+        assert d["num_experts"] == 4
+        json.dumps(plan.detail())   # the bench record path stays JSON-able
+
+    def test_plan_for_config_reads_moe_knobs(self):
+        from apex_tpu.models.gpt import GPTConfig
+
+        cfg = GPTConfig(hidden_size=128, num_layers=4, num_heads=8,
+                        max_seq_len=64, vocab_size=512,
+                        num_experts=4, moe_top_k=2)
+        plan = planner.plan_for_config(cfg, 8, global_batch=8,
+                                       seq_len=64)
+        assert plan.objective["moe"]["num_experts"] == 4
+        assert any(s.ep_wire_bytes > 0 for s in plan.scores)

@@ -3,7 +3,12 @@
 group_gemm is pinned against a per-group matmul loop; the dropless
 GroupedMLP against a dense per-expert reference; the capacity-based
 ExpertParallelMLP sharded over the "expert" axis against its own dense
-run (big capacity factor so nothing drops).
+run (big capacity factor so nothing drops). The PR-19 workload plane
+rides below: the mesh-native MoEMLP (both impls, drop accounting,
+stats collection, fault poisoning), the MoE GPT config + pretrain step
+(aux threading, gauges, the router-collapse latch drill), serving
+token identity for an expert-sharded checkpoint, and the telemetry
+plane (imbalance detector, fleet merge) — docs/moe.md throughout.
 """
 
 import jax
@@ -17,8 +22,12 @@ from apex_tpu.moe import (
     ExpertParallelMLP,
     GroupedMLP,
     MoEConfig,
+    MoEMLP,
+    collect_moe_stats,
+    expert_load,
     group_gemm,
     load_balancing_loss,
+    poison_moe_params,
     router_topk,
 )
 from apex_tpu.transformer import parallel_state as ps
@@ -184,3 +193,450 @@ class TestExpertParallel:
         assert np.isfinite(out).all()
         dropped = (np.abs(out).sum(-1) == 0).sum()
         assert dropped >= 16 - 2 * max(1, int(0.25 * 16 / 2))
+
+
+# -- the PR-19 workload plane ----------------------------------------------
+
+
+def _moe_tokens(rng, s=8, b=4, h=16):
+    return jnp.asarray(rng.randn(s, b, h), jnp.float32)
+
+
+class TestMoEMLP:
+    """The mesh-native GPTLayer drop-in, single device (the sharded
+    path is tests/test_mesh-style — the dryrun + check_mesh.sh EP
+    drill cover >1-model meshes)."""
+
+    def test_bad_impl_raises(self, rng):
+        x = _moe_tokens(rng)
+        with pytest.raises(ValueError, match="impl"):
+            MoEMLP(CFG, impl="routed").init(jax.random.PRNGKey(0), x)
+
+    def test_dropless_vs_capacity_parity(self, rng):
+        """With capacity ample enough that nothing drops, the two
+        implementations are the same function of the same params."""
+        cfg = MoEConfig(hidden_size=16, ffn_hidden_size=32,
+                        num_experts=4, top_k=2, capacity_factor=8.0,
+                        dtype=jnp.float32)
+        x = _moe_tokens(rng)
+        dl = MoEMLP(cfg, impl="dropless")
+        params = dl.init(jax.random.PRNGKey(0), x)
+        out_dl = dl.apply(params, x)
+        out_cap, inter = MoEMLP(cfg, impl="capacity").apply(
+            params, x, mutable=["intermediates"])
+        np.testing.assert_allclose(np.asarray(out_dl), np.asarray(out_cap),
+                                   rtol=1e-5, atol=1e-5)
+        stats = collect_moe_stats(inter, num_experts=4)
+        assert float(stats["dropped"]) == 0.0
+
+    def test_drop_accounting_golden(self, rng):
+        """Dropless never drops; capacity drops exactly the copies
+        over each expert's C slots — the sown count matches a numpy
+        recount of the routing."""
+        x = _moe_tokens(rng)
+        n, k, E = 8 * 4, 2, 4
+        for impl, cf in (("dropless", 0.25), ("capacity", 0.5)):
+            cfg = MoEConfig(hidden_size=16, ffn_hidden_size=32,
+                            num_experts=E, top_k=k, capacity_factor=cf,
+                            dtype=jnp.float32)
+            m = MoEMLP(cfg, impl=impl)
+            params = m.init(jax.random.PRNGKey(0), x)
+            _, inter = m.apply(params, x, mutable=["intermediates"])
+            stats = collect_moe_stats(inter, num_experts=E)
+            if impl == "dropless":
+                assert float(stats["dropped"]) == 0.0
+                continue
+            # recount: choice-major stream, first C copies per expert
+            gate = params["params"]["gate"]
+            toks = np.asarray(x).transpose(1, 0, 2).reshape(n, 16)
+            _, ids, _ = router_topk(jnp.asarray(toks), gate, k)
+            C = max(1, int(cf * n * k / E))
+            flat = np.asarray(ids).T.reshape(-1)   # choice-major
+            kept = np.zeros(E, np.int64)
+            n_dropped = 0
+            for e in flat:
+                if kept[e] < C:
+                    kept[e] += 1
+                else:
+                    n_dropped += 1
+            assert float(stats["dropped"]) == float(n_dropped)
+            assert n_dropped > 0      # cf=0.5 actually exercises drops
+
+    def test_stats_sown_and_collected(self, rng):
+        x = _moe_tokens(rng)
+        m = MoEMLP(CFG, impl="dropless")
+        params = m.init(jax.random.PRNGKey(0), x)
+        out, inter = m.apply(params, x, mutable=["intermediates"])
+        assert out.shape == x.shape
+        stats = collect_moe_stats(inter, num_experts=CFG.num_experts)
+        load = np.asarray(stats["expert_load"])
+        assert load.shape == (CFG.num_experts,)
+        assert load.sum() == 8 * 4 * CFG.top_k   # every routed copy
+        assert float(stats["aux_loss"]) >= 1.0
+        # non-mutable apply: sows are no-ops, output identical
+        out2 = m.apply(params, x)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+
+    def test_collect_no_moe_is_zeros(self):
+        stats = collect_moe_stats({}, num_experts=4)
+        assert float(stats["aux_loss"]) == 0.0
+        assert np.asarray(stats["expert_load"]).shape == (4,)
+        assert float(stats["dropped"]) == 0.0
+
+    def test_return_stats_layers(self, rng):
+        x = jnp.asarray(rng.randn(12, CFG.hidden_size), jnp.float32)
+        for cls in (GroupedMLP, ExpertParallelMLP):
+            m = cls(CFG)
+            params = m.init(jax.random.PRNGKey(0), x)
+            out, stats = m.apply(params, x, return_stats=True)
+            assert out.shape == x.shape
+            assert np.asarray(stats["expert_load"]).sum() == 12 * CFG.top_k
+            assert stats["keep"].shape == (12, CFG.top_k)
+            if cls is GroupedMLP:
+                assert float(stats["dropped"]) == 0.0
+                assert bool(np.asarray(stats["keep"]).all())
+
+
+class TestPoisonMoEParams:
+    def test_collapse_zeroes_gates_and_ties_route_low(self, rng):
+        x = _moe_tokens(rng)
+        m = MoEMLP(CFG, impl="dropless")
+        params = m.init(jax.random.PRNGKey(0), x)
+        poisoned = poison_moe_params(params, collapse=True)
+        np.testing.assert_array_equal(
+            np.asarray(poisoned["params"]["gate"]), 0.0)
+        # zero gate -> logits tie -> top_k routes every token to
+        # experts 0..k-1: the collapse load signature
+        _, inter = m.apply(poisoned, x, mutable=["intermediates"])
+        load = np.asarray(collect_moe_stats(inter)["expert_load"])
+        n = 8 * 4
+        np.testing.assert_array_equal(load, [n, n, 0, 0])
+
+    def test_dead_expert_zeroes_w2_slice(self, rng):
+        x = _moe_tokens(rng)
+        m = MoEMLP(CFG, impl="dropless")
+        params = m.init(jax.random.PRNGKey(0), x)
+        poisoned = poison_moe_params(params, dead_expert=2)
+        w2 = np.asarray(poisoned["params"]["w2"])
+        np.testing.assert_array_equal(w2[2], 0.0)
+        assert np.abs(w2[[0, 1, 3]]).sum() > 0
+        out = m.apply(poisoned, x)
+        assert np.isfinite(np.asarray(out)).all()
+
+    def test_noop_off_plan(self, rng):
+        x = _moe_tokens(rng)
+        params = MoEMLP(CFG).init(jax.random.PRNGKey(0), x)
+        assert poison_moe_params(params) is params
+
+
+class TestMoEGPTConfig:
+    def test_knob_validation(self):
+        from apex_tpu.models.gpt import GPTConfig
+
+        base = dict(vocab_size=64, max_seq_len=16, hidden_size=32,
+                    num_layers=2, num_heads=4)
+        with pytest.raises(ValueError, match="moe_top_k"):
+            GPTConfig(**base, num_experts=4, moe_top_k=5)
+        with pytest.raises(ValueError, match="moe_impl"):
+            GPTConfig(**base, num_experts=4, moe_impl="sparse")
+        with pytest.raises(ValueError, match="moe_layer_freq"):
+            GPTConfig(**base, num_experts=4, moe_layer_freq=0)
+        with pytest.raises(ValueError, match="scan_layers"):
+            GPTConfig(**base, num_experts=4, moe_layer_freq=2,
+                      scan_layers=True)
+        with pytest.raises(ValueError, match="num_experts"):
+            GPTConfig(**base, num_experts=-1)
+
+    def test_moe_layer_schedule(self):
+        from apex_tpu.models.gpt import GPTConfig
+
+        cfg = GPTConfig(vocab_size=64, max_seq_len=16, hidden_size=32,
+                        num_layers=4, num_heads=4, num_experts=4,
+                        moe_layer_freq=2, scan_layers=False)
+        assert [cfg.is_moe_layer(i) for i in range(4)] == \
+            [False, True, False, True]
+        dense = GPTConfig(vocab_size=64, max_seq_len=16, hidden_size=32,
+                          num_layers=4, num_heads=4)
+        assert not any(dense.is_moe_layer(i) for i in range(4))
+
+    def test_dense_tree_unchanged(self):
+        """num_experts=0 keeps the param tree byte-identical to a
+        pre-MoE checkpoint: no gate/w1/w2 leaves anywhere."""
+        from apex_tpu.models.gpt import GPTConfig, GPTModel
+
+        cfg = GPTConfig(vocab_size=64, max_seq_len=16, hidden_size=32,
+                        num_layers=2, num_heads=4,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+        params = GPTModel(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))
+        names = {str(getattr(p[-1], "key", p[-1]))
+                 for p, _ in jax.tree_util.tree_flatten_with_path(params)[0]}
+        assert "gate" not in names and "w1" not in names
+
+    def test_moe_tree_has_experts(self):
+        from apex_tpu.models.gpt import GPTConfig, GPTModel
+
+        cfg = GPTConfig(vocab_size=64, max_seq_len=16, hidden_size=32,
+                        num_layers=2, num_heads=4, num_experts=4,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+        params = GPTModel(cfg).init(
+            jax.random.PRNGKey(0), jnp.zeros((1, 16), jnp.int32))
+        flat = jax.tree_util.tree_flatten_with_path(params)[0]
+        w1 = [leaf for p, leaf in flat
+              if str(getattr(p[-1], "key", p[-1])) == "w1"]
+        assert w1 and all(l.shape[-3] == 4 for l in w1)
+
+
+class TestMoEPretrainStep:
+    @pytest.fixture(autouse=True)
+    def clean(self):
+        from apex_tpu import mesh as gmesh
+        from apex_tpu import telemetry
+
+        gmesh.destroy_mesh()
+        telemetry.reset()
+        yield
+        gmesh.destroy_mesh()
+        telemetry.reset()
+
+    def _cfg(self, **kw):
+        from apex_tpu.models.gpt import GPTConfig
+
+        kw.setdefault("vocab_size", 64)
+        kw.setdefault("max_seq_len", 16)
+        kw.setdefault("hidden_size", 32)
+        kw.setdefault("num_layers", 2)
+        kw.setdefault("num_heads", 4)
+        kw.setdefault("num_experts", 4)
+        kw.setdefault("moe_top_k", 2)
+        kw.setdefault("dtype", jnp.float32)
+        kw.setdefault("param_dtype", jnp.float32)
+        return GPTConfig(**kw)
+
+    def _step(self, cfg):
+        from apex_tpu.models.pretrain import (init_gpt_pretrain_params,
+                                              make_gpt_pretrain_step)
+        from apex_tpu.optimizers import FusedAdam
+
+        params = init_gpt_pretrain_params(cfg, jax.random.PRNGKey(0))
+        return make_gpt_pretrain_step(
+            cfg, FusedAdam(lr=1e-3, impl="xla"))(params)
+
+    def test_aux_and_gauges(self, rng):
+        from apex_tpu.telemetry import metrics as tmetrics
+
+        cfg = self._cfg()
+        step, state = self._step(cfg)
+        toks = jnp.asarray(rng.randint(0, 64, (4, 17)), jnp.int32)
+        state, loss = step(state, toks[:, :-1], toks[:, 1:])
+        assert np.isfinite(float(loss))
+        aux = step.last_aux
+        load = np.asarray(aux["expert_load"])
+        assert load.sum() == 4 * 16 * cfg.moe_top_k * cfg.num_layers
+        g = tmetrics.registry().snapshot()["gauges"]
+        assert g["moe_aux_loss"] == pytest.approx(float(aux["aux_loss"]))
+        assert g["moe_dropped_tokens"] == float(aux["dropped"])
+        for e in range(4):
+            assert g[f'moe_expert_load{{expert="{e}"}}'] == float(load[e])
+        assert "moe_imbalance_ratio" in g
+
+    def test_public_signature_unchanged_for_dense(self, rng):
+        cfg = self._cfg(num_experts=0)
+        step, state = self._step(cfg)
+        toks = jnp.asarray(rng.randint(0, 64, (4, 17)), jnp.int32)
+        state, loss = step(state, toks[:, :-1], toks[:, 1:])
+        assert np.isfinite(float(loss))
+        assert step.last_aux is None
+
+    def test_router_collapse_latches_and_bundles(self, rng, tmp_path,
+                                                 monkeypatch):
+        """The docs/resilience.md collapse drill end to end: fault plan
+        -> all load on experts 0..k-1 -> EWMA latch -> ONE flight
+        bundle whose extra embeds the histogram."""
+        from apex_tpu import records
+        from apex_tpu.resilience import faults
+        from apex_tpu.telemetry import flight
+        from apex_tpu.telemetry import moe as tmoe
+
+        monkeypatch.setattr(records, "RECORDS_DIR", str(tmp_path))
+        tmoe._DETECTOR = tmoe.MoEImbalanceDetector(
+            factor=1.5, ewma_alpha=1.0, min_samples=1)
+        flight.enable(keep=3)
+        try:
+            cfg = self._cfg()
+            step, state = self._step(cfg)
+            toks = jnp.asarray(rng.randint(0, 64, (4, 17)), jnp.int32)
+            with faults.inject(
+                    moe_router_collapse_steps=frozenset(range(8))):
+                for _ in range(3):
+                    state, loss = step(state, toks[:, :-1], toks[:, 1:])
+            n_copies = 4 * 16 * cfg.num_layers   # per chosen expert
+            load = np.asarray(step.last_aux["expert_load"])
+            np.testing.assert_array_equal(load, [n_copies, n_copies, 0, 0])
+            assert np.isfinite(float(loss))
+        finally:
+            flight.disable()
+
+        import glob
+        import json
+        import os
+
+        bundles = sorted(glob.glob(os.path.join(str(tmp_path),
+                                                "flightrec_*.json")))
+        assert len(bundles) == 1      # latched once, not per step
+        payload = json.load(open(bundles[0]))["payload"]
+        assert payload["trigger"] == "moe_imbalance"
+        extra = payload["extra"]
+        assert extra["hot_expert"] in (0, 1)
+        np.testing.assert_array_equal(
+            extra["expert_load"], [n_copies, n_copies, 0, 0])
+
+    def test_dead_expert_finite(self, rng):
+        from apex_tpu.resilience import faults
+
+        cfg = self._cfg()
+        step, state = self._step(cfg)
+        toks = jnp.asarray(rng.randint(0, 64, (4, 17)), jnp.int32)
+        with faults.inject(moe_expert_dead=1):
+            state, loss = step(state, toks[:, :-1], toks[:, 1:])
+        assert np.isfinite(float(loss))
+        # the dead expert still RECEIVES traffic: histogram keeps counting
+        assert np.asarray(step.last_aux["expert_load"]).sum() == \
+            4 * 16 * cfg.moe_top_k * cfg.num_layers
+
+    def test_ep2_mesh_parity_with_single_device(self, rng):
+        """dp=4 x ep/tp=2 GSPMD MoE step matches the no-mesh identity
+        plan's losses to fp32 tolerance — the one-set-of-model-code
+        guarantee extended to expert layers."""
+        from apex_tpu import mesh as gmesh
+
+        cfg = self._cfg()
+        toks = jnp.asarray(rng.randint(0, 64, (8, 17)), jnp.int32)
+
+        def run(n_steps=3):
+            step, state = self._step(cfg)
+            losses = []
+            for _ in range(n_steps):
+                state, loss = step(state, toks[:, :-1], toks[:, 1:])
+                losses.append(float(loss))
+            return losses
+
+        ref = run()
+        gmesh.initialize_mesh(model=2)
+        ep = run()
+        np.testing.assert_allclose(ep, ref, rtol=2e-5, atol=2e-5)
+        assert ep[-1] < ep[0]
+
+
+class TestMoEServing:
+    def test_expert_sharded_decode_token_identical(self):
+        """An MoE checkpoint through the REAL serving DecodeStep:
+        expert-sharded (model=2 mesh, w1/w2 split on the expert dim via
+        gpt_param_specs) produces the same greedy stream as the
+        unsharded engine — nothing MoE-specific to call
+        (docs/moe.md "Serving")."""
+        from apex_tpu import mesh as gmesh
+        from apex_tpu.mesh import annotate
+        from apex_tpu.models.gpt import GPTConfig, GPTModel
+        from apex_tpu.serving import KVCache, make_decode_step
+
+        gmesh.destroy_mesh()
+        cfg = GPTConfig(vocab_size=128, max_seq_len=32, hidden_size=64,
+                        num_layers=2, num_heads=4, num_kv_heads=2,
+                        num_experts=4, moe_top_k=2,
+                        dtype=jnp.float32, param_dtype=jnp.float32)
+        model = GPTModel(cfg)
+        prompt = jnp.asarray(
+            np.random.RandomState(0).randint(0, cfg.vocab_size, (2, 8)),
+            jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)
+
+        def stream(params, cache_state_sharder):
+            cache = KVCache.for_config(cfg, num_blocks=16, block_size=8)
+            state = cache_state_sharder(cache.init_state())
+            step = make_decode_step(model, cache)
+            for i in range(2):
+                cache.allocate(i, 8 + 4)
+            tables = cache.table_array([0, 1], width=4)
+            lengths = np.asarray([8, 8], np.int32)
+            out = step.prefill(params, state, prompt, lengths, tables)
+            state, tok = out.cache, out.next_token
+            toks = [np.asarray(tok)]
+            pos = lengths.copy()
+            for _ in range(3):
+                out = step.decode(params, state, np.asarray(tok), pos,
+                                  tables)
+                state, tok = out.cache, out.next_token
+                pos = pos + 1
+                toks.append(np.asarray(tok))
+            return np.stack(toks)
+
+        try:
+            ref = stream(params, lambda s: s)
+            gmesh.initialize_mesh(model=2)
+            sharded = stream(annotate.shard_params_for_serving(params),
+                             annotate.shard_kv_pool)
+        finally:
+            gmesh.destroy_mesh()
+        np.testing.assert_array_equal(sharded, ref)
+
+
+class TestMoETelemetry:
+    @pytest.fixture(autouse=True)
+    def clean(self):
+        from apex_tpu import telemetry
+
+        telemetry.reset()
+        yield
+        telemetry.reset()
+
+    def test_detector_latches_once_and_rearms(self):
+        from apex_tpu.telemetry import moe as tmoe
+
+        det = tmoe.MoEImbalanceDetector(factor=2.0, ewma_alpha=1.0,
+                                        min_samples=1)
+        flat = [25.0, 25.0, 25.0, 25.0]
+        hot = [97.0, 1.0, 1.0, 1.0]
+        assert not det.observe(flat)
+        assert det.observe(hot)          # latch edge
+        assert not det.observe(hot)      # stays latched, no re-fire
+        assert not det.observe(flat)     # recovery re-arms
+        assert det.observe(hot)          # fresh excursion latches again
+
+    def test_detector_validates(self):
+        from apex_tpu.telemetry import moe as tmoe
+
+        with pytest.raises(ValueError):
+            tmoe.MoEImbalanceDetector(factor=1.0)
+        with pytest.raises(ValueError):
+            tmoe.MoEImbalanceDetector(ewma_alpha=0.0)
+
+    def test_fleet_expert_load_merges_hosts(self):
+        """Each host's gauge is ITS shard's counts: the fleet
+        histogram is the cross-host SUM of the merge_snapshots
+        per-host entries, not the mean."""
+        from apex_tpu.telemetry import fleet, moe as tmoe
+
+        def snap(load):
+            return {"registry": {"gauges": {
+                f'moe_expert_load{{expert="{e}"}}': v
+                for e, v in enumerate(load)} | {"other_gauge": 1.0}}}
+
+        merged = fleet.merge_snapshots([snap([10.0, 5.0]),
+                                        snap([30.0, 15.0])])
+        assert tmoe.fleet_expert_load(merged) == {"0": 40.0, "1": 20.0}
+        assert tmoe.fleet_expert_load({}) == {}
+
+    def test_publish_moe_step_counter_only_on_drops(self):
+        from apex_tpu.telemetry import metrics as tmetrics
+        from apex_tpu.telemetry import moe as tmoe
+
+        tmoe.publish_moe_step({"aux_loss": 1.0, "dropped": 0.0,
+                               "expert_load": [8.0, 8.0]})
+        snap = tmetrics.registry().snapshot()
+        assert "moe_dropped_tokens_total" not in snap["counters"]
+        tmoe.publish_moe_step({"aux_loss": 1.0, "dropped": 3.0,
+                               "expert_load": [8.0, 8.0]})
+        snap = tmetrics.registry().snapshot()
+        assert snap["counters"]["moe_dropped_tokens_total"] == 3.0
+        assert snap["gauges"]['moe_expert_load{expert="1"}'] == 8.0

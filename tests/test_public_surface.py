@@ -127,7 +127,17 @@ SURFACE = {
     "apex_tpu.contrib.conv_bias_relu": [
         "conv_bias", "conv_bias_relu", "conv_bias_mask_relu",
     ],
-    "apex_tpu.moe": ["GroupedMLP", "MoEConfig", "router_topk"],
+    "apex_tpu.moe": [
+        "GroupedMLP", "MoEConfig", "router_topk",
+        # PR-19: the MoE workload plane (docs/moe.md)
+        "MoEMLP", "ExpertParallelMLP", "group_gemm",
+        "load_balancing_loss", "expert_load", "collect_moe_stats",
+        "poison_moe_params",
+    ],
+    "apex_tpu.telemetry.moe": [
+        "MoEImbalanceDetector", "publish_moe_step", "fleet_expert_load",
+        "get_detector", "reset",
+    ],
     "apex_tpu.models.gpt": ["GPTConfig", "GPTModel", "gpt_loss_fn"],
     "apex_tpu.models.bert": None,     # module presence only
     "apex_tpu.models.t5": None,

@@ -56,6 +56,13 @@ gauges and the ``pipeline`` info blob the mesh pipeline train step
 publishes (schedule, microbatches, per-stage activity windows, step
 wall time) — and Prometheus output adds one pipeline summary comment
 line. A snapshot where no schedule ran reports ``pipeline_reason``.
+
+And the MOE plane (docs/moe.md): JSON output appends a ``moe``
+section — every ``moe_*`` series plus the per-expert load histogram
+folded out of the ``moe_expert_load{expert=}`` gauges — and
+Prometheus output adds one MoE summary comment line (aux loss,
+dropped tokens, imbalance EWMA, hottest expert). A snapshot from a
+dense run reports ``moe_reason``.
 """
 
 import argparse
@@ -253,6 +260,34 @@ def pipeline_section(snap):
     return out
 
 
+_MOE_PREFIX = "moe_"
+
+
+def moe_section(snap):
+    """The MoE workload plane of a registry snapshot (docs/moe.md):
+    every ``moe_*`` series — the ``moe_aux_loss`` /
+    ``moe_dropped_tokens`` / ``moe_imbalance_ratio`` gauges and the
+    drop counter — plus ``expert_load``, the per-expert histogram
+    folded out of the ``moe_expert_load{expert=}`` gauges.
+    Null-with-``moe_reason`` when the snapshot is from a dense run
+    (the mfu_reason contract)."""
+    out = _plane(snap, lambda base: base.startswith(_MOE_PREFIX))
+    load = {}
+    for series, v in (out.get("gauges") or {}).items():
+        if _series_base(series) == "moe_expert_load":
+            expert = _series_labels(series).get("expert")
+            if expert is not None:
+                load[expert] = v
+    if load:
+        out["expert_load"] = {e: load[e]
+                              for e in sorted(load, key=int)}
+    if not any(out.get(k) for k in ("counters", "gauges", "histograms")):
+        out["moe_reason"] = (
+            "no MoE gauges in this snapshot (dense run, or "
+            "telemetry.moe.publish_moe_step never called)")
+    return out
+
+
 def plane_comments(snap) -> str:
     """One summary comment line per plane, appended to the Prometheus
     text (comments are legal exposition; the series themselves render
@@ -330,6 +365,18 @@ def plane_comments(snap) -> str:
             f"stages={blob.get('num_stages')} "
             f"microbatches={blob.get('num_microbatches')} "
             f"step_ms={blob.get('step_ms')} bubble[{bub_s}]")
+    mo = moe_section(snap)
+    if "moe_reason" in mo:
+        lines.append(f"# moe: none ({mo['moe_reason']})")
+    else:
+        g = mo.get("gauges") or {}
+        load = mo.get("expert_load") or {}
+        hot = (max(load, key=load.get) if load else None)
+        lines.append(
+            f"# moe: aux_loss={g.get('moe_aux_loss')} "
+            f"dropped={g.get('moe_dropped_tokens')} "
+            f"imbalance_ewma={g.get('moe_imbalance_ratio')} "
+            f"hot_expert={hot} experts={len(load)}")
     return "\n".join(lines) + "\n"
 
 
@@ -344,6 +391,7 @@ def _emit(snap, fmt, help_source=None) -> None:
         out["comms"] = comms_section(snap)
         out["mesh"] = mesh_section(snap)
         out["pipeline"] = pipeline_section(snap)
+        out["moe"] = moe_section(snap)
         print(json.dumps(out, indent=1, sort_keys=True))
         return
     if help_source is not None:
