@@ -647,6 +647,24 @@ class MeshPipelineTrainStep(MeshTrainStep):
             "stages": stages,
         })
         self._price_boundary_transfers(t0, wall_s, tokens)
+        self._feed_goodput(t0, wall_s, tokens)
+
+    def _feed_goodput(self, t0: float, wall_s: float, tokens) -> None:
+        """Run-ledger attribution for one pipeline step: the pipeline
+        has no fused-dispatch ``"step"`` span, so when the ledger is
+        armed the whole step wall is recorded as one — productive (or
+        rework after a rollback) — and the per-stage spans above land
+        in the ledger's ``stages`` diagnostic. Disarmed cost: one
+        module-global check."""
+        from apex_tpu.telemetry import goodput as _goodput
+        from apex_tpu.telemetry import timeline as _timeline
+
+        if _goodput.get_ledger() is None:
+            return
+        _timeline.record_global_span(
+            "step", t0, wall_s, category="train_step",
+            args={"pipeline": self.spec.schedule})
+        _goodput.observe_step(tokens=int(tokens.size), step_s=wall_s)
 
     def _price_boundary_transfers(self, t0: float, wall_s: float,
                                   tokens) -> None:

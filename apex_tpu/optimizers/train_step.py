@@ -174,7 +174,11 @@ class TrainStep:
             # host-side only: the jitted program (and its argument list)
             # is byte-identical with telemetry on or off. sync=True
             # blocks on the outputs so the span covers device execution,
-            # not dispatch.
+            # not dispatch. This "step" span is also the goodput
+            # ledger's productive/rework feed: record_span pushes it
+            # through the timeline's span observer when one is armed
+            # (telemetry.goodput.enable), at the cost of one
+            # module-global check here.
             t0 = tl.clock()
             outs = self._jitted(*args)
             if tl.sync:

@@ -93,8 +93,34 @@ def _publish_io(kind: str, t0: float, seconds: float, **labels) -> None:
         reg.histogram(f"checkpoint_{kind}_seconds",
                       f"wall seconds per checkpoint {kind}").observe(
             seconds, **labels)
-        _timeline.record_global_span("checkpoint", t0, seconds)
+        # kind rides the span args so the goodput ledger can route
+        # save vs restore into distinct buckets
+        _timeline.record_global_span("checkpoint", t0, seconds,
+                                     args={"kind": kind})
     except Exception:  # noqa: BLE001 — telemetry must never break a save
+        pass
+
+
+def _goodput_extra(extra, step):
+    """Fold the armed goodput ledger's cumulative state into a save's
+    ``extra`` payload; identity when disarmed. Never raises."""
+    try:
+        from apex_tpu.telemetry import goodput as _goodput
+
+        return _goodput.merge_into_extra(extra, step=int(step))
+    except Exception:  # noqa: BLE001 — telemetry must never break a save
+        return extra
+
+
+def _goodput_restored(out) -> None:
+    """Feed a restored checkpoint's ``extra`` back into the armed
+    goodput ledger (restart survival + rework window). Never raises."""
+    try:
+        from apex_tpu.telemetry import goodput as _goodput
+
+        _goodput.note_restored(getattr(out, "extra", None),
+                               restored_step=getattr(out, "step", None))
+    except Exception:  # noqa: BLE001 — telemetry must never break a restore
         pass
 
 
@@ -308,6 +334,10 @@ class CheckpointManager:
         ``opt_state`` back into a donating train step.
         """
         self.wait()                      # one in-flight save, surface errors
+        # when the goodput ledger is armed its cumulative state rides
+        # the manifest extra (tmp→fsync→rename like everything else),
+        # so a killed-and-resumed run reports run-level goodput
+        extra = _goodput_extra(extra, step)
         names, arrays, meta = self._snapshot(opt_state)
         manifest_extra = {
             "scaler": _encode_scaler(scaler_state),
@@ -495,6 +525,11 @@ class CheckpointManager:
     def _write_once(self, final: str, buf: np.ndarray,
                     manifest: Dict[str, Any]) -> None:
         faults.check("checkpoint_write")
+        stall = faults.ckpt_stall_s()
+        if stall:
+            # goodput drill: slow checkpoint storage — inside the
+            # timed save, so the stall lands in checkpoint_save
+            time.sleep(stall)
         tmp = f"{final}.tmp-{os.getpid()}-{time.monotonic_ns()}"
         os.makedirs(tmp)
         try:
@@ -746,6 +781,7 @@ class CheckpointManager:
         else:
             out = self._restore_leaf(path, template)
         _publish_io("restore", t0, time.perf_counter() - t0)
+        _goodput_restored(out)
         return out
 
     def _restore_leaf(self, path: str, template) -> RestoredState:

@@ -450,7 +450,7 @@ class ElasticCheckpointManager(CheckpointManager):
         seconds = time.perf_counter() - t0
         self._publish_elastic(seconds, planner, fetched, remapped,
                               int(man0["step"]))
-        return ElasticRestoredState(
+        out = ElasticRestoredState(
             step=int(man0["step"]),
             opt_state=opt_state,
             scaler_state=_decode_scaler(man0.get("scaler")),
@@ -459,6 +459,10 @@ class ElasticCheckpointManager(CheckpointManager):
             fingerprint=sums,
             plan={**planner.describe(me), "ranges": status},
         )
+        from apex_tpu.resilience.checkpoint import _goodput_restored
+
+        _goodput_restored(out)
+        return out
 
     def _reassemble(self, path, planner, me, names, dtypes, layout,
                     template, collective, status):

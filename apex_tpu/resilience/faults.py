@@ -40,6 +40,18 @@ Env knob grammar (semicolon-separated clauses)::
                                  (simulated on-disk corruption)
 - ``crash=<steps>``              ``SimulatedCrash`` from
                                  :func:`maybe_crash` at these steps
+- ``data_stall_ms=<ms>``         sleep ``ms`` inside the
+                                 ``PrefetchLoader`` worker's
+                                 host->device transfer — the consumer
+                                 blocks in its ``data_wait`` span, so
+                                 the goodput drill can assert the
+                                 stalled seconds land in the ledger's
+                                 ``data_wait`` bucket, not
+                                 ``unattributed``
+- ``ckpt_stall_ms=<ms>``         sleep ``ms`` inside the checkpoint
+                                 payload write — inside the timed save,
+                                 so the stall lands in
+                                 ``checkpoint_save``
 
 Distributed sites (the guard/quorum tier, docs/resilience.md):
 
@@ -312,6 +324,9 @@ class FaultInjector:
     # MoE workload-plane sites (mesh/mesh.py MeshTrainStep)
     moe_router_collapse_steps: FrozenSet[int] = frozenset()
     moe_expert_dead: Optional[int] = None
+    # goodput-drill stall sites (telemetry/goodput.py run ledger)
+    data_stall_ms: float = 0.0
+    ckpt_stall_ms: float = 0.0
 
     def __post_init__(self):
         self._counts: Dict[str, int] = {}
@@ -566,6 +581,20 @@ class FaultInjector:
         step zeroes before each dispatch, or None."""
         return self.moe_expert_dead
 
+    # -- goodput-drill stall sites -----------------------------------------
+
+    def data_stall_s(self) -> float:
+        """Seconds the ``PrefetchLoader`` worker sleeps per transfer —
+        stalled input pipeline the ledger must attribute to
+        ``data_wait``. 0.0 off-plan."""
+        return max(0.0, self.data_stall_ms) / 1e3
+
+    def ckpt_stall_s(self) -> float:
+        """Seconds the checkpoint payload write sleeps — slow
+        checkpoint storage the ledger must attribute to
+        ``checkpoint_save``. 0.0 off-plan."""
+        return max(0.0, self.ckpt_stall_ms) / 1e3
+
     def maybe_sigterm(self, step: int) -> None:
         """Deliver a REAL SIGTERM to this process at planned steps —
         the deterministic stand-in for the scheduler's preemption
@@ -659,6 +688,10 @@ class FaultInjector:
                 kw["moe_router_collapse_steps"] = _int_set(val)
             elif key == "moe_expert_dead":
                 kw["moe_expert_dead"] = int(val)
+            elif key == "data_stall_ms":
+                kw["data_stall_ms"] = float(val)
+            elif key == "ckpt_stall_ms":
+                kw["ckpt_stall_ms"] = float(val)
             elif key.startswith("io:"):
                 kw["io_errors"][key[len("io:"):]] = _int_set(val)
             elif key.startswith("io_permanent:"):
@@ -844,10 +877,21 @@ def dead_expert() -> Optional[int]:
     return None if inj is None else inj.dead_expert()
 
 
+def data_stall_s() -> float:
+    inj = active()
+    return 0.0 if inj is None else inj.data_stall_s()
+
+
+def ckpt_stall_s() -> float:
+    inj = active()
+    return 0.0 if inj is None else inj.ckpt_stall_s()
+
+
 __all__ = [
     "ENV_KNOB", "EngineCrash", "FaultError", "FaultInjector",
     "SimulatedCrash",
-    "active", "check", "collective_delay_s", "dead_expert",
+    "active", "check", "ckpt_stall_s", "collective_delay_s",
+    "data_stall_s", "dead_expert",
     "engine_stall_s",
     "flip_bits", "inject",
     "install", "kv_transfer_fault", "maybe_crash",

@@ -59,6 +59,7 @@ from __future__ import annotations
 
 import signal
 import threading
+import time
 from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
@@ -769,14 +770,23 @@ def graceful_shutdown(manager, step: int, state, *, scaler_state=None,
     """
     from apex_tpu import records
 
+    t_drain0 = time.perf_counter()
     col = collective or NullCollective()
     col.barrier()
     manager.wait()
     was_async = manager.async_save
     manager.async_save = False
+    # goodput ledger, part one — BEFORE the save: the final checkpoint
+    # packs the ledger into its extra, so the barrier/drain wall spent
+    # so far must be credited now or it dies with this process
+    from apex_tpu.telemetry import goodput as _goodput
+
+    _goodput.note_drain(time.perf_counter() - t_drain0)
     try:
+        t_save0 = time.perf_counter()
         path = manager.save(step, state, scaler_state=scaler_state,
                             rng_state=rng_state, extra=extra)
+        save_s = time.perf_counter() - t_save0
     finally:
         manager.async_save = was_async
     event = {
@@ -796,6 +806,12 @@ def graceful_shutdown(manager, step: int, state, *, scaler_state=None,
 
     _flight.notify("preemption_shutdown", recorder=flight_recorder,
                    collective=col, extra=event)
+    # goodput ledger, part two — the post-save tail (record + flight
+    # bundle), net of the save itself (the save's own span landed in
+    # checkpoint_save). This portion is live-view only: it postdates
+    # the pack the final checkpoint carried.
+    _goodput.note_drain(time.perf_counter() - t_save0,
+                        save_seconds=save_s)
     return path
 
 

@@ -31,6 +31,7 @@ on top of the skip:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -153,10 +154,15 @@ class NonfiniteWatchdog:
         from apex_tpu import records
 
         self.escalations += 1
+        # escalation wall starts HERE: localization compiles its
+        # segmented norm kernels on first use, and that diagnosis time
+        # is rollback cost, not unattributed residue
+        t_esc0 = time.perf_counter()
         suspects = self._localize(state, flat_grads, aux)
         scale_before = (float(scaler_state.loss_scale)
                         if scaler_state is not None else None)
 
+        restore_s = 0.0
         action = "none"
         restored = None
         if self.manager is not None:
@@ -171,7 +177,9 @@ class NonfiniteWatchdog:
                     "wrong directory); suspects: "
                     f"{[s['name'] for s in suspects] or 'unlocalized'}",
                     suspects=suspects)
+            t_r0 = time.perf_counter()
             restored = self.manager.restore(path, template=state)
+            restore_s = time.perf_counter() - t_r0
             action = "rollback"
         new_sstate = scaler_state
         if self.scaler is not None:
@@ -214,6 +222,15 @@ class NonfiniteWatchdog:
         from apex_tpu.telemetry import flight as _flight
 
         _flight.notify("watchdog_rollback", fleet=False, extra=event)
+        # goodput ledger: the escalation wall (net of the restore I/O,
+        # which its own span attributed to checkpoint_restore) is
+        # rollback cost, and the restored->current step range re-trains
+        # as rework
+        from apex_tpu.telemetry import goodput as _goodput
+
+        _goodput.note_rollback(
+            time.perf_counter() - t_esc0, restore_seconds=restore_s,
+            restored_step=restored.step if restored else None)
         if self.on_event is not None:
             self.on_event(event)
 

@@ -329,6 +329,13 @@ class PrefetchLoader:
 
         def transfer(b):
             faults.check("device_put")
+            stall = faults.data_stall_s()
+            if stall:
+                # goodput drill: a stalled input pipeline — the
+                # consumer blocks in its data_wait span below, so the
+                # injected seconds land in the ledger's data_wait
+                # bucket
+                time.sleep(stall)
             return jax.tree.map(
                 lambda a: jax.device_put(a, self._device), b)
 

@@ -122,6 +122,14 @@ def save_snapshot(batcher, directory: str, *, step: int,
         "utc": time.strftime("%Y%m%dT%H%M%SZ", time.gmtime()),
         "requests": entries,
     }
+    # the armed goodput ledger rides the drain snapshot the same way
+    # it rides training checkpoints — a killed-and-resumed serving
+    # process keeps its run-level attribution
+    from apex_tpu.telemetry import goodput as _goodput
+
+    led = _goodput.get_ledger()
+    if led is not None:
+        payload["goodput"] = led.pack()
     data = json.dumps(payload, sort_keys=True).encode()
     manifest = {
         "format": SNAPSHOT_FORMAT,
@@ -249,6 +257,12 @@ def resume_requests(snapshot: Dict[str, Any]):
     if snapshot.get("format") != SNAPSHOT_FORMAT:
         raise SnapshotError(
             f"unsupported snapshot format {snapshot.get('format')!r}")
+    # restart survival: fold the dead engine's goodput ledger (when the
+    # snapshot carries one and this process's ledger is armed) into the
+    # resumed process's cumulative attribution
+    from apex_tpu.telemetry import goodput as _goodput
+
+    _goodput.note_restored(snapshot)
     origin = f"serving_{int(snapshot.get('step', 0)):012d}"
     requests: List[Request] = []
     prior: Dict[Any, List[int]] = {}

@@ -50,6 +50,14 @@ The observability layer the rest of the runtime reports through
   ``moe_dropped_tokens`` gauges and runs the ``moe_imbalance`` EWMA
   latch (event + flight bundle embedding the load histogram);
   ``fleet_expert_load`` folds merged snapshots into fleet totals.
+- :mod:`~apex_tpu.telemetry.goodput` — the run ledger:
+  :class:`GoodputLedger` attributes every second of run wall-clock to
+  a cause bucket (productive / compile / checkpoint / data_wait /
+  rollback / rework / drain / straggler_wait + published
+  ``unattributed`` residual), survives restarts by riding the
+  checkpoint ``extra`` payload, and runs the :class:`StepSeries`
+  anomaly plane (``loss_spike`` / ``throughput_regression`` flight
+  triggers).
 - :mod:`~apex_tpu.telemetry.flight` — the crash flight recorder:
   bounded rings of recent events / timeline spans / state digests,
   dumped as a self-contained ``flightrec_*.json`` postmortem bundle on
@@ -84,6 +92,7 @@ from apex_tpu.telemetry import (
     devmem,
     fleet,
     flight,
+    goodput,
     metrics,
     moe,
     sharding,
@@ -99,6 +108,7 @@ from apex_tpu.telemetry.fleet import (
     merge_snapshots,
 )
 from apex_tpu.telemetry.flight import FlightRecorder
+from apex_tpu.telemetry.goodput import GoodputLedger, StepSeries
 from apex_tpu.telemetry.metrics import (
     Counter,
     Gauge,
@@ -184,6 +194,16 @@ def snapshot_detail() -> Dict[str, Any]:
         out["layout_plan_reason"] = (
             "no layout plan published in this process "
             "(mesh.planner.publish_plan)")
+    # the run ledger: full attribution table when armed, an explicit
+    # null with the reason when not (same contract as mfu/devmem)
+    led = goodput.get_ledger()
+    if led is not None:
+        out["goodput"] = led.summary()
+    else:
+        out["goodput"] = None
+        out["goodput_reason"] = (
+            "goodput ledger not armed in this process "
+            "(telemetry.goodput.enable)")
     return out
 
 
@@ -195,6 +215,7 @@ def reset() -> None:
     compiled.disable()
     devmem.disable()
     comms.disable()
+    goodput.disable()
     moe.reset()
     metrics.reset()
     timeline.disable()
@@ -208,6 +229,7 @@ __all__ = [
     "FleetAggregator",
     "FlightRecorder",
     "Gauge",
+    "GoodputLedger",
     "Histogram",
     "InMemorySink",
     "InstrumentedCollective",
@@ -221,6 +243,7 @@ __all__ = [
     "SlidingWindowQuantile",
     "Span",
     "StdoutSink",
+    "StepSeries",
     "StepTimeline",
     "TOKEN_COUNT_BUCKETS",
     "comms",
@@ -234,6 +257,7 @@ __all__ = [
     "gather_snapshots",
     "get_timeline",
     "global_enabled",
+    "goodput",
     "merge_snapshots",
     "metrics",
     "moe",

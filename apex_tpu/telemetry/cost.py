@@ -180,6 +180,37 @@ def publish_mfu(est: Dict[str, Any], registry=None) -> None:
             est["hbm_gb_per_sec"])
 
 
+def publish_mfu_window(cost: Optional[Dict[str, float]], seconds: float,
+                       *, kind: Optional[str] = None, alpha: float = 0.2,
+                       registry=None) -> Dict[str, Any]:
+    """Windowed MFU: fold one :func:`mfu_estimate` into the
+    ``mfu_ewma`` gauge so utilization updates continuously (the
+    goodput ledger calls this with its productive-step-window median
+    each publish) instead of only at the one-shot :func:`publish_mfu`.
+
+    Same degradation contract as everything here: when the estimate is
+    null, the gauge is left untouched and ``mfu_reason`` says why —
+    the returned dict carries ``mfu_ewma`` as a value or None."""
+    from apex_tpu.telemetry import metrics as _metrics
+
+    reg = registry if registry is not None else _metrics.registry()
+    est = mfu_estimate(cost, seconds, kind)
+    if est["mfu"] is None:
+        reg.set_info("mfu_reason", est.get("mfu_reason"))
+        est["mfu_ewma"] = None
+        return est
+    g = reg.gauge("mfu_ewma",
+                  "EWMA model FLOPs utilization over the ledger's "
+                  "productive-step window")
+    prev = g.value()
+    cur = est["mfu"] if not prev else (
+        (1.0 - alpha) * prev + alpha * est["mfu"])
+    cur = round(cur, 6)
+    g.set(cur)
+    est["mfu_ewma"] = cur
+    return est
+
+
 __all__ = [
     "bytes_per_element",
     "compiled_cost",
@@ -188,5 +219,6 @@ __all__ = [
     "mfu_estimate",
     "normalize_cost_analysis",
     "publish_mfu",
+    "publish_mfu_window",
     "train_step_cost",
 ]

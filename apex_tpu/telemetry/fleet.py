@@ -201,6 +201,7 @@ def merge_snapshots(per_host: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     timelines: Dict[str, Any] = {}
     mfu: Dict[str, Any] = {}
     info: Dict[str, Any] = {}
+    goodputs: Dict[str, Dict[str, Any]] = {}
     for r, snap in enumerate(per_host):
         reg = snap.get("registry") or {}
         for name, v in (reg.get("counters") or {}).items():
@@ -214,6 +215,9 @@ def merge_snapshots(per_host: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
             info[str(r)] = reg["info"]
         timelines[str(r)] = snap.get("step_timeline")
         mfu[str(r)] = snap.get("mfu")
+        gp = snap.get("goodput")
+        if isinstance(gp, dict) and gp.get("enabled"):
+            goodputs[str(r)] = gp
     for g in gauges.values():
         vals = list(g["per_host"].values())
         g["min"] = min(vals)
@@ -228,6 +232,41 @@ def merge_snapshots(per_host: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         "step_timelines": timelines,
         "mfu": mfu,
         **({"info": info} if info else {}),
+        **({"goodput": _merge_goodput(goodputs)} if goodputs else {}),
+    }
+
+
+def _merge_goodput(per_host: Dict[str, Dict[str, Any]]) -> Dict[str, Any]:
+    """The fleet-merged run ledger: per-host goodput with min/max/mean
+    fraction, cause-bucket seconds summed fleet-wide, and the
+    straggler seconds each ledger attributed. Hosts whose ledger was
+    disarmed simply drop out (the merge never demands telemetry a host
+    didn't collect)."""
+    fractions = [float(g.get("goodput_fraction") or 0.0)
+                 for g in per_host.values()]
+    seconds_total: Dict[str, float] = {}
+    tokens = 0.0
+    for g in per_host.values():
+        tokens += float(g.get("tokens_trained_total") or 0.0)
+        for c, v in (g.get("seconds") or {}).items():
+            seconds_total[c] = round(
+                seconds_total.get(c, 0.0) + float(v), 6)
+    return {
+        "n_hosts": len(per_host),
+        "per_host": {
+            r: {"goodput_fraction": g.get("goodput_fraction"),
+                "wall_seconds": g.get("wall_seconds"),
+                "straggler_wait_seconds":
+                    (g.get("seconds") or {}).get("straggler_wait", 0.0),
+                "restarts": g.get("restarts")}
+            for r, g in per_host.items()},
+        "fraction_min": min(fractions),
+        "fraction_max": max(fractions),
+        "fraction_mean": round(sum(fractions) / len(fractions), 6),
+        "seconds_total": seconds_total,
+        "straggler_wait_seconds_total": seconds_total.get(
+            "straggler_wait", 0.0),
+        "tokens_trained_total": tokens,
     }
 
 
@@ -377,8 +416,30 @@ class FleetAggregator:
             (time.perf_counter() - t0) * 1e3, 4)
         if publish:
             self._publish(fleet["straggler"])
+        self._feed_goodput(fleet["straggler"])
         self.last_fleet = fleet
         return fleet
+
+    @staticmethod
+    def _feed_goodput(straggler: Dict[str, Any]) -> None:
+        """Attribute the straggler spread to the armed goodput ledger:
+        one (slowest EWMA − median) sample per watched phase per
+        aggregate call — an approximation of the seconds the median
+        host spends waiting on the slowest one, documented as such in
+        docs/observability.md. No-op when the ledger is disarmed."""
+        from apex_tpu.telemetry import goodput as _goodput
+
+        led = _goodput.get_ledger()
+        if led is None:
+            return
+        wait_s = 0.0
+        for entry in (straggler.get("phases") or {}).values():
+            ew = entry.get("per_host_ewma_ms") or {}
+            med = entry.get("median_ms")
+            if ew and med is not None:
+                wait_s += max(0.0, max(ew.values()) - med) / 1e3
+        if wait_s > 0.0:
+            led.note_straggler_wait(wait_s)
 
 
 # ---------------------------------------------------------------------------

@@ -84,6 +84,17 @@ trigger                fired by
                        the source/destination engines, and the
                        attempt count — the stream itself survives on
                        the source (colocated degradation)
+``loss_spike``         the goodput ledger's step-series robust
+                       z-score latch fired (``telemetry.goodput
+                       .StepSeries`` — loss z past ``loss_z`` against
+                       the trailing median/MAD window; host-local,
+                       one bundle per episode); the bundle's
+                       ``extra`` embeds the offending series window
+``throughput_regression`` the step-series fast-vs-slow EWMA of
+                       tokens/sec sat below the drop threshold for
+                       ``sustain`` consecutive steps (host-local, one
+                       bundle per episode); ``extra`` embeds the
+                       series window and both EWMAs
 ====================== ====================================================
 
 Fleet-level triggers (the guard's, the shutdown's) fire on EVERY
@@ -253,6 +264,16 @@ class FlightRecorder:
         except Exception as e:  # noqa: BLE001
             return {"error": f"{type(e).__name__}: {e}"}
 
+    def _goodput(self):
+        # the run ledger: full attribution table when armed, the
+        # explicit disabled marker with its reason otherwise
+        from apex_tpu.telemetry import goodput as _goodput
+
+        try:
+            return _goodput.section()
+        except Exception as e:  # noqa: BLE001
+            return {"error": f"{type(e).__name__}: {e}"}
+
     def _last_checkpoint(self):
         if self.manager is None:
             return None
@@ -315,6 +336,7 @@ class FlightRecorder:
                 "devmem": self._devmem(),
                 "compile_plane": self._compile_plane(),
                 "comms": self._comms(),
+                "goodput": self._goodput(),
                 "recent_events": list(self.events),
                 "state_digests": list(self.digests),
                 "last_checkpoint": self._last_checkpoint(),

@@ -81,6 +81,8 @@ class StepTimeline:
         self._lock = threading.Lock()
         self._spans: "deque[Span]" = deque(maxlen=self.capacity)
         self._recorded = 0
+        self._dropped_dur = 0.0
+        self._dropped_published = 0
         self._origin = clock()
         self._step = -1
         self._step_t0: Optional[float] = None
@@ -94,11 +96,26 @@ class StepTimeline:
         if not self.enabled:
             return
         with self._lock:
-            self._spans.append(Span(
+            span = Span(
                 str(name), float(t0), float(dur),
                 self._step if step is None else int(step), str(category),
-                dict(args) if args else None))
+                dict(args) if args else None)
+            if (self._spans.maxlen is not None
+                    and len(self._spans) == self._spans.maxlen):
+                # ring wraparound: the evicted span's time would vanish
+                # from any later pull-based accounting — total it so
+                # summary()/publish() can surface the loss (a zero-
+                # capacity ring evicts the incoming span itself)
+                self._dropped_dur += (self._spans[0].dur
+                                      if self._spans else span.dur)
+            self._spans.append(span)
             self._recorded += 1
+        obs = _SPAN_OBSERVER
+        if obs is not None:
+            try:
+                obs(span)
+            except Exception:  # noqa: BLE001 — observers never take down the loop
+                pass
 
     @contextlib.contextmanager
     def phase(self, name: str, *, sync_on: Any = None,
@@ -180,10 +197,20 @@ class StepTimeline:
         with self._lock:
             return list(self._spans)
 
+    @property
+    def dropped_seconds(self) -> float:
+        """Total duration of spans evicted by ring wraparound — the
+        time a pull-based consumer can no longer see (the goodput
+        ledger surfaces it as ``timeline_dropped_span_seconds``)."""
+        with self._lock:
+            return self._dropped_dur
+
     def reset(self) -> None:
         with self._lock:
             self._spans.clear()
             self._recorded = 0
+            self._dropped_dur = 0.0
+            self._dropped_published = 0
             self._step = -1
             self._step_t0 = None
             self._origin = self.clock()
@@ -208,9 +235,11 @@ class StepTimeline:
                 p[k] = round(p[k], 4)
         with self._lock:
             dropped = self._recorded - len(spans)
+            dropped_s = self._dropped_dur
             steps = self._step + 1
         return {"enabled": self.enabled, "steps": steps,
                 "spans": len(spans), "dropped_spans": dropped,
+                "dropped_span_seconds": round(dropped_s, 6),
                 "phases": phases}
 
     def export_trace(self, path: Optional[str] = None, *,
@@ -273,6 +302,17 @@ class StepTimeline:
                       "mean host-loop phase duration over the window")
         for name, p in summ["phases"].items():
             g.set(p["mean_ms"], phase=name)
+        # ring-wraparound visibility: count evictions lazily here (a
+        # per-span counter inc would violate the hot-path budget)
+        with self._lock:
+            delta = (self._recorded - len(self._spans)
+                     - self._dropped_published)
+            if delta > 0:
+                self._dropped_published += delta
+        if delta > 0:
+            reg.counter(
+                "timeline_dropped_spans_total",
+                "spans evicted by timeline ring wraparound").inc(delta)
         return summ
 
 
@@ -282,6 +322,21 @@ class StepTimeline:
 
 _GLOBAL: Optional[StepTimeline] = None
 _ENV = "APEX_TPU_TELEMETRY"
+
+# one push-based listener every StepTimeline (global AND private
+# instances, e.g. the train step's) feeds each recorded span through —
+# how the goodput ledger attributes time without polling the ring.
+# Checked as a single module-global read per span; None means nobody
+# is listening.
+_SPAN_OBSERVER: Optional[Callable[[Span], None]] = None
+
+
+def set_span_observer(cb: Optional[Callable[[Span], None]]) -> None:
+    """Install (or clear, with None) the process-wide span observer.
+    The callback runs on the recording thread for every span of every
+    enabled timeline; exceptions are swallowed — it must be cheap."""
+    global _SPAN_OBSERVER
+    _SPAN_OBSERVER = cb
 
 
 def _env_enabled() -> bool:
@@ -340,4 +395,5 @@ __all__ = [
     "get_timeline",
     "global_enabled",
     "record_global_span",
+    "set_span_observer",
 ]

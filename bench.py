@@ -263,13 +263,20 @@ def emit(rec, kind):
 
 def _fold_telemetry(detail):
     """Fold the process telemetry into this record's detail: registry
-    snapshot, the step-timeline phase breakdown, and an ``mfu`` that is
-    a value or an explicit null with a reason (docs/observability.md).
-    Benches that computed their own block (the headline) keep it; this
-    only fills what's missing, and never fails a record."""
+    snapshot, the step-timeline phase breakdown, the goodput ledger's
+    attribution table (or its explicit null-with-reason), and an
+    ``mfu`` that is a value or an explicit null with a reason
+    (docs/observability.md). Benches that computed their own block
+    (the headline) keep it; this only fills what's missing, and never
+    fails a record."""
     try:
         from apex_tpu import telemetry
 
+        led = telemetry.goodput.get_ledger()
+        if led is not None:
+            # refresh the gauges/info blob so the snapshot below (and
+            # through it this record) carries the final attribution
+            led.publish()
         tdet = detail.setdefault("telemetry", {})
         std = telemetry.snapshot_detail()
         for k, v in std.items():

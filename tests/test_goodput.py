@@ -1,0 +1,689 @@
+"""Run ledger: goodput attribution, restart continuity, the step-series
+anomaly plane, and the satellites that ride the same PR — timeline
+ring-wraparound accounting, fault-grammar stalls, windowed MFU, fleet
+merge, and the report CLI (docs/observability.md "Run ledger &
+goodput"; end-to-end kill-and-resume lives in
+tools/check_observability.sh)."""
+
+import importlib.util
+import os
+
+import pytest
+
+from apex_tpu import telemetry
+from apex_tpu.telemetry import cost as tcost
+from apex_tpu.telemetry import fleet as tfleet
+from apex_tpu.telemetry import goodput
+from apex_tpu.telemetry import metrics as tmetrics
+from apex_tpu.telemetry import timeline as ttimeline
+from apex_tpu.telemetry.goodput import CAUSES, GoodputLedger, StepSeries
+from apex_tpu.telemetry.timeline import Span, StepTimeline
+
+
+@pytest.fixture(autouse=True)
+def fresh_telemetry():
+    """Every test sees a clean registry, disarmed ledger, and disabled
+    global timeline."""
+    telemetry.reset()
+    yield
+    telemetry.reset()
+
+
+class FakeClock:
+    def __init__(self, t: float = 100.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _span(name, dur, *, category="phase", args=None, step=0):
+    return Span(name, 0.0, float(dur), step, category, args)
+
+
+def _ledger(clock, **kw):
+    kw.setdefault("publish_every", 0)
+    return GoodputLedger(clock=clock, **kw)
+
+
+# ---------------------------------------------------------------------------
+# Attribution identity + span routing
+# ---------------------------------------------------------------------------
+
+
+class TestAttribution:
+    def test_identity_sums_to_wall(self):
+        """The pinned identity: attributed + unattributed == wall, with
+        every feed path exercised at once."""
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("compile", 0.5, category="compile"))
+        led.observe_span(_span("step", 2.0, category="train_step"))
+        led.observe_span(_span("data_wait", 0.3, category="data"))
+        led.observe_span(_span("checkpoint", 0.4,
+                               args={"kind": "save"}))
+        led.observe_span(_span("checkpoint", 0.2,
+                               args={"kind": "restore"}))
+        led.note_rollback(1.0, restore_seconds=0.2)
+        led.note_drain(0.7, save_seconds=0.4)
+        led.note_straggler_wait(0.15)
+        clk.advance(10.0)
+        s = led.summary()
+        attributed = sum(s["seconds"][c] for c in CAUSES)
+        assert s["attributed_seconds"] == pytest.approx(attributed)
+        assert (attributed + s["unattributed_seconds"]
+                == pytest.approx(s["wall_seconds"]))
+        assert s["overlap_seconds"] == 0.0
+        assert s["seconds"]["unattributed"] == s["unattributed_seconds"]
+        # each feed landed in its own bucket
+        assert s["seconds"]["compile"] == pytest.approx(0.5)
+        assert s["seconds"]["productive"] == pytest.approx(1.5)  # net
+        assert s["seconds"]["data_wait"] == pytest.approx(0.3)
+        assert s["seconds"]["checkpoint_save"] == pytest.approx(0.4)
+        assert s["seconds"]["checkpoint_restore"] == pytest.approx(0.2)
+        assert s["seconds"]["rollback"] == pytest.approx(0.8)  # net
+        assert s["seconds"]["drain_shutdown"] == pytest.approx(0.3)  # net
+        assert s["seconds"]["straggler_wait"] == pytest.approx(0.15)
+        assert s["goodput_fraction"] == pytest.approx(1.5 / 10.0)
+
+    def test_overlap_surfaced_not_hidden(self):
+        """Buckets past wall (async saves) surface as overlap_seconds;
+        unattributed clamps at zero rather than going negative."""
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("checkpoint", 5.0, args={"kind": "save"}))
+        clk.advance(1.0)
+        s = led.summary()
+        assert s["unattributed_seconds"] == 0.0
+        assert s["overlap_seconds"] == pytest.approx(4.0)
+
+    def test_compile_nets_out_of_next_step_only(self):
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("compile", 1.5, category="compile"))
+        led.observe_span(_span("step", 2.0))
+        led.observe_span(_span("step", 2.0))
+        s = led.summary()
+        assert s["seconds"]["compile"] == pytest.approx(1.5)
+        assert s["seconds"]["productive"] == pytest.approx(0.5 + 2.0)
+
+    def test_checkpoint_kind_defaults_to_save(self):
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("checkpoint", 0.25))
+        assert led.summary()["seconds"]["checkpoint_save"] == (
+            pytest.approx(0.25))
+
+    def test_pipeline_stages_ride_outside_identity(self):
+        """Per-stage spans overlap the step wall — they show up as a
+        diagnostic, never in the identity buckets."""
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("pipeline:stage0", 0.5,
+                               category="pipeline"))
+        led.observe_span(_span("pipeline:stage0", 0.5,
+                               category="pipeline"))
+        clk.advance(2.0)
+        s = led.summary()
+        assert s["stages"] == {"pipeline:stage0": pytest.approx(1.0)}
+        assert sum(s["seconds"][c] for c in CAUSES) == 0.0
+
+    def test_unknown_spans_stay_unattributed(self):
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("h2d", 0.5))
+        led.observe_span(_span("host_step", 1.0, category="step"))
+        clk.advance(2.0)
+        s = led.summary()
+        assert sum(s["seconds"][c] for c in CAUSES) == 0.0
+        assert s["unattributed_seconds"] == pytest.approx(2.0)
+
+    def test_span_feed_is_authoritative_over_step_s(self):
+        """Once any timeline "step" span has been seen, observe_step's
+        step_s never credits buckets (no double counting) — but steps
+        and tokens still count."""
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("step", 1.0))
+        led.observe_step(step=0, tokens=128, step_s=9.0)
+        s = led.summary()
+        assert s["seconds"]["productive"] == pytest.approx(1.0)
+        assert s["steps"] == 1
+        assert s["tokens_trained_total"] == 128.0
+
+    def test_step_s_feeds_buckets_without_spans(self):
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_step(step=0, tokens=64, step_s=0.5)
+        led.observe_step(step=1, tokens=64, step_s=0.5)
+        s = led.summary()
+        assert s["seconds"]["productive"] == pytest.approx(1.0)
+        assert s["median_step_s"] == pytest.approx(0.5)
+
+    def test_live_span_observer_wiring(self):
+        """enable() installs the observer on the timeline module so
+        every recorded span — global timeline included — reaches the
+        ledger; disable() removes it."""
+        led = goodput.enable(publish_every=0)
+        assert ttimeline._SPAN_OBSERVER is not None
+        ttimeline.record_global_span("data_wait", 0.0, 0.25,
+                                     category="data")
+        assert led.summary()["seconds"]["data_wait"] == (
+            pytest.approx(0.25))
+        goodput.disable()
+        assert ttimeline._SPAN_OBSERVER is None
+
+
+# ---------------------------------------------------------------------------
+# Restart survival: pack / absorb continuity
+# ---------------------------------------------------------------------------
+
+
+class TestRestartContinuity:
+    def _run(self, led, clk, n, dur=0.5, start=0):
+        for i in range(start, start + n):
+            led.observe_span(_span("step", dur, step=i))
+            led.observe_step(step=i, loss=1.0, tokens=100,
+                             step_s=dur)
+            clk.advance(dur)
+
+    def test_kill_and_resume_carries_cumulative_state(self):
+        """A resumed ledger is cumulative across the restart: seconds,
+        wall, tokens, steps carry; restarts increments; the replayed
+        range re-attributes to rework."""
+        clk_a = FakeClock()
+        a = _ledger(clk_a)
+        self._run(a, clk_a, 10)           # steps 0..9, high water 9
+        packed = a.pack(step=9)
+        assert packed["step_high_water"] == 9
+        assert packed["restarts"] == 0
+
+        clk_b = FakeClock(5000.0)
+        b = _ledger(clk_b)
+        # checkpoint was at step 4 → steps 5..9 replay as rework
+        b.absorb(packed, restored_step=4)
+        self._run(b, clk_b, 5, start=5)   # the replay
+        self._run(b, clk_b, 3, start=10)  # fresh ground
+        s = b.summary()
+        assert s["restarts"] == 1
+        assert s["rework_steps"] == 5
+        assert s["replay_remaining"] == 0
+        assert s["seconds"]["rework"] == pytest.approx(5 * 0.5)
+        # prior productive (10 steps) + fresh (3 steps)
+        assert s["seconds"]["productive"] == pytest.approx(13 * 0.5)
+        assert s["steps"] == 18           # 10 prior + 8 this life
+        assert s["tokens_trained_total"] == pytest.approx(1800.0)
+        # wall is cumulative: prior incarnation's + this one's
+        assert s["wall_seconds"] == pytest.approx(
+            packed["wall_seconds"] + 8 * 0.5)
+        # the identity still holds on the merged ledger
+        attributed = sum(s["seconds"][c] for c in CAUSES)
+        assert (attributed + s["unattributed_seconds"]
+                == pytest.approx(max(s["wall_seconds"], attributed)))
+
+    def test_same_incarnation_absorb_is_replay_bookkeeping_only(self):
+        """An in-process rollback restores its own checkpoint: the
+        live state must not double-count, only the rework window
+        arms."""
+        clk = FakeClock()
+        led = _ledger(clk)
+        self._run(led, clk, 6)
+        packed = led.pack(step=5)
+        led.absorb(packed, restored_step=2)
+        s = led.summary()
+        assert s["restarts"] == 0
+        assert s["steps"] == 6            # not 12
+        assert s["replay_remaining"] == 3
+        self._run(led, clk, 3, start=3)
+        assert led.summary()["rework_steps"] == 3
+
+    def test_double_absorb_guard(self):
+        clk_a = FakeClock()
+        a = _ledger(clk_a)
+        self._run(a, clk_a, 4)
+        packed = a.pack(step=3)
+        b = _ledger(FakeClock())
+        b.absorb(packed, restored_step=3)
+        b.absorb(packed, restored_step=3)
+        s = b.summary()
+        assert s["restarts"] == 1
+        assert s["steps"] == 4            # absorbed once, not twice
+
+    def test_restart_chain_counts_every_kill(self):
+        a = _ledger(FakeClock())
+        b = _ledger(FakeClock())
+        b.absorb(a.pack(step=0))
+        c = _ledger(FakeClock())
+        c.absorb(b.pack(step=0))
+        assert c.summary()["restarts"] == 2
+
+    def test_anomaly_episodes_carry_across_restart(self):
+        a = _ledger(FakeClock())
+        a.series.episodes["loss_spike"] = 2
+        b = _ledger(FakeClock())
+        b.absorb(a.pack(step=0))
+        assert b.series.episodes["loss_spike"] == 2
+
+    def test_merge_into_extra_and_note_restored_roundtrip(self):
+        """The module-level checkpoint hooks: disarmed is identity,
+        armed folds the pack in (never clobbering a caller's key), and
+        note_restored absorbs it back."""
+        extra = {"mine": 1}
+        assert goodput.merge_into_extra(extra, step=5) is extra
+
+        led = goodput.enable(publish_every=0)
+        out = goodput.merge_into_extra(None, step=5)
+        assert out["goodput"]["incarnation"] == led.incarnation
+        out2 = goodput.merge_into_extra({"mine": 1}, step=5)
+        assert out2["mine"] == 1 and "goodput" in out2
+        taken = {"goodput": "caller-owned"}
+        assert goodput.merge_into_extra(taken) is taken
+
+        pack = dict(out["goodput"])
+        pack["incarnation"] = "prior-process"
+        pack["steps"] = 7
+        goodput.note_restored({"goodput": pack}, restored_step=5)
+        s = led.summary()
+        assert s["restarts"] == 1 and s["steps"] == 7
+        # disarmed / malformed never raise
+        goodput.disable()
+        goodput.note_restored({"goodput": pack}, restored_step=5)
+        goodput.note_restored(None)
+
+
+# ---------------------------------------------------------------------------
+# Anomaly plane: StepSeries latches + ledger gauge/event surface
+# ---------------------------------------------------------------------------
+
+
+def _warm(series, n=24, base=1.0):
+    for i in range(n):
+        # deterministic non-flat noise so the IQR scale is positive
+        series.push(step=i, loss=base + 0.01 * ((i * 7) % 13),
+                    tokens_per_s=1000.0)
+
+
+class TestStepSeries:
+    def test_loss_spike_latches_once_then_rearms(self):
+        sr = StepSeries(min_samples=16, window=32, loss_z=6.0)
+        _warm(sr)
+        fired = sr.push(step=24, loss=50.0)
+        assert [(k, p) for k, p, _ in fired] == [("loss_spike", "latch")]
+        assert sr.episodes["loss_spike"] == 1
+        # still high: latched, no re-fire
+        assert sr.push(step=25, loss=50.0) == []
+        assert sr.episodes["loss_spike"] == 1
+        # recovery re-arms
+        fired = sr.push(step=26, loss=1.0)
+        assert [(k, p) for k, p, _ in fired] == [("loss_spike",
+                                                  "recover")]
+        assert not sr.active["loss_spike"]
+        # window still carries the two 50.0 outliers, so re-warm until
+        # they age out before the second episode
+        _warm(sr, n=40)
+        fired = sr.push(step=99, loss=50.0)
+        assert [(k, p) for k, p, _ in fired] == [("loss_spike", "latch")]
+        assert sr.episodes["loss_spike"] == 2
+
+    def test_needs_min_samples_before_scoring(self):
+        sr = StepSeries(min_samples=16, window=32, loss_z=6.0)
+        for i in range(15):
+            assert sr.push(step=i, loss=1000.0 * i) == []
+
+    def test_flat_window_spikes_up_never_down(self):
+        sr = StepSeries(min_samples=8, window=16, loss_z=6.0)
+        for i in range(10):
+            sr.push(step=i, loss=2.0)
+        assert sr.push(step=10, loss=0.5) == []     # downward: never
+        fired = sr.push(step=11, loss=2.1)
+        assert [(k, p) for k, p, _ in fired] == [("loss_spike", "latch")]
+
+    def test_throughput_regression_needs_sustain(self):
+        sr = StepSeries(min_samples=8, throughput_drop=0.3, sustain=3,
+                        fast_alpha=0.9, slow_alpha=0.0)
+        for i in range(10):
+            sr.push(step=i, tokens_per_s=1000.0)
+        fired = []
+        for i in range(10, 16):
+            fired += sr.push(step=i, tokens_per_s=100.0)
+            if i < 12:
+                assert sr.episodes["throughput_regression"] == 0
+        assert sr.episodes["throughput_regression"] == 1
+        assert [f for f in fired if f[1] == "latch"][0][0] == (
+            "throughput_regression")
+        # recovery re-arms
+        rec = []
+        for i in range(16, 22):
+            rec += sr.push(step=i, tokens_per_s=1000.0)
+        assert ("throughput_regression", "recover") in [
+            (k, p) for k, p, _ in rec]
+
+    def test_window_is_flight_bundle_sized(self):
+        sr = StepSeries(capacity=64)
+        for i in range(100):
+            sr.push(step=i, loss=1.0)
+        w = sr.window(32)
+        assert len(w) == 32 and w[-1]["step"] == 99
+        assert sr.summary()["samples"] == 64
+
+    def test_nonfinite_samples_never_poison_the_window(self):
+        sr = StepSeries(min_samples=4, window=8)
+        for i in range(6):
+            sr.push(step=i, loss=1.0 + 0.1 * i)
+        sr.push(step=6, loss=float("nan"))
+        sr.push(step=7, loss=float("inf"))
+        assert all(s["loss"] is not None or s["step"] >= 6
+                   for s in sr.window(8))
+        # scoring continues on the finite prior window
+        fired = sr.push(step=8, loss=500.0)
+        assert [(k, p) for k, p, _ in fired] == [("loss_spike", "latch")]
+
+
+class TestLedgerAnomalySurface:
+    def test_latch_flips_gauge_and_emits_event_and_recovers(self):
+        reg = tmetrics.registry()
+        led = goodput.enable(publish_every=0, min_samples=8, window=16,
+                             loss_z=6.0)
+        for i in range(12):
+            led.observe_step(step=i, loss=1.0 + 0.01 * ((i * 7) % 13))
+        led.observe_step(step=12, loss=80.0)
+        g = reg.gauge("goodput_anomaly_active")
+        assert g.value(kind="loss_spike") == 1.0
+        assert reg.counter("telemetry_events").value(
+            event="loss_spike") == 1.0
+        led.observe_step(step=13, loss=1.0)
+        assert g.value(kind="loss_spike") == 0.0
+        assert reg.counter("telemetry_events").value(
+            event="loss_spike_recovered") == 1.0
+        assert led.summary()["anomalies"]["episodes"]["loss_spike"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Publish surface: gauges + info blob + windowed MFU
+# ---------------------------------------------------------------------------
+
+
+class TestPublish:
+    def test_publish_mirrors_summary_into_registry(self):
+        reg = tmetrics.registry()
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("step", 1.0))
+        led.observe_step(step=0, tokens=512)
+        clk.advance(2.0)
+        summ = led.publish(reg)
+        g = reg.gauge("goodput_seconds")
+        assert g.value(cause="productive") == pytest.approx(1.0)
+        assert g.value(cause="unattributed") == pytest.approx(1.0)
+        assert reg.gauge("goodput_fraction").value() == pytest.approx(0.5)
+        assert reg.gauge("tokens_trained_total").value() == 512.0
+        assert reg.gauge("effective_tokens_per_sec").value() == (
+            pytest.approx(256.0))
+        assert reg.get_info("goodput")["wall_seconds"] == (
+            summ["wall_seconds"])
+
+    def test_publish_every_cadence(self):
+        reg = tmetrics.registry()
+        led = goodput.enable(publish_every=5)
+        for i in range(4):
+            led.observe_step(step=i, step_s=0.1)
+        assert reg.get_info("goodput") is None
+        led.observe_step(step=4, step_s=0.1)
+        assert reg.get_info("goodput")["steps"] == 5
+
+    def test_publish_folds_mfu_from_step_cost(self):
+        """When a step cost was published, publish() refreshes the
+        mfu_ewma window from the productive-step median."""
+        reg = tmetrics.registry()
+        reg.gauge("step_flops", "").set(275e12)
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("step", 1.0))
+        summ = led.publish(reg)
+        # chip kind resolution is host-dependent; the contract is the
+        # key exists and, on CPU hosts, the null reason is published
+        assert "mfu_ewma" in summ
+
+
+class TestMfuWindow:
+    def test_seeds_then_folds_ewma(self):
+        reg = tmetrics.registry()
+        est = tcost.publish_mfu_window({"flops": 275e12}, 1.0,
+                                       kind="v4", registry=reg)
+        assert est["mfu"] == pytest.approx(1.0)
+        assert reg.gauge("mfu_ewma").value() == pytest.approx(1.0)
+        est = tcost.publish_mfu_window({"flops": 137.5e12}, 1.0,
+                                       kind="v4", alpha=0.2,
+                                       registry=reg)
+        assert est["mfu_ewma"] == pytest.approx(0.9)
+        assert reg.gauge("mfu_ewma").value() == pytest.approx(0.9)
+
+    def test_null_estimate_leaves_gauge_and_names_reason(self):
+        reg = tmetrics.registry()
+        est = tcost.publish_mfu_window(None, 1.0, kind="v4",
+                                       registry=reg)
+        assert est["mfu_ewma"] is None
+        assert "cost model" in reg.get_info("mfu_reason")
+        assert reg.gauge("mfu_ewma").value() == 0.0  # untouched default
+        est = tcost.publish_mfu_window({"flops": 1e12}, 0.0, kind="v4",
+                                       registry=reg)
+        assert est["mfu"] is None
+        assert "non-positive" in reg.get_info("mfu_reason")
+
+
+# ---------------------------------------------------------------------------
+# Fleet merge goldens
+# ---------------------------------------------------------------------------
+
+
+def _host_summary(fraction, wall, *, straggler=0.0, tokens=0.0,
+                  restarts=0):
+    seconds = {c: 0.0 for c in CAUSES}
+    seconds["productive"] = round(fraction * wall, 6)
+    seconds["straggler_wait"] = straggler
+    return {"enabled": True, "goodput_fraction": fraction,
+            "wall_seconds": wall, "seconds": seconds,
+            "tokens_trained_total": tokens, "restarts": restarts}
+
+
+class TestFleetMerge:
+    def test_merge_goodput_golden(self):
+        snaps = [
+            {"registry": {}, "goodput": _host_summary(
+                0.8, 100.0, straggler=2.0, tokens=1000.0, restarts=1)},
+            {"registry": {}, "goodput": _host_summary(
+                0.6, 100.0, straggler=5.0, tokens=500.0)},
+            {"registry": {}},                        # disarmed host
+            {"registry": {}, "goodput": {"enabled": False}},
+        ]
+        merged = tfleet.merge_snapshots(snaps)
+        gp = merged["goodput"]
+        assert gp["n_hosts"] == 2                    # disarmed drop out
+        assert set(gp["per_host"]) == {"0", "1"}
+        assert gp["per_host"]["0"] == {
+            "goodput_fraction": 0.8, "wall_seconds": 100.0,
+            "straggler_wait_seconds": 2.0, "restarts": 1}
+        assert gp["fraction_min"] == 0.6
+        assert gp["fraction_max"] == 0.8
+        assert gp["fraction_mean"] == pytest.approx(0.7)
+        assert gp["seconds_total"]["productive"] == pytest.approx(140.0)
+        assert gp["straggler_wait_seconds_total"] == pytest.approx(7.0)
+        assert gp["tokens_trained_total"] == pytest.approx(1500.0)
+
+    def test_no_goodput_key_when_fleet_disarmed(self):
+        merged = tfleet.merge_snapshots([{"registry": {}},
+                                         {"registry": {}}])
+        assert "goodput" not in merged
+
+
+# ---------------------------------------------------------------------------
+# Timeline ring wraparound (satellite regression)
+# ---------------------------------------------------------------------------
+
+
+class TestTimelineWraparound:
+    def test_dropped_seconds_and_counter_delta(self):
+        """Ring eviction is accounted, not silent: dropped_seconds
+        totals the evicted durations, summary surfaces them, and
+        publish() bumps the counter by the delta exactly once."""
+        reg = tmetrics.registry()
+        tl = StepTimeline(capacity=4, enabled=True)
+        for i in range(10):
+            tl.record_span(f"p{i}", 0.0, 1.0)
+        assert tl.dropped_seconds == pytest.approx(6.0)
+        s = tl.summary()
+        assert s["dropped_spans"] == 6
+        assert s["dropped_span_seconds"] == pytest.approx(6.0)
+        tl.publish(reg)
+        c = reg.counter("timeline_dropped_spans_total")
+        assert c.value() == 6.0
+        tl.publish(reg)                   # no new evictions: no delta
+        assert c.value() == 6.0
+        for i in range(2):
+            tl.record_span("q", 0.0, 0.5)
+        tl.publish(reg)
+        assert c.value() == 8.0
+        # the evicted spans (dur 1.0 each) are what is totaled, not
+        # the newly recorded ones
+        assert tl.dropped_seconds == pytest.approx(8.0)
+
+    def test_under_capacity_drops_nothing(self):
+        tl = StepTimeline(capacity=8, enabled=True)
+        for i in range(8):
+            tl.record_span("p", 0.0, 1.0)
+        assert tl.dropped_seconds == 0.0
+        assert tl.summary()["dropped_spans"] == 0
+
+    def test_ledger_surfaces_global_timeline_drops(self):
+        ttimeline.enable(capacity=2)
+        led = goodput.enable(publish_every=0)
+        for i in range(5):
+            ttimeline.record_global_span("h2d", 0.0, 0.25)
+        assert led.summary()["timeline_dropped_span_seconds"] == (
+            pytest.approx(0.75))
+
+    def test_reset_clears_drop_accounting(self):
+        tl = StepTimeline(capacity=2, enabled=True)
+        for i in range(5):
+            tl.record_span("p", 0.0, 1.0)
+        tl.reset()
+        assert tl.dropped_seconds == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Faults grammar: stall clauses (satellite)
+# ---------------------------------------------------------------------------
+
+
+class TestFaultStalls:
+    def test_grammar_parses_stall_clauses(self):
+        from apex_tpu.resilience.faults import FaultInjector
+
+        inj = FaultInjector.from_env("data_stall_ms=7;ckpt_stall_ms=2.5")
+        assert inj.data_stall_ms == 7.0
+        assert inj.ckpt_stall_ms == 2.5
+        assert inj.data_stall_s() == pytest.approx(0.007)
+        assert inj.ckpt_stall_s() == pytest.approx(0.0025)
+
+    def test_negative_stall_clamps_to_zero(self):
+        from apex_tpu.resilience.faults import FaultInjector
+
+        inj = FaultInjector(data_stall_ms=-5.0, ckpt_stall_ms=-1.0)
+        assert inj.data_stall_s() == 0.0
+        assert inj.ckpt_stall_s() == 0.0
+
+    def test_module_helpers_default_to_zero_without_injector(self):
+        from apex_tpu.resilience import faults
+
+        if faults.active() is None:
+            assert faults.data_stall_s() == 0.0
+            assert faults.ckpt_stall_s() == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Disarmed contract + report CLI
+# ---------------------------------------------------------------------------
+
+
+class TestDisarmed:
+    def test_section_reports_reason(self):
+        sec = goodput.section()
+        assert sec["enabled"] is False
+        assert "not armed" in sec["goodput_reason"]
+
+    def test_module_feeds_are_noops(self):
+        goodput.observe_step(step=0, loss=1.0, tokens=10, step_s=0.1)
+        goodput.note_rollback(1.0)
+        goodput.note_drain(1.0)
+        goodput.note_straggler_wait(1.0)
+        assert goodput.get_ledger() is None
+        assert goodput.enabled() is False
+
+    def test_snapshot_detail_carries_reason(self):
+        snap = telemetry.snapshot_detail()
+        assert snap["goodput"] is None
+        assert "not armed" in snap["goodput_reason"]
+
+    def test_snapshot_detail_carries_summary_when_armed(self):
+        goodput.enable(publish_every=0)
+        snap = telemetry.snapshot_detail()
+        assert snap["goodput"]["enabled"] is True
+        assert set(CAUSES) <= set(snap["goodput"]["seconds"])
+
+
+def _load_report_tool():
+    path = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "tools", "goodput_report.py")
+    spec = importlib.util.spec_from_file_location("goodput_report", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestReportTool:
+    def test_normalize_rederives_from_pack(self):
+        """A manifest pack has no derived fields; the report re-derives
+        fraction / unattributed / effective tok/s from the raw
+        buckets."""
+        rpt = _load_report_tool()
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("step", 2.0))
+        led.observe_step(step=0, tokens=1000)
+        clk.advance(4.0)
+        summ = rpt.normalize(led.pack(step=0))
+        assert summ["goodput_fraction"] == pytest.approx(0.5)
+        assert summ["unattributed_seconds"] == pytest.approx(2.0)
+        assert summ["effective_tokens_per_sec"] == pytest.approx(250.0)
+        with pytest.raises(ValueError):
+            rpt.normalize({"not": "a pack"})
+
+    def test_extract_finds_nested_payloads(self):
+        rpt = _load_report_tool()
+        led = _ledger(FakeClock())
+        pack = led.pack(step=0)
+        for wrap in (pack,
+                     {"goodput": pack},
+                     {"extra": {"goodput": pack}},
+                     {"payload": {"telemetry": {"goodput": pack}}}):
+            got = rpt.extract(wrap)
+            assert got["incarnation"] == led.incarnation
+        assert rpt.extract({"unrelated": 1}) is None
+        assert rpt.extract("not a dict") is None
+
+    def test_render_shows_restarts_and_table(self):
+        rpt = _load_report_tool()
+        clk = FakeClock()
+        led = _ledger(clk)
+        led.observe_span(_span("step", 1.0))
+        clk.advance(2.0)
+        led.absorb({"incarnation": "prior", "restarts": 0,
+                    "seconds": {}, "wall_seconds": 1.0})
+        text = rpt.render(rpt.normalize(led.pack(step=0)))
+        assert "== goodput report ==" in text
+        assert "restarts    1" in text
+        for cause in (*CAUSES, "unattributed"):
+            assert cause in text

@@ -138,6 +138,12 @@ SURFACE = {
         "MoEImbalanceDetector", "publish_moe_step", "fleet_expert_load",
         "get_detector", "reset",
     ],
+    "apex_tpu.telemetry.goodput": [
+        # PR-20: the run ledger (docs/observability.md "Run ledger")
+        "CAUSES", "GoodputLedger", "StepSeries", "enable", "disable",
+        "get_ledger", "section", "observe_step", "merge_into_extra",
+        "note_restored",
+    ],
     "apex_tpu.models.gpt": ["GPTConfig", "GPTModel", "gpt_loss_fn"],
     "apex_tpu.models.bert": None,     # module presence only
     "apex_tpu.models.t5": None,

@@ -63,6 +63,16 @@ folded out of the ``moe_expert_load{expert=}`` gauges — and
 Prometheus output adds one MoE summary comment line (aux loss,
 dropped tokens, imbalance EWMA, hottest expert). A snapshot from a
 dense run reports ``moe_reason``.
+
+And the GOODPUT plane (docs/observability.md "Run ledger & goodput"):
+JSON output appends a ``goodput`` section — the
+``goodput_seconds{cause=}`` attribution gauges, the fraction /
+token-rate / ``mfu_ewma`` gauges, and the full ``info["goodput"]``
+summary blob the ledger publishes (buckets, unattributed residual,
+rework, restarts, anomaly episodes) — and Prometheus output adds one
+goodput summary comment line. A snapshot whose ledger never armed
+reports the explicit ``goodput_reason``; see
+``tools/goodput_report.py`` for the human attribution table.
 """
 
 import argparse
@@ -288,6 +298,31 @@ def moe_section(snap):
     return out
 
 
+_GOODPUT_PREFIXES = ("goodput_", "tokens_trained", "effective_tokens",
+                     "mfu_ewma")
+
+
+def goodput_section(snap):
+    """The run-ledger plane of a registry snapshot
+    (docs/observability.md "Run ledger & goodput"): the
+    ``goodput_seconds{cause=}`` attribution gauges next to the
+    ``goodput_fraction`` / ``tokens_trained_total`` /
+    ``effective_tokens_per_sec`` / ``mfu_ewma`` gauges, plus the full
+    ``info["goodput"]`` summary blob the ledger publishes (buckets,
+    unattributed residual, rework, restarts, anomaly episodes).
+    Null-with-``goodput_reason`` when the ledger never armed in the
+    process that wrote the snapshot."""
+    out = _plane(snap, lambda base: base.startswith(_GOODPUT_PREFIXES))
+    blob = (snap.get("info") or {}).get("goodput")
+    if blob is not None:
+        out["goodput"] = blob
+    if not out.get("gauges") and blob is None:
+        out["goodput_reason"] = (
+            "goodput ledger not armed in this snapshot "
+            "(telemetry.goodput.enable)")
+    return out
+
+
 def plane_comments(snap) -> str:
     """One summary comment line per plane, appended to the Prometheus
     text (comments are legal exposition; the series themselves render
@@ -377,6 +412,22 @@ def plane_comments(snap) -> str:
             f"dropped={g.get('moe_dropped_tokens')} "
             f"imbalance_ewma={g.get('moe_imbalance_ratio')} "
             f"hot_expert={hot} experts={len(load)}")
+    gp = goodput_section(snap)
+    if "goodput_reason" in gp:
+        lines.append(f"# goodput: none ({gp['goodput_reason']})")
+    else:
+        blob = gp.get("goodput") or {}
+        gauges = gp.get("gauges") or {}
+        secs = blob.get("seconds") or {}
+        frac = blob.get("goodput_fraction",
+                        gauges.get("goodput_fraction"))
+        lines.append(
+            f"# goodput: fraction={frac} "
+            f"productive={secs.get('productive')}s "
+            f"unattributed={blob.get('unattributed_seconds')}s "
+            f"restarts={blob.get('restarts')} "
+            f"rework_steps={blob.get('rework_steps')} "
+            f"eff_tok_per_s={blob.get('effective_tokens_per_sec')}")
     return "\n".join(lines) + "\n"
 
 
@@ -392,6 +443,7 @@ def _emit(snap, fmt, help_source=None) -> None:
         out["mesh"] = mesh_section(snap)
         out["pipeline"] = pipeline_section(snap)
         out["moe"] = moe_section(snap)
+        out["goodput"] = goodput_section(snap)
         print(json.dumps(out, indent=1, sort_keys=True))
         return
     if help_source is not None:
